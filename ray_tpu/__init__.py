@@ -1,0 +1,42 @@
+"""ray_tpu: a TPU-native distributed computing framework.
+
+A ground-up re-design of Ray's capabilities (tasks, actors, a distributed
+shared-memory object store with ownership and lineage, per-node scheduling
+with cluster spillback, placement groups, and the ML libraries: Data,
+Train, Tune, Serve, an LLM engine and an RL learner stack) for TPU
+hardware: JAX/XLA/Pallas for all device compute, `jax.sharding` meshes +
+collectives over ICI/DCN instead of NCCL, and a native C++ shared-memory
+object store. See SURVEY.md at the repo root for the reference analysis
+this build follows.
+"""
+
+from .core.api import (
+    ActorClass,
+    ActorHandle,
+    available_resources,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    method,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from .core.object_ref import ObjectRef
+from .core import status as exceptions
+from .core.status import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTaskError,
+    RayTpuError,
+    TaskCancelledError,
+    WorkerCrashedError,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,318 @@
+"""Public API: init/shutdown, @remote, get/put/wait, actors.
+
+Equivalent of the reference's ``python/ray/_private/worker.py`` (init:1285,
+get:2642, put:2810, wait:2875, remote:3263), ``remote_function.py`` and
+``actor.py`` (ActorClass:605, ActorHandle:1273).
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import threading
+import time
+from typing import Any, Callable, Sequence
+
+from .ids import ActorID
+from .object_ref import ObjectRef
+from .status import RayTpuError
+from .worker import CoreWorker, global_worker, set_global_worker
+
+_init_lock = threading.Lock()
+_node = None
+
+
+def init(
+    address: str | None = None,
+    *,
+    num_cpus: float | None = None,
+    resources: dict | None = None,
+    labels: dict | None = None,
+    object_store_memory: int | None = None,
+    ignore_reinit_error: bool = False,
+    _system_config: dict | None = None,
+) -> dict:
+    """Start (or connect to) a cluster. Reference: worker.py:1285."""
+    global _node
+    with _init_lock:
+        if is_initialized():
+            if ignore_reinit_error:
+                return {"address": _node.gcs_address if _node else address}
+            raise RayTpuError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
+        if _system_config:
+            from .config import get_config
+
+            get_config().apply_dict(_system_config)
+        from .node import Node
+
+        if address is None:
+            _node = Node(
+                head=True,
+                num_cpus=num_cpus,
+                resources=resources,
+                labels=labels,
+                object_store_memory=object_store_memory,
+            )
+        else:
+            # Connect to an existing cluster: start a local raylet joined to
+            # the remote GCS (simplest driver attachment for the harness).
+            _node = Node(
+                head=False,
+                gcs_address=address,
+                num_cpus=num_cpus if num_cpus is not None else 0,
+                resources=resources,
+                labels=labels,
+                object_store_memory=object_store_memory,
+            )
+        _node.connect_driver()
+        return {"address": _node.gcs_address, "node_id": _node.raylet.node_id.hex()}
+
+
+def is_initialized() -> bool:
+    try:
+        global_worker()
+        return True
+    except RayTpuError:
+        return False
+
+
+def shutdown() -> None:
+    global _node
+    with _init_lock:
+        try:
+            worker = global_worker()
+            worker.shutdown()
+        except RayTpuError:
+            pass
+        set_global_worker(None)
+        if _node is not None:
+            _node.shutdown()
+            _node = None
+
+
+def put(value: Any) -> ObjectRef:
+    return global_worker().put(value)
+
+
+def get(refs, timeout: float | None = None):
+    if isinstance(refs, ObjectRef):
+        return global_worker().get([refs], timeout)[0]
+    return global_worker().get(list(refs), timeout)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1, timeout: float | None = None):
+    return global_worker().wait(list(refs), num_returns, timeout)
+
+
+def kill(actor: "ActorHandle") -> None:
+    global_worker().kill_actor(actor._actor_id)
+
+
+def get_actor(name: str) -> "ActorHandle":
+    found = global_worker().get_actor_by_name(name)
+    if found is None:
+        raise ValueError(f"No actor named '{name}'")
+    actor_id, _info = found
+    return ActorHandle(actor_id)
+
+
+def cluster_resources() -> dict:
+    worker = global_worker()
+    reply = worker._gcs_call("GetAllNodes", {})
+    total: dict[str, float] = {}
+    for node in reply["nodes"]:
+        if node["state"] != "ALIVE":
+            continue
+        for k, v in node["resources"]["total"].items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def available_resources() -> dict:
+    worker = global_worker()
+    reply = worker._gcs_call("GetAllNodes", {})
+    total: dict[str, float] = {}
+    for node in reply["nodes"]:
+        if node["state"] != "ALIVE":
+            continue
+        for k, v in node["resources"]["available"].items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def nodes() -> list:
+    return global_worker()._gcs_call("GetAllNodes", {})["nodes"]
+
+
+# ----------------------------------------------------------------- @remote
+_ABSENT = object()
+
+
+class RemoteFunction:
+    """Reference: remote_function.py (_remote:303)."""
+
+    def __init__(self, fn: Callable, **options):
+        self._fn = fn
+        self._options = options
+        functools.update_wrapper(self, fn)
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._options)
+
+    def options(self, **new_options) -> "RemoteFunction":
+        merged = {**self._options, **new_options}
+        return RemoteFunction(self._fn, **merged)
+
+    def _remote(self, args, kwargs, opts):
+        worker = global_worker()
+        resources = dict(opts.get("resources") or {})
+        if opts.get("num_cpus") is not None:
+            resources["CPU"] = opts["num_cpus"]
+        if opts.get("num_tpus") is not None:
+            resources["TPU"] = opts["num_tpus"]
+        strategy = _strategy_to_wire(opts.get("scheduling_strategy"))
+        pg_id, bundle = _placement_opts(opts)
+        num_returns = opts.get("num_returns", 1)
+        refs = worker.submit_task(
+            self._fn,
+            args,
+            kwargs,
+            name=opts.get("name") or self._fn.__name__,
+            num_returns=num_returns,
+            resources=resources,
+            max_retries=opts.get("max_retries"),
+            scheduling_strategy=strategy,
+            placement_group_id=pg_id,
+            placement_group_bundle_index=bundle,
+        )
+        return refs[0] if num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self._fn.__name__}' cannot be called directly; "
+            f"use .remote()."
+        )
+
+
+class ActorMethod:
+    """Reference: actor.py:116."""
+
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        refs = global_worker().submit_actor_task(
+            self._handle._actor_id, self._name, args, kwargs, num_returns=self._num_returns
+        )
+        return refs[0] if self._num_returns == 1 else refs
+
+    def options(self, num_returns: int = 1) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns)
+
+
+class ActorHandle:
+    """Reference: actor.py:1273. Pickles to the actor id; any process with
+    the handle can call methods (per-caller sequencing actor-side). The
+    owning process kills a non-detached, unnamed actor when its last local
+    handle is garbage-collected."""
+
+    def __init__(self, actor_id: bytes, _owned: bool = False):
+        object.__setattr__(self, "_actor_id", actor_id)
+        object.__setattr__(self, "_registered", False)
+        try:
+            global_worker().register_actor_handle(actor_id, _owned)
+            object.__setattr__(self, "_registered", True)
+        except RayTpuError:
+            pass
+
+    def __getattr__(self, item: str) -> ActorMethod:
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return ActorMethod(self, item)
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id,))
+
+    def __del__(self):
+        if getattr(self, "_registered", False):
+            try:
+                global_worker().deregister_actor_handle(self._actor_id)
+            except Exception:
+                pass
+
+    def __repr__(self):
+        return f"ActorHandle({ActorID(self._actor_id).hex()})"
+
+
+class ActorClass:
+    """Reference: actor.py:605."""
+
+    def __init__(self, cls: type, **options):
+        self._cls = cls
+        self._options = options
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        worker = global_worker()
+        opts = self._options
+        resources = dict(opts.get("resources") or {})
+        if opts.get("num_tpus") is not None:
+            resources["TPU"] = opts["num_tpus"]
+        strategy = _strategy_to_wire(opts.get("scheduling_strategy"))
+        pg_id, bundle = _placement_opts(opts)
+        actor_id = worker.create_actor(
+            self._cls,
+            args,
+            kwargs,
+            name=opts.get("name", ""),
+            num_cpus=opts.get("num_cpus"),
+            resources=resources,
+            max_restarts=opts.get("max_restarts", 0),
+            max_concurrency=opts.get("max_concurrency", 1),
+            detached=opts.get("lifetime") == "detached",
+            scheduling_strategy=strategy,
+            placement_group_id=pg_id,
+            placement_group_bundle_index=bundle,
+        )
+        owned = not opts.get("name") and opts.get("lifetime") != "detached"
+        return ActorHandle(actor_id, _owned=owned)
+
+    def options(self, **new_options) -> "ActorClass":
+        return ActorClass(self._cls, **{**self._options, **new_options})
+
+
+def _strategy_to_wire(strategy) -> dict:
+    if strategy is None:
+        return {}
+    if isinstance(strategy, dict):
+        return strategy
+    return strategy.to_wire()
+
+
+def _placement_opts(opts) -> tuple[bytes, int]:
+    strategy = opts.get("scheduling_strategy")
+    if strategy is not None and hasattr(strategy, "placement_group_id"):
+        return strategy.placement_group_id, strategy.placement_group_bundle_index
+    return b"", -1
+
+
+def remote(*args, **options):
+    """``@remote`` / ``@remote(num_cpus=..., ...)`` for functions and classes."""
+
+    def wrap(target):
+        if inspect.isclass(target):
+            return ActorClass(target, **options)
+        return RemoteFunction(target, **options)
+
+    if len(args) == 1 and not options and (callable(args[0]) or inspect.isclass(args[0])):
+        return wrap(args[0])
+    return wrap
+
+
+def method(num_returns: int = 1):
+    def decorator(fn):
+        fn.__ray_num_returns__ = num_returns
+        return fn
+
+    return decorator

@@ -1,0 +1,133 @@
+"""Typed global config table with environment overrides.
+
+Equivalent of the reference's ``RAY_CONFIG`` macro table
+(``src/ray/common/ray_config_def.h``, 223 entries): every knob is a typed
+entry, overridable via a ``RAY_TPU_<name>`` environment variable or an
+explicit dict (the reference passes a JSON blob as ``--raylet_config``).
+Only knobs the TPU build actually consumes are defined; add entries here as
+subsystems grow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, fields
+from typing import Any
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+@dataclass
+class RayTpuConfig:
+    # --- object store / plasma ---------------------------------------------
+    # Max bytes serialized inline into the owner's in-process memory store
+    # instead of plasma (reference: ``max_direct_call_object_size``).
+    max_inline_object_size: int = 100 * 1024
+    # Default plasma capacity as a fraction of system memory.
+    object_store_memory_fraction: float = 0.3
+    object_store_minimum_memory_bytes: int = 64 * 1024 * 1024
+    # Chunk size for inter-node object transfer (reference: 5 MiB chunks,
+    # ``object_manager.h:117``).
+    object_manager_chunk_size: int = 5 * 1024 * 1024
+    # Fraction of plasma that a single create may use before falling back.
+    object_spilling_threshold: float = 0.8
+
+    # --- scheduling ----------------------------------------------------------
+    # Hybrid policy: pack onto nodes below this utilization score, then spread
+    # (reference ``hybrid_scheduling_policy.cc``).
+    scheduler_spread_threshold: float = 0.5
+    # Max tasks dispatched to one worker lease before returning it.
+    worker_lease_timeout_ms: int = 500
+    max_pending_lease_requests_per_scheduling_category: int = 10
+
+    # --- worker pool ---------------------------------------------------------
+    num_prestart_workers: int = 2
+    worker_register_timeout_s: float = 30.0
+    idle_worker_killing_time_threshold_ms: int = 1000
+    maximum_startup_concurrency: int = 4
+
+    # --- fault tolerance -----------------------------------------------------
+    task_max_retries: int = 3
+    actor_max_restarts: int = 0
+    health_check_period_ms: int = 1000
+    health_check_failure_threshold: int = 5
+    lineage_max_bytes: int = 1 << 30
+
+    # --- RPC -----------------------------------------------------------------
+    rpc_connect_timeout_s: float = 10.0
+    rpc_retry_base_delay_ms: int = 100
+    rpc_retry_max_delay_ms: int = 5000
+    rpc_max_retries: int = 5
+    # Fault-injection spec, format "Service.Method=req_prob,resp_prob"
+    # (reference ``rpc_chaos.cc:34``, env RAY_testing_rpc_failure).
+    testing_rpc_failure: str = ""
+
+    # --- GCS -----------------------------------------------------------------
+    gcs_pubsub_poll_timeout_s: float = 30.0
+    gcs_storage_backend: str = "memory"  # "memory" | "file"
+
+    # --- task events / observability ----------------------------------------
+    task_events_buffer_size: int = 10000
+    task_events_flush_interval_ms: int = 1000
+    enable_timeline: bool = True
+
+    # --- TPU -----------------------------------------------------------------
+    # Resource name prefix for slice-head scheduling (reference
+    # ``_private/accelerators/tpu.py:70-192`` auto-creates TPU-{type}-head).
+    tpu_head_resource_prefix: str = "TPU-"
+    tpu_chips_per_host_default: int = 4
+
+    def apply_env_overrides(self) -> None:
+        for f in fields(self):
+            env_key = _ENV_PREFIX + f.name
+            if env_key in os.environ:
+                raw = os.environ[env_key]
+                setattr(self, f.name, _coerce(raw, f.type))
+
+    def apply_dict(self, overrides: dict[str, Any]) -> None:
+        valid = {f.name for f in fields(self)}
+        for key, value in overrides.items():
+            if key not in valid:
+                raise ValueError(f"Unknown config key: {key}")
+            setattr(self, key, value)
+
+    def to_json(self) -> str:
+        return json.dumps({f.name: getattr(self, f.name) for f in fields(self)})
+
+    @classmethod
+    def from_json(cls, blob: str) -> "RayTpuConfig":
+        cfg = cls()
+        cfg.apply_dict(json.loads(blob))
+        return cfg
+
+
+def _coerce(raw: str, type_name: Any) -> Any:
+    name = type_name if isinstance(type_name, str) else getattr(type_name, "__name__", str(type_name))
+    if name == "bool":
+        return raw.lower() in ("1", "true", "yes")
+    if name == "int":
+        return int(raw)
+    if name == "float":
+        return float(raw)
+    return raw
+
+
+_config_lock = threading.Lock()
+_config: RayTpuConfig | None = None
+
+
+def get_config() -> RayTpuConfig:
+    global _config
+    with _config_lock:
+        if _config is None:
+            _config = RayTpuConfig()
+            _config.apply_env_overrides()
+        return _config
+
+
+def reset_config() -> None:
+    global _config
+    with _config_lock:
+        _config = None

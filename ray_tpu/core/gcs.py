@@ -1,0 +1,483 @@
+"""GCS — the cluster-wide control plane.
+
+Equivalent of the reference's ``GcsServer`` (``src/ray/gcs/gcs_server/
+gcs_server.h:89``) composed of the same managers:
+
+  * NodeManager        — registration, resource views, death broadcast
+  * ActorManager       — actor registration/creation/restart FSM
+                         (``gcs_actor_manager.h:324``, RestartActor .cc:565)
+  * JobManager         — job table
+  * InternalKV         — cluster KV (function table, named things)
+  * Publisher          — long-poll pub/sub (``src/ray/pubsub/publisher.h:300``)
+  * HealthCheckManager — periodic raylet pings (``gcs_health_check_manager.h:61``)
+
+Storage is in-memory (the reference's default ``InMemoryStoreClient``); a
+Redis-style external backend can be slotted behind ``_kv`` later for GCS
+fault tolerance.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any
+
+from .config import get_config
+from .ids import ActorID, NodeID
+from .rpc import RetryableRpcClient, RpcClient, RpcServer
+
+logger = logging.getLogger(__name__)
+
+# Actor FSM states (reference rpc::ActorTableData::ActorState).
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class Publisher:
+    """Per-channel sequenced message log with long-poll subscribers."""
+
+    def __init__(self, max_buffer: int = 10000):
+        self._channels: dict[str, list[tuple[int, Any]]] = {}
+        self._seqs: dict[str, int] = {}
+        self._cond = asyncio.Condition()
+        self._max_buffer = max_buffer
+
+    async def publish(self, channel: str, message: Any) -> None:
+        async with self._cond:
+            seq = self._seqs.get(channel, 0) + 1
+            self._seqs[channel] = seq
+            buf = self._channels.setdefault(channel, [])
+            buf.append((seq, message))
+            if len(buf) > self._max_buffer:
+                del buf[: len(buf) // 2]
+            self._cond.notify_all()
+
+    async def poll(self, cursors: dict[str, int], timeout: float) -> dict[str, list]:
+        """Long-poll: block until any channel has messages past its cursor."""
+        deadline = time.monotonic() + timeout
+        async with self._cond:
+            while True:
+                out: dict[str, list] = {}
+                for channel, cursor in cursors.items():
+                    msgs = [(s, m) for s, m in self._channels.get(channel, []) if s > cursor]
+                    if msgs:
+                        out[channel] = msgs
+                if out:
+                    return out
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {}
+                try:
+                    await asyncio.wait_for(self._cond.wait(), remaining)
+                except asyncio.TimeoutError:
+                    return {}
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = RpcServer(host, port)
+        self._server.register_service(self)
+        self.publisher = Publisher()
+        # node_id(hex) -> {address, resources{total,available,labels}, state,
+        #                  last_heartbeat}
+        self._nodes: dict[str, dict] = {}
+        self._raylet_clients: dict[str, RpcClient] = {}
+        # actor_id(hex) -> record
+        self._actors: dict[str, dict] = {}
+        self._named_actors: dict[str, str] = {}  # name -> actor_id hex
+        self._jobs: dict[str, dict] = {}
+        self._next_job = 1
+        self._kv: dict[str, bytes] = {}
+        self._health_task: asyncio.Task | None = None
+        self._placement_groups: dict[str, dict] = {}
+
+    # ------------------------------------------------------------------ util
+    async def start(self) -> None:
+        await self._server.start()
+        self._health_task = asyncio.ensure_future(self._health_check_loop())
+
+    async def stop(self) -> None:
+        if self._health_task:
+            self._health_task.cancel()
+        await self._server.stop()
+
+    @property
+    def address(self) -> str:
+        return self._server.address
+
+    def _raylet(self, node_id_hex: str) -> RpcClient | None:
+        node = self._nodes.get(node_id_hex)
+        if node is None or node["state"] != "ALIVE":
+            return None
+        client = self._raylet_clients.get(node_id_hex)
+        if client is None:
+            client = RetryableRpcClient(node["address"])
+            self._raylet_clients[node_id_hex] = client
+        return client
+
+    # ----------------------------------------------------------- node manager
+    async def handle_RegisterNode(self, p: dict) -> dict:
+        node_id = p["node_id"].hex() if isinstance(p["node_id"], bytes) else p["node_id"]
+        self._nodes[node_id] = {
+            "node_id": node_id,
+            "address": p["address"],
+            "object_store_path": p.get("object_store_path", ""),
+            "object_store_capacity": p.get("object_store_capacity", 0),
+            "resources": p["resources"],
+            "state": "ALIVE",
+            "last_heartbeat": time.time(),
+        }
+        await self.publisher.publish("node", {"node_id": node_id, "state": "ALIVE"})
+        logger.info("Node %s registered at %s", node_id[:8], p["address"])
+        return {"node_id": node_id}
+
+    async def handle_Heartbeat(self, p: dict) -> dict:
+        node = self._nodes.get(p["node_id"])
+        if node is None:
+            return {"unknown": True}
+        node["last_heartbeat"] = time.time()
+        if "resources" in p and p["resources"]:
+            node["resources"] = p["resources"]
+        return {}
+
+    async def handle_GetAllNodes(self, p: dict) -> dict:
+        return {"nodes": list(self._nodes.values())}
+
+    async def handle_DrainNode(self, p: dict) -> dict:
+        await self._mark_node_dead(p["node_id"], "drained")
+        return {}
+
+    async def _health_check_loop(self) -> None:
+        cfg = get_config()
+        period = cfg.health_check_period_ms / 1000.0
+        failures: dict[str, int] = {}
+        while True:
+            await asyncio.sleep(period)
+            for node_id, node in list(self._nodes.items()):
+                if node["state"] != "ALIVE":
+                    continue
+                client = self._raylet(node_id)
+                try:
+                    await client.call("HealthCheck", {}, timeout=period * 2)
+                    failures[node_id] = 0
+                except Exception:
+                    failures[node_id] = failures.get(node_id, 0) + 1
+                    if failures[node_id] >= cfg.health_check_failure_threshold:
+                        await self._mark_node_dead(node_id, "health check failed")
+
+    async def _mark_node_dead(self, node_id: str, reason: str) -> None:
+        node = self._nodes.get(node_id)
+        if node is None or node["state"] == "DEAD":
+            return
+        node["state"] = "DEAD"
+        logger.warning("Node %s marked DEAD (%s)", node_id[:8], reason)
+        await self.publisher.publish("node", {"node_id": node_id, "state": "DEAD"})
+        self._raylet_clients.pop(node_id, None)
+        # Restart / fail actors that lived there (gcs_actor_manager.cc
+        # OnNodeDead).
+        for actor in list(self._actors.values()):
+            if actor.get("node_id") == node_id and actor["state"] in (ALIVE, PENDING_CREATION):
+                await self._restart_or_kill_actor(actor, f"node {node_id[:8]} died")
+
+    # ---------------------------------------------------------- job manager
+    async def handle_AddJob(self, p: dict) -> dict:
+        job_id = self._next_job
+        self._next_job += 1
+        self._jobs[str(job_id)] = {
+            "job_id": job_id,
+            "driver_address": p.get("driver_address", ""),
+            "start_time": time.time(),
+            "state": "RUNNING",
+        }
+        return {"job_id": job_id}
+
+    async def handle_FinishJob(self, p: dict) -> dict:
+        job = self._jobs.get(str(p["job_id"]))
+        if job:
+            job["state"] = "FINISHED"
+            job["end_time"] = time.time()
+        return {}
+
+    async def handle_GetAllJobs(self, p: dict) -> dict:
+        return {"jobs": list(self._jobs.values())}
+
+    # ------------------------------------------------------------ internal KV
+    async def handle_KvPut(self, p: dict) -> dict:
+        key = p["key"]
+        overwrite = p.get("overwrite", True)
+        exists = key in self._kv
+        if exists and not overwrite:
+            return {"added": False}
+        self._kv[key] = p["value"]
+        return {"added": not exists}
+
+    async def handle_KvGet(self, p: dict) -> dict:
+        value = self._kv.get(p["key"])
+        return {"value": value, "found": value is not None}
+
+    async def handle_KvDel(self, p: dict) -> dict:
+        existed = self._kv.pop(p["key"], None) is not None
+        return {"deleted": existed}
+
+    async def handle_KvKeys(self, p: dict) -> dict:
+        prefix = p.get("prefix", "")
+        return {"keys": [k for k in self._kv if k.startswith(prefix)]}
+
+    # --------------------------------------------------------------- pub/sub
+    async def handle_Publish(self, p: dict) -> dict:
+        await self.publisher.publish(p["channel"], p["message"])
+        return {}
+
+    async def handle_SubscribePoll(self, p: dict) -> dict:
+        cfg = get_config()
+        timeout = min(p.get("timeout", cfg.gcs_pubsub_poll_timeout_s), cfg.gcs_pubsub_poll_timeout_s)
+        out = await self.publisher.poll(p["cursors"], timeout)
+        return {"messages": out}
+
+    # ---------------------------------------------------------- actor manager
+    async def handle_RegisterActor(self, p: dict) -> dict:
+        """Register + asynchronously create an actor (gcs_actor_manager.cc:389,475)."""
+        spec = p["spec"]
+        actor_id = spec["actor_id"].hex() if isinstance(spec["actor_id"], bytes) else spec["actor_id"]
+        name = p.get("name", "")
+        if name:
+            if name in self._named_actors:
+                return {"error": f"Actor name '{name}' already taken"}
+            self._named_actors[name] = actor_id
+        record = {
+            "actor_id": actor_id,
+            "name": name,
+            "spec": spec,
+            "state": PENDING_CREATION,
+            "address": "",
+            "node_id": "",
+            "worker_id": "",
+            "num_restarts": 0,
+            "max_restarts": spec.get("max_restarts", 0),
+            "detached": p.get("detached", False),
+            "death_cause": "",
+        }
+        self._actors[actor_id] = record
+        asyncio.ensure_future(self._create_actor(record))
+        return {"actor_id": actor_id}
+
+    async def _create_actor(self, record: dict) -> None:
+        """Lease a worker and push the creation task (GcsActorScheduler)."""
+        spec = record["spec"]
+        resources = spec.get("resources") or {"CPU": 1.0}
+        strategy = spec.get("scheduling_strategy") or {}
+        for attempt in range(60):
+            node_id = self._select_node(resources, strategy)
+            if node_id is None:
+                await asyncio.sleep(0.5)
+                continue
+            client = self._raylet(node_id)
+            if client is None:
+                continue
+            try:
+                lease = await client.call(
+                    "RequestWorkerLease",
+                    {"spec": spec, "dedicated": True},
+                    timeout=get_config().worker_register_timeout_s,
+                )
+            except Exception as e:
+                logger.warning("Actor lease on node %s failed: %s", node_id[:8], e)
+                await asyncio.sleep(0.2)
+                continue
+            if lease.get("spillback"):
+                continue  # re-select with fresh view
+            if not lease.get("granted"):
+                await asyncio.sleep(0.2)
+                continue
+            worker_addr = lease["worker_address"]
+            logger.info("Actor %s: pushing creation task to %s", record["actor_id"][:8], worker_addr)
+            try:
+                worker = RpcClient(worker_addr)
+                reply = await worker.call(
+                    "PushTask", {"spec": spec}, timeout=get_config().worker_register_timeout_s * 2
+                )
+                await worker.close()
+                logger.info("Actor %s: creation reply %s", record["actor_id"][:8], "err" if reply.get("error") else "ok")
+                if reply.get("error"):
+                    record["state"] = DEAD
+                    record["death_cause"] = f"creation task failed: {reply['error']}"
+                    await self._publish_actor(record)
+                    return
+            except Exception as e:
+                record["death_cause"] = f"creation push failed: {e}"
+                await asyncio.sleep(0.2)
+                continue
+            record["state"] = ALIVE
+            record["address"] = worker_addr
+            record["node_id"] = node_id
+            record["worker_id"] = lease.get("worker_id", "")
+            await self._publish_actor(record)
+            return
+        record["state"] = DEAD
+        record["death_cause"] = record.get("death_cause") or "no node could schedule the actor"
+        await self._publish_actor(record)
+
+    def _select_node(self, resources: dict, strategy: dict | None = None) -> str | None:
+        from .scheduling import select_node_for_resources
+
+        return select_node_for_resources(self._nodes, resources, strategy or {})
+
+    async def _publish_actor(self, record: dict) -> None:
+        await self.publisher.publish(
+            "actor",
+            {
+                "actor_id": record["actor_id"],
+                "state": record["state"],
+                "address": record["address"],
+                "num_restarts": record["num_restarts"],
+                "death_cause": record["death_cause"],
+            },
+        )
+
+    async def handle_GetActorInfo(self, p: dict) -> dict:
+        actor_id = p["actor_id"]
+        record = self._actors.get(actor_id)
+        if record is None:
+            return {"found": False}
+        return {
+            "found": True,
+            "state": record["state"],
+            "address": record["address"],
+            "num_restarts": record["num_restarts"],
+            "death_cause": record["death_cause"],
+        }
+
+    async def handle_GetActorByName(self, p: dict) -> dict:
+        actor_id = self._named_actors.get(p["name"])
+        if actor_id is None:
+            return {"found": False}
+        info = await self.handle_GetActorInfo({"actor_id": actor_id})
+        info["actor_id"] = actor_id
+        info["spec"] = self._actors[actor_id]["spec"]
+        return info
+
+    async def handle_ListActors(self, p: dict) -> dict:
+        return {
+            "actors": [
+                {k: v for k, v in rec.items() if k != "spec"}
+                for rec in self._actors.values()
+            ]
+        }
+
+    async def handle_ReportActorDeath(self, p: dict) -> dict:
+        """Raylet/worker reports an actor's process died (OnWorkerDead)."""
+        record = self._actors.get(p["actor_id"])
+        if record is None or record["state"] == DEAD:
+            return {}
+        await self._restart_or_kill_actor(record, p.get("reason", "worker died"))
+        return {}
+
+    async def handle_KillActor(self, p: dict) -> dict:
+        record = self._actors.get(p["actor_id"])
+        if record is None:
+            return {"found": False}
+        record["max_restarts"] = 0  # no_restart
+        node = self._raylet(record["node_id"]) if record["node_id"] else None
+        if record["state"] == ALIVE and record["address"]:
+            try:
+                w = RpcClient(record["address"])
+                await w.call("Exit", {}, timeout=2.0)
+                await w.close()
+            except Exception:
+                pass
+        record["state"] = DEAD
+        record["death_cause"] = "killed via ray.kill"
+        if record.get("name"):
+            self._named_actors.pop(record["name"], None)
+        await self._publish_actor(record)
+        return {"found": True}
+
+    async def _restart_or_kill_actor(self, record: dict, reason: str) -> None:
+        """The restart FSM (gcs_actor_manager.cc:565 RestartActor)."""
+        max_restarts = record.get("max_restarts", 0)
+        if max_restarts == -1 or record["num_restarts"] < max_restarts:
+            record["num_restarts"] += 1
+            record["state"] = RESTARTING
+            record["address"] = ""
+            await self._publish_actor(record)
+            asyncio.ensure_future(self._create_actor(record))
+        else:
+            record["state"] = DEAD
+            record["death_cause"] = reason
+            if record.get("name"):
+                self._named_actors.pop(record["name"], None)
+            await self._publish_actor(record)
+
+    # ------------------------------------------------------ placement groups
+    async def handle_CreatePlacementGroup(self, p: dict) -> dict:
+        from .scheduling import schedule_placement_group
+
+        pg_id = p["pg_id"].hex() if isinstance(p["pg_id"], bytes) else p["pg_id"]
+        record = {
+            "pg_id": pg_id,
+            "bundles": p["bundles"],
+            "strategy": p.get("strategy", "PACK"),
+            "state": "PENDING",
+            "bundle_locations": [],
+            "name": p.get("name", ""),
+        }
+        self._placement_groups[pg_id] = record
+        # 2PC bundle reservation (gcs_placement_group_scheduler.h:117-119):
+        # phase 1 reserve on raylets, phase 2 commit — here both phases are
+        # executed against raylet `ReserveBundle`/`CommitBundle` RPCs.
+        placement = schedule_placement_group(self._nodes, p["bundles"], record["strategy"])
+        if placement is None:
+            record["state"] = "INFEASIBLE"
+            return {"pg_id": pg_id, "state": record["state"]}
+        reserved = []
+        ok = True
+        for idx, node_id in enumerate(placement):
+            client = self._raylet(node_id)
+            try:
+                r = await client.call(
+                    "ReserveBundle",
+                    {"pg_id": pg_id, "bundle_index": idx, "resources": p["bundles"][idx]},
+                    timeout=5.0,
+                )
+                if not r.get("ok"):
+                    ok = False
+                    break
+                reserved.append((idx, node_id))
+            except Exception:
+                ok = False
+                break
+        if not ok:
+            for idx, node_id in reserved:
+                client = self._raylet(node_id)
+                try:
+                    await client.call("CancelBundle", {"pg_id": pg_id, "bundle_index": idx}, timeout=5.0)
+                except Exception:
+                    pass
+            record["state"] = "PENDING"
+            return {"pg_id": pg_id, "state": record["state"]}
+        for idx, node_id in reserved:
+            client = self._raylet(node_id)
+            await client.call("CommitBundle", {"pg_id": pg_id, "bundle_index": idx}, timeout=5.0)
+        record["state"] = "CREATED"
+        record["bundle_locations"] = [n for _, n in sorted(reserved)]
+        return {"pg_id": pg_id, "state": "CREATED", "bundle_locations": record["bundle_locations"]}
+
+    async def handle_GetPlacementGroup(self, p: dict) -> dict:
+        record = self._placement_groups.get(p["pg_id"])
+        return {"found": record is not None, "pg": record}
+
+    async def handle_RemovePlacementGroup(self, p: dict) -> dict:
+        record = self._placement_groups.pop(p["pg_id"], None)
+        if record and record["state"] == "CREATED":
+            for idx, node_id in enumerate(record["bundle_locations"]):
+                client = self._raylet(node_id)
+                if client:
+                    try:
+                        await client.call("ReturnBundle", {"pg_id": record["pg_id"], "bundle_index": idx}, timeout=5.0)
+                    except Exception:
+                        pass
+        return {"removed": record is not None}

@@ -1,0 +1,82 @@
+"""Node bootstrap: start/stop the head services and the driver CoreWorker.
+
+Equivalent of the reference's ``python/ray/_private/node.py``
+(``start_head_processes``:1401) and ``services.py``. Difference from the
+reference: the GCS and the raylet run as asyncio services on a dedicated
+thread inside the driver process rather than as separate C++ processes —
+worker processes are real subprocesses either way, and the
+``cluster.Cluster`` harness can start additional raylets to get full
+multi-node semantics on one machine (reference ``cluster_utils.py:135``).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+
+from .config import get_config
+from .gcs import GcsServer
+from .ids import JobID
+from .raylet import Raylet
+from .rpc import EventLoopThread
+from .worker import MODE_DRIVER, CoreWorker, set_global_worker
+
+
+class Node:
+    def __init__(
+        self,
+        *,
+        head: bool = True,
+        gcs_address: str | None = None,
+        num_cpus: float | None = None,
+        resources: dict | None = None,
+        labels: dict | None = None,
+        object_store_memory: int | None = None,
+        session_dir: str | None = None,
+    ):
+        self.session_dir = session_dir or tempfile.mkdtemp(prefix="raytpu-session-")
+        self.services_loop = EventLoopThread("raytpu-services")
+        self.gcs: GcsServer | None = None
+        if head:
+            self.gcs = GcsServer()
+            self.services_loop.run_sync(self.gcs.start())
+            gcs_address = self.gcs.address
+        assert gcs_address is not None
+        self.gcs_address = gcs_address
+        self.raylet = Raylet(
+            gcs_address,
+            num_cpus=num_cpus,
+            resources=resources,
+            labels=labels,
+            object_store_capacity=object_store_memory,
+            session_dir=self.session_dir,
+        )
+        self.services_loop.run_sync(self.raylet.start())
+
+    def connect_driver(self, job_id: int = 1) -> CoreWorker:
+        worker = CoreWorker(
+            mode=MODE_DRIVER,
+            gcs_address=self.gcs_address,
+            raylet_address=self.raylet.address,
+            node_id=self.raylet.node_id.hex(),
+            store_path=self.raylet.store_path,
+            store_capacity=self.raylet.object_store_capacity,
+            job_id=JobID.from_int(job_id),
+        )
+        worker.connect()
+        worker._gcs_call("AddJob", {"driver_address": worker.address})
+        set_global_worker(worker)
+        return worker
+
+    def shutdown(self) -> None:
+        try:
+            self.services_loop.run_sync(self.raylet.stop(), timeout=10)
+        except Exception:
+            pass
+        if self.gcs is not None:
+            try:
+                self.services_loop.run_sync(self.gcs.stop(), timeout=5)
+            except Exception:
+                pass
+        self.services_loop.stop()

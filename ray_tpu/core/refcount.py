@@ -1,0 +1,152 @@
+"""Distributed reference counting (owner side).
+
+Equivalent of the reference's ``ReferenceCounter``
+(``src/ray/core_worker/reference_count.h:66``): per-object counts of
+
+  * local refs        — live Python ``ObjectRef`` instances in this process
+  * submitted refs    — in-flight tasks that take the object as an arg
+  * contained refs    — objects serialized inside other objects (nesting)
+  * borrower count    — other workers holding a deserialized copy of the ref
+
+When all counts reach zero the owner frees the object: memory-store entry
+dropped, plasma copies deleted on every node that reported a location, and
+lineage unpinned. Borrowing here is a simplified variant of the reference
+protocol: a borrower reports itself to the owner on deserialization and
+sends a single release when its local count drains (the reference batches
+this via ``WaitForRefRemoved`` pub/sub).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable
+
+from .ids import ObjectID
+
+
+@dataclass
+class _Ref:
+    local: int = 0
+    submitted: int = 0
+    borrowers: int = 0
+    contained_in: int = 0
+    # Object IDs this object's value contains (nested refs).
+    contains: set = field(default_factory=set)
+    # Nodes known to hold a plasma copy.
+    locations: set = field(default_factory=set)
+    owned: bool = False
+    lineage_pinned: bool = False
+
+    def total(self) -> int:
+        return self.local + self.submitted + self.borrowers + self.contained_in
+
+
+class ReferenceCounter:
+    def __init__(self, on_object_freed: Callable[[ObjectID, set], None] | None = None):
+        self._lock = threading.RLock()
+        self._refs: dict[ObjectID, _Ref] = {}
+        self._on_object_freed = on_object_freed
+
+    def _entry(self, oid: ObjectID) -> _Ref:
+        ref = self._refs.get(oid)
+        if ref is None:
+            ref = self._refs[oid] = _Ref()
+        return ref
+
+    # -- ownership -----------------------------------------------------------
+    def add_owned_object(self, oid: ObjectID, contained: list[ObjectID] | None = None) -> None:
+        with self._lock:
+            ref = self._entry(oid)
+            ref.owned = True
+            for child in contained or []:
+                ref.contains.add(child)
+                self._entry(child).contained_in += 1
+
+    def is_owned(self, oid: ObjectID) -> bool:
+        with self._lock:
+            ref = self._refs.get(oid)
+            return bool(ref and ref.owned)
+
+    # -- counts --------------------------------------------------------------
+    def add_local_ref(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._entry(oid).local += 1
+
+    def remove_local_ref(self, oid: ObjectID) -> None:
+        self._dec(oid, "local")
+
+    def add_submitted_ref(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._entry(oid).submitted += 1
+
+    def remove_submitted_ref(self, oid: ObjectID) -> None:
+        self._dec(oid, "submitted")
+
+    def add_borrower(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._entry(oid).borrowers += 1
+
+    def remove_borrower(self, oid: ObjectID) -> None:
+        self._dec(oid, "borrowers")
+
+    def _dec(self, oid: ObjectID, kind: str) -> None:
+        freed: list[tuple[ObjectID, set]] = []
+        with self._lock:
+            ref = self._refs.get(oid)
+            if ref is None:
+                return
+            setattr(ref, kind, max(0, getattr(ref, kind) - 1))
+            self._maybe_free(oid, ref, freed)
+        for oid_, locations in freed:
+            if self._on_object_freed is not None:
+                self._on_object_freed(oid_, locations)
+
+    def _maybe_free(self, oid: ObjectID, ref: _Ref, freed: list) -> None:
+        if ref.total() > 0:
+            return
+        self._refs.pop(oid, None)
+        freed.append((oid, set(ref.locations)))
+        for child in ref.contains:
+            child_ref = self._refs.get(child)
+            if child_ref is not None:
+                child_ref.contained_in = max(0, child_ref.contained_in - 1)
+                self._maybe_free(child, child_ref, freed)
+
+    # -- locations -----------------------------------------------------------
+    def add_location(self, oid: ObjectID, node_id: bytes) -> None:
+        with self._lock:
+            self._entry(oid).locations.add(node_id)
+
+    def remove_location(self, oid: ObjectID, node_id: bytes) -> None:
+        with self._lock:
+            ref = self._refs.get(oid)
+            if ref:
+                ref.locations.discard(node_id)
+
+    def get_locations(self, oid: ObjectID) -> set:
+        with self._lock:
+            ref = self._refs.get(oid)
+            return set(ref.locations) if ref else set()
+
+    def has_ref(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._refs
+
+    def num_objects(self) -> int:
+        with self._lock:
+            return len(self._refs)
+
+    def debug(self, oid: ObjectID) -> dict:
+        with self._lock:
+            ref = self._refs.get(oid)
+            if ref is None:
+                return {}
+            return {
+                "local": ref.local,
+                "submitted": ref.submitted,
+                "borrowers": ref.borrowers,
+                "contained_in": ref.contained_in,
+                "locations": {n.hex() if isinstance(n, bytes) else n for n in ref.locations},
+                "owned": ref.owned,
+            }

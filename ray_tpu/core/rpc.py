@@ -1,0 +1,299 @@
+"""RPC layer: asyncio TCP transport with msgpack framing.
+
+TPU-native equivalent of the reference's ``src/ray/rpc/`` (gRPC server/client
+wrappers). The control plane does not need gRPC/protobuf machinery on TPU
+VMs; a length-prefixed msgpack protocol over asyncio TCP gives the same
+request/response semantics with far less code:
+
+    frame   := [u32 little-endian length][msgpack body]
+    request := {"id": u64, "method": str, "payload": {...}}
+    reply   := {"id": u64, "ok": bool, "payload": {...} | "error": str}
+
+``RetryableRpcClient`` mirrors ``retryable_grpc_client.h`` (exponential
+backoff, bounded retries, fail-fast on server-declared death).
+``RpcChaos`` mirrors ``rpc_chaos.h:23-37``: deterministic failure injection
+per method, configured via the ``testing_rpc_failure`` config entry /
+``RAY_TPU_testing_rpc_failure`` env var.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import struct
+import threading
+from typing import Any, Awaitable, Callable
+
+import msgpack
+
+from .config import get_config
+from .status import RpcError
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 1 << 31
+
+
+def _pack(obj: Any) -> bytes:
+    body = msgpack.packb(obj, use_bin_type=True)
+    return _LEN.pack(len(body)) + body
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(_LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise RpcError(f"Frame too large: {length}")
+    body = await reader.readexactly(length)
+    return msgpack.unpackb(body, raw=False)
+
+
+class RpcChaos:
+    """Deterministic request/response failure injection (rpc_chaos.cc:34)."""
+
+    def __init__(self, spec: str = ""):
+        # spec: "Method=req_prob,resp_prob;Method2=..."
+        self._probs: dict[str, tuple[float, float]] = {}
+        for item in filter(None, spec.split(";")):
+            method, probs = item.split("=")
+            req, resp = probs.split(",")
+            self._probs[method] = (float(req), float(resp))
+        self._rng = random.Random(0xC0FFEE)
+
+    def should_fail_request(self, method: str) -> bool:
+        p = self._probs.get(method)
+        return bool(p) and self._rng.random() < p[0]
+
+    def should_fail_response(self, method: str) -> bool:
+        p = self._probs.get(method)
+        return bool(p) and self._rng.random() < p[1]
+
+
+_chaos: RpcChaos | None = None
+
+
+def get_chaos() -> RpcChaos:
+    global _chaos
+    if _chaos is None:
+        _chaos = RpcChaos(get_config().testing_rpc_failure)
+    return _chaos
+
+
+Handler = Callable[[dict], Awaitable[dict]]
+
+
+class RpcServer:
+    """Asyncio TCP server dispatching named methods (grpc_server.h equiv)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._handlers: dict[str, Handler] = {}
+        self._server: asyncio.AbstractServer | None = None
+
+    def register(self, method: str, handler: Handler) -> None:
+        self._handlers[method] = handler
+
+    def register_service(self, service: object, prefix: str = "") -> None:
+        """Register every ``handle_<Name>`` coroutine as method ``<Name>``."""
+        for attr in dir(service):
+            if attr.startswith("handle_"):
+                self.register(prefix + attr[len("handle_") :], getattr(service, attr))
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:  # pragma: no cover - teardown best effort
+                pass
+            self._server = None
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                msg = await _read_frame(reader)
+                asyncio.ensure_future(self._dispatch(msg, writer))
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, msg: dict, writer: asyncio.StreamWriter) -> None:
+        method = msg.get("method", "")
+        chaos = get_chaos()
+        if chaos.should_fail_request(method):
+            return  # drop request silently
+        handler = self._handlers.get(method)
+        if handler is None:
+            reply = {"id": msg["id"], "ok": False, "error": f"No such method: {method}"}
+        else:
+            try:
+                payload = await handler(msg.get("payload") or {})
+                reply = {"id": msg["id"], "ok": True, "payload": payload}
+            except Exception as e:
+                logger.debug("RPC handler %s raised", method, exc_info=True)
+                reply = {"id": msg["id"], "ok": False, "error": f"{type(e).__name__}: {e}"}
+        if chaos.should_fail_response(method):
+            return  # drop response
+        try:
+            writer.write(_pack(reply))
+            await writer.drain()
+        except (ConnectionError, RuntimeError):
+            pass
+
+
+class RpcClient:
+    """Single-connection async client (grpc_client.h equiv)."""
+
+    def __init__(self, address: str):
+        self.address = address
+        host, port = address.rsplit(":", 1)
+        self._host, self._port = host, int(port)
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._pending: dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self._lock = asyncio.Lock()
+        self._read_task: asyncio.Task | None = None
+
+    async def _ensure_connected(self) -> None:
+        if self._writer is not None and not self._writer.is_closing():
+            return
+        async with self._lock:
+            if self._writer is not None and not self._writer.is_closing():
+                return
+            cfg = get_config()
+            self._reader, self._writer = await asyncio.wait_for(
+                asyncio.open_connection(self._host, self._port),
+                timeout=cfg.rpc_connect_timeout_s,
+            )
+            self._read_task = asyncio.ensure_future(self._read_loop())
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = await _read_frame(self._reader)
+                fut = self._pending.pop(msg["id"], None)
+                if fut is not None and not fut.done():
+                    fut.set_result(msg)
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError, RpcError) as e:
+            self._fail_all(RpcError(f"Connection to {self.address} lost: {e}"))
+
+    def _fail_all(self, error: Exception) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+        self._writer = None
+        self._reader = None
+        pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(error)
+
+    async def call(self, method: str, payload: dict | None = None, timeout: float | None = None) -> dict:
+        await self._ensure_connected()
+        self._next_id += 1
+        req_id = self._next_id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        try:
+            self._writer.write(_pack({"id": req_id, "method": method, "payload": payload or {}}))
+            await self._writer.drain()
+        except (ConnectionError, RuntimeError) as e:
+            self._pending.pop(req_id, None)
+            self._fail_all(RpcError(str(e)))
+            raise RpcError(f"Send to {self.address} failed: {e}") from e
+        try:
+            msg = await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(req_id, None)
+            raise RpcError(f"RPC {method} to {self.address} timed out")
+        if not msg.get("ok"):
+            raise RpcError(msg.get("error", "unknown RPC error"))
+        return msg.get("payload") or {}
+
+    async def close(self) -> None:
+        if self._read_task is not None:
+            self._read_task.cancel()
+        self._fail_all(RpcError("client closed"))
+
+
+class RetryableRpcClient(RpcClient):
+    """Client with exponential-backoff reconnect (retryable_grpc_client.h)."""
+
+    async def call(self, method: str, payload: dict | None = None, timeout: float | None = None) -> dict:
+        cfg = get_config()
+        delay = cfg.rpc_retry_base_delay_ms / 1000.0
+        last: Exception | None = None
+        for attempt in range(cfg.rpc_max_retries + 1):
+            try:
+                return await super().call(method, payload, timeout)
+            except RpcError as e:
+                msg = str(e)
+                if "No such method" in msg or msg.startswith("RPC") and "timed out" in msg:
+                    raise
+                # Application-level errors (handler raised) are not retryable;
+                # only transport failures are.
+                if "Connection" not in msg and "Send to" not in msg and "refused" not in msg.lower():
+                    raise
+                last = e
+                if attempt == cfg.rpc_max_retries:
+                    break
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, cfg.rpc_retry_max_delay_ms / 1000.0)
+        raise RpcError(f"RPC {method} to {self.address} failed after retries: {last}")
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop on a daemon thread.
+
+    Plays the role of the CoreWorker's io_service threads
+    (``core_worker_process.h``): synchronous frontend code schedules
+    coroutines here and blocks on concurrent futures.
+    """
+
+    def __init__(self, name: str = "raytpu-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run_coro(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def run_sync(self, coro, timeout: float | None = None):
+        return self.run_coro(coro).result(timeout)
+
+    def stop(self) -> None:
+        def _shutdown():
+            for task in asyncio.all_tasks(self.loop):
+                task.cancel()
+            self.loop.stop()
+
+        if self.loop.is_running():
+            self.loop.call_soon_threadsafe(_shutdown)
+            self._thread.join(timeout=5)
+        if not self.loop.is_running():
+            try:
+                self.loop.close()
+            except Exception:
+                pass

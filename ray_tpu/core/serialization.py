@@ -1,0 +1,152 @@
+"""Object serialization: cloudpickle + pickle-5 out-of-band buffers.
+
+Equivalent of the reference's ``python/ray/_private/serialization.py:122``
+(``SerializationContext``): values are cloudpickled with protocol 5 so large
+contiguous buffers (numpy / jax host arrays / arrow) are extracted
+out-of-band and written verbatim, enabling zero-copy reads from the
+shared-memory store. The on-wire layout is one contiguous blob:
+
+    [u32 magic][u32 n_buffers][n_buffers x (u64 offset, u64 size)]
+    [padding to 64B][buffer 0 = pickle stream][buffer 1..][...]
+
+Buffers are 64-byte aligned so vectorized consumers can use them in place.
+Nested ``ObjectRef`` capture is supported via a thread-local context the
+owner installs around serialize/deserialize (the reference does this for
+borrowed-ref bookkeeping, ``reference_count.h:66``).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import threading
+from typing import Any, Callable
+
+import cloudpickle
+
+_MAGIC = 0x52545055  # "RTPU"
+_ALIGN = 64
+_HEADER = struct.Struct("<II")
+_ENTRY = struct.Struct("<QQ")
+
+# Metadata tags (reference: ray_constants OBJECT_METADATA_TYPE_*).
+META_PICKLE5 = b"PICKLE5"
+META_ERROR = b"ERROR"
+META_ACTOR_HANDLE = b"ACTOR_HANDLE"
+META_RAW = b"RAW"
+
+
+class _SerializationThreadContext(threading.local):
+    def __init__(self):
+        self.contained_refs: list | None = None
+        self.outer_object_id = None
+
+
+_ctx = _SerializationThreadContext()
+
+# Registered by object_ref.py to avoid a circular import: maps ObjectRef
+# instances through pickling while recording containment.
+_object_ref_reducer: Callable | None = None
+_object_ref_class: type | None = None
+
+
+def register_object_ref_serializer(ref_class: type, reducer: Callable) -> None:
+    global _object_ref_class, _object_ref_reducer
+    _object_ref_class = ref_class
+    _object_ref_reducer = reducer
+
+
+def record_contained_ref(ref) -> None:
+    if _ctx.contained_refs is not None:
+        _ctx.contained_refs.append(ref)
+
+
+class _Pickler(cloudpickle.Pickler):
+    def reducer_override(self, obj):
+        if _object_ref_class is not None and type(obj) is _object_ref_class:
+            record_contained_ref(obj)
+            return _object_ref_reducer(obj)
+        return NotImplemented
+
+
+def serialize(value: Any) -> tuple[bytes, bytes, list]:
+    """Serialize ``value`` → (metadata, blob, contained_object_refs)."""
+    _ctx.contained_refs = []
+    try:
+        buffers: list[pickle.PickleBuffer] = []
+        import io
+
+        stream = io.BytesIO()
+        pickler = _Pickler(stream, protocol=5, buffer_callback=buffers.append)
+        pickler.dump(value)
+        payload = stream.getvalue()
+        raw_buffers = [payload] + [b.raw() for b in buffers]
+        blob = _frame(raw_buffers)
+        return META_PICKLE5, blob, list(_ctx.contained_refs)
+    finally:
+        _ctx.contained_refs = None
+
+
+def serialize_error(error) -> tuple[bytes, bytes, list]:
+    payload = cloudpickle.dumps(error)
+    return META_ERROR, _frame([payload]), []
+
+
+def deserialize(metadata: bytes, blob: bytes | memoryview) -> Any:
+    if metadata == META_RAW:
+        return bytes(blob)
+    bufs = _unframe(blob)
+    if metadata == META_ERROR:
+        # Return (not raise) so callers can re-raise with the cause's type
+        # (RayTaskError.as_instanceof_cause, reference exceptions.py).
+        error = pickle.loads(bufs[0])
+        return error if isinstance(error, BaseException) else RuntimeError(str(error))
+    if metadata in (META_PICKLE5, META_ACTOR_HANDLE):
+        return pickle.loads(bufs[0], buffers=[pickle.PickleBuffer(b) for b in bufs[1:]])
+    raise ValueError(f"Unknown object metadata: {metadata!r}")
+
+
+def _frame(buffers: list) -> bytes:
+    n = len(buffers)
+    table_end = _HEADER.size + n * _ENTRY.size
+    parts = [b""] * (2 * n + 1)
+    entries = []
+    offset = _pad(table_end)
+    chunks = []
+    for buf in buffers:
+        mv = memoryview(buf)
+        aligned = _pad(offset)
+        if aligned != offset:
+            chunks.append(b"\x00" * (aligned - offset))
+            offset = aligned
+        entries.append((offset, mv.nbytes))
+        chunks.append(mv)
+        offset += mv.nbytes
+    header = _HEADER.pack(_MAGIC, n) + b"".join(_ENTRY.pack(o, s) for o, s in entries)
+    header += b"\x00" * (_pad(table_end) - table_end)
+    out = bytearray(offset)
+    out[: len(header)] = header
+    pos = len(header)
+    for chunk in chunks:
+        mv = memoryview(chunk)
+        out[pos : pos + mv.nbytes] = mv
+        pos += mv.nbytes
+    return bytes(out)
+
+
+def _unframe(blob: bytes | memoryview) -> list[memoryview]:
+    mv = memoryview(blob)
+    magic, n = _HEADER.unpack_from(mv, 0)
+    if magic != _MAGIC:
+        raise ValueError("Corrupt object blob (bad magic)")
+    bufs = []
+    pos = _HEADER.size
+    for _ in range(n):
+        offset, size = _ENTRY.unpack_from(mv, pos)
+        pos += _ENTRY.size
+        bufs.append(mv[offset : offset + size])
+    return bufs
+
+
+def _pad(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN

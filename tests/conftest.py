@@ -1,0 +1,27 @@
+"""Test configuration.
+
+TPU sharding tests run on a virtual 8-device CPU mesh
+(``xla_force_host_platform_device_count``); real-TPU benchmarks live in
+``bench.py``, not here.
+"""
+
+import os
+
+# Must be set before jax import anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def ray_cluster():
+    """One shared local cluster for API-level tests (reference
+    ``ray_start_shared_local_modes`` style)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()

@@ -1,0 +1,87 @@
+"""Actor tests. Mirrors reference ``python/ray/tests/test_actor.py`` basics."""
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(autouse=True)
+def _cluster(ray_cluster):
+    yield
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, k=1):
+        self.n += k
+        return self.n
+
+    def read(self):
+        return self.n
+
+
+def test_actor_create_and_call():
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+    assert ray_tpu.get(c.incr.remote(5), timeout=60) == 6
+
+
+def test_actor_ordering():
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(20)]
+    assert ray_tpu.get(refs, timeout=60) == list(range(1, 21))
+
+
+def test_actor_constructor_args():
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.read.remote(), timeout=60) == 100
+
+
+def test_two_actors_independent():
+    a, b = Counter.remote(), Counter.remote()
+    ray_tpu.get([a.incr.remote(), a.incr.remote(), b.incr.remote()], timeout=60)
+    assert ray_tpu.get(a.read.remote(), timeout=60) == 2
+    assert ray_tpu.get(b.read.remote(), timeout=60) == 1
+
+
+def test_named_actor():
+    Counter.options(name="test_named_counter").remote(7)
+    h = ray_tpu.get_actor("test_named_counter")
+    assert ray_tpu.get(h.read.remote(), timeout=60) == 7
+
+
+def test_actor_handle_passing():
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def use(handle):
+        return ray_tpu.get(handle.incr.remote(10), timeout=30)
+
+    assert ray_tpu.get(use.remote(c), timeout=60) == 10
+    assert ray_tpu.get(c.read.remote(), timeout=60) == 10
+
+
+def test_actor_method_error():
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor method error")
+
+    b = Bad.remote()
+    with pytest.raises(RuntimeError):
+        ray_tpu.get(b.fail.remote(), timeout=60)
+
+
+def test_kill_actor():
+    c = Counter.remote()
+    ray_tpu.get(c.incr.remote(), timeout=60)
+    ray_tpu.kill(c)
+    import time
+
+    with pytest.raises(ray_tpu.exceptions.RayTpuError):
+        for _ in range(50):
+            ray_tpu.get(c.incr.remote(), timeout=30)
+            time.sleep(0.1)

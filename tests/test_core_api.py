@@ -1,0 +1,125 @@
+"""Core API integration tests on a shared local cluster.
+
+Mirrors the reference's ``python/ray/tests/test_basic.py`` family.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(autouse=True)
+def _cluster(ray_cluster):
+    yield
+
+
+def test_simple_task():
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1), timeout=60) == 2
+
+
+def test_task_chaining():
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    ref = f.remote(0)
+    for _ in range(4):
+        ref = f.remote(ref)
+    assert ray_tpu.get(ref, timeout=60) == 5
+
+
+def test_put_get_roundtrip():
+    for value in [1, "abc", {"k": [1, 2]}, None]:
+        assert ray_tpu.get(ray_tpu.put(value), timeout=30) == value
+
+
+def test_large_object_via_shm():
+    arr = np.random.rand(500_000).astype(np.float32)
+    ref = ray_tpu.put(arr)
+    np.testing.assert_array_equal(ray_tpu.get(ref, timeout=30), arr)
+
+
+def test_large_arg_and_return():
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    arr = np.ones(500_000, dtype=np.float32)
+    out = ray_tpu.get(double.remote(arr), timeout=60)
+    np.testing.assert_array_equal(out, arr * 2)
+
+
+def test_multiple_returns():
+    @ray_tpu.remote(num_returns=2)
+    def two():
+        return 1, 2
+
+    a, b = two.remote()
+    assert ray_tpu.get([a, b], timeout=60) == [1, 2]
+
+
+def test_kwargs():
+    @ray_tpu.remote
+    def f(a, b=0, c=0):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1, c=5), timeout=60) == 6
+
+
+def test_error_propagation():
+    @ray_tpu.remote
+    def boom():
+        raise KeyError("missing")
+
+    with pytest.raises(KeyError):
+        ray_tpu.get(boom.remote(), timeout=60)
+
+
+def test_error_type_preserved():
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("v")
+
+    with pytest.raises(ray_tpu.RayTaskError):
+        ray_tpu.get(boom.remote(), timeout=60)
+
+
+def test_wait():
+    @ray_tpu.remote
+    def quick(i):
+        return i
+
+    refs = [quick.remote(i) for i in range(8)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=8, timeout=60)
+    assert len(ready) == 8 and not not_ready
+
+
+def test_nested_tasks():
+    @ray_tpu.remote
+    def inner(x):
+        return x * 10
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x), timeout=30) + 1
+
+    assert ray_tpu.get(outer.remote(4), timeout=60) == 41
+
+
+def test_ref_passed_to_task():
+    @ray_tpu.remote
+    def consume(x):
+        return x + 1
+
+    ref = ray_tpu.put(10)
+    assert ray_tpu.get(consume.remote(ref), timeout=60) == 11
+
+
+def test_cluster_resources():
+    res = ray_tpu.cluster_resources()
+    assert res.get("CPU", 0) >= 4

@@ -1,0 +1,53 @@
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
+
+
+def test_job_id_roundtrip():
+    j = JobID.from_int(7)
+    assert j.int_value() == 7
+    assert JobID.from_hex(j.hex()) == j
+
+
+def test_task_id_embeds_actor_and_job():
+    job = JobID.from_int(3)
+    driver = TaskID.for_driver_task(job)
+    t = TaskID.for_normal_task(job, driver, 1)
+    assert t.job_id() == job
+    assert t.actor_id().is_nil() is False or t.actor_id().job_id() == job
+
+
+def test_object_id_embeds_task():
+    job = JobID.from_int(1)
+    driver = TaskID.for_driver_task(job)
+    t = TaskID.for_normal_task(job, driver, 5)
+    o = ObjectID.for_task_return(t, 2)
+    assert o.task_id() == t
+    assert o.index() == 2
+    assert not o.is_put()
+    p = ObjectID.for_put(t, 1)
+    assert p.is_put()
+    assert p.task_id() == t
+
+
+def test_deterministic_lineage():
+    """Same (parent, counter) must regenerate the same IDs — required for
+    lineage reconstruction."""
+    job = JobID.from_int(1)
+    driver = TaskID.for_driver_task(job)
+    assert TaskID.for_normal_task(job, driver, 9) == TaskID.for_normal_task(job, driver, 9)
+    assert TaskID.for_normal_task(job, driver, 9) != TaskID.for_normal_task(job, driver, 10)
+
+
+def test_actor_id():
+    job = JobID.from_int(2)
+    driver = TaskID.for_driver_task(job)
+    a = ActorID.of(job, driver, 1)
+    assert a.job_id() == job
+    creation = TaskID.for_actor_creation_task(a)
+    assert creation.actor_id() == a
+
+
+def test_random_and_nil():
+    n = NodeID.from_random()
+    assert not n.is_nil()
+    assert NodeID.nil().is_nil()
+    assert len(PlacementGroupID.of(JobID.from_int(1)).binary()) == 18

@@ -1,0 +1,76 @@
+import pytest
+
+from ray_tpu.core.resources import NodeResources, ResourceSet
+from ray_tpu.core.scheduling import schedule_placement_group, select_node_for_resources
+
+
+def test_fixed_point_no_drift():
+    nr = NodeResources({"CPU": 1.0})
+    req = ResourceSet({"CPU": 0.1})
+    for _ in range(10):
+        nr.acquire(req)
+    assert nr.available.get("CPU") == 0.0
+    for _ in range(10):
+        nr.release(req)
+    assert nr.available.get("CPU") == 1.0
+
+
+def test_subset_and_algebra():
+    a = ResourceSet({"CPU": 2, "TPU": 4})
+    b = ResourceSet({"CPU": 1})
+    assert b.subset_of(a)
+    assert not a.subset_of(b)
+    c = a.subtract(b)
+    assert c.get("CPU") == 1 and c.get("TPU") == 4
+    with pytest.raises(ValueError):
+        b.subtract(a)
+
+
+def make_nodes(*specs):
+    out = {}
+    for i, (total, avail) in enumerate(specs):
+        nr = NodeResources(total)
+        nr.available = ResourceSet(avail)
+        out[f"node{i}"] = {"node_id": f"node{i}", "state": "ALIVE", "resources": nr.to_dict(), "address": f"a:{i}"}
+    return out
+
+
+def test_hybrid_packs_then_spreads():
+    nodes = make_nodes(
+        ({"CPU": 10}, {"CPU": 8}),   # util 0.2
+        ({"CPU": 10}, {"CPU": 10}),  # util 0.0
+    )
+    # Pack: prefer the more-utilized node while under threshold.
+    assert select_node_for_resources(nodes, {"CPU": 1}, {}) == "node0"
+    # Over threshold: spread to least utilized.
+    nodes2 = make_nodes(
+        ({"CPU": 10}, {"CPU": 2}),   # util 0.8
+        ({"CPU": 10}, {"CPU": 9}),   # util 0.1 — above 0.5? no
+    )
+    assert select_node_for_resources(nodes2, {"CPU": 1}, {}) == "node1"
+
+
+def test_infeasible_returns_none():
+    nodes = make_nodes(({"CPU": 2}, {"CPU": 2}))
+    assert select_node_for_resources(nodes, {"TPU": 4}, {}) is None
+
+
+def test_node_affinity():
+    nodes = make_nodes(({"CPU": 4}, {"CPU": 4}), ({"CPU": 4}, {"CPU": 4}))
+    strat = {"type": "node_affinity", "node_id": "node1"}
+    assert select_node_for_resources(nodes, {"CPU": 1}, strat) == "node1"
+    strat_bad = {"type": "node_affinity", "node_id": "nope", "soft": False}
+    assert select_node_for_resources(nodes, {"CPU": 1}, strat_bad) is None
+
+
+def test_pg_strict_spread():
+    nodes = make_nodes(({"CPU": 4}, {"CPU": 4}), ({"CPU": 4}, {"CPU": 4}))
+    placement = schedule_placement_group(nodes, [{"CPU": 2}, {"CPU": 2}], "STRICT_SPREAD")
+    assert placement is not None and placement[0] != placement[1]
+    assert schedule_placement_group(nodes, [{"CPU": 2}] * 3, "STRICT_SPREAD") is None
+
+
+def test_pg_strict_pack():
+    nodes = make_nodes(({"CPU": 4}, {"CPU": 4}), ({"CPU": 8}, {"CPU": 8}))
+    placement = schedule_placement_group(nodes, [{"CPU": 3}, {"CPU": 3}], "STRICT_PACK")
+    assert placement == ["node1", "node1"]
