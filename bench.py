@@ -1,0 +1,83 @@
+"""Headline benchmark: Llama train-step throughput on the local TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+North star (BASELINE.json) is Ray Train tokens/sec/chip on Llama-3 — the
+reference has no TPU number, so this establishes the baseline; vs_baseline
+is reported against the value recorded in BENCH_BASELINE.json if present
+(else 1.0).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    import optax
+
+    from ray_tpu.models import PRESETS, init_params, loss_fn
+    from ray_tpu.parallel import MeshConfig, create_mesh
+    from ray_tpu.parallel.sharding import shard_params
+    from ray_tpu.models import param_axes
+
+    n_dev = len(jax.devices())
+    mesh = create_mesh(MeshConfig(dp=n_dev))
+    cfg = PRESETS["llama3-1b"]
+    batch_per_chip, seq = 8, 2048
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    params = shard_params(params, param_axes(cfg), mesh)
+    opt = optax.adafactor(1e-3)
+    opt_state = jax.jit(opt.init)(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch_per_chip * n_dev, seq), 0, cfg.vocab_size
+    )
+    batch = {"tokens": tokens}
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch, cfg, mesh=mesh)
+        )(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # warmup / compile. NOTE: under the axon tunnel block_until_ready is a
+    # no-op; device_get is the only reliable completion fence, so the loss
+    # scalar is fetched to host to close each timing region.
+    for _ in range(2):
+        params, opt_state, loss = train_step(params, opt_state, batch)
+    float(jax.device_get(loss))
+
+    steps = 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = train_step(params, opt_state, batch)
+    float(jax.device_get(loss))
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec_per_chip = batch_per_chip * seq * steps / dt
+    baseline = None
+    if os.path.exists("BENCH_BASELINE.json"):
+        try:
+            baseline = json.load(open("BENCH_BASELINE.json")).get("value")
+        except Exception:
+            baseline = None
+    vs = tokens_per_sec_per_chip / baseline if baseline else 1.0
+    print(json.dumps({
+        "metric": "train_tokens_per_sec_per_chip_llama3_1b",
+        "value": round(tokens_per_sec_per_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""Model zoo. Flagship: Llama-3-family decoder built TPU-first — scanned
+layers, bf16 params with f32 statistics, logical-axis shardings from
+``ray_tpu.parallel``, Pallas flash attention / ring attention."""
+
+from .llama import (
+    LlamaConfig,
+    PRESETS,
+    init_params,
+    forward,
+    loss_fn,
+    param_axes,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "PRESETS",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "param_axes",
+]

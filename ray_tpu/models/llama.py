@@ -1,0 +1,240 @@
+"""Llama-3-family decoder, TPU-first.
+
+Design choices (vs. a torch port):
+- Layers are **stacked and scanned** (`lax.scan`): one compiled block body
+  regardless of depth; `jax.checkpoint` on the block body trades FLOPs for
+  HBM (rematerialisation).
+- Params are a plain pytree of jnp arrays; ``param_axes(config)`` returns a
+  matching tree of logical-axis tuples consumed by
+  ``ray_tpu.parallel.sharding`` — strategy changes never touch this file.
+- Attention is the Pallas flash kernel (``ray_tpu.ops.flash_attention``)
+  or ring attention over the ``sp`` mesh axis for long context.
+- bf16 params/activations, f32 softmax/norm statistics and loss.
+
+The reference has no model code of its own (models live in torch/vLLM
+behind Train/Serve); this supplies the TPU-native equivalent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from ..ops import flash_attention, mha_reference, ring_attention, rms_norm, apply_rope
+from ..parallel.sharding import shard_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    hidden: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    intermediate: int = 14_336
+    head_dim: int = 128
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    # attention implementation: "flash" | "ring" | "reference"
+    attn_impl: str = "flash"
+    remat: bool = True
+
+
+PRESETS: dict[str, LlamaConfig] = {
+    # llama-3-8b: the BASELINE.md north-star model
+    "llama3-8b": LlamaConfig(),
+    "llama3-1b": LlamaConfig(hidden=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+                             intermediate=8192, head_dim=64),
+    # tiny configs for tests / dryruns
+    "debug": LlamaConfig(vocab_size=256, hidden=64, n_layers=2, n_heads=4,
+                         n_kv_heads=2, intermediate=128, head_dim=16),
+    "debug-128": LlamaConfig(vocab_size=512, hidden=128, n_layers=2, n_heads=4,
+                             n_kv_heads=2, intermediate=256, head_dim=32),
+}
+
+
+def param_axes(config: LlamaConfig):
+    """Tree of logical-axis tuples matching ``init_params`` output."""
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "norm"),
+            "wq": ("layers", "embed", "heads", "head_dim"),
+            "wk": ("layers", "embed", "kv_heads", "head_dim"),
+            "wv": ("layers", "embed", "kv_heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+            "mlp_norm": ("layers", "norm"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("norm",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(config: LlamaConfig, key: jax.Array) -> dict:
+    """Random init (truncated-normal fan-in scaling), stacked over layers."""
+    c = config
+    keys = jax.random.split(key, 9)
+    L, H, E = c.n_layers, c.n_heads, c.hidden
+    KH, D, M = c.n_kv_heads, c.head_dim, c.intermediate
+
+    def norm_init(k, shape, fan_in):
+        return (jax.random.truncated_normal(k, -2, 2, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(c.dtype)
+
+    return {
+        "embed": norm_init(keys[0], (c.vocab_size, E), E),
+        "layers": {
+            "attn_norm": jnp.ones((L, E), c.dtype),
+            "wq": norm_init(keys[1], (L, E, H, D), E),
+            "wk": norm_init(keys[2], (L, E, KH, D), E),
+            "wv": norm_init(keys[3], (L, E, KH, D), E),
+            "wo": norm_init(keys[4], (L, H, D, E), H * D),
+            "mlp_norm": jnp.ones((L, E), c.dtype),
+            "w_gate": norm_init(keys[5], (L, E, M), E),
+            "w_up": norm_init(keys[6], (L, E, M), E),
+            "w_down": norm_init(keys[7], (L, M, E), M),
+        },
+        "final_norm": jnp.ones((E,), c.dtype),
+        "lm_head": norm_init(keys[8], (E, c.vocab_size), E),
+    }
+
+
+def _attention(q, k, v, config: LlamaConfig, mesh: Mesh | None):
+    if config.attn_impl == "ring" and mesh is not None and mesh.shape["sp"] > 1:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        spec = P(("dp", "fsdp"), "tp", "sp", None)
+        fn = shard_map(
+            functools.partial(ring_attention, axis="sp", causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+    if config.attn_impl == "reference":
+        return mha_reference(q, k, v, causal=True)
+    return flash_attention(q, k, v, causal=True)
+
+
+def _block(x, layer, positions, config: LlamaConfig, mesh: Mesh | None):
+    """One decoder block. x: [B, S, E] in config.dtype."""
+    c = config
+
+    def sc(t, axes):
+        return shard_constraint(t, mesh, axes) if mesh is not None else t
+
+    h = rms_norm(x, layer["attn_norm"], eps=c.norm_eps)
+    q = jnp.einsum("bse,ehd->bhsd", h, layer["wq"])
+    k = jnp.einsum("bse,ehd->bhsd", h, layer["wk"])
+    v = jnp.einsum("bse,ehd->bhsd", h, layer["wv"])
+    q = apply_rope(q, positions, theta=c.rope_theta)
+    k = apply_rope(k, positions, theta=c.rope_theta)
+    q = sc(q, ("batch", "heads", "seq", "head_dim"))
+    attn = _attention(q, k, v, c, mesh)
+    attn_out = jnp.einsum("bhsd,hde->bse", attn, layer["wo"])
+    x = x + sc(attn_out, ("batch", "seq", "embed_act"))
+
+    h = rms_norm(x, layer["mlp_norm"], eps=c.norm_eps)
+    gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"])
+    up = jnp.einsum("bse,em->bsm", h, layer["w_up"])
+    ff = jax.nn.silu(gate.astype(jnp.float32)).astype(c.dtype) * up
+    ff = sc(ff, ("batch", "seq", "mlp"))
+    down = jnp.einsum("bsm,me->bse", ff, layer["w_down"])
+    return x + sc(down, ("batch", "seq", "embed_act"))
+
+
+def forward_hidden(params, tokens, config: LlamaConfig, *, mesh: Mesh | None = None):
+    """tokens [B, S] int32 -> final hidden states [B, S, E] in config.dtype."""
+    c = config
+    b, s = tokens.shape
+    positions = jnp.arange(s, dtype=jnp.int32)
+    x = params["embed"][tokens].astype(c.dtype)
+    if mesh is not None:
+        x = shard_constraint(x, mesh, ("batch", "seq", "embed_act"))
+
+    block = functools.partial(_block, positions=positions, config=c, mesh=mesh)
+    if c.remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(carry, layer):
+        return block(carry, layer), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    return rms_norm(x, params["final_norm"], eps=c.norm_eps)
+
+
+def forward(params, tokens, config: LlamaConfig, *, mesh: Mesh | None = None):
+    """tokens [B, S] int32 -> logits [B, S, vocab] f32. For inference/tests;
+    training uses ``loss_fn`` which never materializes full logits."""
+    x = forward_hidden(params, tokens, config, mesh=mesh)
+    logits = jnp.einsum("bse,ev->bsv", x, params["lm_head"])
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(
+    params,
+    batch,
+    config: LlamaConfig,
+    *,
+    mesh: Mesh | None = None,
+    chunk_tokens: int = 512,
+):
+    """Next-token cross entropy. batch: {"tokens": [B,S], "mask": [B,S]}.
+
+    The lm_head matmul is fused into a rematerialized scan over token
+    chunks so the [B,S,vocab] logits tensor never exists in HBM — at 128k
+    vocab that tensor alone would OOM a v5e chip at batch 8 × 2048.
+    """
+    tokens = batch["tokens"]
+    hidden = forward_hidden(params, tokens, config, mesh=mesh)
+    targets = tokens[:, 1:]
+    hidden = hidden[:, :-1]
+    mask = batch.get("mask")
+    mask = (jnp.ones_like(targets, jnp.float32) if mask is None
+            else mask[:, 1:].astype(jnp.float32))
+
+    b, s, e = hidden.shape
+    n = b * s
+    flat_h = hidden.reshape(n, e)
+    flat_t = targets.reshape(n)
+    flat_m = mask.reshape(n)
+    chunk = min(chunk_tokens, n)
+    if n % chunk:
+        pad = chunk - n % chunk
+        flat_h = jnp.pad(flat_h, ((0, pad), (0, 0)))
+        flat_t = jnp.pad(flat_t, (0, pad))
+        flat_m = jnp.pad(flat_m, (0, pad))
+        n += pad
+    nc = n // chunk
+    lm_head = params["lm_head"]
+
+    @jax.checkpoint
+    def chunk_loss(xs):
+        h, t, m = xs
+        logits = jnp.einsum(
+            "ce,ev->cv", h, lm_head, preferred_element_type=jnp.float32
+        )
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        ll = jnp.take_along_axis(logits, t[:, None], axis=-1)[:, 0] - lse
+        return (ll * m).sum()
+
+    def body(carry, xs):
+        return carry + chunk_loss(xs), None
+
+    total, _ = lax.scan(
+        body,
+        jnp.zeros((), jnp.float32),
+        (flat_h.reshape(nc, chunk, e), flat_t.reshape(nc, chunk),
+         flat_m.reshape(nc, chunk)),
+    )
+    return -total / jnp.maximum(flat_m.sum(), 1.0)

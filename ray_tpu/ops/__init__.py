@@ -1,0 +1,20 @@
+"""TPU compute ops: Pallas kernels and the JAX ops the models are built on.
+
+The hot paths (attention) are Pallas TPU kernels; everything elementwise
+is left to XLA fusion. Sequence/context parallelism (ring attention) is
+green-field — the reference has none (SURVEY.md §5.7).
+"""
+
+from .attention import flash_attention, mha_reference
+from .ring_attention import ring_attention
+from .norms import rms_norm
+from .rope import apply_rope, rope_frequencies
+
+__all__ = [
+    "flash_attention",
+    "mha_reference",
+    "ring_attention",
+    "rms_norm",
+    "apply_rope",
+    "rope_frequencies",
+]

@@ -1,0 +1,16 @@
+"""Normalization ops. Plain jnp — XLA fuses these into neighbors on TPU;
+a hand-written kernel would only duplicate that fusion."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, *, eps: float = 1e-6):
+    """Llama-style RMSNorm, f32 statistics regardless of input dtype."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * weight.astype(jnp.float32)).astype(dtype)

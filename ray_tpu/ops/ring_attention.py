@@ -1,0 +1,88 @@
+"""Ring attention: exact attention over a sequence sharded across devices.
+
+Green-field — the reference has no sequence/context parallelism at all
+(SURVEY.md §5.7); long context is delegated to vLLM. Here it is a
+first-class op: each device holds a contiguous sequence chunk of Q/K/V;
+KV chunks rotate around the ``sp`` ring via ``lax.ppermute`` while each
+device folds every chunk into an online-softmax accumulator. Compute on
+chunk t overlaps the transfer of chunk t+1 (XLA schedules the ppermute
+DMA concurrently with the einsums on ICI).
+
+Call inside ``shard_map`` with the sequence axis mapped to ``sp``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    *,
+    axis: str = "sp",
+    causal: bool = True,
+    sm_scale: float | None = None,
+):
+    """q [B,Hq,Sl,D], k/v [B,Hkv,Sl,D] — Sl is the per-device chunk; devices
+    hold chunks in ring order. Returns the local output chunk [B,Hq,Sl,D].
+    """
+    n = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    b, hq, sl, d = q.shape
+    hkv = k.shape[1]
+    g = hq // hkv
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    # GQA via a grouped head axis: K/V stay at hkv heads, so each ring hop
+    # ships 1/g of the bytes a repeat-to-hq layout would
+    qf = (q.astype(jnp.float32) * scale).reshape(b, hkv, g, sl, d)
+
+    q_pos = my * sl + jnp.arange(sl)  # global positions of local q rows
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def attend(t, kc, vc, acc, m, l):
+        src = (my - t) % n  # which global chunk this kv block is
+        s = jnp.einsum(
+            "bhgqd,bhkd->bhgqk", qf, kc.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            k_pos = src * sl + jnp.arange(sl)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None, None], s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_cur)
+        # fully-masked rows keep m_new == NEG_INF: exp underflows to 0
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhgqk,bhkd->bhgqd", p, vc.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
+
+    def step(t, carry):
+        kc, vc, acc, m, l = carry
+        acc, m, l = attend(t, kc, vc, acc, m, l)
+        kc = lax.ppermute(kc, axis, perm=perm)
+        vc = lax.ppermute(vc, axis, perm=perm)
+        return kc, vc, acc, m, l
+
+    acc0 = jnp.zeros((b, hkv, g, sl, d), jnp.float32)
+    m0 = jnp.full((b, hkv, g, sl), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, g, sl), jnp.float32)
+    # rotate n-1 times; the final chunk attends without a dead last ppermute
+    kc, vc, acc, m, l = lax.fori_loop(0, n - 1, step, (k, v, acc0, m0, l0))
+    acc, m, l = attend(n - 1, kc, vc, acc, m, l)
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    return out.reshape(b, hq, sl, d).astype(q.dtype)

@@ -1,0 +1,53 @@
+"""Parallelism layer: device meshes, logical-axis sharding, collectives.
+
+This is the TPU-native replacement for the reference's NCCL/Gloo stack
+(``python/ray/util/collective/collective.py:123-625``) and the parallelism
+strategies it delegates to vLLM/torch (SURVEY.md §2.5). Instead of
+user-space collectives, tensor communication is compiled into XLA programs:
+the framework's job is to pick a ``jax.sharding.Mesh``, annotate arrays
+with logical-axis shardings, and let XLA insert ICI/DCN collectives.
+"""
+
+from .mesh import (
+    MeshConfig,
+    create_mesh,
+    local_mesh,
+    mesh_shape_for,
+)
+from .sharding import (
+    LogicalAxisRules,
+    DEFAULT_RULES,
+    logical_sharding,
+    shard_constraint,
+    shard_params,
+    unshard,
+)
+from .collectives import (
+    all_gather,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    ppermute,
+    reduce_scatter,
+)
+
+__all__ = [
+    "MeshConfig",
+    "create_mesh",
+    "local_mesh",
+    "mesh_shape_for",
+    "LogicalAxisRules",
+    "DEFAULT_RULES",
+    "logical_sharding",
+    "shard_constraint",
+    "shard_params",
+    "unshard",
+    "all_gather",
+    "all_reduce",
+    "all_to_all",
+    "barrier",
+    "broadcast",
+    "ppermute",
+    "reduce_scatter",
+]

@@ -1,0 +1,69 @@
+"""Collective ops for use inside ``shard_map`` program bodies.
+
+API-compatible surface with the reference's ``ray.util.collective``
+(``collective.py:268-625`` — allreduce/allgather/reducescatter/broadcast/
+send/recv) but compiled into the XLA program over ICI rather than issued
+to NCCL at runtime. Each function takes the mesh axis name instead of a
+process group.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def all_reduce(x, axis: str = "dp", op: str = "sum"):
+    """Reference: collective.py:268 (allreduce)."""
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "mean":
+        return lax.pmean(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    raise ValueError(f"unsupported reduce op {op!r}")
+
+
+def all_gather(x, axis: str, *, tiled: bool = True, gather_dim: int = 0):
+    """Reference: collective.py:433 (allgather)."""
+    return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str, *, scatter_dim: int = 0):
+    """Reference: collective.py:482 (reducescatter)."""
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
+
+
+def broadcast(x, axis: str, root: int = 0):
+    """Reference: collective.py:383 — root's shard replicated to all."""
+    full = lax.all_gather(x, axis, axis=0, tiled=False)
+    return full[root]
+
+
+def all_to_all(x, axis: str, *, split_dim: int, concat_dim: int):
+    """Ulysses-style sequence<->head reshuffle primitive."""
+    return lax.all_to_all(
+        x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=True
+    )
+
+
+def ppermute(x, axis: str, *, shift: int = 1):
+    """Ring shift: device i sends to (i+shift) mod n. The building block of
+    ring attention (SURVEY.md §5.7)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm=perm)
+
+
+def barrier(axis: str):
+    """Synchronize all devices on an axis (psum of a unit scalar)."""
+    return lax.psum(jnp.ones((), jnp.int32), axis)
+
+
+def send_recv(x, axis: str, pairs: list[tuple[int, int]]):
+    """Point-to-point via ppermute perm list. Reference: collective.py:541/604
+    (send/recv) — in XLA both sides are one collective permute."""
+    return lax.ppermute(x, axis, perm=pairs)

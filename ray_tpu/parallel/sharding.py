@@ -1,0 +1,100 @@
+"""Logical-axis sharding rules.
+
+Model code names array dimensions with *logical* axes ("batch", "embed",
+"heads", ...); a rule table maps each logical axis to zero or more mesh
+axes. Changing the parallelism strategy = changing the table, not the
+model. (Same design as t5x/flax partitioning — the idiomatic JAX way to
+express what the reference delegates to torch DDP/FSDP/vLLM.)
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis -> mesh axis (or tuple of mesh axes, or None = replicate)
+LogicalAxisRules = dict[str, object]
+
+DEFAULT_RULES: LogicalAxisRules = {
+    # activations
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed_act": None,
+    # params: fsdp shards the embed dim (ZeRO-3); tp shards heads/mlp/vocab
+    "embed": "fsdp",
+    "heads": "tp",
+    "kv_heads": "tp",
+    "head_dim": None,
+    "mlp": "tp",
+    "vocab": "tp",
+    "layers": "pp",
+    "experts": "ep",
+    "kv_seq": "sp",
+    "norm": None,
+}
+
+
+def spec_for(logical_axes: tuple[str | None, ...], rules: LogicalAxisRules) -> P:
+    """Translate a tuple of logical axis names into a PartitionSpec."""
+    mesh_axes = []
+    used: set[str] = set()
+    for name in logical_axes:
+        axis = rules.get(name) if name else None
+        # a mesh axis may appear only once in a spec; later repeats replicate
+        if isinstance(axis, tuple):
+            axis = tuple(a for a in axis if a not in used) or None
+            if isinstance(axis, tuple) and len(axis) == 1:
+                axis = axis[0]
+        if isinstance(axis, str) and axis in used:
+            axis = None
+        if axis is None:
+            mesh_axes.append(None)
+        else:
+            for a in axis if isinstance(axis, tuple) else (axis,):
+                used.add(a)
+            mesh_axes.append(axis)
+    while mesh_axes and mesh_axes[-1] is None:
+        mesh_axes.pop()
+    return P(*mesh_axes)
+
+
+def logical_sharding(
+    mesh: Mesh,
+    logical_axes: tuple[str | None, ...],
+    rules: LogicalAxisRules | None = None,
+) -> NamedSharding:
+    """NamedSharding for an array whose dims carry the given logical axes."""
+    return NamedSharding(mesh, spec_for(logical_axes, rules or DEFAULT_RULES))
+
+
+def shard_constraint(x, mesh: Mesh, logical_axes, rules=None):
+    """``with_sharding_constraint`` by logical axes — use inside jit."""
+    return jax.lax.with_sharding_constraint(
+        x, logical_sharding(mesh, tuple(logical_axes), rules)
+    )
+
+
+def shard_params(params, axes_tree, mesh: Mesh, rules=None):
+    """Device-put a param pytree according to a matching tree of logical-axes
+    tuples. ``axes_tree`` must have the same structure as ``params``."""
+    shardings = jax.tree.map(
+        lambda axes: logical_sharding(mesh, tuple(axes), rules),
+        axes_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+    return jax.device_put(params, shardings)
+
+
+def sharding_tree(axes_tree, mesh: Mesh, rules=None):
+    """Tree of NamedShardings from a tree of logical-axes tuples (for use as
+    jit in_shardings/out_shardings)."""
+    return jax.tree.map(
+        lambda axes: logical_sharding(mesh, tuple(axes), rules),
+        axes_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def unshard(x):
+    """Gather a (possibly sharded) array fully onto the host."""
+    return jax.device_get(x)

@@ -7,11 +7,20 @@ TPU sharding tests run on a virtual 8-device CPU mesh
 
 import os
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+# The container's sitecustomize force-registers the TPU PJRT plugin and wins
+# over JAX_PLATFORMS=cpu in the env, so pin the platform via jax.config
+# (effective because no backend has initialized yet at conftest import time).
+if os.environ.get("RAY_TPU_TEST_ON_TPU") != "1":
+    # assignment (not setdefault): spawned ray workers inherit this env and
+    # must not grab the real TPU during the CPU suite
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
