@@ -273,6 +273,23 @@ class Raylet:
         request = ResourceSet(self._lease_resources(spec))
         grant_only_local = bool(p.get("grant_only_local") or p.get("dedicated"))
 
+        # Placement-group tasks run on the node holding their bundle: local
+        # if the bundle is committed here, otherwise spill straight to the
+        # bundle's node (GcsPlacementGroupScheduler keeps the locations).
+        pg_id = spec.get("placement_group_id") or b""
+        if pg_id:
+            pg_hex = pg_id.hex() if isinstance(pg_id, bytes) else pg_id
+            idx = spec.get("placement_group_bundle_index", -1)
+            if not self._has_local_bundle(pg_hex, idx):
+                target = await self._pg_bundle_node(pg_hex, idx)
+                if target is None:
+                    return {"granted": False, "reason": f"placement group {pg_hex} not created"}
+                if target != self.node_id.hex():
+                    node = self._node_table.get(target)
+                    if node is None:
+                        return {"granted": False, "reason": "bundle node lost"}
+                    return {"spillback": True, "node_address": node["address"], "node_id": target}
+
         if not request.subset_of(self.resources.total):
             if grant_only_local:
                 return {"granted": False, "reason": "infeasible on this node"}
@@ -320,6 +337,27 @@ class Raylet:
             "worker_address": worker.address,
             "node_id": self.node_id.hex(),
         }
+
+    def _has_local_bundle(self, pg_hex: str, idx: int) -> bool:
+        if idx >= 0:
+            b = self._pg_bundles.get((pg_hex, idx))
+            return bool(b and b.get("committed"))
+        return any(
+            k[0] == pg_hex and b.get("committed") for k, b in self._pg_bundles.items()
+        )
+
+    async def _pg_bundle_node(self, pg_hex: str, idx: int) -> str | None:
+        try:
+            reply = await self._gcs.call("GetPlacementGroup", {"pg_id": pg_hex}, timeout=5.0)
+        except Exception:
+            return None
+        pg = reply.get("pg") or {}
+        locations = pg.get("bundle_locations") or []
+        if not locations:
+            return None
+        if idx >= 0:
+            return locations[idx] if idx < len(locations) else None
+        return locations[0]
 
     def _lease_resources(self, spec: dict) -> dict:
         res = dict(spec.get("resources") or {})

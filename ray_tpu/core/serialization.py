@@ -66,7 +66,11 @@ class _Pickler(cloudpickle.Pickler):
         if _object_ref_class is not None and type(obj) is _object_ref_class:
             record_contained_ref(obj)
             return _object_ref_reducer(obj)
-        return NotImplemented
+        # Delegate to cloudpickle's own reducer_override — it is how
+        # closures/lambdas/local classes get pickled by value; returning
+        # NotImplemented here would silently fall back to stock pickle's
+        # by-reference handling, which breaks on any <locals> object.
+        return super().reducer_override(obj)
 
 
 def serialize(value: Any) -> tuple[bytes, bytes, list]:

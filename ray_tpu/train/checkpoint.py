@@ -1,0 +1,119 @@
+"""Checkpoints: directory handles + top-K retention.
+
+Reference: ``python/ray/train/_checkpoint.py:56`` (Checkpoint),
+``_internal/checkpoint_manager.py`` (top-K by metric). JAX pytrees are
+saved with orbax when available (``save_pytree``/``load_pytree``), plain
+directories otherwise.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import heapq
+import json
+import os
+import shutil
+import tempfile
+import uuid
+
+
+class Checkpoint:
+    """A handle to a checkpoint directory. Reference: _checkpoint.py:56."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, dest: str | None = None) -> str:
+        dest = dest or os.path.join(tempfile.gettempdir(), f"ckpt_{uuid.uuid4().hex[:8]}")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextlib.contextmanager
+    def as_directory(self):
+        yield self.path
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+
+def save_pytree(tree, path: str, *, name: str = "state") -> None:
+    """Save a JAX pytree under ``path/name`` (orbax if present)."""
+    os.makedirs(path, exist_ok=True)
+    target = os.path.join(path, name)
+    try:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(target, tree, force=True)
+        ckptr.wait_until_finished()
+    except ModuleNotFoundError:
+        import pickle
+
+        import jax
+
+        with open(target + ".pkl", "wb") as f:
+            pickle.dump(jax.device_get(tree), f)
+
+
+def load_pytree(path: str, *, name: str = "state", like=None):
+    """Load a pytree saved by ``save_pytree``. ``like`` restores sharding/
+    dtype structure under orbax."""
+    target = os.path.join(path, name)
+    if os.path.isdir(target):
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        if like is not None:
+            return ckptr.restore(target, like)
+        return ckptr.restore(target)
+    import pickle
+
+    with open(target + ".pkl", "rb") as f:
+        return pickle.load(f)
+
+
+class CheckpointManager:
+    """Keeps the top-K reported checkpoints by a score attribute."""
+
+    def __init__(self, config) -> None:
+        self._config = config
+        self._entries: list[tuple[float, int, Checkpoint]] = []  # (score, seq, ckpt)
+        self._seq = 0
+        self.latest: Checkpoint | None = None
+
+    def register(self, checkpoint: Checkpoint, metrics: dict) -> None:
+        self.latest = checkpoint
+        attr = self._config.checkpoint_score_attribute
+        keep = self._config.num_to_keep
+        score = 0.0
+        if attr is not None and attr in (metrics or {}):
+            score = float(metrics[attr])
+            if self._config.checkpoint_score_order == "min":
+                score = -score
+        self._seq += 1
+        heapq.heappush(self._entries, (score, self._seq, checkpoint))
+        meta = {"metrics": metrics or {}}
+        try:
+            with open(os.path.join(checkpoint.path, ".metrics.json"), "w") as f:
+                json.dump(meta, f, default=str)
+        except OSError:
+            pass
+        if keep is not None:
+            while len(self._entries) > keep:
+                _, _, evicted = heapq.heappop(self._entries)
+                if evicted.path != checkpoint.path:
+                    shutil.rmtree(evicted.path, ignore_errors=True)
+
+    @property
+    def best(self) -> Checkpoint | None:
+        if not self._entries:
+            return self.latest
+        return max(self._entries)[2]

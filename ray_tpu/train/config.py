@@ -1,0 +1,78 @@
+"""Train configuration objects.
+
+Reference: AIR ``python/ray/air/config.py`` (ScalingConfig:103,
+FailureConfig:398, CheckpointConfig:448, RunConfig:597). TPU delta: a
+worker is a *host* of a TPU slice, not a GPU; ``topology`` names the slice
+type and the whole slice is the atomic scheduling/failure unit
+(SURVEY.md §7.1/§7.3-4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers and what each one holds.
+
+    num_workers: SPMD processes (one per TPU host in a real slice).
+    use_tpu: request TPU chip resources for each worker.
+    topology: TPU slice type (e.g. "v5litepod-16"); when set, the worker
+      group claims the matching ``TPU-{topology}-head`` resource so a slice
+      is scheduled atomically (reference scheme: accelerators/tpu.py:70-192).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: dict | None = None
+    topology: str | None = None
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> dict:
+        res = dict(self.resources_per_worker or {})
+        if not res:
+            res = {"CPU": 1.0}
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = 1.0
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """max_failures: group-level restarts; -1 = unlimited. The whole worker
+    group (slice) restarts together — per-worker restart is meaningless
+    under SPMD (a dead host invalidates every peer's collectives)."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: int | None = None
+    checkpoint_score_attribute: str | None = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: str | None = None
+    storage_path: str | None = None
+    checkpoint_config: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+    failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+
+
+@dataclasses.dataclass
+class Result:
+    """What ``fit()`` returns. Reference: ``ray/air/result.py``."""
+
+    metrics: dict[str, Any] | None
+    checkpoint: Any | None
+    path: str | None
+    error: Exception | None = None
+    metrics_history: list[dict] = dataclasses.field(default_factory=list)
+
+    @property
+    def best_checkpoints(self) -> list:
+        return [self.checkpoint] if self.checkpoint else []

@@ -1,0 +1,144 @@
+"""TrainController: the driver-side control loop.
+
+Reference: ``python/ray/train/v2/_internal/execution/controller/
+controller.py:91`` (run:453, loop:430) with pluggable ScalingPolicy
+(``execution/scaling_policy/``) and FailurePolicy
+(``execution/failure_handling/``). TPU delta (SURVEY.md §7.3-4): the
+worker group (slice) is the atomic failure unit — any worker failure
+tears the whole group down and restarts it from the latest checkpoint.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from .checkpoint import Checkpoint, CheckpointManager
+from .config import Result, RunConfig, ScalingConfig
+from .worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+class FixedScalingPolicy:
+    """Reference: execution/scaling_policy/fixed.py."""
+
+    def __init__(self, scaling_config: ScalingConfig):
+        self._config = scaling_config
+
+    def group_size(self, attempt: int) -> int:
+        return self._config.num_workers
+
+
+class MaxFailurePolicy:
+    """Restart the whole group up to max_failures times (-1 = unlimited)."""
+
+    def __init__(self, max_failures: int):
+        self._max = max_failures
+        self.failures = 0
+
+    def should_restart(self) -> bool:
+        self.failures += 1
+        return self._max == -1 or self.failures <= self._max
+
+
+class WorkerGroupError(RuntimeError):
+    pass
+
+
+class TrainController:
+    def __init__(
+        self,
+        train_fn,
+        *,
+        train_loop_config: dict | None,
+        scaling_config: ScalingConfig,
+        run_config: RunConfig,
+        resume_from_checkpoint: Checkpoint | None = None,
+        poll_interval_s: float = 0.2,
+    ):
+        self._train_fn = train_fn
+        self._config = train_loop_config or {}
+        self._scaling = scaling_config
+        self._run_config = run_config
+        self._scaling_policy = FixedScalingPolicy(scaling_config)
+        self._failure_policy = MaxFailurePolicy(run_config.failure_config.max_failures)
+        self._ckpt_manager = CheckpointManager(run_config.checkpoint_config)
+        self._resume = resume_from_checkpoint
+        self._poll_interval = poll_interval_s
+        self._metrics_history: list[dict] = []
+
+    def run(self) -> Result:
+        import os
+
+        name = self._run_config.name or f"train_{int(time.time())}"
+        storage = self._run_config.storage_path or "/tmp/ray_tpu/results"
+        run_dir = os.path.join(storage, name)
+        os.makedirs(run_dir, exist_ok=True)
+
+        last_error: Exception | None = None
+        while True:
+            group = WorkerGroup.create(self._scaling, name, run_dir)
+            try:
+                self._run_attempt(group)
+                break
+            except WorkerGroupError as e:
+                last_error = e
+                if self._failure_policy.should_restart():
+                    resume = self._ckpt_manager.latest or self._resume
+                    logger.warning(
+                        "Worker group failed (attempt %d); restarting whole "
+                        "group from %s: %s",
+                        self._failure_policy.failures, resume, e,
+                    )
+                    continue
+                return Result(
+                    metrics=self._metrics_history[-1] if self._metrics_history else None,
+                    checkpoint=self._ckpt_manager.best,
+                    path=run_dir,
+                    error=last_error,
+                    metrics_history=self._metrics_history,
+                )
+            finally:
+                group.shutdown()
+
+        return Result(
+            metrics=self._metrics_history[-1] if self._metrics_history else None,
+            checkpoint=self._ckpt_manager.best,
+            path=run_dir,
+            error=None,
+            metrics_history=self._metrics_history,
+        )
+
+    # ------------------------------------------------------------------
+    def _run_attempt(self, group: WorkerGroup) -> None:
+        resume = self._ckpt_manager.latest or self._resume
+        resume_path = resume.path if resume else None
+        try:
+            group.run_on_all("run_train_fn", self._train_fn, self._config, resume_path)
+        except Exception as e:
+            raise WorkerGroupError(f"failed to start train_fn: {e}") from e
+
+        while True:
+            try:
+                polls = group.poll()
+            except Exception as e:
+                raise WorkerGroupError(f"lost contact with worker group: {e}") from e
+            self._ingest(polls)
+            for i, p in enumerate(polls):
+                if p.get("error"):
+                    raise WorkerGroupError(f"worker {i} failed:\n{p['error']}")
+            if all(p.get("done") for p in polls):
+                return
+            time.sleep(self._poll_interval)
+
+    def _ingest(self, polls: list[dict]) -> None:
+        for p in polls:
+            for entry in p.get("reports", []):
+                if entry["rank"] == 0:
+                    metrics = entry["metrics"]
+                    self._metrics_history.append(metrics)
+                    if "checkpoint_path" in entry:
+                        self._ckpt_manager.register(
+                            Checkpoint(entry["checkpoint_path"]), metrics
+                        )

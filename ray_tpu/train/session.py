@@ -1,0 +1,109 @@
+"""Worker-side training session: ``report()`` and ``get_context()``.
+
+Reference: ``python/ray/train/_internal/session.py:112,405,672``
+(_TrainSession.report) and v2 ``train_fn_utils.py:13``. The session lives
+inside each TrainWorker actor process; ``report`` enqueues metrics (and an
+optional checkpoint directory) for the controller's next poll.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import threading
+import uuid
+from typing import Any
+
+from .checkpoint import Checkpoint
+
+
+@dataclasses.dataclass
+class TrainContext:
+    world_rank: int
+    world_size: int
+    local_rank: int
+    local_world_size: int
+    node_rank: int
+    experiment_name: str
+    storage_path: str
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_local_world_size(self) -> int:
+        return self.local_world_size
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+
+class _Session:
+    def __init__(self, context: TrainContext, resume_checkpoint: Checkpoint | None):
+        self.context = context
+        self.resume_checkpoint = resume_checkpoint
+        self._lock = threading.Lock()
+        self._reports: list[dict] = []
+        self._step = 0
+
+    def report(self, metrics: dict, checkpoint: Checkpoint | None = None) -> None:
+        entry: dict[str, Any] = {"metrics": dict(metrics or {}), "rank": self.context.world_rank}
+        if checkpoint is not None:
+            # persist into run storage so it outlives the worker's tmpdir
+            dest = os.path.join(
+                self.context.storage_path,
+                f"checkpoint_{self._step:06d}_{uuid.uuid4().hex[:6]}",
+            )
+            if os.path.abspath(checkpoint.path) != dest:
+                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+            entry["checkpoint_path"] = dest
+        self._step += 1
+        with self._lock:
+            self._reports.append(entry)
+
+    def drain(self) -> list[dict]:
+        with self._lock:
+            out, self._reports = self._reports, []
+        return out
+
+
+_session: _Session | None = None
+
+
+def _set_session(s: _Session | None) -> None:
+    global _session
+    _session = s
+
+
+def _get_session() -> _Session:
+    if _session is None:
+        raise RuntimeError(
+            "No training session active — report()/get_context() are only "
+            "valid inside a train_fn launched by a Trainer"
+        )
+    return _session
+
+
+def report(metrics: dict, checkpoint: Checkpoint | None = None) -> None:
+    """Report metrics (+ optional checkpoint) from the train loop.
+    Reference: v2/api/train_fn_utils.py:13."""
+    _get_session().report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    """Reference: ray.train.get_context()."""
+    return _get_session().context
+
+
+def get_checkpoint() -> Checkpoint | None:
+    """Checkpoint to resume from, if the controller restored one."""
+    return _get_session().resume_checkpoint

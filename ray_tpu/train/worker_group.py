@@ -1,0 +1,142 @@
+"""Worker group: N SPMD worker actors placed as one atomic unit.
+
+Reference: ``python/ray/train/v2/_internal/execution/worker_group/
+worker_group.py:102`` and v1 ``backend_executor.py:226`` (placement
+group creation). TPU delta (SURVEY.md §7.1): each worker is one host of a
+slice; the group is scheduled with a placement group so the slice is
+claimed atomically, and ``jax.distributed.initialize`` is the process-
+group bootstrap (the reference's ``_setup_torch_process_group``,
+``torch/config.py:66``, is the analogous step).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import traceback
+
+from ..core import api as ray
+from ..util import PlacementGroupSchedulingStrategy, placement_group, remove_placement_group
+from .checkpoint import Checkpoint
+from .session import TrainContext, _Session, _set_session
+
+logger = logging.getLogger(__name__)
+
+
+class TrainWorker:
+    """Actor hosting one SPMD process of the training job."""
+
+    def __init__(self, world_rank: int, world_size: int, experiment_name: str,
+                 storage_path: str, coordinator: str | None = None):
+        self._context = TrainContext(
+            world_rank=world_rank,
+            world_size=world_size,
+            local_rank=0,
+            local_world_size=1,
+            node_rank=world_rank,
+            experiment_name=experiment_name,
+            storage_path=storage_path,
+        )
+        self._coordinator = coordinator
+        self._thread: threading.Thread | None = None
+        self._session: _Session | None = None
+        self._error: str | None = None
+        self._done = False
+
+    def init_distributed(self) -> bool:
+        """``jax.distributed.initialize`` across the group — multi-host
+        slices only (single-host groups share one process's devices)."""
+        if self._coordinator is None:
+            return False
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=self._coordinator,
+            num_processes=self._context.world_size,
+            process_id=self._context.world_rank,
+        )
+        return True
+
+    def run_train_fn(self, train_fn, config: dict, resume_path: str | None) -> bool:
+        resume = Checkpoint(resume_path) if resume_path else None
+        self._session = _Session(self._context, resume)
+        self._error = None
+        self._done = False
+
+        def runner():
+            _set_session(self._session)
+            try:
+                train_fn(config)
+            except BaseException:
+                self._error = traceback.format_exc()
+            finally:
+                self._done = True
+                _set_session(None)
+
+        self._thread = threading.Thread(target=runner, daemon=True)
+        self._thread.start()
+        return True
+
+    def poll(self) -> dict:
+        reports = self._session.drain() if self._session else []
+        return {"reports": reports, "done": self._done, "error": self._error}
+
+    def shutdown(self) -> bool:
+        return True
+
+
+class WorkerGroup:
+    """Creates, polls and tears down the worker actors as one unit."""
+
+    def __init__(self, workers, pg):
+        self.workers = workers
+        self._pg = pg
+
+    @classmethod
+    def create(cls, scaling_config, experiment_name: str, storage_path: str) -> "WorkerGroup":
+        n = scaling_config.num_workers
+        res = scaling_config.worker_resources()
+        bundles = [dict(res) for _ in range(n)]
+        if scaling_config.topology:
+            # claim the slice head so the whole slice is ours atomically
+            bundles[0][f"TPU-{scaling_config.topology}-head"] = 1.0
+        pg = placement_group(bundles, strategy=scaling_config.placement_strategy)
+        if not pg.wait(timeout_seconds=60.0):
+            remove_placement_group(pg)
+            raise TimeoutError(
+                f"placement group for {n} train workers not ready within 60s"
+            )
+        actor_cls = ray.remote(TrainWorker)
+        workers = [
+            actor_cls.options(
+                resources=dict(bundles[i]),
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg, placement_group_bundle_index=i
+                ),
+                name=f"train_worker_{experiment_name}_{i}",
+            ).remote(i, n, experiment_name, storage_path)
+            for i in range(n)
+        ]
+        return cls(workers, pg)
+
+    def run_on_all(self, method: str, *args, timeout: float = 120.0):
+        refs = [getattr(w, method).remote(*args) for w in self.workers]
+        return ray.get(refs, timeout=timeout)
+
+    def poll(self, timeout: float = 60.0) -> list[dict]:
+        return self.run_on_all("poll", timeout=timeout)
+
+    def shutdown(self) -> None:
+        try:
+            self.run_on_all("shutdown", timeout=10.0)
+        except Exception:
+            pass
+        for w in self.workers:
+            try:
+                ray.kill(w)
+            except Exception:
+                pass
+        try:
+            remove_placement_group(self._pg)
+        except Exception:
+            pass

@@ -1,0 +1,64 @@
+"""Placement group API tests (reference: test patterns around
+``python/ray/tests/test_placement_group*.py``)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    remove_placement_group,
+)
+
+
+def test_placement_group_create_and_schedule(ray_cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(timeout_seconds=30)
+    assert pg.ready()
+
+    @ray_tpu.remote
+    def where():
+        import os
+
+        return os.getpid()
+
+    ref = where.options(
+        num_cpus=1,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0
+        ),
+    ).remote()
+    assert isinstance(ray_tpu.get(ref, timeout=60), int)
+    remove_placement_group(pg)
+
+
+def test_placement_group_infeasible(ray_cluster):
+    pg = placement_group([{"CPU": 10_000}], strategy="STRICT_PACK")
+    from ray_tpu.core.status import PlacementGroupUnschedulableError
+
+    with pytest.raises(PlacementGroupUnschedulableError):
+        pg.wait(timeout_seconds=5)
+
+
+def test_placement_group_actor(ray_cluster):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(timeout_seconds=30)
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.options(
+        num_cpus=1,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0
+        ),
+    ).remote()
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 1
+    ray_tpu.kill(c)
+    remove_placement_group(pg)

@@ -1,0 +1,129 @@
+"""Train library: controller/worker-group/report/checkpoint/failure
+semantics (reference: python/ray/train/v2/tests/)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+def test_single_worker_reports_metrics(ray_cluster, tmp_path):
+    def train_fn(config):
+        ctx = train.get_context()
+        for step in range(3):
+            train.report({"step": step, "rank": ctx.get_world_rank()})
+
+    trainer = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t1", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert len(result.metrics_history) == 3
+
+
+def test_two_workers_context(ray_cluster, tmp_path):
+    def train_fn(config):
+        ctx = train.get_context()
+        train.report({"world_size": ctx.get_world_size(), "rank": ctx.get_world_rank()})
+
+    trainer = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t2", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["world_size"] == 2
+    assert result.metrics["rank"] == 0  # controller keeps rank-0 metrics
+
+
+def test_checkpoint_roundtrip(ray_cluster, tmp_path):
+    def train_fn(config):
+        import tempfile
+
+        resumed = train.get_checkpoint()
+        start = 0
+        if resumed:
+            with resumed.as_directory() as d:
+                start = int(open(os.path.join(d, "step.txt")).read())
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, "step.txt"), "w") as f:
+                f.write(str(start + 5))
+            train.report({"final_step": start + 5}, checkpoint=Checkpoint.from_directory(d))
+
+    run_cfg = RunConfig(
+        name="ckpt", storage_path=str(tmp_path),
+        checkpoint_config=CheckpointConfig(num_to_keep=2),
+    )
+    trainer = JaxTrainer(
+        train_fn, scaling_config=ScalingConfig(num_workers=1), run_config=run_cfg,
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.checkpoint is not None
+    with result.checkpoint.as_directory() as d:
+        assert open(os.path.join(d, "step.txt")).read() == "5"
+
+    # resume from the produced checkpoint
+    trainer2 = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="ckpt2", storage_path=str(tmp_path)),
+        resume_from_checkpoint=result.checkpoint,
+    )
+    r2 = trainer2.fit()
+    assert r2.error is None
+    assert r2.metrics["final_step"] == 10
+
+
+def test_failure_policy_restarts_group(ray_cluster, tmp_path):
+    marker = str(tmp_path / "attempted_once")
+
+    def train_fn(config):
+        if not os.path.exists(config["marker"]):
+            open(config["marker"], "w").write("x")
+            raise RuntimeError("injected first-attempt failure")
+        train.report({"ok": 1})
+
+    trainer = JaxTrainer(
+        train_fn,
+        train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="ft", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics == {"ok": 1}
+
+
+def test_failure_policy_exhausted(ray_cluster, tmp_path):
+    def train_fn(config):
+        raise RuntimeError("always fails")
+
+    trainer = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="fail", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=0),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is not None
+    assert "always fails" in str(result.error)
