@@ -1,0 +1,39 @@
+"""ray_tpu.data: streaming, block-based distributed datasets.
+
+Reference: ``python/ray/data/`` (SURVEY.md §2.3, §3.6): lazy logical
+plans, operator fusion, a backpressured streaming executor over object-
+store blocks, and train-worker stream splits. TPU-relevant surface:
+``DataIterator.to_device_batches`` double-buffers host→HBM transfers.
+"""
+
+from .block import BlockAccessor
+from .dataset import (
+    Dataset,
+    MaterializedDataset,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,  # noqa: A004
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+)
+from .iterator import DataIterator
+
+__all__ = [
+    "BlockAccessor",
+    "DataIterator",
+    "Dataset",
+    "MaterializedDataset",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "read_csv",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+]

@@ -1,0 +1,138 @@
+"""Blocks: the unit of data movement. Arrow tables in the object store.
+
+Reference: ``python/ray/data/block.py`` + ``_internal/arrow_block.py``.
+Blocks are immutable pyarrow Tables (zero-copy via plasma + pickle5
+out-of-band buffers); ``BlockAccessor`` adapts them to user-facing batch
+formats (numpy / pandas / pyarrow), numpy being the TPU-relevant one
+(host staging before ``jax.device_put``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import numpy as np
+import pyarrow as pa
+
+
+def build_block(rows: list) -> pa.Table:
+    """Build an Arrow block from a list of rows (dicts or scalars)."""
+    if rows and not isinstance(rows[0], dict):
+        rows = [{"item": r} for r in rows]
+    if not rows:
+        return pa.table({})
+    cols: dict[str, list] = {k: [] for k in rows[0]}
+    for r in rows:
+        for k in cols:
+            cols[k].append(r.get(k))
+    return pa.table({k: _to_array(v) for k, v in cols.items()})
+
+
+def _to_array(values: list) -> pa.Array:
+    if values and isinstance(values[0], np.ndarray):
+        flat = np.stack(values)
+        return pa.FixedSizeListArray.from_arrays(
+            pa.array(flat.reshape(-1)), flat.size // len(values)
+        )
+    return pa.array(values)
+
+
+def batch_to_block(batch: Any) -> pa.Table:
+    """Normalize a user-returned batch (dict of arrays / pandas / arrow /
+    list of rows) into an Arrow block."""
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, dict):
+        cols = {}
+        for k, v in batch.items():
+            arr = np.asarray(v)
+            if arr.ndim > 1:
+                cols[k] = pa.FixedSizeListArray.from_arrays(
+                    pa.array(arr.reshape(-1)), int(np.prod(arr.shape[1:]))
+                )
+            else:
+                cols[k] = pa.array(arr)
+        return pa.table(cols)
+    if isinstance(batch, list):
+        return build_block(batch)
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+    except ModuleNotFoundError:
+        pass
+    raise TypeError(f"unsupported batch type {type(batch)}")
+
+
+class BlockAccessor:
+    """Reference: block.py BlockAccessor."""
+
+    def __init__(self, block: pa.Table):
+        self._block = block
+
+    @staticmethod
+    def for_block(block: pa.Table) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    def num_rows(self) -> int:
+        return self._block.num_rows
+
+    def size_bytes(self) -> int:
+        return self._block.nbytes
+
+    def schema(self):
+        return self._block.schema
+
+    def slice(self, start: int, end: int) -> pa.Table:
+        return self._block.slice(start, end - start)
+
+    def to_numpy(self) -> dict[str, np.ndarray]:
+        out = {}
+        for name in self._block.column_names:
+            col = self._block.column(name)
+            if pa.types.is_fixed_size_list(col.type):
+                width = col.type.list_size
+                flat = col.combine_chunks().flatten().to_numpy(zero_copy_only=False)
+                out[name] = flat.reshape(self._block.num_rows, width)
+            else:
+                out[name] = col.to_numpy(zero_copy_only=False)
+        return out
+
+    def to_pandas(self):
+        return self._block.to_pandas()
+
+    def to_arrow(self) -> pa.Table:
+        return self._block
+
+    def to_batch(self, batch_format: str):
+        if batch_format in ("numpy", "default"):
+            return self.to_numpy()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("pyarrow", "arrow"):
+            return self._block
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    def iter_rows(self) -> Iterable[dict]:
+        for i in range(self._block.num_rows):
+            yield self._row(i)
+
+    def _row(self, i: int) -> dict:
+        out = {}
+        for name in self._block.column_names:
+            col = self._block.column(name)
+            if pa.types.is_fixed_size_list(col.type):
+                width = col.type.list_size
+                flat = col.combine_chunks().flatten().to_numpy(zero_copy_only=False)
+                out[name] = flat[i * width:(i + 1) * width]
+            else:
+                out[name] = col[i].as_py()
+        return out
+
+
+def concat_blocks(blocks: list[pa.Table]) -> pa.Table:
+    blocks = [b for b in blocks if b.num_rows]
+    if not blocks:
+        return pa.table({})
+    return pa.concat_tables(blocks)

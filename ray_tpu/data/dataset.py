@@ -1,0 +1,208 @@
+"""Dataset: the user-facing lazy, distributed data API.
+
+Reference: ``python/ray/data/dataset.py`` (Dataset), ``read_api.py:340``.
+Transforms build a logical chain; execution lowers it to physical ops and
+streams blocks through the object store (SURVEY.md §3.6).
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable, Iterator
+
+from ..core import api as ray
+from . import datasource as ds
+from . import logical as L
+from .block import BlockAccessor, batch_to_block, concat_blocks
+from .executor import StreamingExecutor, plan
+from .iterator import DataIterator, SplitCoordinator, batches_from_blocks
+
+
+class Dataset:
+    def __init__(self, last_op: L.LogicalOp):
+        self._last_op = last_op
+
+    # ------------------------------------------------------------ transforms
+    def _chain(self, op: L.LogicalOp) -> "Dataset":
+        return Dataset(op)
+
+    def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
+                    fn_kwargs: dict | None = None, **_ignored) -> "Dataset":
+        return self._chain(L.MapBatches(
+            "map_batches", self._last_op, fn=fn, batch_format=batch_format,
+            fn_kwargs=fn_kwargs or {}))
+
+    def map(self, fn: Callable) -> "Dataset":
+        return self._chain(L.MapRows("map", self._last_op, fn=fn))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return self._chain(L.FlatMap("flat_map", self._last_op, fn=fn))
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return self._chain(L.Filter("filter", self._last_op, fn=fn))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._chain(L.Repartition("repartition", self._last_op, num_blocks=num_blocks))
+
+    def random_shuffle(self, *, seed: int | None = None) -> "Dataset":
+        return self._chain(L.RandomShuffle("random_shuffle", self._last_op, seed=seed))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._chain(L.Sort("sort", self._last_op, key=key, descending=descending))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._chain(L.Limit("limit", self._last_op, limit=n))
+
+    # ------------------------------------------------------------ execution
+    def iter_internal_ref_bundles(self) -> Iterator:
+        executor = StreamingExecutor(plan(self._last_op))
+        return executor.run()
+
+    def _iter_blocks(self) -> Iterator:
+        for ref in self.iter_internal_ref_bundles():
+            yield ray.get(ref, timeout=300)
+
+    def materialize(self) -> "MaterializedDataset":
+        refs = list(self.iter_internal_ref_bundles())
+        return MaterializedDataset(refs)
+
+    def iter_batches(self, *, batch_size: int | None = 256,
+                     batch_format: str = "numpy", drop_last: bool = False):
+        return batches_from_blocks(
+            self._iter_blocks(), batch_size=batch_size,
+            batch_format=batch_format, drop_last=drop_last)
+
+    def iter_rows(self) -> Iterator[dict]:
+        for block in self._iter_blocks():
+            yield from BlockAccessor.for_block(block).iter_rows()
+
+    def take(self, n: int = 20) -> list[dict]:
+        out: list[dict] = []
+        for row in self.limit(n).iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> list[dict]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(b.num_rows for b in self._iter_blocks())
+
+    def schema(self):
+        for block in self._iter_blocks():
+            if block.num_rows:
+                return block.schema
+        return None
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def to_pandas(self):
+        return concat_blocks(list(self._iter_blocks())).to_pandas()
+
+    def stats(self) -> str:
+        mat = self.materialize()
+        return f"Dataset: {len(mat._refs)} blocks"
+
+    # --------------------------------------------------------- train feeding
+    def streaming_split(self, n: int, *, equal: bool = False) -> list[DataIterator]:
+        """Reference: dataset.py:1598 — coordinator actor deals blocks to n
+        consumers (one per train worker)."""
+        coord_cls = ray.remote(SplitCoordinator)
+        coord = coord_cls.options(name=f"split_coordinator_{id(self)}").remote(self, n)
+        return [DataIterator(coord, i) for i in builtins.range(n)]
+
+    def split(self, n: int) -> list["MaterializedDataset"]:
+        mat = self.materialize()
+        refs = mat._refs
+        bounds = [round(i * len(refs) / n) for i in builtins.range(n + 1)]
+        return [MaterializedDataset(refs[bounds[i]:bounds[i + 1]]) for i in builtins.range(n)]
+
+    # ---------------------------------------------------------------- writes
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self._iter_blocks()):
+            pq.write_table(block, os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def write_csv(self, path: str) -> None:
+        import os
+
+        import pyarrow.csv as pcsv
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self._iter_blocks()):
+            pcsv.write_csv(block, os.path.join(path, f"part-{i:05d}.csv"))
+
+    def __repr__(self):
+        return f"Dataset(ops={[o.name for o in self._last_op.chain()]})"
+
+
+class MaterializedDataset(Dataset):
+    """Blocks pinned in the object store. Reference: MaterializedDataset."""
+
+    def __init__(self, refs: list):
+        self._refs = refs
+
+        def make(r):
+            return lambda: ray.get(r, timeout=120)
+
+        # chained transforms re-read the pinned blocks from the object store
+        super().__init__(L.Read("materialized", read_tasks=[make(r) for r in refs]))
+
+    def iter_internal_ref_bundles(self) -> Iterator:
+        return iter(self._refs)
+
+    def num_blocks(self) -> int:
+        return len(self._refs)
+
+    def __repr__(self):
+        return f"MaterializedDataset({len(self._refs)} blocks)"
+
+
+# ------------------------------------------------------------------ read api
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    return Dataset(L.Read("read_range", read_tasks=ds.range_tasks(n, parallelism)))
+
+
+def from_items(items: list, *, parallelism: int = 8) -> Dataset:
+    return Dataset(L.Read("read_items", read_tasks=ds.items_tasks(items, parallelism)))
+
+
+def read_parquet(paths) -> Dataset:
+    return Dataset(L.Read("read_parquet", read_tasks=ds.parquet_tasks(paths)))
+
+
+def read_csv(paths) -> Dataset:
+    return Dataset(L.Read("read_csv", read_tasks=ds.csv_tasks(paths)))
+
+
+def read_json(paths) -> Dataset:
+    return Dataset(L.Read("read_json", read_tasks=ds.json_tasks(paths)))
+
+
+def read_numpy(paths, *, column: str = "data") -> Dataset:
+    return Dataset(L.Read("read_numpy", read_tasks=ds.numpy_tasks(paths, column)))
+
+
+def from_numpy(arr, *, column: str = "data") -> MaterializedDataset:
+    block = batch_to_block({column: arr})
+    return MaterializedDataset([ray.put(block)])
+
+
+def from_pandas(df) -> MaterializedDataset:
+    import pyarrow as pa
+
+    return MaterializedDataset([ray.put(pa.Table.from_pandas(df, preserve_index=False))])
+
+
+def from_arrow(table) -> MaterializedDataset:
+    return MaterializedDataset([ray.put(table)])

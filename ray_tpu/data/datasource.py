@@ -1,0 +1,114 @@
+"""Datasources: read task factories.
+
+Reference: ``python/ray/data/read_api.py:340`` + ``datasource/`` (30+
+sources; the file-based ones here cover the formats in the baked image:
+parquet/csv/json/numpy + in-memory items/range).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Callable
+
+
+def _expand_paths(paths) -> list[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                f for f in _glob.glob(os.path.join(p, "**", "*"), recursive=True)
+                if os.path.isfile(f)
+            ))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+def range_tasks(n: int, parallelism: int) -> list[Callable]:
+    parallelism = max(1, min(parallelism, n)) if n else 1
+    bounds = [round(i * n / parallelism) for i in range(parallelism + 1)]
+
+    def make(lo, hi):
+        def read():
+            import numpy as np
+
+            return {"id": np.arange(lo, hi, dtype=np.int64)}
+
+        return read
+
+    return [make(bounds[i], bounds[i + 1]) for i in range(parallelism)]
+
+
+def items_tasks(items: list, parallelism: int) -> list[Callable]:
+    from .block import build_block
+
+    parallelism = max(1, min(parallelism, len(items))) if items else 1
+    bounds = [round(i * len(items) / parallelism) for i in range(parallelism + 1)]
+
+    def make(chunk):
+        return lambda: build_block(chunk)
+
+    return [make(items[bounds[i]:bounds[i + 1]]) for i in range(parallelism)]
+
+
+def parquet_tasks(paths) -> list[Callable]:
+    files = _expand_paths(paths)
+
+    def make(f):
+        def read():
+            import pyarrow.parquet as pq
+
+            return pq.read_table(f)
+
+        return read
+
+    return [make(f) for f in files]
+
+
+def csv_tasks(paths) -> list[Callable]:
+    files = _expand_paths(paths)
+
+    def make(f):
+        def read():
+            import pyarrow.csv as pcsv
+
+            return pcsv.read_csv(f)
+
+        return read
+
+    return [make(f) for f in files]
+
+
+def json_tasks(paths) -> list[Callable]:
+    files = _expand_paths(paths)
+
+    def make(f):
+        def read():
+            import pyarrow.json as pjson
+
+            return pjson.read_json(f)
+
+        return read
+
+    return [make(f) for f in files]
+
+
+def numpy_tasks(paths, column: str = "data") -> list[Callable]:
+    files = _expand_paths(paths)
+
+    def make(f):
+        def read():
+            import numpy as np
+
+            return {column: np.load(f)}
+
+        return read
+
+    return [make(f) for f in files]

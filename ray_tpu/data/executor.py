@@ -1,0 +1,326 @@
+"""Physical plan + streaming executor.
+
+Reference: ``python/ray/data/_internal/execution/streaming_executor.py:48``
+(loop :233,285; ``select_operator_to_run`` in streaming_executor_state.py:531).
+The shape is the same in miniature: physical operators with input/output
+queues, a driver scheduling loop that moves completed blocks downstream
+and launches new tasks under per-op concurrency and a global in-flight
+cap (backpressure). Map chains are fused into one task per block
+(the optimizer's operator-fusion rule).
+
+All-to-all ops (shuffle/sort/repartition) currently run as single
+consolidation tasks, not a map-reduce exchange — fine for host-RAM-scale
+data; the exchange planner is a later widening.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from ..core import api as ray
+from . import logical as L
+from .block import BlockAccessor, batch_to_block, build_block, concat_blocks
+
+# ---------------------------------------------------------------- map stages
+
+
+@dataclasses.dataclass
+class MapStage:
+    kind: str  # "batches" | "rows" | "flat" | "filter"
+    fn: Callable
+    batch_format: str = "numpy"
+    fn_kwargs: dict = dataclasses.field(default_factory=dict)
+
+
+def _apply_stages(block, stages: list[MapStage]):
+    for st in stages:
+        acc = BlockAccessor.for_block(block)
+        if st.kind == "batches":
+            batch = acc.to_batch(st.batch_format)
+            block = batch_to_block(st.fn(batch, **st.fn_kwargs))
+        elif st.kind == "rows":
+            block = build_block([st.fn(r) for r in acc.iter_rows()])
+        elif st.kind == "flat":
+            out = []
+            for r in acc.iter_rows():
+                out.extend(st.fn(r))
+            block = build_block(out)
+        elif st.kind == "filter":
+            block = build_block([r for r in acc.iter_rows() if st.fn(r)])
+        else:
+            raise ValueError(st.kind)
+    return block
+
+
+def _read_task(fn):
+    block = fn()
+    import pyarrow as pa
+
+    if not isinstance(block, pa.Table):
+        block = batch_to_block(block)
+    return block
+
+
+def _map_task(stages: list[MapStage], block):
+    return _apply_stages(block, stages)
+
+
+def _consolidate_task(op_kind: str, num_out: int, seed, sort_key, descending, *blocks):
+    merged = concat_blocks(list(blocks))
+    n = merged.num_rows
+    if op_kind == "shuffle":
+        rng = np.random.default_rng(seed)
+        merged = merged.take(rng.permutation(n))
+    elif op_kind == "sort":
+        order = "descending" if descending else "ascending"
+        merged = merged.sort_by([(sort_key, order)])
+    if num_out <= 1:
+        return merged
+    bounds = [round(i * n / num_out) for i in range(num_out + 1)]
+    return tuple(merged.slice(bounds[i], bounds[i + 1] - bounds[i]) for i in range(num_out))
+
+
+# ------------------------------------------------------------- physical ops
+
+
+class PhysicalOp:
+    """Blocks are emitted in input order (completion order is buffered
+    through a per-op reorder window), so downstream semantics — take(),
+    zip-like joins, batch determinism — match the logical plan order."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.input_queue: list = []  # upstream block refs
+        self.in_flight: dict = {}  # ref -> seq
+        self.output_queue: list = []
+        self.upstream_done = False
+        self._next_seq = 0
+        self._emit_seq = 0
+        self._completed: dict[int, Any] = {}
+
+    def done(self) -> bool:
+        return self.upstream_done and not self.input_queue and not self.in_flight
+
+    def can_launch(self) -> bool:
+        return bool(self.input_queue)
+
+    def launch_one(self) -> list:
+        raise NotImplementedError
+
+    def _track(self, refs: list) -> list:
+        for r in refs:
+            self.in_flight[r] = self._next_seq
+            self._next_seq += 1
+        return refs
+
+    def on_complete(self, ref) -> None:
+        seq = self.in_flight.pop(ref)
+        self._completed[seq] = ref
+        while self._emit_seq in self._completed:
+            self.output_queue.append(self._completed.pop(self._emit_seq))
+            self._emit_seq += 1
+
+
+class ReadPhysicalOp(PhysicalOp):
+    def __init__(self, read_tasks):
+        super().__init__("Read")
+        self._remote = ray.remote(_read_task)
+        self.input_queue = list(read_tasks)
+        self.upstream_done = True
+
+    def launch_one(self):
+        fn = self.input_queue.pop(0)
+        return self._track([self._remote.remote(fn)])
+
+
+class MapPhysicalOp(PhysicalOp):
+    def __init__(self, stages: list[MapStage]):
+        names = "->".join(s.kind for s in stages)
+        super().__init__(f"Map[{names}]")
+        self._remote = ray.remote(_map_task)
+        self._stages = stages
+
+    def launch_one(self):
+        block_ref = self.input_queue.pop(0)
+        return self._track([self._remote.remote(self._stages, block_ref)])
+
+
+class AllToAllPhysicalOp(PhysicalOp):
+    """Barrier op: waits for the whole upstream, then one consolidation
+    task emits num_out blocks."""
+
+    def __init__(self, kind: str, *, num_out: int | None = None, seed=None,
+                 sort_key: str = "", descending: bool = False):
+        super().__init__(f"AllToAll[{kind}]")
+        self._kind = kind
+        self._num_out = num_out
+        self._seed = seed
+        self._sort_key = sort_key
+        self._descending = descending
+        self._launched = False
+
+    def can_launch(self) -> bool:
+        return self.upstream_done and not self._launched and bool(self.input_queue)
+
+    def launch_one(self):
+        blocks = list(self.input_queue)
+        self.input_queue.clear()
+        self._launched = True
+        num_out = self._num_out or len(blocks) or 1
+        remote = ray.remote(_consolidate_task).options(num_returns=num_out)
+        refs = remote.remote(
+            self._kind, num_out, self._seed, self._sort_key, self._descending, *blocks
+        )
+        if num_out == 1:
+            refs = [refs]
+        return self._track(list(refs))
+
+    def done(self) -> bool:
+        # also covers an empty upstream (nothing to consolidate)
+        return self.upstream_done and not self.in_flight and not self.input_queue
+
+
+class LimitPhysicalOp(PhysicalOp):
+    """Driver-side streaming limit: truncates blocks until the row budget
+    is spent, then drops the rest of the stream."""
+
+    def __init__(self, limit: int):
+        super().__init__(f"Limit[{limit}]")
+        self._remaining = limit
+        self._slice_remote = ray.remote(_slice_task)
+
+    def can_launch(self) -> bool:
+        # one in-flight slice at a time: each slice budget depends on the
+        # rows consumed by the previous one
+        return bool(self.input_queue) and not self.in_flight
+
+    def launch_one(self):
+        block_ref = self.input_queue.pop(0)
+        if self._remaining <= 0:
+            return []
+        return self._track([self._slice_remote.remote(self._remaining, block_ref)])
+
+    def on_complete(self, ref) -> None:
+        block = ray.get(ref)
+        self._remaining -= BlockAccessor.for_block(block).num_rows()
+        super().on_complete(ref)
+        if self._remaining <= 0:
+            self.input_queue.clear()
+            self.upstream_done = True
+
+
+def _slice_task(limit: int, block):
+    if block.num_rows <= limit:
+        return block
+    return block.slice(0, limit)
+
+
+# ----------------------------------------------------------------- planning
+
+
+def plan(last_op: L.LogicalOp) -> list[PhysicalOp]:
+    """Lower the logical chain to physical ops, fusing adjacent maps."""
+    ops: list[PhysicalOp] = []
+    pending_stages: list[MapStage] = []
+
+    def flush_maps():
+        nonlocal pending_stages
+        if pending_stages:
+            ops.append(MapPhysicalOp(pending_stages))
+            pending_stages = []
+
+    for lop in last_op.chain():
+        if isinstance(lop, L.Read):
+            ops.append(ReadPhysicalOp(lop.read_tasks))
+        elif isinstance(lop, L.MapBatches):
+            pending_stages.append(MapStage("batches", lop.fn, lop.batch_format, lop.fn_kwargs))
+        elif isinstance(lop, L.MapRows):
+            pending_stages.append(MapStage("rows", lop.fn))
+        elif isinstance(lop, L.FlatMap):
+            pending_stages.append(MapStage("flat", lop.fn))
+        elif isinstance(lop, L.Filter):
+            pending_stages.append(MapStage("filter", lop.fn))
+        elif isinstance(lop, L.Repartition):
+            flush_maps()
+            ops.append(AllToAllPhysicalOp("repartition", num_out=lop.num_blocks))
+        elif isinstance(lop, L.RandomShuffle):
+            flush_maps()
+            ops.append(AllToAllPhysicalOp("shuffle", seed=lop.seed))
+        elif isinstance(lop, L.Sort):
+            flush_maps()
+            ops.append(AllToAllPhysicalOp("sort", sort_key=lop.key, descending=lop.descending))
+        elif isinstance(lop, L.Limit):
+            flush_maps()
+            ops.append(LimitPhysicalOp(lop.limit))
+        elif isinstance(lop, L.Union):
+            raise NotImplementedError("union is handled at the Dataset level")
+        else:
+            raise ValueError(f"unknown logical op {lop}")
+    flush_maps()
+    return ops
+
+
+# ---------------------------------------------------------------- executor
+
+
+class StreamingExecutor:
+    """Drives the physical op pipeline; yields output block refs as ready.
+
+    Backpressure: at most ``max_in_flight`` tasks cluster-wide and
+    ``per_op_concurrency`` per operator (reference: backpressure_policy/).
+    """
+
+    def __init__(self, ops: list[PhysicalOp], *, max_in_flight: int = 8,
+                 per_op_concurrency: int = 4):
+        self._ops = ops
+        self._max_in_flight = max_in_flight
+        self._per_op = per_op_concurrency
+
+    def run(self) -> Iterator[Any]:
+        ops = self._ops
+        last = ops[-1]
+        while True:
+            # 1. propagate completion flags + move outputs downstream
+            for i, op in enumerate(ops):
+                if i > 0:
+                    upstream = ops[i - 1]
+                    op.input_queue.extend(upstream.output_queue)
+                    upstream.output_queue.clear()
+                    op.upstream_done = upstream.done()
+            while last.output_queue:
+                yield last.output_queue.pop(0)
+            if last.done():
+                return
+
+            # 2. poll in-flight tasks (small timeout so the loop stays live)
+            all_refs = [r for op in ops for r in op.in_flight]
+            progressed = False
+            if all_refs:
+                ready, _ = ray.wait(all_refs, num_returns=1, timeout=0.5)
+                for ref in ready:
+                    for op in ops:
+                        if ref in op.in_flight:
+                            op.on_complete(ref)
+                            progressed = True
+                            break
+
+            # 3. launch new work, downstream ops first (finish-what-you-
+            #    started, the reference's select_operator_to_run bias)
+            total_in_flight = sum(len(op.in_flight) for op in ops)
+            for op in reversed(ops):
+                while (
+                    op.can_launch()
+                    and len(op.in_flight) < self._per_op
+                    and total_in_flight < self._max_in_flight
+                ):
+                    launched = op.launch_one()
+                    total_in_flight += len(launched)
+                    progressed = True
+            if not progressed and not all_refs:
+                # nothing running and nothing launched: avoid a hot spin
+                import time
+
+                time.sleep(0.01)

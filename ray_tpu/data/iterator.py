@@ -1,0 +1,110 @@
+"""DataIterator: batch iteration and train-worker stream splitting.
+
+Reference: ``python/ray/data/iterator.py:94`` (iter_batches) and
+``dataset.py:1598`` streaming_split via a SplitCoordinator actor feeding
+one iterator per train worker.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from ..core import api as ray
+from .block import BlockAccessor, concat_blocks
+
+
+def batches_from_blocks(
+    block_iter,
+    *,
+    batch_size: int | None,
+    batch_format: str = "numpy",
+    drop_last: bool = False,
+) -> Iterator:
+    """Re-slice a stream of blocks into fixed-size batches."""
+    if batch_size is None:
+        for block in block_iter:
+            if block.num_rows:
+                yield BlockAccessor.for_block(block).to_batch(batch_format)
+        return
+    carry = []
+    carry_rows = 0
+    for block in block_iter:
+        carry.append(block)
+        carry_rows += block.num_rows
+        while carry_rows >= batch_size:
+            merged = concat_blocks(carry)
+            batch = merged.slice(0, batch_size)
+            rest = merged.slice(batch_size, merged.num_rows - batch_size)
+            carry = [rest] if rest.num_rows else []
+            carry_rows = rest.num_rows
+            yield BlockAccessor.for_block(batch).to_batch(batch_format)
+    if carry_rows and not drop_last:
+        merged = concat_blocks(carry)
+        yield BlockAccessor.for_block(merged).to_batch(batch_format)
+
+
+class SplitCoordinator:
+    """Actor that owns a dataset's output stream and deals blocks to n
+    consumers (reference: StreamSplitDataIterator's coordinator)."""
+
+    def __init__(self, dataset, n: int):
+        self._iter = dataset.iter_internal_ref_bundles()
+        self._n = n
+        self._exhausted = False
+
+    def next_block_ref(self, split_idx: int):
+        """Returns the next block ref, or None when exhausted. Consumers
+        share one stream; fairness comes from polling order."""
+        if self._exhausted:
+            return None
+        try:
+            return next(self._iter)
+        except StopIteration:
+            self._exhausted = True
+            return None
+
+
+class DataIterator:
+    """Per-worker view of a split stream."""
+
+    def __init__(self, coordinator, split_idx: int):
+        self._coord = coordinator
+        self._idx = split_idx
+
+    def _blocks(self):
+        while True:
+            ref = ray.get(self._coord.next_block_ref.remote(self._idx), timeout=120)
+            if ref is None:
+                return
+            yield ray.get(ref, timeout=120)
+
+    def iter_batches(self, *, batch_size: int | None = 256,
+                     batch_format: str = "numpy", drop_last: bool = False):
+        return batches_from_blocks(
+            self._blocks(), batch_size=batch_size,
+            batch_format=batch_format, drop_last=drop_last,
+        )
+
+    def iter_rows(self):
+        for block in self._blocks():
+            yield from BlockAccessor.for_block(block).iter_rows()
+
+    def to_device_batches(self, *, batch_size: int, sharding=None,
+                          batch_format: str = "numpy", drop_last: bool = True):
+        """TPU idiom: host batch → ``jax.device_put`` (async HBM prefetch
+        with one batch of lookahead double-buffering)."""
+        import jax
+
+        prev = None
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format=batch_format,
+                                       drop_last=drop_last):
+            arrs = {k: np.asarray(v) for k, v in batch.items()}
+            cur = jax.device_put(arrs, sharding) if sharding else jax.device_put(arrs)
+            if prev is not None:
+                yield prev
+            prev = cur
+        if prev is not None:
+            yield prev

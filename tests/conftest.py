@@ -25,6 +25,18 @@ if os.environ.get("RAY_TPU_TEST_ON_TPU") != "1":
 import pytest
 
 
+def pytest_sessionstart(session):
+    # shm segments leaked by previously killed runs exhaust /dev/shm and
+    # poison every store allocation in this run — clear them up front
+    import glob
+
+    for f in glob.glob("/dev/shm/raytpu_*"):
+        try:
+            os.unlink(f)
+        except OSError:
+            pass
+
+
 @pytest.fixture(scope="session")
 def ray_cluster():
     """One shared local cluster for API-level tests (reference

@@ -1,0 +1,101 @@
+"""Data library tests (reference patterns: python/ray/data/tests/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+def test_range_count_take(ray_cluster):
+    ds = rd.range(100, parallelism=4)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+
+
+def test_map_batches_fusion(ray_cluster):
+    ds = (
+        rd.range(64, parallelism=4)
+        .map_batches(lambda b: {"id": b["id"] * 2})
+        .map_batches(lambda b: {"id": b["id"] + 1})
+    )
+    from ray_tpu.data.executor import plan
+
+    ops = plan(ds._last_op)
+    assert len(ops) == 2  # Read + one fused Map
+    out = sorted(r["id"] for r in ds.take_all())
+    assert out == sorted((i * 2) + 1 for i in range(64))
+
+
+def test_map_filter_flat_map(ray_cluster):
+    ds = rd.from_items([{"x": i} for i in range(10)], parallelism=2)
+    out = (
+        ds.map(lambda r: {"x": r["x"] * 10})
+        .filter(lambda r: r["x"] >= 50)
+        .flat_map(lambda r: [{"x": r["x"]}, {"x": r["x"] + 1}])
+    )
+    vals = sorted(r["x"] for r in out.take_all())
+    assert vals == sorted(v for i in range(5, 10) for v in (i * 10, i * 10 + 1))
+
+
+def test_repartition_and_shuffle(ray_cluster):
+    ds = rd.range(50, parallelism=5).repartition(3)
+    mat = ds.materialize()
+    assert mat.num_blocks() == 3
+    assert mat.count() == 50
+
+    shuffled = rd.range(50, parallelism=5).random_shuffle(seed=0)
+    vals = [r["id"] for r in shuffled.take_all()]
+    assert sorted(vals) == list(range(50))
+    assert vals != list(range(50))
+
+
+def test_sort(ray_cluster):
+    ds = rd.from_items([{"v": i % 7, "i": i} for i in range(30)], parallelism=3)
+    out = [r["v"] for r in ds.sort("v").take_all()]
+    assert out == sorted(out)
+    out_desc = [r["v"] for r in ds.sort("v", descending=True).take_all()]
+    assert out_desc == sorted(out, reverse=True)
+
+
+def test_limit_streaming(ray_cluster):
+    ds = rd.range(1000, parallelism=10).limit(17)
+    assert ds.count() == 17
+
+
+def test_iter_batches_sizes(ray_cluster):
+    ds = rd.range(100, parallelism=4)
+    batches = list(ds.iter_batches(batch_size=32))
+    sizes = [len(b["id"]) for b in batches]
+    assert sum(sizes) == 100
+    assert sizes[:-1] == [32, 32, 32]
+    b0 = batches[0]
+    assert isinstance(b0["id"], np.ndarray)
+
+
+def test_tensor_columns_roundtrip(ray_cluster):
+    arr = np.arange(60, dtype=np.float32).reshape(20, 3)
+    ds = rd.from_numpy(arr, column="feat")
+    batch = next(iter(ds.iter_batches(batch_size=None)))
+    np.testing.assert_array_equal(batch["feat"], arr)
+    out = ds.map_batches(lambda b: {"feat": b["feat"] * 2.0}).take_all()
+    np.testing.assert_allclose(out[0]["feat"], arr[0] * 2.0)
+
+
+def test_parquet_roundtrip(ray_cluster, tmp_path):
+    ds = rd.range(40, parallelism=2)
+    ds.write_parquet(str(tmp_path / "pq"))
+    back = rd.read_parquet(str(tmp_path / "pq"))
+    assert back.count() == 40
+    assert sorted(r["id"] for r in back.take_all()) == list(range(40))
+
+
+def test_streaming_split_feeds_all_consumers(ray_cluster):
+    ds = rd.range(60, parallelism=6)
+    its = ds.streaming_split(2)
+    seen = []
+    for it in its:
+        for batch in it.iter_batches(batch_size=None):
+            seen.extend(batch["id"].tolist())
+    assert sorted(seen) == list(range(60))
