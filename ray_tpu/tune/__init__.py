@@ -1,0 +1,44 @@
+"""ray_tpu.tune: hyperparameter search.
+
+Reference: ``python/ray/tune/`` (SURVEY.md §2.3): Tuner.fit over trial
+actors with searchers (grid/random) and schedulers (ASHA, PBT).
+``tune.report`` shares the Train session plumbing — a trial is a
+one-worker train run.
+"""
+
+from ..train.session import get_checkpoint, get_context, report
+from .schedulers import (
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+)
+from .search import (
+    BasicVariantGenerator,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from .tuner import ResultGrid, TuneConfig, Tuner
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+__all__ = [
+    "ASHAScheduler",
+    "AsyncHyperBandScheduler",
+    "BasicVariantGenerator",
+    "FIFOScheduler",
+    "PopulationBasedTraining",
+    "ResultGrid",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "get_context",
+    "grid_search",
+    "loguniform",
+    "randint",
+    "report",
+    "uniform",
+]

@@ -1,0 +1,127 @@
+"""Trial schedulers: FIFO, ASHA, PBT.
+
+Reference: ``python/ray/tune/schedulers/`` — ``async_hyperband.py``
+(ASHA), ``pbt.py`` (PopulationBasedTraining). Decisions are made on each
+reported result: CONTINUE / STOP; PBT additionally mutates a trial's
+config from a better trial's checkpoint at perturbation intervals.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial, metrics: dict) -> str:
+        return CONTINUE
+
+
+class AsyncHyperBandScheduler:
+    """ASHA: promote only the top 1/reduction_factor of trials past each
+    rung milestone; stop the rest at the rung. Reference:
+    schedulers/async_hyperband.py."""
+
+    def __init__(
+        self,
+        *,
+        metric: str,
+        mode: str = "max",
+        time_attr: str = "training_iteration",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+    ):
+        self._metric = metric
+        self._sign = 1.0 if mode == "max" else -1.0
+        self._time_attr = time_attr
+        self._max_t = max_t
+        # rung milestones: grace_period * rf^k up to max_t
+        self._rungs: list[int] = []
+        t = grace_period
+        while t < max_t:
+            self._rungs.append(t)
+            t *= reduction_factor
+        self._rf = reduction_factor
+        self._rung_scores: dict[int, list[float]] = {r: [] for r in self._rungs}
+        self._trial_rung: dict[Any, int] = {}
+
+    def on_result(self, trial, metrics: dict) -> str:
+        t = metrics.get(self._time_attr, 0)
+        score = self._sign * float(metrics.get(self._metric, float("-inf")))
+        for rung in self._rungs:
+            if t >= rung and self._trial_rung.get(trial, -1) < rung:
+                self._trial_rung[trial] = rung
+                scores = self._rung_scores[rung]
+                scores.append(score)
+                if len(scores) >= 2:
+                    import numpy as np
+
+                    # promote only the top 1/rf fraction recorded so far
+                    cutoff = float(np.percentile(scores, (1 - 1 / self._rf) * 100))
+                    if score < cutoff:
+                        return STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining:
+    """PBT: at each perturbation interval, bottom-quantile trials exploit a
+    top-quantile trial's checkpoint + config and explore by mutation.
+    Reference: schedulers/pbt.py."""
+
+    def __init__(
+        self,
+        *,
+        metric: str,
+        mode: str = "max",
+        time_attr: str = "training_iteration",
+        perturbation_interval: int = 5,
+        hyperparam_mutations: dict | None = None,
+        quantile_fraction: float = 0.25,
+        seed: int | None = None,
+    ):
+        self._metric = metric
+        self._sign = 1.0 if mode == "max" else -1.0
+        self._time_attr = time_attr
+        self._interval = perturbation_interval
+        self._mutations = hyperparam_mutations or {}
+        self._quantile = quantile_fraction
+        self._rng = random.Random(seed)
+        self._last_perturb: dict[Any, int] = {}
+        self._scores: dict[Any, float] = {}
+
+    def on_result(self, trial, metrics: dict) -> str:
+        self._scores[trial] = self._sign * float(metrics.get(self._metric, float("-inf")))
+        return CONTINUE
+
+    def maybe_exploit(self, trial, metrics: dict, population: list) -> dict | None:
+        """Returns a new (exploited+explored) config if the trial should
+        restart from a better trial, else None. Controller applies it."""
+        t = metrics.get(self._time_attr, 0)
+        if t - self._last_perturb.get(trial, 0) < self._interval:
+            return None
+        self._last_perturb[trial] = t
+        if len(self._scores) < 2:
+            return None
+        ranked = sorted(population, key=lambda tr: self._scores.get(tr, float("-inf")))
+        k = max(1, int(len(ranked) * self._quantile))
+        bottom, top = ranked[:k], ranked[-k:]
+        if trial not in bottom:
+            return None
+        donor = self._rng.choice(top)
+        if donor is trial:
+            return None
+        new_config = dict(donor.config)
+        for key, mut in self._mutations.items():
+            if callable(mut):
+                new_config[key] = mut()
+            elif isinstance(mut, list):
+                new_config[key] = self._rng.choice(mut)
+            else:  # numeric perturbation: x0.8 or x1.2
+                base = new_config.get(key, trial.config.get(key))
+                new_config[key] = base * self._rng.choice([0.8, 1.2])
+        new_config["_pbt_exploit_from"] = donor.trial_id
+        return new_config

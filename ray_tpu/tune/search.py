@@ -1,0 +1,106 @@
+"""Search spaces and suggestion algorithms.
+
+Reference: ``python/ray/tune/search/`` — sample-space primitives
+(``tune.uniform``/``choice``/``grid_search``) and the default
+``BasicVariantGenerator`` (grid expansion × random sampling). External
+searchers (Optuna/HyperOpt/...) are separate pip packages in the
+reference; here they gate on import availability.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        import math
+
+        self._lo, self._hi = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(self._lo, self._hi))
+
+
+class RandInt(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Choice(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class GridSearch:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+def choice(categories) -> Choice:
+    return Choice(categories)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+class BasicVariantGenerator:
+    """Grid axes are expanded exhaustively; Domain axes sampled num_samples
+    times. Reference: search/basic_variant.py."""
+
+    def __init__(self, *, seed: int | None = None):
+        self._rng = random.Random(seed)
+
+    def generate(self, param_space: dict, num_samples: int) -> list[dict]:
+        grid_keys = [k for k, v in param_space.items() if isinstance(v, GridSearch)]
+        grids = [param_space[k].values for k in grid_keys]
+        configs: list[dict] = []
+        grid_combos = list(itertools.product(*grids)) if grid_keys else [()]
+        for _ in range(num_samples):
+            for combo in grid_combos:
+                cfg = {}
+                for k, v in param_space.items():
+                    if isinstance(v, GridSearch):
+                        cfg[k] = combo[grid_keys.index(k)]
+                    elif isinstance(v, Domain):
+                        cfg[k] = v.sample(self._rng)
+                    else:
+                        cfg[k] = v
+                configs.append(cfg)
+        return configs

@@ -1,0 +1,106 @@
+"""Tune tests: search spaces, Tuner end-to-end, ASHA early stopping, PBT
+exploit (reference patterns: python/ray/tune/tests/)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import RunConfig
+
+
+def test_basic_variant_grid_and_sampling():
+    gen = tune.BasicVariantGenerator(seed=0)
+    cfgs = gen.generate(
+        {"lr": tune.grid_search([0.1, 0.01]), "b": tune.choice([1, 2]), "c": 7},
+        num_samples=3,
+    )
+    assert len(cfgs) == 6  # 3 samples x 2 grid values
+    assert all(c["c"] == 7 for c in cfgs)
+    assert {c["lr"] for c in cfgs} == {0.1, 0.01}
+    assert all(c["b"] in (1, 2) for c in cfgs)
+
+
+def test_tuner_finds_best(ray_cluster, tmp_path):
+    def trainable(config):
+        # quadratic bowl: best at x=3
+        score = -((config["x"] - 3.0) ** 2)
+        tune.report({"score": score, "x": config["x"]})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([0.0, 1.0, 3.0, 5.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max", max_concurrent_trials=2),
+        run_config=RunConfig(name="grid", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 4
+    best = results.get_best_result(metric="score", mode="max")
+    assert best.metrics["x"] == 3.0
+
+
+def test_asha_stops_bad_trials(ray_cluster, tmp_path):
+    def trainable(config):
+        import time
+
+        for it in range(1, 9):
+            tune.report({"training_iteration": it, "acc": config["quality"] * it})
+            time.sleep(0.25)  # let the controller poll between iterations
+
+    sched = tune.ASHAScheduler(metric="acc", max_t=8, grace_period=2, reduction_factor=2)
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"quality": tune.grid_search([1.0, 0.9, 0.2, 0.1])},
+        tune_config=tune.TuneConfig(scheduler=sched, max_concurrent_trials=4),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    # good trials ran to completion
+    best = results.get_best_result(metric="acc", mode="max")
+    assert best.metrics["training_iteration"] == 8
+    # at least one poor trial was cut early
+    iters = [r.metrics.get("training_iteration", 0) for r in results]
+    assert min(iters) < 8
+
+
+def test_tuner_trial_error_isolated(ray_cluster, tmp_path):
+    def trainable(config):
+        if config["x"] == 1:
+            raise ValueError("bad trial")
+        tune.report({"ok": config["x"]})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([0, 1, 2])},
+        run_config=RunConfig(name="err", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results.errors) == 1
+    oks = sorted(r.metrics["ok"] for r in results if r.metrics)
+    assert oks == [0, 2]
+
+
+def test_pbt_exploit_logic():
+    from ray_tpu.tune.schedulers import PopulationBasedTraining
+
+    class T:
+        _n = 0
+
+        def __init__(self, cfg):
+            T._n += 1
+            self.trial_id = f"t{T._n}"
+            self.config = cfg
+
+    pbt = PopulationBasedTraining(
+        metric="score", perturbation_interval=2,
+        hyperparam_mutations={"lr": [0.1, 0.01]}, seed=0,
+    )
+    good, bad = T({"lr": 0.1}), T({"lr": 0.5})
+    pbt.on_result(good, {"training_iteration": 2, "score": 10.0})
+    pbt.on_result(bad, {"training_iteration": 2, "score": 1.0})
+    # bad trial at the perturbation interval exploits the good trial
+    new_cfg = pbt.maybe_exploit(bad, {"training_iteration": 2, "score": 1.0}, [good, bad])
+    assert new_cfg is not None
+    assert new_cfg["_pbt_exploit_from"] == good.trial_id
+    assert new_cfg["lr"] in (0.1, 0.01)
+    # good trial does not exploit
+    assert pbt.maybe_exploit(good, {"training_iteration": 2, "score": 10.0}, [good, bad]) is None
