@@ -20,6 +20,7 @@ worker (as in the reference).
 from __future__ import annotations
 
 import hashlib
+import inspect
 import logging
 import os
 import socket
@@ -914,6 +915,13 @@ class CoreWorker:
             else:
                 fn, _tag = self.functions.get(spec.function_id)
                 result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                # async actor methods (reference: fiber scheduling queues,
+                # transport/fiber.h) — each call runs on its own loop in
+                # this executor thread
+                import asyncio
+
+                result = asyncio.run(result)
             return {"returns": self._serialize_returns(spec, result)}
         except Exception as e:
             tb = traceback.format_exc()

@@ -1,0 +1,94 @@
+"""Deployment definitions and application graphs.
+
+Reference: ``python/ray/serve/api.py`` (@serve.deployment),
+``deployment.py``, ``build_app.py``. A Deployment wraps a user class or
+function with replica/autoscaling settings; ``bind()`` produces an
+Application node whose init args may contain other bound deployments
+(composed into handles at deploy time).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 2.0
+    downscale_delay_s: float = 10.0
+
+
+class Deployment:
+    def __init__(
+        self,
+        func_or_class: Any,
+        *,
+        name: str | None = None,
+        num_replicas: int | None = None,
+        max_ongoing_requests: int = 8,
+        autoscaling_config: AutoscalingConfig | dict | None = None,
+        ray_actor_options: dict | None = None,
+    ):
+        self.func_or_class = func_or_class
+        self.name = name or getattr(func_or_class, "__name__", "deployment")
+        self.num_replicas = num_replicas or 1
+        self.max_ongoing_requests = max_ongoing_requests
+        if isinstance(autoscaling_config, dict):
+            autoscaling_config = AutoscalingConfig(**autoscaling_config)
+        self.autoscaling_config = autoscaling_config
+        self.ray_actor_options = ray_actor_options or {}
+
+    def options(self, **kwargs) -> "Deployment":
+        merged = dict(
+            name=self.name,
+            num_replicas=self.num_replicas,
+            max_ongoing_requests=self.max_ongoing_requests,
+            autoscaling_config=self.autoscaling_config,
+            ray_actor_options=self.ray_actor_options,
+        )
+        merged.update(kwargs)
+        return Deployment(self.func_or_class, **merged)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def __repr__(self):
+        return f"Deployment({self.name}, replicas={self.num_replicas})"
+
+
+class Application:
+    """A bound deployment DAG node. Reference: serve's built app graph."""
+
+    def __init__(self, deployment: Deployment, args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.init_args = args
+        self.init_kwargs = kwargs
+
+    def walk(self) -> list["Application"]:
+        """All Application nodes reachable from this one (deps first)."""
+        seen: list[Application] = []
+
+        def visit(node: Application):
+            for a in list(node.init_args) + list(node.init_kwargs.values()):
+                if isinstance(a, Application):
+                    visit(a)
+            if node not in seen:
+                seen.append(node)
+
+        visit(self)
+        return seen
+
+
+def deployment(_func_or_class: Any = None, **kwargs) -> Any:
+    """@serve.deployment decorator. Reference: serve/api.py."""
+    if _func_or_class is not None:
+        return Deployment(_func_or_class)
+
+    def wrap(fc):
+        return Deployment(fc, **kwargs)
+
+    return wrap
