@@ -275,7 +275,10 @@ class ActorClass:
             placement_group_id=pg_id,
             placement_group_bundle_index=bundle,
         )
-        owned = not opts.get("name") and opts.get("lifetime") != "detached"
+        # Non-detached actors — named or not — die when the creator's last
+        # handle is GC'd (reference actor.py: only lifetime="detached"
+        # survives its creator).
+        owned = opts.get("lifetime") != "detached"
         return ActorHandle(actor_id, _owned=owned)
 
     def options(self, **new_options) -> "ActorClass":

@@ -25,7 +25,7 @@ from typing import Any
 
 from .config import get_config
 from .ids import ActorID, NodeID
-from .rpc import RetryableRpcClient, RpcClient, RpcServer
+from .rpc import RetryableRpcClient, RpcClient, RpcServer, spawn
 
 logger = logging.getLogger(__name__)
 
@@ -98,7 +98,7 @@ class GcsServer:
     # ------------------------------------------------------------------ util
     async def start(self) -> None:
         await self._server.start()
-        self._health_task = asyncio.ensure_future(self._health_check_loop())
+        self._health_task = spawn(self._health_check_loop())
 
     async def stop(self) -> None:
         if self._health_task:
@@ -262,15 +262,21 @@ class GcsServer:
             "death_cause": "",
         }
         self._actors[actor_id] = record
-        asyncio.ensure_future(self._create_actor(record))
+        spawn(self._create_actor(record))
         return {"actor_id": actor_id}
 
     async def _create_actor(self, record: dict) -> None:
-        """Lease a worker and push the creation task (GcsActorScheduler)."""
+        """Lease a worker and push the creation task (GcsActorScheduler).
+
+        Invariant: a granted dedicated lease is ALWAYS either promoted to a
+        live actor or returned to its raylet (killing the worker) — failed
+        creations must not strand leased resources."""
         spec = record["spec"]
         resources = spec.get("resources") or {"CPU": 1.0}
         strategy = spec.get("scheduling_strategy") or {}
         for attempt in range(60):
+            if record["state"] == DEAD:  # killed while pending
+                return
             node_id = self._select_node(resources, strategy)
             if node_id is None:
                 await asyncio.sleep(0.5)
@@ -282,7 +288,7 @@ class GcsServer:
                 lease = await client.call(
                     "RequestWorkerLease",
                     {"spec": spec, "dedicated": True},
-                    timeout=get_config().worker_register_timeout_s,
+                    timeout=get_config().worker_register_timeout_s + 10.0,
                 )
             except Exception as e:
                 logger.warning("Actor lease on node %s failed: %s", node_id[:8], e)
@@ -294,6 +300,14 @@ class GcsServer:
                 await asyncio.sleep(0.2)
                 continue
             worker_addr = lease["worker_address"]
+            worker_id = lease.get("worker_id", "")
+
+            async def _return_lease(kill: bool) -> None:
+                try:
+                    await client.call("ReturnWorker", {"worker_id": worker_id, "kill": kill}, timeout=10.0)
+                except Exception:
+                    pass
+
             logger.info("Actor %s: pushing creation task to %s", record["actor_id"][:8], worker_addr)
             try:
                 worker = RpcClient(worker_addr)
@@ -303,18 +317,23 @@ class GcsServer:
                 await worker.close()
                 logger.info("Actor %s: creation reply %s", record["actor_id"][:8], "err" if reply.get("error") else "ok")
                 if reply.get("error"):
+                    await _return_lease(kill=True)
                     record["state"] = DEAD
                     record["death_cause"] = f"creation task failed: {reply['error']}"
                     await self._publish_actor(record)
                     return
             except Exception as e:
                 record["death_cause"] = f"creation push failed: {e}"
+                await _return_lease(kill=True)
                 await asyncio.sleep(0.2)
                 continue
+            if record["state"] == DEAD:  # ray.kill raced with creation
+                await _return_lease(kill=True)
+                return
             record["state"] = ALIVE
             record["address"] = worker_addr
             record["node_id"] = node_id
-            record["worker_id"] = lease.get("worker_id", "")
+            record["worker_id"] = worker_id
             await self._publish_actor(record)
             return
         record["state"] = DEAD
@@ -404,7 +423,7 @@ class GcsServer:
             record["state"] = RESTARTING
             record["address"] = ""
             await self._publish_actor(record)
-            asyncio.ensure_future(self._create_actor(record))
+            spawn(self._create_actor(record))
         else:
             record["state"] = DEAD
             record["death_cause"] = reason
@@ -414,8 +433,6 @@ class GcsServer:
 
     # ------------------------------------------------------ placement groups
     async def handle_CreatePlacementGroup(self, p: dict) -> dict:
-        from .scheduling import schedule_placement_group
-
         pg_id = p["pg_id"].hex() if isinstance(p["pg_id"], bytes) else p["pg_id"]
         record = {
             "pg_id": pg_id,
@@ -426,21 +443,55 @@ class GcsServer:
             "name": p.get("name", ""),
         }
         self._placement_groups[pg_id] = record
-        # 2PC bundle reservation (gcs_placement_group_scheduler.h:117-119):
-        # phase 1 reserve on raylets, phase 2 commit — here both phases are
-        # executed against raylet `ReserveBundle`/`CommitBundle` RPCs.
-        placement = schedule_placement_group(self._nodes, p["bundles"], record["strategy"])
-        if placement is None:
-            record["state"] = "INFEASIBLE"
-            return {"pg_id": pg_id, "state": record["state"]}
-        reserved = []
+        spawn(self._schedule_pg_loop(record))
+        return {"pg_id": pg_id, "state": record["state"]}
+
+    async def _schedule_pg_loop(self, record: dict) -> None:
+        """Keep a PENDING group scheduling until it is placed or removed.
+
+        A group whose bundles exceed every node's TOTAL resources is
+        terminally INFEASIBLE; one that merely doesn't fit the currently
+        AVAILABLE resources stays PENDING and is retried as resources free
+        up (reference: GcsPlacementGroupManager pending queue,
+        ``gcs_placement_group_scheduler.h:117-119`` 2PC)."""
+        from .scheduling import schedule_placement_group
+
+        infeasible_since: float | None = None
+        while record["state"] == "PENDING":
+            if self._nodes:
+                feasible = schedule_placement_group(
+                    self._nodes, record["bundles"], record["strategy"], use_total=True
+                )
+                if feasible is None:
+                    # Only terminally INFEASIBLE if the totals check keeps
+                    # failing for a grace window — nodes may still be
+                    # registering (late raylets must not doom the group).
+                    now = time.time()
+                    if infeasible_since is None:
+                        infeasible_since = now
+                    elif now - infeasible_since > 10.0:
+                        record["state"] = "INFEASIBLE"
+                        return
+                else:
+                    infeasible_since = None
+                    placement = schedule_placement_group(
+                        self._nodes, record["bundles"], record["strategy"]
+                    )
+                    if placement is not None and await self._try_reserve(record, placement):
+                        return
+            await asyncio.sleep(0.25)
+
+    async def _try_reserve(self, record: dict, placement: list[str]) -> bool:
+        """2PC: reserve every bundle, then commit; cancel all on any failure."""
+        pg_id = record["pg_id"]
+        reserved: list[tuple[int, str]] = []
         ok = True
         for idx, node_id in enumerate(placement):
             client = self._raylet(node_id)
             try:
                 r = await client.call(
                     "ReserveBundle",
-                    {"pg_id": pg_id, "bundle_index": idx, "resources": p["bundles"][idx]},
+                    {"pg_id": pg_id, "bundle_index": idx, "resources": record["bundles"][idx]},
                     timeout=5.0,
                 )
                 if not r.get("ok"):
@@ -450,21 +501,31 @@ class GcsServer:
             except Exception:
                 ok = False
                 break
-        if not ok:
+        # RemovePlacementGroup may have raced with the reservations: roll
+        # back instead of committing, or the raylet-side reservations leak.
+        if not ok or record["state"] != "PENDING":
             for idx, node_id in reserved:
                 client = self._raylet(node_id)
                 try:
                     await client.call("CancelBundle", {"pg_id": pg_id, "bundle_index": idx}, timeout=5.0)
                 except Exception:
                     pass
-            record["state"] = "PENDING"
-            return {"pg_id": pg_id, "state": record["state"]}
+            return record["state"] != "PENDING"  # stop the loop if removed
         for idx, node_id in reserved:
             client = self._raylet(node_id)
             await client.call("CommitBundle", {"pg_id": pg_id, "bundle_index": idx}, timeout=5.0)
-        record["state"] = "CREATED"
         record["bundle_locations"] = [n for _, n in sorted(reserved)]
-        return {"pg_id": pg_id, "state": "CREATED", "bundle_locations": record["bundle_locations"]}
+        if record["state"] != "PENDING":
+            # removed mid-commit: release everything we just committed
+            for idx, node_id in enumerate(record["bundle_locations"]):
+                client = self._raylet(node_id)
+                try:
+                    await client.call("ReturnBundle", {"pg_id": pg_id, "bundle_index": idx}, timeout=5.0)
+                except Exception:
+                    pass
+            return True
+        record["state"] = "CREATED"
+        return True
 
     async def handle_GetPlacementGroup(self, p: dict) -> dict:
         record = self._placement_groups.get(p["pg_id"])
@@ -472,6 +533,8 @@ class GcsServer:
 
     async def handle_RemovePlacementGroup(self, p: dict) -> dict:
         record = self._placement_groups.pop(p["pg_id"], None)
+        if record and record["state"] == "PENDING":
+            record["state"] = "REMOVED"  # stops the scheduling loop
         if record and record["state"] == "CREATED":
             for idx, node_id in enumerate(record["bundle_locations"]):
                 client = self._raylet(node_id)

@@ -30,22 +30,50 @@ class MemoryStore:
         self._lock = threading.Lock()
         self._objects: dict[ObjectID, _Entry] = {}
         self._waiters: dict[ObjectID, list[threading.Event]] = {}
+        # Event-driven (non-blocking) waiters: oid -> list of callbacks fired
+        # once, from the putting thread, when the object becomes present.
+        self._callbacks: dict[ObjectID, list] = {}
 
-    def put(self, object_id: ObjectID, metadata: bytes, blob: bytes) -> None:
+    def _store(self, object_id: ObjectID, entry: _Entry) -> None:
         with self._lock:
-            self._objects[object_id] = _Entry(metadata, blob)
+            self._objects[object_id] = entry
             events = self._waiters.pop(object_id, [])
+            callbacks = self._callbacks.pop(object_id, [])
         for ev in events:
             ev.set()
+        for cb in callbacks:
+            try:
+                cb(object_id)
+            except Exception:
+                pass
+
+    def put(self, object_id: ObjectID, metadata: bytes, blob: bytes) -> None:
+        self._store(object_id, _Entry(metadata, blob))
 
     def put_plasma_marker(self, object_id: ObjectID, node_id: bytes) -> None:
         """Record that the value lives in plasma on ``node_id`` (the
         reference stores an IN_PLASMA_ERROR sentinel the same way)."""
+        self._store(object_id, _Entry(b"", b"", in_plasma=True, node_id=node_id))
+
+    def add_callback(self, object_id: ObjectID, callback) -> bool:
+        """Register ``callback(oid)`` for when ``object_id`` appears.
+        Returns False (callback NOT registered) if it is already present."""
         with self._lock:
-            self._objects[object_id] = _Entry(b"", b"", in_plasma=True, node_id=node_id)
-            events = self._waiters.pop(object_id, [])
-        for ev in events:
-            ev.set()
+            if object_id in self._objects:
+                return False
+            self._callbacks.setdefault(object_id, []).append(callback)
+            return True
+
+    def remove_callback(self, object_id: ObjectID, callback) -> None:
+        with self._lock:
+            cbs = self._callbacks.get(object_id)
+            if cbs is not None:
+                try:
+                    cbs.remove(callback)
+                except ValueError:
+                    pass
+                if not cbs:
+                    self._callbacks.pop(object_id, None)
 
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:

@@ -33,7 +33,7 @@ from dataclasses import dataclass, field
 from .config import get_config
 from .ids import NodeID, WorkerID
 from .resources import NodeResources, ResourceSet
-from .rpc import RetryableRpcClient, RpcClient, RpcServer
+from .rpc import RetryableRpcClient, RpcClient, RpcServer, spawn
 from ..native.store import ShmStore, StoreFullError
 
 logger = logging.getLogger(__name__)
@@ -48,6 +48,8 @@ class WorkerHandle:
     state: str = "starting"  # starting | idle | leased | dedicated | dead
     actor_id: str = ""
     lease_resources: ResourceSet = field(default_factory=ResourceSet)
+    # Bundle this lease draws from, if the task runs in a placement group.
+    bundle_key: tuple | None = None
     registered: asyncio.Future | None = None
     last_idle_time: float = 0.0
 
@@ -113,8 +115,8 @@ class Raylet:
                 "resources": self.resources.to_dict(),
             },
         )
-        self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
-        self._tasks.append(asyncio.ensure_future(self._worker_monitor_loop()))
+        self._tasks.append(spawn(self._heartbeat_loop()))
+        self._tasks.append(spawn(self._worker_monitor_loop()))
         cfg = get_config()
         for _ in range(cfg.num_prestart_workers):
             self._start_worker()
@@ -174,13 +176,23 @@ class Raylet:
                         except Exception:
                             pass
 
+    def _release_lease(self, w: WorkerHandle) -> None:
+        if w.lease_resources.is_empty():
+            return
+        if w.bundle_key is not None:
+            b = self._pg_bundles.get(w.bundle_key)
+            if b is not None:
+                b["used"] = b["used"].subtract(w.lease_resources, allow_negative=True)
+            w.bundle_key = None
+        else:
+            self.resources.release(w.lease_resources)
+        w.lease_resources = ResourceSet()
+
     def _on_worker_dead(self, w: WorkerHandle) -> None:
         w.state = "dead"
         if w.worker_id in self._idle:
             self._idle.remove(w.worker_id)
-        if not w.lease_resources.is_empty():
-            self.resources.release(w.lease_resources)
-            w.lease_resources = ResourceSet()
+        self._release_lease(w)
         self._workers.pop(w.worker_id, None)
 
     # ------------------------------------------------------------ worker pool
@@ -273,9 +285,9 @@ class Raylet:
         request = ResourceSet(self._lease_resources(spec))
         grant_only_local = bool(p.get("grant_only_local") or p.get("dedicated"))
 
-        # Placement-group tasks run on the node holding their bundle: local
-        # if the bundle is committed here, otherwise spill straight to the
-        # bundle's node (GcsPlacementGroupScheduler keeps the locations).
+        # Placement-group tasks run on the node holding their bundle and
+        # draw resources from the bundle's reservation, not the node pool
+        # (reference: bundle_scheduling_policy.cc, bundle resources are real).
         pg_id = spec.get("placement_group_id") or b""
         if pg_id:
             pg_hex = pg_id.hex() if isinstance(pg_id, bytes) else pg_id
@@ -289,6 +301,7 @@ class Raylet:
                     if node is None:
                         return {"granted": False, "reason": "bundle node lost"}
                     return {"spillback": True, "node_address": node["address"], "node_id": target}
+            return await self._grant_in_bundle(p, spec, pg_hex, idx)
 
         if not request.subset_of(self.resources.total):
             if grant_only_local:
@@ -338,6 +351,61 @@ class Raylet:
             "node_id": self.node_id.hex(),
         }
 
+    async def _grant_in_bundle(self, p: dict, spec: dict, pg_hex: str, idx: int) -> dict:
+        """Lease a worker whose resources are charged against a committed
+        bundle's reservation (so bundles cannot be oversubscribed)."""
+        res = dict(spec.get("resources") or {})
+        if not res:
+            res = {"CPU": 1.0}
+        request = ResourceSet(res)
+        deadline = time.monotonic() + get_config().worker_register_timeout_s
+        key = None
+        while True:
+            key = self._pick_bundle(pg_hex, idx, request)
+            if key is not None:
+                b = self._pg_bundles[key]
+                b["used"] = b["used"].add(request)
+                break
+            if time.monotonic() > deadline:
+                return {"granted": False, "reason": f"bundle {pg_hex}[{idx}] has no spare capacity for {res}"}
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._lease_waiters.append(fut)
+            try:
+                await asyncio.wait_for(fut, 0.5)
+            except asyncio.TimeoutError:
+                pass
+        worker = await self._get_idle_worker(get_config().worker_register_timeout_s)
+        if worker is None:
+            b = self._pg_bundles.get(key)
+            if b is not None:
+                b["used"] = b["used"].subtract(request, allow_negative=True)
+            return {"granted": False, "reason": "no worker available"}
+        worker.lease_resources = request
+        worker.bundle_key = key
+        worker.state = "dedicated" if p.get("dedicated") else "leased"
+        if p.get("dedicated"):
+            actor_id = spec.get("actor_id", b"")
+            worker.actor_id = actor_id.hex() if isinstance(actor_id, bytes) else actor_id
+        self._wake_lease_waiters()
+        return {
+            "granted": True,
+            "worker_id": worker.worker_id,
+            "worker_address": worker.address,
+            "node_id": self.node_id.hex(),
+        }
+
+    def _pick_bundle(self, pg_hex: str, idx: int, request: ResourceSet) -> tuple | None:
+        """Find a committed local bundle with spare capacity for `request`."""
+        for key, b in self._pg_bundles.items():
+            if key[0] != pg_hex or not b.get("committed"):
+                continue
+            if idx >= 0 and key[1] != idx:
+                continue
+            spare = b["resources"].subtract(b["used"], allow_negative=True)
+            if request.subset_of(spare):
+                return key
+        return None
+
     def _has_local_bundle(self, pg_hex: str, idx: int) -> bool:
         if idx >= 0:
             b = self._pg_bundles.get((pg_hex, idx))
@@ -363,10 +431,6 @@ class Raylet:
         res = dict(spec.get("resources") or {})
         if not res and spec.get("kind", 0) == 0:
             res = {"CPU": 1.0}
-        pg_id = spec.get("placement_group_id") or b""
-        if pg_id:
-            # Resources come from the reserved bundle, not the node pool.
-            return {}
         return res
 
     def _pick_remote_node(self, request: ResourceSet, require_available: bool = False) -> dict | None:
@@ -387,9 +451,7 @@ class Raylet:
         w = self._workers.get(p["worker_id"])
         if w is None or w.state == "dead":
             return {}
-        if not w.lease_resources.is_empty():
-            self.resources.release(w.lease_resources)
-            w.lease_resources = ResourceSet()
+        self._release_lease(w)
         if p.get("kill"):
             if w.proc is not None:
                 w.proc.terminate()
@@ -535,6 +597,7 @@ class Raylet:
         self.resources.acquire(request)
         self._pg_bundles[(p["pg_id"], p["bundle_index"])] = {
             "resources": request,
+            "used": ResourceSet(),
             "committed": False,
         }
         return {"ok": True}

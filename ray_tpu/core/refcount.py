@@ -37,6 +37,11 @@ class _Ref:
     locations: set = field(default_factory=set)
     owned: bool = False
     lineage_pinned: bool = False
+    # For non-owned (borrowed) refs: the owner's RPC address, so the last
+    # local release can send RemoveBorrower back to the owner.
+    owner_address: str = ""
+    # Borrow registration with the owner has been initiated.
+    borrow_registered: bool = False
 
     def total(self) -> int:
         return self.local + self.submitted + self.borrowers + self.contained_in
@@ -68,6 +73,29 @@ class ReferenceCounter:
             ref = self._refs.get(oid)
             return bool(ref and ref.owned)
 
+    def note_borrowed(self, oid: ObjectID, owner_address: str) -> bool:
+        """Record that this process borrows ``oid`` from ``owner_address``.
+        Returns True exactly once per borrow episode — the caller must then
+        send AddBorrower to the owner (reference: borrower registration,
+        ``reference_count.h:66``)."""
+        with self._lock:
+            ref = self._entry(oid)
+            if ref.owned or ref.borrow_registered:
+                return False
+            ref.owner_address = owner_address
+            ref.borrow_registered = True
+            return True
+
+    def add_containment(self, outer: ObjectID, children: list[ObjectID]) -> None:
+        """outer's value embeds the children (nested refs): children live at
+        least as long as outer does in this process."""
+        with self._lock:
+            ref = self._entry(outer)
+            for child in children:
+                if child not in ref.contains:
+                    ref.contains.add(child)
+                    self._entry(child).contained_in += 1
+
     # -- counts --------------------------------------------------------------
     def add_local_ref(self, oid: ObjectID) -> None:
         with self._lock:
@@ -91,27 +119,29 @@ class ReferenceCounter:
         self._dec(oid, "borrowers")
 
     def _dec(self, oid: ObjectID, kind: str) -> None:
-        freed: list[tuple[ObjectID, set]] = []
+        freed: list[_Ref] = []
+        freed_ids: list[ObjectID] = []
         with self._lock:
             ref = self._refs.get(oid)
             if ref is None:
                 return
             setattr(ref, kind, max(0, getattr(ref, kind) - 1))
-            self._maybe_free(oid, ref, freed)
-        for oid_, locations in freed:
+            self._maybe_free(oid, ref, freed_ids, freed)
+        for oid_, ref_ in zip(freed_ids, freed):
             if self._on_object_freed is not None:
-                self._on_object_freed(oid_, locations)
+                self._on_object_freed(oid_, ref_)
 
-    def _maybe_free(self, oid: ObjectID, ref: _Ref, freed: list) -> None:
+    def _maybe_free(self, oid: ObjectID, ref: _Ref, freed_ids: list, freed: list) -> None:
         if ref.total() > 0:
             return
         self._refs.pop(oid, None)
-        freed.append((oid, set(ref.locations)))
+        freed_ids.append(oid)
+        freed.append(ref)
         for child in ref.contains:
             child_ref = self._refs.get(child)
             if child_ref is not None:
                 child_ref.contained_in = max(0, child_ref.contained_in - 1)
-                self._maybe_free(child, child_ref, freed)
+                self._maybe_free(child, child_ref, freed_ids, freed)
 
     # -- locations -----------------------------------------------------------
     def add_location(self, oid: ObjectID, node_id: bytes) -> None:

@@ -35,6 +35,22 @@ logger = logging.getLogger(__name__)
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 31
 
+# Strong roots for fire-and-forget asyncio tasks. The event loop holds only
+# weak references to tasks; a task blocked on an RPC future forms a
+# reference cycle (task -> coroutine frame -> client -> pending future ->
+# task) with no external root, so the cyclic GC can destroy it mid-await,
+# throwing GeneratorExit into the coroutine. Every background task must be
+# anchored here until done.
+_BACKGROUND_TASKS: set = set()
+
+
+def spawn(coro) -> "asyncio.Task":
+    """ensure_future with a strong reference for the task's lifetime."""
+    task = asyncio.ensure_future(coro)
+    _BACKGROUND_TASKS.add(task)
+    task.add_done_callback(_BACKGROUND_TASKS.discard)
+    return task
+
 
 def _pack(obj: Any) -> bytes:
     body = msgpack.packb(obj, use_bin_type=True)
@@ -123,7 +139,7 @@ class RpcServer:
         try:
             while True:
                 msg = await _read_frame(reader)
-                asyncio.ensure_future(self._dispatch(msg, writer))
+                spawn(self._dispatch(msg, writer))
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
         finally:
@@ -177,11 +193,16 @@ class RpcClient:
             if self._writer is not None and not self._writer.is_closing():
                 return
             cfg = get_config()
-            self._reader, self._writer = await asyncio.wait_for(
-                asyncio.open_connection(self._host, self._port),
-                timeout=cfg.rpc_connect_timeout_s,
-            )
-            self._read_task = asyncio.ensure_future(self._read_loop())
+            try:
+                self._reader, self._writer = await asyncio.wait_for(
+                    asyncio.open_connection(self._host, self._port),
+                    timeout=cfg.rpc_connect_timeout_s,
+                )
+            except (OSError, asyncio.TimeoutError) as e:
+                # Normalize so every transport failure surfaces as RpcError
+                # (callers' except clauses and the retry filter rely on it).
+                raise RpcError(f"Connection to {self.address} failed: {e}") from e
+            self._read_task = spawn(self._read_loop())
 
     async def _read_loop(self) -> None:
         try:
@@ -270,6 +291,7 @@ class EventLoopThread:
 
     def __init__(self, name: str = "raytpu-io"):
         self.loop = asyncio.new_event_loop()
+        self._inflight: set = set()
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._thread.start()
 
@@ -278,19 +300,33 @@ class EventLoopThread:
         self.loop.run_forever()
 
     def run_coro(self, coro):
-        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        # Anchor the future (and through its cancel-chaining callback, the
+        # task) so fire-and-forget coroutines can't be GC'd mid-await.
+        self._inflight.add(fut)
+        fut.add_done_callback(self._inflight.discard)
+        return fut
 
     def run_sync(self, coro, timeout: float | None = None):
         return self.run_coro(coro).result(timeout)
 
     def stop(self) -> None:
-        def _shutdown():
-            for task in asyncio.all_tasks(self.loop):
-                task.cancel()
-            self.loop.stop()
+        async def _drain():
+            me = asyncio.current_task()
+            tasks = [t for t in asyncio.all_tasks() if t is not me]
+            for t in tasks:
+                t.cancel()
+            # Let cancellations unwind (finally blocks) before the loop dies,
+            # so no "Task was destroyed but it is pending!" floods.
+            if tasks:
+                await asyncio.wait(tasks, timeout=2.0)
 
         if self.loop.is_running():
-            self.loop.call_soon_threadsafe(_shutdown)
+            try:
+                asyncio.run_coroutine_threadsafe(_drain(), self.loop).result(timeout=4)
+            except Exception:
+                pass
+            self.loop.call_soon_threadsafe(self.loop.stop)
             self._thread.join(timeout=5)
         if not self.loop.is_running():
             try:

@@ -80,16 +80,25 @@ def select_node_for_resources(nodes: dict, resources: dict, strategy: dict) -> s
     return min(feasible, key=lambda x: (x[1].utilization(), x[0]))[0]
 
 
-def schedule_placement_group(nodes: dict, bundles: list[dict], strategy: str) -> list[str] | None:
+def schedule_placement_group(
+    nodes: dict, bundles: list[dict], strategy: str, use_total: bool = False
+) -> list[str] | None:
     """Map each bundle to a node id. Returns per-bundle node list or None.
 
-    Reference: bundle_scheduling_policy.cc (PACK/SPREAD/STRICT_*).
+    ``use_total=True`` checks against node TOTAL resources (feasibility:
+    could this ever be placed on an empty cluster?) rather than currently
+    available ones. Reference: bundle_scheduling_policy.cc.
     """
     alive = {
         nid: NodeResources.from_dict(n["resources"])
         for nid, n in nodes.items()
         if n.get("state") == "ALIVE"
     }
+    if use_total:
+        for nr in alive.values():
+            # acquire() rebinds `available` rather than mutating, so sharing
+            # the total ResourceSet here is safe.
+            nr.available = nr.total
     if not alive:
         return None
     requests = [ResourceSet(b) for b in bundles]

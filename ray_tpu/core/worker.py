@@ -213,6 +213,12 @@ class CoreWorker:
         # __ray_terminate__ on handle GC).
         self._actor_handle_counts: dict[bytes, int] = {}
         self._owned_actors: set[bytes] = set()
+        # Borrowing protocol state: per-owner ordered RPC clients, and
+        # temporary holds on owned objects we returned to a caller that has
+        # not yet registered as a borrower (expiring failsafe).
+        self._borrow_clients: dict[str, RetryableRpcClient] = {}
+        self._borrow_holds: dict[bytes, list[float]] = {}
+        self._borrow_holds_lock = threading.Lock()
 
         # Executor-side state (worker mode).
         self.actor_instance: Any = None
@@ -229,6 +235,7 @@ class CoreWorker:
         self.server.register_service(self)
         self.io.run_sync(self.server.start())
         self.address = self.server.address
+        self.io.run_coro(self._borrow_hold_sweeper())
 
         install_refcount_hooks(self._hook_add_local, self._hook_remove_local)
 
@@ -246,8 +253,19 @@ class CoreWorker:
 
     def shutdown(self) -> None:
         install_refcount_hooks(lambda r: None, lambda r: None)
+
+        async def _close_all():
+            await self.server.stop()
+            for state in self._actors.values():
+                if state.client is not None:
+                    await state.client.close()
+            for client in self._borrow_clients.values():
+                await client.close()
+            await self.gcs.close()
+            await self.raylet.close()
+
         try:
-            self.io.run_sync(self.server.stop(), timeout=5)
+            self.io.run_sync(_close_all(), timeout=5)
         except Exception:
             pass
         self.io.stop()
@@ -262,16 +280,38 @@ class CoreWorker:
 
     # -------------------------------------------------------------- refcount
     def _hook_add_local(self, ref: ObjectRef) -> None:
-        self.refcounter.add_local_ref(ref.id())
+        oid = ref.id()
+        self.refcounter.add_local_ref(oid)
+        owner = ref.owner_address
+        if owner and owner != self.address and self.refcounter.note_borrowed(oid, owner):
+            # First local ref to a borrowed object: register with its owner
+            # so the owner keeps it alive (reference_count.h:66 borrowing).
+            self.io.run_coro(self._send_borrow(owner, "AddBorrower", oid))
 
     def _hook_remove_local(self, ref: ObjectRef) -> None:
         self.refcounter.remove_local_ref(ref.id())
 
-    def _on_object_freed(self, oid: ObjectID, locations: set) -> None:
-        """All references dropped: delete every copy (reference_count.cc →
-        plasma Delete broadcast)."""
+    async def _send_borrow(self, owner_address: str, method: str, oid: ObjectID) -> None:
+        try:
+            client = self._borrow_clients.get(owner_address)
+            if client is None:
+                # One ordered connection per owner so Add/Remove can't race.
+                client = self._borrow_clients[owner_address] = RetryableRpcClient(owner_address)
+            await client.call(method, {"id": oid.binary(), "borrower": self.worker_id}, timeout=30.0)
+        except Exception:
+            pass  # owner died: its state is gone anyway
+
+    def _on_object_freed(self, oid: ObjectID, ref) -> None:
+        """All references dropped. Owned objects: delete every copy
+        (reference_count.cc → plasma Delete broadcast). Borrowed objects:
+        report the release back to the owner."""
+        if not ref.owned:
+            if ref.borrow_registered and ref.owner_address:
+                self.io.run_coro(self._send_borrow(ref.owner_address, "RemoveBorrower", oid))
+            return
         self.memory_store.delete(oid)
         self.task_manager.evict_lineage(oid)
+        locations = set(ref.locations)
 
         async def _free():
             for node_id in locations:
@@ -436,43 +476,79 @@ class CoreWorker:
 
     # ------------------------------------------------------------------ wait
     def wait(self, refs: Sequence[ObjectRef], num_returns: int, timeout: float | None):
-        deadline = None if timeout is None else time.monotonic() + timeout
+        """Event-driven wait (reference ``core_worker.cc`` Wait): one asyncio
+        waiter per ref resolves on memory-store arrival (owned refs) or on an
+        owner long-poll (borrowed refs) — no polling loop."""
         refs = list(refs)
-        while True:
-            ready, not_ready = [], []
-            for ref in refs:
-                (ready if self._is_ready(ref) else not_ready).append(ref)
-            if len(ready) >= num_returns:
-                return ready[:num_returns], [r for r in refs if r not in ready[:num_returns]]
-            if deadline is not None and time.monotonic() >= deadline:
-                return ready, not_ready
-            time.sleep(0.01)
+        fut = self.io.run_coro(self._wait_async(refs, num_returns, timeout))
+        ready_idx = fut.result()
+        ready = [refs[i] for i in sorted(ready_idx)][:num_returns]
+        not_ready = [r for r in refs if r not in ready]
+        return ready, not_ready
 
-    def _is_ready(self, ref: ObjectRef) -> bool:
-        oid = ref.id()
-        entry = self.memory_store.get_if_exists(oid)
-        if entry is not None:
-            if not entry.in_plasma:
-                return True
-            state = self._raylet_call("PlasmaContains", {"id": oid.binary()})["state"]
-            if state == 2:
-                return True
-            return bool(self.refcounter.get_locations(oid))
-        if not self.refcounter.is_owned(oid) and ref.owner_address and ref.owner_address != self.address:
-            try:
-                owner = RpcClient(ref.owner_address)
+    async def _wait_async(self, refs: list[ObjectRef], num_returns: int, timeout: float | None) -> list[int]:
+        import asyncio
 
-                async def _call():
-                    try:
-                        return await owner.call("GetObjectStatus", {"id": ref.binary(), "wait": False}, timeout=5.0)
-                    finally:
-                        await owner.close()
+        loop = asyncio.get_running_loop()
+        ready: list[int] = []
+        pending: dict[asyncio.Task, int] = {}
+        cleanups = []
+        for i, ref in enumerate(refs):
+            if self.memory_store.contains(ref.id()):
+                ready.append(i)
+            elif self.refcounter.is_owned(ref.id()) or not ref.owner_address or ref.owner_address == self.address:
+                fut: asyncio.Future = loop.create_future()
 
-                status = self.io.run_sync(_call())
-                return bool(status.get("inline") or status.get("in_plasma"))
-            except Exception:
-                return False
-        return False
+                def _on_ready(_oid, fut=fut):
+                    loop.call_soon_threadsafe(lambda: fut.done() or fut.set_result(True))
+
+                if self.memory_store.add_callback(ref.id(), _on_ready):
+                    cleanups.append((ref.id(), _on_ready))
+                    pending[asyncio.ensure_future(self._await_future(fut))] = i
+                else:
+                    ready.append(i)
+            else:
+                pending[asyncio.ensure_future(self._wait_borrowed(ref, timeout))] = i
+        try:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while len(ready) < num_returns and pending:
+                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                done, _ = await asyncio.wait(
+                    pending.keys(), timeout=remaining, return_when=asyncio.FIRST_COMPLETED
+                )
+                if not done:
+                    break  # timeout
+                for task in done:
+                    ready.append(pending.pop(task))
+            return ready
+        finally:
+            for task in pending:
+                task.cancel()
+            for oid, cb in cleanups:
+                self.memory_store.remove_callback(oid, cb)
+
+    @staticmethod
+    async def _await_future(fut) -> None:
+        await fut
+
+    async def _wait_borrowed(self, ref: ObjectRef, timeout: float | None) -> None:
+        """Long-poll the owner until a borrowed ref is ready. Owner death
+        counts as ready (the subsequent get raises OwnerDiedError)."""
+        owner = RpcClient(ref.owner_address)
+        try:
+            while True:
+                try:
+                    status = await owner.call(
+                        "GetObjectStatus",
+                        {"id": ref.binary(), "wait": True, "timeout": 30.0 if timeout is None else min(timeout, 3600.0)},
+                        timeout=None,
+                    )
+                except RpcError:
+                    return
+                if status.get("inline") or status.get("in_plasma"):
+                    return
+        finally:
+            await owner.close()
 
     # --------------------------------------------------------- task submission
     def next_task_id(self) -> TaskID:
@@ -571,7 +647,11 @@ class CoreWorker:
 
     async def _lease_pipeline(self, key: tuple) -> None:
         """One lease worker: acquire a lease, drain the queue, return it
-        (NormalTaskSubmitter::RequestNewWorkerIfNeeded, :291)."""
+        (NormalTaskSubmitter::RequestNewWorkerIfNeeded, :291).
+
+        Invariant: once a spec is popped from the queue it is ALWAYS resolved
+        — completed, re-enqueued for retry, or failed — on every exit path,
+        including cancellation and unexpected exceptions."""
         try:
             while True:
                 with self._queue_lock:
@@ -594,13 +674,21 @@ class CoreWorker:
                             if not self._task_queues.get(key):
                                 break
                             spec = self._task_queues[key].pop(0)
-                        await self._push_and_complete(spec, worker, worker_id)
+                        try:
+                            await self._push_and_complete(spec, worker, worker_id)
+                        except BaseException as e:
+                            # Never lose a popped spec: cancellation and
+                            # unexpected errors fail it visibly.
+                            self._fail_task(spec, RayTpuError(f"task submission aborted: {type(e).__name__}: {e}"))
+                            raise
                 finally:
                     await worker.close()
                     try:
                         await raylet_client.call("ReturnWorker", {"worker_id": worker_id}, timeout=10.0)
                     except Exception:
                         pass
+                    if raylet_client is not self.raylet:
+                        await raylet_client.close()
         finally:
             with self._queue_lock:
                 self._pipelines[key] = max(0, self._pipelines.get(key, 1) - 1)
@@ -611,22 +699,30 @@ class CoreWorker:
     async def _acquire_lease(self, spec: TaskSpec):
         """Follow the lease/spillback protocol up to a hop limit."""
         raylet = self.raylet
-        for _hop in range(4):
-            try:
-                reply = await raylet.call(
-                    "RequestWorkerLease",
-                    {"spec": spec.to_wire()},
-                    timeout=get_config().worker_register_timeout_s + 10.0,
-                )
-            except RpcError:
+        try:
+            for _hop in range(4):
+                try:
+                    reply = await raylet.call(
+                        "RequestWorkerLease",
+                        {"spec": spec.to_wire()},
+                        timeout=get_config().worker_register_timeout_s + 10.0,
+                    )
+                except RpcError:
+                    return None
+                if reply.get("granted"):
+                    lease = reply["worker_address"], reply["worker_id"], raylet
+                    raylet = self.raylet  # returned raylet kept by caller; don't close it
+                    return lease
+                if reply.get("spillback"):
+                    if raylet is not self.raylet:
+                        await raylet.close()
+                    raylet = RetryableRpcClient(reply["node_address"])
+                    continue
                 return None
-            if reply.get("granted"):
-                return reply["worker_address"], reply["worker_id"], raylet
-            if reply.get("spillback"):
-                raylet = RetryableRpcClient(reply["node_address"])
-                continue
             return None
-        return None
+        finally:
+            if raylet is not self.raylet:
+                await raylet.close()
 
     async def _push_and_complete(self, spec: TaskSpec, worker: RpcClient, worker_id: str) -> None:
         try:
@@ -647,6 +743,19 @@ class CoreWorker:
         returns = reply.get("returns", [])
         for i, ret in enumerate(returns):
             rid = ObjectID.for_task_return(task_id, i + 1)
+            # The return value embeds nested refs: record containment (they
+            # live while the return object lives here) and register as a
+            # borrower with their owners (reference: nested-ref borrowing).
+            contained = ret.get("contained") or []
+            if contained:
+                child_ids = []
+                for c in contained:
+                    cid = ObjectID(c["id"])
+                    child_ids.append(cid)
+                    owner = c.get("owner", "")
+                    if owner and owner != self.address and self.refcounter.note_borrowed(cid, owner):
+                        self.io.run_coro(self._send_borrow(owner, "AddBorrower", cid))
+                self.refcounter.add_containment(rid, child_ids)
             if ret["t"] == "v":
                 self.memory_store.put(rid, ret["meta"], ret["blob"])
             else:  # in plasma on executor's node
@@ -851,10 +960,23 @@ class CoreWorker:
         status = _check()
         if status is not None or not wait:
             return status or {"error": "unknown object"}
+        # Event-driven long-poll: park an asyncio future on the store rather
+        # than burning an executor thread per waiting borrower.
         import asyncio
 
         loop = asyncio.get_running_loop()
-        await loop.run_in_executor(None, lambda: self.memory_store.wait_ready([oid], 1, timeout))
+        fut: asyncio.Future = loop.create_future()
+
+        def _on_ready(_oid):
+            loop.call_soon_threadsafe(lambda: fut.done() or fut.set_result(True))
+
+        if self.memory_store.add_callback(oid, _on_ready):
+            try:
+                await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                pass
+            finally:
+                self.memory_store.remove_callback(oid, _on_ready)
         return _check() or {"error": "timeout"}
 
     async def handle_GetObjectLocations(self, p: dict) -> dict:
@@ -867,6 +989,45 @@ class CoreWorker:
 
     async def handle_Ping(self, p: dict) -> dict:
         return {"worker_id": self.worker_id}
+
+    # --------------------------------------------------- borrowing protocol
+    async def handle_AddBorrower(self, p: dict) -> dict:
+        oid = ObjectID(p["id"])
+        self.refcounter.add_borrower(oid)
+        # The borrower has registered: release one temporary return-hold.
+        with self._borrow_holds_lock:
+            holds = self._borrow_holds.get(oid.binary())
+            had_hold = bool(holds)
+            if holds:
+                holds.pop()
+                if not holds:
+                    self._borrow_holds.pop(oid.binary(), None)
+        if had_hold:
+            self.refcounter.remove_borrower(oid)
+        return {}
+
+    async def handle_RemoveBorrower(self, p: dict) -> dict:
+        self.refcounter.remove_borrower(ObjectID(p["id"]))
+        return {}
+
+    async def _borrow_hold_sweeper(self) -> None:
+        """Failsafe: drop return-holds whose caller never registered (it
+        died before processing the reply)."""
+        import asyncio
+
+        while True:
+            await asyncio.sleep(30.0)
+            now = time.monotonic()
+            expired: list[bytes] = []
+            with self._borrow_holds_lock:
+                for key, holds in list(self._borrow_holds.items()):
+                    while holds and holds[0] <= now:
+                        holds.pop(0)
+                        expired.append(key)
+                    if not holds:
+                        self._borrow_holds.pop(key, None)
+            for key in expired:
+                self.refcounter.remove_borrower(ObjectID(key))
 
     # ------------------------------------------------------------ executor
     async def handle_PushTask(self, p: dict) -> dict:
@@ -906,22 +1067,28 @@ class CoreWorker:
                 self.actor_instance = cls(*args, **kwargs)
                 self.actor_id = spec.actor_id
                 self._actor_next_seq = {}
+                # Actor-wide concurrency limit: sequencing is per-caller, but
+                # calls from DIFFERENT callers must still respect
+                # max_concurrency (default 1 = serialized actor).
+                self._actor_sem = threading.Semaphore(max(1, spec.max_concurrency))
                 return {"returns": []}
             if spec.kind == TASK_KIND_ACTOR_TASK:
                 if self.actor_instance is None:
                     return {"error": "actor instance not initialized"}
                 method = getattr(self.actor_instance, spec.actor_method)
-                result = method(*args, **kwargs)
+                sem = self._actor_sem
+                if sem is not None:
+                    with sem:
+                        # run-to-completion INSIDE the semaphore: an async
+                        # method returns its coroutine instantly, so the
+                        # asyncio.run must also be covered or
+                        # max_concurrency=1 would not serialize async actors
+                        result = _run_to_completion(method(*args, **kwargs))
+                else:
+                    result = _run_to_completion(method(*args, **kwargs))
             else:
                 fn, _tag = self.functions.get(spec.function_id)
-                result = fn(*args, **kwargs)
-            if inspect.iscoroutine(result):
-                # async actor methods (reference: fiber scheduling queues,
-                # transport/fiber.h) — each call runs on its own loop in
-                # this executor thread
-                import asyncio
-
-                result = asyncio.run(result)
+                result = _run_to_completion(fn(*args, **kwargs))
             return {"returns": self._serialize_returns(spec, result)}
         except Exception as e:
             tb = traceback.format_exc()
@@ -959,14 +1126,36 @@ class CoreWorker:
         out = []
         task_id = TaskID(spec.task_id)
         for i, value in enumerate(results):
-            metadata, blob, _contained = serialization.serialize(value)
+            metadata, blob, contained = serialization.serialize(value)
+            wire_contained = self._hold_returned_refs(contained)
             if len(blob) <= cfg.max_inline_object_size:
-                out.append({"t": "v", "meta": metadata, "blob": blob})
+                entry = {"t": "v", "meta": metadata, "blob": blob}
             else:
                 rid = ObjectID.for_task_return(task_id, i + 1)
                 self._plasma_put(rid, metadata, blob)
-                out.append({"t": "p", "node_id": self.node_id})
+                entry = {"t": "p", "node_id": self.node_id}
+            if wire_contained:
+                entry["contained"] = wire_contained
+            out.append(entry)
         return out
+
+    def _hold_returned_refs(self, contained: list) -> list[dict]:
+        """A return value embeds ObjectRefs: take a temporary borrower hold
+        on each ref we own so it survives until the caller registers as a
+        borrower (released in handle_AddBorrower, or by the expiry sweep if
+        the caller died). Returns the wire descriptors."""
+        wire = []
+        now = time.monotonic()
+        for r in contained:
+            oid = r.id()
+            owner = r.owner_address or self.address
+            if self.refcounter.is_owned(oid):
+                owner = self.address
+                self.refcounter.add_borrower(oid)
+                with self._borrow_holds_lock:
+                    self._borrow_holds.setdefault(oid.binary(), []).append(now + 600.0)
+            wire.append({"id": oid.binary(), "owner": owner})
+        return wire
 
     async def handle_Exit(self, p: dict) -> dict:
         import asyncio
@@ -979,6 +1168,16 @@ def asyncio_sleep(t: float):
     import asyncio
 
     return asyncio.sleep(t)
+
+
+def _run_to_completion(result):
+    """async actor/task functions run on their own loop in this executor
+    thread (reference: fiber scheduling queues, transport/fiber.h)."""
+    if inspect.iscoroutine(result):
+        import asyncio
+
+        return asyncio.run(result)
+    return result
 
 
 # ---------------------------------------------------------------- global API

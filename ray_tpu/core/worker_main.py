@@ -28,6 +28,11 @@ def main() -> None:
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO, format="[worker %(process)d] %(message)s")
+    # SIGUSR1 → dump all thread stacks to the worker log (debugging stuck
+    # workers; reference exposes the same via `ray stack`).
+    import faulthandler
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     worker = CoreWorker(
         mode=MODE_WORKER,
         gcs_address=args.gcs_address,

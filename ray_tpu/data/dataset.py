@@ -110,9 +110,12 @@ class Dataset:
     # --------------------------------------------------------- train feeding
     def streaming_split(self, n: int, *, equal: bool = False) -> list[DataIterator]:
         """Reference: dataset.py:1598 — coordinator actor deals blocks to n
-        consumers (one per train worker)."""
+        consumers (one per train worker). num_cpus=0: the coordinator only
+        shuffles refs and must never occupy a schedulable slot."""
         coord_cls = ray.remote(SplitCoordinator)
-        coord = coord_cls.options(name=f"split_coordinator_{id(self)}").remote(self, n)
+        # Unnamed: the handle-GC kills the coordinator when the last driver
+        # handle drops, so repeated splits can't accumulate actors.
+        coord = coord_cls.options(num_cpus=0).remote(self, n, equal)
         return [DataIterator(coord, i) for i in builtins.range(n)]
 
     def split(self, n: int) -> list["MaterializedDataset"]:

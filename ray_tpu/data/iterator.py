@@ -47,23 +47,55 @@ def batches_from_blocks(
 
 class SplitCoordinator:
     """Actor that owns a dataset's output stream and deals blocks to n
-    consumers (reference: StreamSplitDataIterator's coordinator)."""
+    consumers (reference: StreamSplitDataIterator's coordinator).
 
-    def __init__(self, dataset, n: int):
+    Blocks are dealt round-robin to per-split queues so every consumer gets
+    a fair share regardless of polling order. Scheduled with num_cpus=0
+    (it only shuffles refs) so it never starves the cluster."""
+
+    def __init__(self, dataset, n: int, equal: bool = False):
         self._iter = dataset.iter_internal_ref_bundles()
         self._n = n
+        self._equal = equal
+        self._queues: list[list] = [[] for _ in range(n)]
+        self._delivered = [0] * n
+        self._next_split = 0
         self._exhausted = False
+        self._finished: set[int] = set()
+
+    def _pull_until(self, split_idx: int) -> None:
+        while not self._queues[split_idx] and not self._exhausted:
+            try:
+                ref = next(self._iter)
+            except StopIteration:
+                self._exhausted = True
+                if self._equal:
+                    # equal=True: trim trailing imbalance so every split
+                    # sees the same number of blocks (reference: equal
+                    # splits drop the remainder).
+                    floor = min(self._delivered[i] + len(self._queues[i]) for i in range(self._n))
+                    for i in range(self._n):
+                        excess = self._delivered[i] + len(self._queues[i]) - floor
+                        if excess > 0:
+                            del self._queues[i][-excess:]
+                return
+            self._queues[self._next_split].append(ref)
+            self._next_split = (self._next_split + 1) % self._n
 
     def next_block_ref(self, split_idx: int):
-        """Returns the next block ref, or None when exhausted. Consumers
-        share one stream; fairness comes from polling order."""
-        if self._exhausted:
-            return None
-        try:
-            return next(self._iter)
-        except StopIteration:
-            self._exhausted = True
-            return None
+        """Returns the next block ref for this split, or None when its
+        share of the stream is exhausted."""
+        self._pull_until(split_idx)
+        if self._queues[split_idx]:
+            self._delivered[split_idx] += 1
+            return self._queues[split_idx].pop(0)
+        return None
+
+    def mark_finished(self, split_idx: int) -> bool:
+        """Consumer i is done; returns True when ALL consumers are done
+        (the last one kills this actor to release its slot)."""
+        self._finished.add(split_idx)
+        return len(self._finished) >= self._n
 
 
 class DataIterator:
@@ -72,11 +104,30 @@ class DataIterator:
     def __init__(self, coordinator, split_idx: int):
         self._coord = coordinator
         self._idx = split_idx
+        self._exhausted = False
 
     def _blocks(self):
+        if self._exhausted:
+            return  # second epoch over a drained one-shot stream is empty
+        from ..core.status import ActorDiedError
+
         while True:
-            ref = ray.get(self._coord.next_block_ref.remote(self._idx), timeout=120)
+            try:
+                ref = ray.get(self._coord.next_block_ref.remote(self._idx), timeout=120)
+            except ActorDiedError:
+                # coordinator reclaimed by another consumer's final kill
+                self._exhausted = True
+                return
             if ref is None:
+                self._exhausted = True
+                # Last finished consumer reclaims the coordinator actor so a
+                # leaked slot can't starve later scheduling (advisor round 1).
+                try:
+                    all_done = ray.get(self._coord.mark_finished.remote(self._idx), timeout=30)
+                    if all_done:
+                        ray.kill(self._coord)
+                except Exception:
+                    pass
                 return
             yield ray.get(ref, timeout=120)
 

@@ -60,9 +60,11 @@ def ring_attention(
             s = jnp.where(mask[None, None, None], s, NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, m_cur)
-        # fully-masked rows keep m_new == NEG_INF: exp underflows to 0
-        p = jnp.exp(s - m_new[..., None])
-        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        # Explicitly zero masked entries: for a fully-masked row m_new is
+        # still NEG_INF and exp(s - m_new) would be exp(0) = 1, so the
+        # mask (not underflow) must kill those probabilities. Correct for
+        # any rotation schedule, not just diagonal-first.
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new[..., None]))
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(

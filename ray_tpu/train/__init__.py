@@ -14,7 +14,7 @@ from .config import (
     RunConfig,
     ScalingConfig,
 )
-from .session import get_checkpoint, get_context, report
+from .session import get_checkpoint, get_context, get_dataset_shard, report
 from .trainer import DataParallelTrainer, JaxTrainer
 
 __all__ = [
@@ -27,6 +27,7 @@ __all__ = [
     "RunConfig",
     "ScalingConfig",
     "get_checkpoint",
+    "get_dataset_shard",
     "get_context",
     "report",
     "load_pytree",

@@ -56,9 +56,11 @@ class TrainController:
         run_config: RunConfig,
         resume_from_checkpoint: Checkpoint | None = None,
         poll_interval_s: float = 0.2,
+        datasets: dict | None = None,
     ):
         self._train_fn = train_fn
         self._config = train_loop_config or {}
+        self._datasets = datasets or {}
         self._scaling = scaling_config
         self._run_config = run_config
         self._scaling_policy = FixedScalingPolicy(scaling_config)
@@ -80,6 +82,9 @@ class TrainController:
         while True:
             group = WorkerGroup.create(self._scaling, name, run_dir)
             try:
+                # Fresh streaming splits per attempt: a restarted group must
+                # not consume a dead attempt's half-drained stream.
+                group.setup_datasets(self._datasets)
                 self._run_attempt(group)
                 break
             except WorkerGroupError as e:

@@ -48,9 +48,11 @@ class TrainContext:
 
 
 class _Session:
-    def __init__(self, context: TrainContext, resume_checkpoint: Checkpoint | None):
+    def __init__(self, context: TrainContext, resume_checkpoint: Checkpoint | None,
+                 dataset_shards: dict | None = None):
         self.context = context
         self.resume_checkpoint = resume_checkpoint
+        self.dataset_shards = dataset_shards or {}
         self._lock = threading.Lock()
         self._reports: list[dict] = []
         self._step = 0
@@ -107,3 +109,16 @@ def get_context() -> TrainContext:
 def get_checkpoint() -> Checkpoint | None:
     """Checkpoint to resume from, if the controller restored one."""
     return _get_session().resume_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    """This rank's streaming DataIterator for the named dataset passed to
+    the Trainer (reference: ``ray.train.get_dataset_shard``,
+    ``python/ray/train/_internal/session.py:672``)."""
+    shard = _get_session().dataset_shards.get(name)
+    if shard is None:
+        raise KeyError(
+            f"No dataset {name!r} was passed to the Trainer "
+            f"(available: {sorted(_get_session().dataset_shards)})"
+        )
+    return shard

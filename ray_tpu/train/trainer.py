@@ -42,6 +42,7 @@ class DataParallelTrainer:
             scaling_config=self._scaling_config,
             run_config=self._run_config,
             resume_from_checkpoint=self._resume,
+            datasets=self._datasets,
         )
         return controller.run()
 

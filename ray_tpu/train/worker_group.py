@@ -27,7 +27,7 @@ class TrainWorker:
     """Actor hosting one SPMD process of the training job."""
 
     def __init__(self, world_rank: int, world_size: int, experiment_name: str,
-                 storage_path: str, coordinator: str | None = None):
+                 storage_path: str):
         self._context = TrainContext(
             world_rank=world_rank,
             world_size=world_size,
@@ -37,29 +37,56 @@ class TrainWorker:
             experiment_name=experiment_name,
             storage_path=storage_path,
         )
-        self._coordinator = coordinator
+        self._dataset_shards: dict = {}
         self._thread: threading.Thread | None = None
         self._session: _Session | None = None
         self._error: str | None = None
         self._done = False
 
-    def init_distributed(self) -> bool:
+    def get_coordinator_address(self) -> str:
+        """Rank 0 picks the jax.distributed coordinator endpoint: its own IP
+        plus a free port (``jax.distributed.initialize`` on process 0 binds
+        and serves it)."""
+        import socket
+
+        # Routable address: a UDP "connect" picks the outbound interface
+        # without sending traffic — gethostbyname(gethostname()) resolves to
+        # loopback on common /etc/hosts setups, which would break every
+        # cross-host join.
+        try:
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            probe.connect(("8.8.8.8", 80))
+            host = probe.getsockname()[0]
+            probe.close()
+        except OSError:
+            host = socket.gethostbyname(socket.gethostname())
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return f"{host}:{port}"
+
+    def init_distributed(self, coordinator: str) -> bool:
         """``jax.distributed.initialize`` across the group — multi-host
         slices only (single-host groups share one process's devices)."""
-        if self._coordinator is None:
-            return False
         import jax
 
         jax.distributed.initialize(
-            coordinator_address=self._coordinator,
+            coordinator_address=coordinator,
             num_processes=self._context.world_size,
             process_id=self._context.world_rank,
         )
         return True
 
+    def set_dataset_shards(self, shards: dict) -> bool:
+        """Receive this rank's DataIterator per dataset name (reference:
+        ``dataset.py:1598`` streaming_split → per-worker iterators)."""
+        self._dataset_shards = shards
+        return True
+
     def run_train_fn(self, train_fn, config: dict, resume_path: str | None) -> bool:
         resume = Checkpoint(resume_path) if resume_path else None
-        self._session = _Session(self._context, resume)
+        self._session = _Session(self._context, resume, dataset_shards=self._dataset_shards)
         self._error = None
         self._done = False
 
@@ -91,6 +118,7 @@ class WorkerGroup:
     def __init__(self, workers, pg):
         self.workers = workers
         self._pg = pg
+        self._splits: dict = {}
 
     @classmethod
     def create(cls, scaling_config, experiment_name: str, storage_path: str) -> "WorkerGroup":
@@ -117,7 +145,28 @@ class WorkerGroup:
             ).remote(i, n, experiment_name, storage_path)
             for i in range(n)
         ]
-        return cls(workers, pg)
+        group = cls(workers, pg)
+        if scaling_config.topology and n > 1:
+            # Multi-host slice: bootstrap jax.distributed across the group.
+            # Rank 0 resolves the coordinator endpoint; every worker joins
+            # concurrently (initialize blocks until all processes arrive).
+            coordinator = ray.get(workers[0].get_coordinator_address.remote(), timeout=60)
+            ray.get([w.init_distributed.remote(coordinator) for w in workers], timeout=300)
+        return group
+
+    def setup_datasets(self, datasets: dict) -> None:
+        """streaming_split each dataset across the group; worker i consumes
+        split i. The split iterators are pinned on this group so their
+        coordinator actors live exactly as long as the attempt."""
+        if not datasets:
+            return
+        n = len(self.workers)
+        self._splits = {name: ds.streaming_split(n) for name, ds in datasets.items()}
+        refs = []
+        for i, w in enumerate(self.workers):
+            shards = {name: splits[i] for name, splits in self._splits.items()}
+            refs.append(w.set_dataset_shards.remote(shards))
+        ray.get(refs, timeout=120)
 
     def run_on_all(self, method: str, *args, timeout: float = 120.0):
         refs = [getattr(w, method).remote(*args) for w in self.workers]
@@ -136,6 +185,13 @@ class WorkerGroup:
                 ray.kill(w)
             except Exception:
                 pass
+        for splits in self._splits.values():
+            for it in splits:
+                try:
+                    ray.kill(it._coord)
+                except Exception:
+                    pass
+        self._splits = {}
         try:
             remove_placement_group(self._pg)
         except Exception:

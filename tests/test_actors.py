@@ -48,9 +48,28 @@ def test_two_actors_independent():
 
 
 def test_named_actor():
-    Counter.options(name="test_named_counter").remote(7)
+    # the creator's handle must stay alive: non-detached named actors are
+    # GC'd with their creator's handles (reference actor.py lifetime rules)
+    creator_handle = Counter.options(name="test_named_counter").remote(7)
     h = ray_tpu.get_actor("test_named_counter")
     assert ray_tpu.get(h.read.remote(), timeout=60) == 7
+    del creator_handle
+
+
+def test_named_actor_gc_on_handle_drop():
+    Counter.options(name="test_named_gc").remote(1)
+    import gc, time
+
+    gc.collect()
+    # death removes the name from the GCS registry → get_actor raises
+    for _ in range(100):
+        try:
+            ray_tpu.get_actor("test_named_gc")
+        except ValueError:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("named actor not reclaimed after handle drop")
 
 
 def test_actor_handle_passing():

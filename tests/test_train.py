@@ -112,6 +112,74 @@ def test_failure_policy_restarts_group(ray_cluster, tmp_path):
     assert result.metrics == {"ok": 1}
 
 
+def test_train_on_dataset(ray_cluster, tmp_path):
+    """datasets= flows to workers as per-rank streaming_split iterators
+    (reference: dataset.py:1598 + get_dataset_shard)."""
+    from ray_tpu import data
+
+    def train_fn(config):
+        shard = train.get_dataset_shard("train")
+        seen = sum(batch["id"].shape[0] for batch in shard.iter_batches(batch_size=8))
+        train.report({"rows_seen": seen})
+
+    trainer = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="ds", storage_path=str(tmp_path)),
+        datasets={"train": data.range(64, parallelism=4)},
+    )
+    result = trainer.fit()
+    assert result.error is None
+    # split streams partition all 64 rows across the 2 workers
+    assert result.metrics["rows_seen"] > 0
+    assert result.metrics["rows_seen"] < 64
+
+
+def test_train_dataset_worker_kill_resume(ray_cluster, tmp_path):
+    """Worker dies mid-epoch → whole group restarts with a FRESH stream and
+    resumes from the latest checkpoint (VERDICT round 1 #6)."""
+    from ray_tpu import data
+
+    marker = str(tmp_path / "killed_once")
+
+    def train_fn(config):
+        import os as _os
+
+        resumed = train.get_checkpoint()
+        start = 0
+        if resumed:
+            with resumed.as_directory() as d:
+                start = int(open(_os.path.join(d, "start.txt")).read())
+        shard = train.get_dataset_shard("train")
+        rows = 0
+        for batch in shard.iter_batches(batch_size=8):
+            rows += batch["id"].shape[0]
+            if rows >= 8 and not _os.path.exists(config["marker"]) and start == 0:
+                open(config["marker"], "w").write("x")
+                import tempfile
+
+                with tempfile.TemporaryDirectory() as d:
+                    open(_os.path.join(d, "start.txt"), "w").write("1")
+                    train.report({"rows": rows}, checkpoint=Checkpoint.from_directory(d))
+                raise RuntimeError("injected mid-epoch death")
+        train.report({"rows": rows, "resumed_from": start})
+
+    trainer = JaxTrainer(
+        train_fn,
+        train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="dsft", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+        datasets={"train": data.range(32, parallelism=4)},
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["resumed_from"] == 1
+    assert result.metrics["rows"] == 32  # fresh stream on restart
+
+
 def test_failure_policy_exhausted(ray_cluster, tmp_path):
     def train_fn(config):
         raise RuntimeError("always fails")
