@@ -17,6 +17,7 @@ from .core.api import (
     cluster_resources,
     get,
     get_actor,
+    get_runtime_context,
     init,
     is_initialized,
     kill,

@@ -144,6 +144,34 @@ def nodes() -> list:
     return global_worker()._gcs_call("GetAllNodes", {})["nodes"]
 
 
+class RuntimeContext:
+    """Reference: ``python/ray/runtime_context.py`` (get_runtime_context)."""
+
+    @property
+    def node_id(self) -> str:
+        return global_worker().node_id
+
+    @property
+    def worker_id(self) -> str:
+        return global_worker().worker_id
+
+    @property
+    def job_id(self) -> int:
+        return global_worker().job_id.int_value()
+
+    @property
+    def actor_id(self) -> str | None:
+        aid = global_worker().actor_id
+        return aid.hex() if aid else None
+
+    def get_node_id(self) -> str:
+        return self.node_id
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext()
+
+
 # ----------------------------------------------------------------- @remote
 _ABSENT = object()
 

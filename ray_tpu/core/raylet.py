@@ -125,22 +125,32 @@ class Raylet:
     def address(self) -> str:
         return self._server.address
 
-    async def stop(self) -> None:
+    async def stop(self, graceful: bool = True) -> None:
         self._shutdown = True
         for t in self._tasks:
             t.cancel()
         for w in self._workers.values():
             if w.proc is not None and w.proc.poll() is None:
-                w.proc.terminate()
-        await asyncio.sleep(0)
-        for w in self._workers.values():
-            if w.proc is not None:
-                try:
-                    w.proc.wait(timeout=3)
-                except Exception:
+                if graceful:
+                    w.proc.terminate()
+                else:
                     w.proc.kill()
-        await self._server.stop()
+        if graceful:
+            await asyncio.sleep(0)
+            for w in self._workers.values():
+                if w.proc is not None:
+                    try:
+                        w.proc.wait(timeout=3)
+                    except Exception:
+                        w.proc.kill()
+        await self._server.stop(grace=0.5 if graceful else 0.0)
         self.store.close()
+
+    async def kill(self) -> None:
+        """Abrupt node death (no drain, SIGKILL workers) — the GCS discovers
+        it via failed health checks. Test-harness API (reference
+        ``cluster_utils.py`` remove_node non-graceful path)."""
+        await self.stop(graceful=False)
 
     async def _heartbeat_loop(self) -> None:
         cfg = get_config()
@@ -152,8 +162,7 @@ class Raylet:
                     {"node_id": self.node_id.hex(), "resources": self.resources.to_dict()},
                     timeout=5.0,
                 )
-                nodes = await self._gcs.call("GetAllNodes", {}, timeout=5.0)
-                self._node_table = {n["node_id"]: n for n in nodes["nodes"]}
+                await self._refresh_node_table()
             except Exception:
                 pass
 
@@ -299,17 +308,44 @@ class Raylet:
                 if target != self.node_id.hex():
                     node = self._node_table.get(target)
                     if node is None:
+                        await self._refresh_node_table()
+                        node = self._node_table.get(target)
+                    if node is None:
                         return {"granted": False, "reason": "bundle node lost"}
                     return {"spillback": True, "node_address": node["address"], "node_id": target}
             return await self._grant_in_bundle(p, spec, pg_hex, idx)
 
+        # Spread strategy: round-robin the lease over all feasible nodes
+        # BEFORE considering local fit (policy/spread_scheduling_policy.cc);
+        # otherwise lease pipelining would pack every task onto one node.
+        strategy = spec.get("scheduling_strategy") or {}
+        if strategy.get("type") == "spread" and not grant_only_local and not p.get("spilled"):
+            from .scheduling import select_node_for_resources
+
+            await self._refresh_node_table()
+            pick = select_node_for_resources(
+                self._node_table, self._lease_resources(spec), strategy
+            )
+            if pick is not None and pick != self.node_id.hex():
+                node = self._node_table.get(pick)
+                if node is not None:
+                    return {"spillback": True, "node_address": node["address"], "node_id": pick}
+
         if not request.subset_of(self.resources.total):
             if grant_only_local:
                 return {"granted": False, "reason": "infeasible on this node"}
-            node = self._pick_remote_node(request)
-            if node is None:
-                return {"granted": False, "reason": "infeasible everywhere"}
-            return {"spillback": True, "node_address": node["address"], "node_id": node["node_id"]}
+            # Infeasible locally: wait (bounded) for a feasible peer — the
+            # node table may be stale or a node may be joining (reference:
+            # infeasible tasks queue until the cluster changes).
+            deadline = time.monotonic() + get_config().worker_register_timeout_s
+            while True:
+                await self._refresh_node_table()
+                node = self._pick_remote_node(request)
+                if node is not None:
+                    return {"spillback": True, "node_address": node["address"], "node_id": node["node_id"]}
+                if time.monotonic() > deadline:
+                    return {"granted": False, "reason": "infeasible everywhere"}
+                await asyncio.sleep(0.5)
 
         # Spillback decision before queuing (hybrid policy): if we cannot fit
         # now but another node can, send the lease there.
@@ -432,6 +468,13 @@ class Raylet:
         if not res and spec.get("kind", 0) == 0:
             res = {"CPU": 1.0}
         return res
+
+    async def _refresh_node_table(self) -> None:
+        try:
+            nodes = await self._gcs.call("GetAllNodes", {}, timeout=5.0)
+            self._node_table = {n["node_id"]: n for n in nodes["nodes"]}
+        except Exception:
+            pass
 
     def _pick_remote_node(self, request: ResourceSet, require_available: bool = False) -> dict | None:
         best = None

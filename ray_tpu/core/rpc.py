@@ -97,6 +97,12 @@ def get_chaos() -> RpcChaos:
     return _chaos
 
 
+def set_chaos(chaos: RpcChaos | None) -> None:
+    """Install failure injection for this process (tests)."""
+    global _chaos
+    _chaos = chaos
+
+
 Handler = Callable[[dict], Awaitable[dict]]
 
 
@@ -108,6 +114,7 @@ class RpcServer:
         self.port = port
         self._handlers: dict[str, Handler] = {}
         self._server: asyncio.AbstractServer | None = None
+        self._conns: set[asyncio.StreamWriter] = set()
 
     def register(self, method: str, handler: Handler) -> None:
         self._handlers[method] = handler
@@ -126,16 +133,30 @@ class RpcServer:
     def address(self) -> str:
         return f"{self.host}:{self.port}"
 
-    async def stop(self) -> None:
+    async def stop(self, grace: float = 0.5) -> None:
         if self._server is not None:
             self._server.close()
+            # wait_closed() (3.12) blocks until every connection handler
+            # finishes; give in-flight RPCs a grace period, then abort the
+            # stragglers (long-polls would otherwise hold shutdown forever).
+            if grace > 0:
+                try:
+                    await asyncio.wait_for(self._server.wait_closed(), timeout=grace)
+                except Exception:
+                    pass
+            for writer in list(self._conns):
+                try:
+                    writer.transport.abort()
+                except Exception:
+                    pass
             try:
-                await self._server.wait_closed()
+                await asyncio.wait_for(self._server.wait_closed(), timeout=1.0)
             except Exception:  # pragma: no cover - teardown best effort
                 pass
             self._server = None
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._conns.add(writer)
         try:
             while True:
                 msg = await _read_frame(reader)
@@ -143,6 +164,7 @@ class RpcServer:
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
         finally:
+            self._conns.discard(writer)
             try:
                 writer.close()
             except Exception:
@@ -201,7 +223,9 @@ class RpcClient:
             except (OSError, asyncio.TimeoutError) as e:
                 # Normalize so every transport failure surfaces as RpcError
                 # (callers' except clauses and the retry filter rely on it).
-                raise RpcError(f"Connection to {self.address} failed: {e}") from e
+                err = RpcError(f"Connection to {self.address} failed: {e}")
+                err.undelivered = True  # request never reached the server
+                raise err from e
             self._read_task = spawn(self._read_loop())
 
     async def _read_loop(self) -> None:

@@ -163,6 +163,9 @@ class _ActorState:
         self.address = ""
         self.state = "PENDING_CREATION"
         self.seq_no = 0
+        # Bumped on each detected death: sequence numbers are scoped to one
+        # actor incarnation (the restarted executor expects seq 0).
+        self.incarnation = 0
         self.client: RpcClient | None = None
         self.death_cause = ""
         self.lock = threading.Lock()
@@ -205,6 +208,7 @@ class CoreWorker:
             self.current_task_id = TaskID.nil()
         self._task_queues: dict[tuple, list] = {}
         self._pipelines: dict[tuple, int] = {}
+        self._spread_salt = 0
         self._queue_lock = threading.Lock()
         self._actors: dict[bytes, _ActorState] = {}
         self._node_table: dict[str, dict] = {}
@@ -627,11 +631,20 @@ class CoreWorker:
                 self.refcounter.remove_submitted_ref(ObjectID(arg["id"]))
 
     def _shape_key(self, spec: TaskSpec) -> tuple:
+        strategy = spec.scheduling_strategy or {}
+        # Spread tasks get one lease each (salted key): sharing a lease
+        # pipeline would pack them all onto the first leased worker.
+        salt = 0
+        if strategy.get("type") == "spread":
+            with self._counter_lock:
+                self._spread_salt += 1
+                salt = self._spread_salt
         return (
             tuple(sorted(spec.required_resources().items())),
             spec.placement_group_id,
             spec.placement_group_bundle_index,
-            tuple(sorted(spec.scheduling_strategy.items())) if spec.scheduling_strategy else (),
+            tuple(sorted(strategy.items())) if strategy else (),
+            salt,
         )
 
     def _enqueue_task(self, spec: TaskSpec) -> None:
@@ -695,30 +708,50 @@ class CoreWorker:
                 if self._task_queues.get(key):
                     self._pipelines[key] += 1
                     self.io.run_coro(self._lease_pipeline(key))
+                elif self._pipelines.get(key, 0) == 0:
+                    # Drop drained keys — spread tasks salt the key per
+                    # task, so stale entries would accumulate forever.
+                    self._pipelines.pop(key, None)
+                    self._task_queues.pop(key, None)
 
     async def _acquire_lease(self, spec: TaskSpec):
-        """Follow the lease/spillback protocol up to a hop limit."""
+        """Follow the lease/spillback protocol. A dead spillback target (its
+        raylet unreachable) sends us back to the local raylet for a fresh
+        placement — nodes can die between the spill decision and the hop —
+        until an overall deadline expires."""
+        import asyncio
+
+        deadline = time.monotonic() + get_config().worker_register_timeout_s * 2
         raylet = self.raylet
         try:
-            for _hop in range(4):
-                try:
-                    reply = await raylet.call(
-                        "RequestWorkerLease",
-                        {"spec": spec.to_wire()},
-                        timeout=get_config().worker_register_timeout_s + 10.0,
-                    )
-                except RpcError:
-                    return None
-                if reply.get("granted"):
-                    lease = reply["worker_address"], reply["worker_id"], raylet
-                    raylet = self.raylet  # returned raylet kept by caller; don't close it
-                    return lease
-                if reply.get("spillback"):
-                    if raylet is not self.raylet:
-                        await raylet.close()
-                    raylet = RetryableRpcClient(reply["node_address"])
-                    continue
-                return None
+            while time.monotonic() < deadline:
+                for _hop in range(4):
+                    try:
+                        reply = await raylet.call(
+                            "RequestWorkerLease",
+                            # `spilled` marks follow-up hops so policies that
+                            # redirect (spread) don't ping-pong the lease
+                            {"spec": spec.to_wire(), "spilled": _hop > 0},
+                            timeout=get_config().worker_register_timeout_s + 10.0,
+                        )
+                    except RpcError:
+                        if raylet is self.raylet:
+                            return None  # our own raylet is gone
+                        break  # spill target died: restart from local
+                    if reply.get("granted"):
+                        lease = reply["worker_address"], reply["worker_id"], raylet
+                        raylet = self.raylet  # returned client kept by caller
+                        return lease
+                    if reply.get("spillback"):
+                        if raylet is not self.raylet:
+                            await raylet.close()
+                        raylet = RetryableRpcClient(reply["node_address"])
+                        continue
+                    return None  # definitive denial (infeasible / timeout)
+                if raylet is not self.raylet:
+                    await raylet.close()
+                    raylet = self.raylet
+                await asyncio.sleep(0.5)
             return None
         finally:
             if raylet is not self.raylet:
@@ -851,6 +884,7 @@ class CoreWorker:
         with state.lock:
             seq_no = state.seq_no
             state.seq_no += 1
+            incarnation = state.incarnation
         spec = TaskSpec(
             task_id=task_id.binary(),
             job_id=self.job_id.binary(),
@@ -864,6 +898,7 @@ class CoreWorker:
             actor_method=method_name,
             seq_no=seq_no,
         )
+        spec._incarnation = incarnation
         return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(num_returns)]
         for rid in return_ids:
             self.refcounter.add_owned_object(rid)
@@ -871,13 +906,21 @@ class CoreWorker:
         self.io.run_coro(self._submit_actor_task_async(spec))
         return [ObjectRef(rid, self.address) for rid in return_ids]
 
-    async def _submit_actor_task_async(self, spec: TaskSpec) -> None:
+    async def _submit_actor_task_async(self, spec: TaskSpec, attempts: int = 3) -> None:
         state = self._actor_state(spec.actor_id)
         try:
             address = await self._resolve_actor(state)
         except ActorDiedError as e:
             self._fail_task(spec, e)
             return
+        # Sequence numbers are scoped to one actor incarnation: a spec
+        # assigned before a restart gets a fresh seq for the new executor
+        # (whose per-caller ordering buffer starts at 0 again).
+        with state.lock:
+            if getattr(spec, "_incarnation", state.incarnation) != state.incarnation:
+                spec.seq_no = state.seq_no
+                state.seq_no += 1
+                spec._incarnation = state.incarnation
         try:
             if state.client is None or state.client.address != address:
                 state.client = RpcClient(address)
@@ -886,26 +929,36 @@ class CoreWorker:
                 self._fail_task(spec, RayTpuError(reply["error"]))
             else:
                 self._handle_task_reply(spec, reply)
-        except RpcError:
-            # Actor worker unreachable: wait for GCS to restart or declare
-            # death, then retry once against the new address.
-            state.address = ""
-            state.client = None
-            try:
-                await self._resolve_actor(state, wait_restart=True)
-                await self._submit_actor_task_async(spec)
-            except ActorDiedError as e:
-                self._fail_task(spec, e)
+        except RpcError as e:
+            with state.lock:
+                if state.address == address:  # first observer of this death
+                    state.incarnation += 1
+                    state.seq_no = 0
+                    state.address = ""
+                    state.client = None
+            # Never-delivered sends (connect failed — e.g. the cached
+            # address points at a pre-restart incarnation) are side-effect
+            # free: re-resolve and retry. Failures after delivery follow
+            # reference semantics (actor_task_submitter.cc): the task FAILS
+            # — the method may have executed and had side effects.
+            if getattr(e, "undelivered", False) and attempts > 0:
+                await self._submit_actor_task_async(spec, attempts - 1)
+                return
+            self._fail_task(
+                spec, ActorDiedError(spec.actor_id.hex(), f"actor died while executing {spec.name}: {e}")
+            )
 
-    async def _resolve_actor(self, state: _ActorState, wait_restart: bool = False) -> str:
-        if state.address and not wait_restart:
+    async def _resolve_actor(self, state: _ActorState) -> str:
+        """Resolve the actor's current address, polling the GCS through
+        PENDING/RESTARTING states."""
+        if state.address:
             return state.address
         deadline = time.monotonic() + 120.0
         while time.monotonic() < deadline:
             reply = await self.gcs.call("GetActorInfo", {"actor_id": state.actor_id.hex()}, timeout=10.0)
             if not reply.get("found"):
                 raise ActorDiedError(state.actor_id.hex(), "actor not registered")
-            if reply["state"] == "ALIVE" and reply["address"] and (not wait_restart or reply["address"] != state.address):
+            if reply["state"] == "ALIVE" and reply["address"]:
                 state.address = reply["address"]
                 state.state = "ALIVE"
                 return state.address

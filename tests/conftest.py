@@ -37,12 +37,23 @@ def pytest_sessionstart(session):
             pass
 
 
-@pytest.fixture(scope="session")
+@pytest.fixture()
 def ray_cluster():
     """One shared local cluster for API-level tests (reference
-    ``ray_start_shared_local_modes`` style)."""
+    ``ray_start_shared_local_modes`` style). Function-scoped but lazily
+    shared: init() is a no-op while the cluster from a previous test is
+    still up; tests that tear the global cluster down (multinode harness)
+    simply cause the next user to boot a fresh one."""
     import ray_tpu
 
     ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
     yield
-    ray_tpu.shutdown()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    import ray_tpu
+
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
