@@ -232,7 +232,15 @@ class CoreWorker:
         self._actor_next_seq: dict[str, int] = {}
         self._actor_ooo_buffer: dict[tuple[str, int], Any] = {}
         self._actor_sem: threading.Semaphore | None = None
+        self._actor_max_concurrency = 1
         self._exec_local = threading.local()
+
+        # Task execution threads: the loop's default executor caps at
+        # cpu_count+4 which starves long-poll-style actor methods (Serve
+        # listen_for_change); give every worker a deep pool.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.io.loop.set_default_executor(ThreadPoolExecutor(max_workers=64, thread_name_prefix="raytpu-exec"))
 
         # RPC server for owner + executor duties.
         self.server = RpcServer("127.0.0.1", 0)
@@ -1094,19 +1102,36 @@ class CoreWorker:
         return await loop.run_in_executor(None, self._execute_task, spec)
 
     async def _execute_actor_task(self, spec: TaskSpec, loop) -> dict:
-        # Sequential ordering with an out-of-order arrival buffer
-        # (transport/actor_scheduling_queue.cc), per caller.
+        # Per-caller submission-order delivery with an out-of-order arrival
+        # buffer (transport/actor_scheduling_queue.cc). Tasks are RELEASED
+        # to the executor in sequence order, but the next seq is unblocked
+        # as soon as this one starts — the actor's max_concurrency
+        # semaphore (not the ordering buffer) bounds concurrent execution,
+        # so max_concurrency=1 still serializes while concurrent actors
+        # overlap (reference: threaded/async scheduling queues).
         caller = spec.owner_address
         while spec.seq_no > self._actor_next_seq.get(caller, 0):
             fut = loop.create_future()
             self._actor_ooo_buffer[(caller, spec.seq_no)] = fut
             await fut
-        result = await loop.run_in_executor(None, self._execute_task, spec)
-        self._actor_next_seq[caller] = max(self._actor_next_seq.get(caller, 0), spec.seq_no + 1)
+        if self._actor_max_concurrency <= 1:
+            # Serialized actor: strict execution order — complete before
+            # releasing the next sequence number.
+            result = await loop.run_in_executor(None, self._execute_task, spec)
+            self._release_next_actor_seq(caller, spec.seq_no)
+            return result
+        # Concurrent actor: release the next seq as soon as this task is
+        # handed to the executor; the max_concurrency semaphore bounds
+        # parallelism.
+        exec_fut = loop.run_in_executor(None, self._execute_task, spec)
+        self._release_next_actor_seq(caller, spec.seq_no)
+        return await exec_fut
+
+    def _release_next_actor_seq(self, caller: str, seq_no: int) -> None:
+        self._actor_next_seq[caller] = max(self._actor_next_seq.get(caller, 0), seq_no + 1)
         nxt = self._actor_ooo_buffer.pop((caller, self._actor_next_seq[caller]), None)
         if nxt is not None and not nxt.done():
             nxt.set_result(True)
-        return result
 
     def _execute_task(self, spec: TaskSpec) -> dict:
         """ExecuteTask (core_worker.cc:3229) + Cython execute_task
@@ -1123,7 +1148,8 @@ class CoreWorker:
                 # Actor-wide concurrency limit: sequencing is per-caller, but
                 # calls from DIFFERENT callers must still respect
                 # max_concurrency (default 1 = serialized actor).
-                self._actor_sem = threading.Semaphore(max(1, spec.max_concurrency))
+                self._actor_max_concurrency = max(1, spec.max_concurrency)
+                self._actor_sem = threading.Semaphore(self._actor_max_concurrency)
                 return {"returns": []}
             if spec.kind == TASK_KIND_ACTOR_TASK:
                 if self.actor_instance is None:

@@ -1,0 +1,39 @@
+"""ray_tpu.serve: model serving.
+
+Reference: ``python/ray/serve/`` (SURVEY.md §2.3/§3.5): controller actor
+reconciling a replica FSM with rolling updates, per-process routers with
+power-of-two replica choice, long-poll config push, queue-based
+autoscaling, and an HTTP ingress proxy.
+"""
+
+from .api import (
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    http_address,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from .deployment import Application, AutoscalingConfig, Deployment, deployment
+from .replica import Request
+from .router import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "Request",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "http_address",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

@@ -1,0 +1,470 @@
+"""Serve controller: reconciles deployment target state onto replica actors.
+
+Reference: ``python/ray/serve/_private/controller.py:84`` (ServeController)
++ ``deployment_state.py:1249`` (replica FSM / rolling updates) +
+``autoscaling_state.py`` (queue-based autoscaling). One detached named
+actor owns all Serve state: a reconcile thread diffs target vs running
+replicas, starts/drains replica actors, health-checks them, and pushes
+routing tables to routers via the long-poll host. State is checkpointed
+to the GCS KV after every mutation so a restarted controller can
+re-adopt running replicas.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from typing import Any
+
+import cloudpickle
+
+from ..core import api as ray
+from .long_poll import LongPollHost
+
+logger = logging.getLogger(__name__)
+
+# Replica FSM states (reference deployment_state.py ReplicaState).
+STARTING = "STARTING"
+RUNNING = "RUNNING"
+STOPPING = "STOPPING"
+
+CHECKPOINT_KEY = "serve:controller:checkpoint"
+
+
+class _Replica:
+    def __init__(self, replica_id: str, version: str, actor_handle, actor_id: bytes):
+        self.replica_id = replica_id
+        self.version = version
+        self.actor = actor_handle
+        self.actor_id = actor_id
+        self.state = STARTING
+        self.ready_ref = None
+        self.started_at = time.time()
+        self.health_failures = 0
+        self.draining_since = 0.0
+        self.applied_user_config = None
+
+
+class _DeploymentState:
+    def __init__(self, app_name: str, config: dict):
+        self.app_name = app_name
+        self.config = config  # name, serialized_callable, init args, options
+        self.version = config["version"]
+        self.replicas: list[_Replica] = []
+        self.next_replica_no = 0
+        self.autoscale_history: list[tuple[float, float]] = []
+        self.last_scale_up = 0.0
+        self.last_scale_down = 0.0
+        self.target_replicas = config["num_replicas"]
+
+    @property
+    def name(self) -> str:
+        return self.config["name"]
+
+
+class ServeController:
+    """The detached SERVE_CONTROLLER actor."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._apps: dict[str, dict[str, _DeploymentState]] = {}
+        self._routes: dict[str, tuple[str, str]] = {}  # prefix -> (app, ingress dep)
+        self._long_poll = LongPollHost()
+        self._stopped = threading.Event()
+        self._reconcile_thread = threading.Thread(
+            target=self._reconcile_loop, daemon=True, name="serve-reconcile"
+        )
+        self._recover()
+        self._reconcile_thread.start()
+
+    # ------------------------------------------------------------ public API
+    def deploy_application(self, app_name: str, route_prefix: str | None,
+                           deployments: list[dict], ingress: str) -> bool:
+        """Set/replace target state for an application (reference
+        controller.deploy_application)."""
+        with self._lock:
+            existing = self._apps.get(app_name, {})
+            new_states: dict[str, _DeploymentState] = {}
+            for config in deployments:
+                name = config["name"]
+                state = existing.get(name)
+                if state is None:
+                    state = _DeploymentState(app_name, config)
+                else:
+                    state.config = config
+                    if state.version != config["version"]:
+                        state.version = config["version"]  # reconcile rolls replicas
+                    auto = config.get("autoscaling")
+                    if auto:
+                        # keep the autoscaler's current target, clamped to
+                        # the new bounds
+                        state.target_replicas = max(
+                            auto["min_replicas"],
+                            min(auto["max_replicas"], state.target_replicas),
+                        )
+                    else:
+                        state.target_replicas = config["num_replicas"]
+                new_states[name] = state
+            # deployments removed from the app drain in reconcile
+            for name, state in existing.items():
+                if name not in new_states:
+                    state.target_replicas = 0
+                    state.config["deleted"] = True
+                    new_states[name] = state
+            self._apps[app_name] = new_states
+            if route_prefix is not None:
+                self._routes = {p: t for p, t in self._routes.items() if t[0] != app_name}
+                self._routes[route_prefix] = (app_name, ingress)
+            self._push_routes()
+            self._checkpoint()
+        return True
+
+    def delete_application(self, app_name: str) -> bool:
+        with self._lock:
+            app = self._apps.get(app_name)
+            if app is None:
+                return False
+            for state in app.values():
+                state.target_replicas = 0
+                state.config["deleted"] = True
+            self._routes = {p: t for p, t in self._routes.items() if t[0] != app_name}
+            self._push_routes()
+            self._checkpoint()
+        return True
+
+    def get_app_status(self, app_name: str) -> dict:
+        with self._lock:
+            app = self._apps.get(app_name, {})
+            out = {}
+            for name, state in app.items():
+                running = [r for r in state.replicas if r.state == RUNNING and r.version == state.version]
+                out[name] = {
+                    "target_replicas": state.target_replicas,
+                    "running_replicas": len(running),
+                    "version": state.version,
+                    "healthy": len(running) >= state.target_replicas,
+                    "deleted": bool(state.config.get("deleted")),
+                }
+            return out
+
+    def list_deployments(self) -> dict:
+        with self._lock:
+            return {
+                app: {name: s.config["name"] for name, s in deps.items()}
+                for app, deps in self._apps.items()
+            }
+
+    def get_ingress(self, route_prefix: str) -> tuple[str, str] | None:
+        with self._lock:
+            return self._routes.get(route_prefix)
+
+    def listen_for_change(self, keys_to_snapshot_ids: dict) -> dict:
+        return self._long_poll.listen_for_change(keys_to_snapshot_ids)
+
+    def get_snapshot(self, key: str):
+        return self._long_poll.get(key)[1]
+
+    def register_proxy(self, actor_id: bytes) -> bool:
+        # push the current routing table to the newly-attached proxy
+        self._push_routes()
+        return True
+
+    def graceful_shutdown(self) -> bool:
+        """Drain every replica before the controller itself is killed."""
+        with self._lock:
+            for app in self._apps.values():
+                for state in app.values():
+                    state.target_replicas = 0
+                    state.config["deleted"] = True
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with self._lock:
+                if all(not s.replicas for app in self._apps.values() for s in app.values()):
+                    break
+            time.sleep(0.1)
+        self._stopped.set()
+        try:
+            ray.global_worker()._gcs_call("KvDel", {"key": CHECKPOINT_KEY})
+        except Exception:
+            pass
+        return True
+
+    # ------------------------------------------------------- reconciliation
+    def _reconcile_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                self._reconcile_once()
+            except Exception:
+                logger.exception("serve reconcile iteration failed")
+            self._stopped.wait(0.25)
+
+    def _reconcile_once(self) -> None:
+        with self._lock:
+            apps = {a: dict(deps) for a, deps in self._apps.items()}
+        dirty = False
+        for app_name, deps in apps.items():
+            for state in deps.values():
+                dirty |= self._reconcile_deployment(state)
+        with self._lock:
+            # drop fully-drained deleted deployments
+            for app_name in list(self._apps):
+                deps = self._apps[app_name]
+                for name in list(deps):
+                    s = deps[name]
+                    if s.config.get("deleted") and not s.replicas:
+                        del deps[name]
+                        dirty = True
+                if not deps:
+                    del self._apps[app_name]
+        if dirty:
+            self._checkpoint()
+
+    def _reconcile_deployment(self, state: _DeploymentState) -> bool:
+        # ---- probe phase: all blocking replica RPCs happen WITHOUT the
+        # controller lock, so a hung replica can't freeze the control plane.
+        with self._lock:
+            replicas = list(state.replicas)
+            user_config = state.config.get("user_config")
+        probes: dict[str, dict] = {}
+        for r in replicas:
+            p: dict = {}
+            if r.state == STARTING:
+                if r.ready_ref is None:
+                    r.ready_ref = r.actor.ready.remote()
+                try:
+                    done, _ = ray.wait([r.ready_ref], num_returns=1, timeout=0)
+                    if done:
+                        ray.get(done[0], timeout=5)
+                        p["ready"] = True
+                except Exception:
+                    p["failed"] = True
+            elif r.state == RUNNING:
+                p["alive"] = self._replica_alive(r)
+                try:
+                    p["queue"] = ray.get(r.actor.get_queue_len.remote(), timeout=5)
+                except Exception:
+                    p["queue"] = 0
+                if p["alive"] and r.applied_user_config != user_config:
+                    # config-only change: in-place reconfigure, no restart
+                    try:
+                        ray.get(r.actor.reconfigure.remote(user_config), timeout=30)
+                        r.applied_user_config = user_config
+                    except Exception:
+                        logger.warning("reconfigure of %s failed", r.replica_id)
+            elif r.state == STOPPING:
+                try:
+                    p["queue"] = ray.get(r.actor.get_queue_len.remote(), timeout=5)
+                except Exception:
+                    p["queue"] = 0
+            probes[r.replica_id] = p
+
+        # ---- decision phase: mutate under the lock, RPC-free.
+        to_kill: list[_Replica] = []
+        n_to_start = 0
+        dirty = False
+        with self._lock:
+            self._autoscale_from_probes(state, probes)
+            target = state.target_replicas
+            for r in list(state.replicas):
+                p = probes.get(r.replica_id, {})
+                if r.state == STARTING:
+                    if p.get("ready"):
+                        r.state = RUNNING
+                        r.applied_user_config = user_config
+                        dirty = True
+                    elif p.get("failed"):
+                        logger.warning("replica %s failed to start; replacing", r.replica_id)
+                        state.replicas.remove(r)
+                        to_kill.append(r)
+                        dirty = True
+                elif r.state == RUNNING and not p.get("alive", True):
+                    logger.warning("replica %s died; removing", r.replica_id)
+                    state.replicas.remove(r)
+                    to_kill.append(r)
+                    dirty = True
+                elif r.state == STOPPING and (
+                    p.get("queue", 0) == 0 or time.time() - r.draining_since > 15.0
+                ):
+                    state.replicas.remove(r)
+                    to_kill.append(r)
+                    dirty = True
+            current = [r for r in state.replicas if r.state in (STARTING, RUNNING)]
+            cur_version = [r for r in current if r.version == state.version]
+            old_version = [r for r in current if r.version != state.version]
+            # rolling update: surge one new replica, then drain one old
+            # (deployment_state.py rolling update with max surge 1)
+            if old_version:
+                if len(cur_version) < target + 1 and not any(r.state == STARTING for r in cur_version):
+                    n_to_start = 1
+                if any(r.state == RUNNING for r in cur_version):
+                    self._drain_replica(old_version[0])
+                    dirty = True
+            else:
+                if len(cur_version) < target:
+                    n_to_start = target - len(cur_version)
+                elif len(cur_version) > target:
+                    running = [r for r in cur_version if r.state == RUNNING]
+                    excess = len(cur_version) - target
+                    for r in (running or cur_version)[:excess]:
+                        self._drain_replica(r)
+                    dirty = True
+
+        # ---- action phase: actor create/kill RPCs without the lock.
+        for r in to_kill:
+            try:
+                ray.kill(r.actor)
+            except Exception:
+                pass
+        for _ in range(n_to_start):
+            self._start_replica(state)
+            dirty = True
+        if dirty:
+            with self._lock:
+                self._push_replica_table(state)
+        return dirty
+
+    def _replica_alive(self, r: _Replica) -> bool:
+        try:
+            ray.get(r.actor.check_health.remote(), timeout=10)
+            r.health_failures = 0
+            return True
+        except Exception:
+            r.health_failures += 1
+            return r.health_failures < 3
+
+    def _start_replica(self, state: _DeploymentState) -> None:
+        from .replica import ReplicaActor
+
+        with self._lock:
+            cfg = state.config
+            state.next_replica_no += 1
+            replica_id = f"{state.app_name}#{state.name}#{state.next_replica_no}"
+            version = state.version
+        actor_options = dict(cfg.get("ray_actor_options") or {})
+        actor_options.setdefault("num_cpus", 0.1)
+        cls = ray.remote(ReplicaActor)
+        handle = cls.options(
+            max_concurrency=cfg["max_ongoing"] + 8, **actor_options
+        ).remote(
+            cfg["serialized_callable"], cfg["init_args"], cfg["init_kwargs"],
+            cfg.get("user_config"), state.name, state.app_name,
+        )
+        r = _Replica(replica_id, version, handle, handle._actor_id)
+        r.applied_user_config = cfg.get("user_config")
+        with self._lock:
+            state.replicas.append(r)
+        logger.info("starting replica %s (version %s)", replica_id, version[:8])
+
+    def _drain_replica(self, r: _Replica) -> None:
+        """Stop routing to the replica; it is killed once its in-flight
+        requests complete (graceful_shutdown_wait_loop in the reference)."""
+        if r.state != STOPPING:
+            r.state = STOPPING
+            r.draining_since = time.time()
+
+    # ----------------------------------------------------------- autoscaling
+    def _autoscale_from_probes(self, state: _DeploymentState, probes: dict) -> None:
+        """Queue-based autoscaling (reference autoscaling_state.py): desired
+        replicas = ceil(total ongoing / target_ongoing_requests), clamped,
+        with separate up/downscale delays."""
+        auto = state.config.get("autoscaling")
+        if not auto or state.config.get("deleted"):
+            return
+        running = [r for r in state.replicas if r.state == RUNNING]
+        if not running:
+            return
+        total = float(sum(probes.get(r.replica_id, {}).get("queue", 0) for r in running))
+        now = time.time()
+        state.autoscale_history.append((now, total))
+        state.autoscale_history = [(t, v) for t, v in state.autoscale_history if now - t <= 30.0]
+        desired = math.ceil(total / auto["target_ongoing_requests"]) if total > 0 else auto["min_replicas"]
+        desired = max(auto["min_replicas"], min(auto["max_replicas"], desired))
+        cur = state.target_replicas
+        if desired > cur and now - state.last_scale_up >= auto["upscale_delay_s"]:
+            state.target_replicas = desired
+            state.last_scale_up = now
+            logger.info("autoscale %s: %d -> %d (ongoing=%.1f)", state.name, cur, desired, total)
+        elif desired < cur and now - state.last_scale_down >= auto["downscale_delay_s"]:
+            state.target_replicas = desired
+            state.last_scale_down = now
+            logger.info("autoscale %s: %d -> %d (ongoing=%.1f)", state.name, cur, desired, total)
+
+    # ------------------------------------------------------------- push/ckpt
+    def _push_replica_table(self, state: _DeploymentState) -> None:
+        table = [
+            {
+                "replica_id": r.replica_id,
+                "actor_id": r.actor_id.hex(),
+                "max_ongoing": state.config["max_ongoing"],
+            }
+            for r in state.replicas
+            if r.state == RUNNING
+        ]
+        self._long_poll.notify_changed(f"replicas::{state.app_name}::{state.name}", table)
+
+    def _push_routes(self) -> None:
+        self._long_poll.notify_changed(
+            "routes", [{"prefix": p, "app": a, "deployment": d} for p, (a, d) in self._routes.items()]
+        )
+
+    def _checkpoint(self) -> None:
+        with self._lock:
+            blob = cloudpickle.dumps({
+                "routes": self._routes,
+                "apps": {
+                    app: {
+                        name: {
+                            "config": s.config,
+                            "target": s.target_replicas,
+                            "replicas": [
+                                (r.replica_id, r.version, r.actor_id, r.state)
+                                for r in s.replicas
+                            ],
+                            "next_no": s.next_replica_no,
+                        }
+                        for name, s in deps.items()
+                    }
+                    for app, deps in self._apps.items()
+                },
+            })
+        try:
+            ray.global_worker()._gcs_call("KvPut", {"key": CHECKPOINT_KEY, "value": blob, "overwrite": True})
+        except Exception:
+            pass
+
+    def _recover(self) -> None:
+        """Re-adopt replicas from the checkpoint after a controller restart
+        (reference: controller recovers DeploymentStateManager from the
+        checkpointed state)."""
+        from ..core.api import ActorHandle
+
+        try:
+            reply = ray.global_worker()._gcs_call("KvGet", {"key": CHECKPOINT_KEY})
+        except Exception:
+            return
+        if not reply.get("found"):
+            return
+        data = cloudpickle.loads(reply["value"])
+        self._routes = data["routes"]
+        for app, deps in data["apps"].items():
+            self._apps[app] = {}
+            for name, saved in deps.items():
+                state = _DeploymentState(app, saved["config"])
+                state.target_replicas = saved["target"]
+                state.next_replica_no = saved["next_no"]
+                for replica_id, version, actor_id, rstate in saved["replicas"]:
+                    if rstate != RUNNING:
+                        continue
+                    try:
+                        handle = ActorHandle(actor_id)
+                        r = _Replica(replica_id, version, handle, actor_id)
+                        r.state = RUNNING
+                        state.replicas.append(r)
+                    except Exception:
+                        pass
+                self._apps[app][name] = state
+                self._push_replica_table(state)
+        self._push_routes()
+        logger.info("serve controller recovered %d app(s) from checkpoint", len(self._apps))

@@ -32,6 +32,7 @@ class Deployment:
         max_ongoing_requests: int = 8,
         autoscaling_config: AutoscalingConfig | dict | None = None,
         ray_actor_options: dict | None = None,
+        user_config: Any = None,
     ):
         self.func_or_class = func_or_class
         self.name = name or getattr(func_or_class, "__name__", "deployment")
@@ -41,6 +42,7 @@ class Deployment:
             autoscaling_config = AutoscalingConfig(**autoscaling_config)
         self.autoscaling_config = autoscaling_config
         self.ray_actor_options = ray_actor_options or {}
+        self.user_config = user_config
 
     def options(self, **kwargs) -> "Deployment":
         merged = dict(
@@ -49,6 +51,7 @@ class Deployment:
             max_ongoing_requests=self.max_ongoing_requests,
             autoscaling_config=self.autoscaling_config,
             ray_actor_options=self.ray_actor_options,
+            user_config=self.user_config,
         )
         merged.update(kwargs)
         return Deployment(self.func_or_class, **merged)

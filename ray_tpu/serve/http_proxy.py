@@ -1,0 +1,162 @@
+"""HTTP ingress: a minimal asyncio HTTP/1.1 server actor.
+
+Reference: ``python/ray/serve/_private/proxy.py:754`` (per-node proxy).
+The proxy owns a routing table (route prefix → app/ingress deployment,
+pushed by the controller via long-poll), assigns each request through the
+power-of-two router, and streams the response back. Plain asyncio — no
+web framework is needed for the request/response shapes Serve handles.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import urllib.parse
+from typing import Any
+
+from ..core import api as ray
+from ..core.worker import global_worker
+from .long_poll import LongPollClient
+from .replica import Request
+from .router import CONTROLLER_NAME, DeploymentHandle
+
+
+class ProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self._host = host
+        self._port = port
+        self._routes: list[dict] = []  # [{prefix, app, deployment}] longest-prefix-first
+        self._handles: dict[tuple[str, str], DeploymentHandle] = {}
+        self._ready = threading.Event()
+        self._start_error: str | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        controller = ray.get_actor(CONTROLLER_NAME)
+        self._long_poll = LongPollClient(controller, {"routes": self._update_routes})
+        try:
+            snap = ray.get(controller.get_snapshot.remote("routes"), timeout=30)
+            if snap:
+                self._update_routes(snap)
+        except Exception:
+            pass
+        self._thread = threading.Thread(target=self._serve_forever, daemon=True, name="serve-http")
+        self._thread.start()
+        self._ready.wait(timeout=30)
+
+    def _update_routes(self, table: Any) -> None:
+        table = sorted(table or [], key=lambda e: len(e["prefix"]), reverse=True)
+        self._routes = table
+
+    def _serve_forever(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def _start():
+            server = await asyncio.start_server(self._handle_conn, self._host, self._port)
+            self._port = server.sockets[0].getsockname()[1]
+            self._ready.set()
+            async with server:
+                await server.serve_forever()
+
+        try:
+            self._loop.run_until_complete(_start())
+        except Exception as e:
+            # surface bind/listen failures to ready()/address() callers
+            # instead of pretending the proxy is up
+            self._start_error = f"{type(e).__name__}: {e}"
+            self._ready.set()
+
+    def _check_started(self) -> None:
+        self._ready.wait(timeout=30)
+        if self._start_error is not None:
+            raise RuntimeError(f"HTTP proxy failed to start: {self._start_error}")
+
+    def address(self) -> str:
+        self._check_started()
+        return f"http://{self._host}:{self._port}"
+
+    def ready(self) -> bool:
+        self._check_started()
+        return True
+
+    # ------------------------------------------------------------- http core
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                request = await self._read_request(reader)
+                if request is None:
+                    break
+                status, body = await self._dispatch(request)
+                payload = (
+                    f"HTTP/1.1 {status}\r\nContent-Type: application/json\r\n"
+                    f"Content-Length: {len(body)}\r\nConnection: keep-alive\r\n\r\n"
+                ).encode() + body
+                writer.write(payload)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _read_request(self, reader: asyncio.StreamReader) -> Request | None:
+        try:
+            line = await reader.readline()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return None
+        if not line or line in (b"\r\n", b"\n"):
+            return None
+        try:
+            method, target, _version = line.decode().split()
+        except ValueError:
+            return None
+        headers: dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        length = int(headers.get("content-length", 0))
+        body = await reader.readexactly(length) if length else b""
+        parsed = urllib.parse.urlsplit(target)
+        query = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        return Request(method=method, path=parsed.path, query=query, headers=headers, body=body)
+
+    async def _dispatch(self, request: Request) -> tuple[str, bytes]:
+        if request.path == "/-/healthz":
+            return "200 OK", b'"ok"'
+        route = next((r for r in self._routes if request.path.startswith(r["prefix"])), None)
+        if route is None:
+            return "404 Not Found", json.dumps({"error": f"no route for {request.path}"}).encode()
+        key = (route["app"], route["deployment"])
+        handle = self._handles.get(key)
+        if handle is None:
+            handle = self._handles[key] = DeploymentHandle(*key)
+        loop = asyncio.get_running_loop()
+        try:
+            # assign + submit off-loop (the router may block on
+            # backpressure); await the reply via the owned-ref callback
+            response = await loop.run_in_executor(None, handle.remote, request)
+            result = await self._await_response(response, loop)
+        except TimeoutError as e:
+            return "503 Service Unavailable", json.dumps({"error": str(e)}).encode()
+        except Exception as e:
+            return "500 Internal Server Error", json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+        if isinstance(result, bytes):
+            return "200 OK", result
+        return "200 OK", json.dumps(result).encode()
+
+    async def _await_response(self, response, loop):
+        worker = global_worker()
+        fut: asyncio.Future = loop.create_future()
+        oid = response.ref.id()
+
+        def _on_ready(_oid):
+            loop.call_soon_threadsafe(lambda: fut.done() or fut.set_result(True))
+
+        if worker.memory_store.add_callback(oid, _on_ready):
+            await asyncio.wait_for(fut, timeout=120.0)
+        return await loop.run_in_executor(None, response.result, 60.0)

@@ -1,0 +1,100 @@
+"""Replica actor: hosts one copy of a deployment's user callable.
+
+Reference: ``python/ray/serve/_private/replica.py`` (UserCallableWrapper).
+The replica exposes readiness/health/queue-length probes for the
+controller and ``handle_request`` for routers; ongoing-request counts feed
+both the router's power-of-two choice and queue-based autoscaling.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import cloudpickle
+
+
+class Request:
+    """Minimal HTTP request view handed to ingress deployments
+    (reference passes a starlette Request)."""
+
+    def __init__(self, method: str = "GET", path: str = "/", query: dict | None = None,
+                 headers: dict | None = None, body: bytes = b""):
+        self.method = method
+        self.path = path
+        self.query_params = query or {}
+        self.headers = headers or {}
+        self.body = body
+
+    def json(self):
+        import json
+
+        return json.loads(self.body or b"null")
+
+    def __reduce__(self):
+        return (Request, (self.method, self.path, self.query_params, self.headers, self.body))
+
+
+class ReplicaActor:
+    """One deployment replica. Created by the controller with the pickled
+    user class so replicas never re-import application modules."""
+
+    def __init__(self, serialized_callable: bytes, init_args: tuple, init_kwargs: dict,
+                 user_config: Any = None, deployment_name: str = "", app_name: str = ""):
+        from .router import resolve_handle_markers
+
+        self._lock = threading.Lock()
+        self._ongoing = 0
+        self._total = 0
+        self._deployment_name = deployment_name
+        self._app_name = app_name
+        func_or_class = cloudpickle.loads(serialized_callable)
+        init_args = resolve_handle_markers(init_args)
+        init_kwargs = resolve_handle_markers(init_kwargs)
+        if isinstance(func_or_class, type):
+            self._callable = func_or_class(*init_args, **init_kwargs)
+        else:
+            self._callable = func_or_class  # plain function deployment
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def ready(self) -> bool:
+        return True
+
+    def check_health(self) -> bool:
+        probe = getattr(self._callable, "check_health", None)
+        if probe is not None:
+            probe()
+        return True
+
+    def get_queue_len(self) -> int:
+        with self._lock:
+            return self._ongoing
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"ongoing": self._ongoing, "total": self._total}
+
+    def reconfigure(self, user_config: Any) -> bool:
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+        return True
+
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            target = getattr(self._callable, method_name) if method_name else self._callable
+            result = target(*args, **kwargs)
+            import inspect
+
+            if inspect.iscoroutine(result):
+                import asyncio
+
+                result = asyncio.run(result)
+            return result
+        finally:
+            with self._lock:
+                self._ongoing -= 1

@@ -1,0 +1,204 @@
+"""Router + DeploymentHandle: request scheduling onto replicas.
+
+Reference: ``python/ray/serve/_private/router.py:321`` and
+``replica_scheduler/pow_2_scheduler.py:52`` — the router keeps a local
+view of each replica's in-flight count, samples two replicas at random
+and picks the less loaded one, skipping replicas at their
+``max_ongoing_requests`` cap (backpressure: the caller queues until a
+slot frees). Replica membership arrives via long-poll from the
+controller, so scale-ups and rolling updates apply without polling.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any
+
+from ..core import api as ray
+from ..core.worker import global_worker
+from .long_poll import LongPollClient
+
+HANDLE_MARKER = "__serve_handle_marker__"
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+def resolve_handle_markers(obj):
+    """Replace deploy-time handle markers with live DeploymentHandles
+    (composition: a deployment's init args may reference other
+    deployments)."""
+    if isinstance(obj, tuple):
+        return tuple(resolve_handle_markers(o) for o in obj)
+    if isinstance(obj, list):
+        return [resolve_handle_markers(o) for o in obj]
+    if isinstance(obj, dict):
+        if obj.get("t") == HANDLE_MARKER:
+            return DeploymentHandle(obj["app"], obj["deployment"])
+        return {k: resolve_handle_markers(v) for k, v in obj.items()}
+    return obj
+
+
+class Router:
+    """Per-process router for one deployment."""
+
+    def __init__(self, app_name: str, deployment_name: str):
+        self._key = f"replicas::{app_name}::{deployment_name}"
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # replica_id -> {"actor": ActorHandle, "max_ongoing": int}
+        self._replicas: dict[str, dict] = {}
+        self._inflight: dict[str, int] = {}
+        controller = ray.get_actor(CONTROLLER_NAME)
+        self._long_poll = LongPollClient(controller, {self._key: self._update_replicas})
+        # prime with the current table so the first request needn't wait a
+        # full poll round-trip
+        try:
+            snap = ray.get(controller.get_snapshot.remote(self._key), timeout=30)
+            if snap is not None:
+                self._update_replicas(snap)
+        except Exception:
+            pass
+
+    def _update_replicas(self, table: Any) -> None:
+        from ..core.api import ActorHandle
+
+        table = table or []
+        with self._cond:
+            fresh = {}
+            for entry in table:
+                rid = entry["replica_id"]
+                existing = self._replicas.get(rid)
+                if existing is not None:
+                    fresh[rid] = existing
+                else:
+                    fresh[rid] = {
+                        "actor": ActorHandle(bytes.fromhex(entry["actor_id"])),
+                        "max_ongoing": entry["max_ongoing"],
+                    }
+            self._replicas = fresh
+            self._inflight = {rid: self._inflight.get(rid, 0) for rid in fresh}
+            self._cond.notify_all()
+
+    def assign_replica(self, timeout: float = 60.0) -> tuple[str, Any]:
+        """Power-of-two choice among replicas below their cap; blocks while
+        every replica is saturated (backpressure)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                candidates = [
+                    rid for rid, r in self._replicas.items()
+                    if self._inflight.get(rid, 0) < r["max_ongoing"]
+                ]
+                if candidates:
+                    if len(candidates) == 1:
+                        pick = candidates[0]
+                    else:
+                        a, b = random.sample(candidates, 2)
+                        pick = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+                    self._inflight[pick] = self._inflight.get(pick, 0) + 1
+                    return pick, self._replicas[pick]["actor"]
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"No replica available for {self._key} within {timeout}s "
+                        f"({len(self._replicas)} replicas, all saturated)"
+                    )
+                self._cond.wait(min(remaining, 1.0))
+
+    def release(self, replica_id: str) -> None:
+        with self._cond:
+            if replica_id in self._inflight:
+                self._inflight[replica_id] = max(0, self._inflight[replica_id] - 1)
+            self._cond.notify_all()
+
+    def shutdown(self) -> None:
+        self._long_poll.stop()
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (reference DeploymentResponse)."""
+
+    def __init__(self, ref, on_done):
+        self._ref = ref
+        self._on_done = on_done
+        self._settle_lock = threading.Lock()
+        self._settled = False
+        worker = global_worker()
+        oid = ref.id()
+
+        def _cb(_oid):
+            self._settle()
+
+        if not worker.memory_store.add_callback(oid, _cb):
+            self._settle()
+
+    def _settle(self) -> None:
+        # atomic test-and-set: the store callback and a result() caller can
+        # race here, and on_done (router slot release) must run exactly once
+        with self._settle_lock:
+            if self._settled:
+                return
+            self._settled = True
+        try:
+            self._on_done()
+        except Exception:
+            pass
+
+    def result(self, timeout: float | None = 60.0):
+        value = ray.get(self._ref, timeout=timeout)
+        self._settle()
+        return value
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    """Client-side handle to a deployment (reference serve.handle.DeploymentHandle)."""
+
+    def __init__(self, app_name: str, deployment_name: str, method_name: str = "",
+                 _router_holder: dict | None = None):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self._method_name = method_name
+        # Shared, mutable: every handle derived from this one (h.method)
+        # must reuse ONE router — a router per derived handle would leak a
+        # long-poll thread per request.
+        self._router_holder = (
+            _router_holder if _router_holder is not None
+            else {"router": None, "lock": threading.Lock()}
+        )
+
+    def _get_router(self) -> Router:
+        with self._router_holder["lock"]:
+            if self._router_holder["router"] is None:
+                self._router_holder["router"] = Router(self.app_name, self.deployment_name)
+            return self._router_holder["router"]
+
+    def options(self, method_name: str = "") -> "DeploymentHandle":
+        return DeploymentHandle(
+            self.app_name, self.deployment_name, method_name,
+            _router_holder=self._router_holder,
+        )
+
+    def __getattr__(self, item: str) -> "DeploymentHandle":
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return self.options(method_name=item)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        router = self._get_router()
+        replica_id, actor = router.assign_replica()
+        try:
+            ref = actor.handle_request.remote(self._method_name, args, kwargs)
+        except Exception:
+            router.release(replica_id)
+            raise
+        return DeploymentResponse(ref, on_done=lambda: router.release(replica_id))
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.app_name, self.deployment_name, self._method_name))
