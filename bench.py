@@ -1,81 +1,220 @@
-"""Headline benchmark: Llama train-step throughput on the local TPU chip.
+"""Headline benchmark: Llama train throughput THROUGH the framework.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Runs ``JaxTrainer.fit`` — controller → placement group → train-worker
+actor (which claims the TPU via runtime_env) → Data streaming split →
+report/checkpoint — and prints ONE JSON line
+``{"metric", "value", "unit", "vs_baseline"}`` plus MFU and the raw-loop
+number so framework overhead is visible.
 
-North star (BASELINE.json) is Ray Train tokens/sec/chip on Llama-3 — the
-reference has no TPU number, so this establishes the baseline; vs_baseline
-is reported against the value recorded in BENCH_BASELINE.json if present
-(else 1.0).
+North star (BASELINE.json) is Ray Train tokens/sec/chip on Llama-3; the
+reference has no TPU number, so vs_baseline compares against
+BENCH_BASELINE.json when present (else 1.0).
 """
 
 from __future__ import annotations
 
-import functools
 import json
 import os
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
+# The driver stays OFF the TPU: the train worker claims the chip.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+PRESET = os.environ.get("RAY_TPU_BENCH_PRESET", "llama3-1b")
+BATCH = int(os.environ.get("RAY_TPU_BENCH_BATCH", "8"))
+SEQ = int(os.environ.get("RAY_TPU_BENCH_SEQ", "2048"))
+TIMED_STEPS = int(os.environ.get("RAY_TPU_BENCH_STEPS", "10"))
+WARMUP_STEPS = 2
+ALLOW_CPU = os.environ.get("RAY_TPU_BENCH_ALLOW_CPU") == "1"  # plumbing smoke test
 
 
-def main() -> None:
+def train_fn(config: dict) -> None:
+    """Runs inside the TPU-owning train worker actor."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
     import optax
 
-    from ray_tpu.models import PRESETS, init_params, loss_fn
+    from ray_tpu import train
+    from ray_tpu.models import PRESETS, init_params, loss_fn, param_axes
+    from ray_tpu.models.llama import train_flops_per_token
     from ray_tpu.parallel import MeshConfig, create_mesh
     from ray_tpu.parallel.sharding import shard_params
-    from ray_tpu.models import param_axes
+    import dataclasses
 
+    if config.get("allow_cpu"):
+        # smoke mode: force-pin CPU (the container sitecustomize registers
+        # the TPU plugin and wins over the env var)
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        platform = jax.devices()[0].platform
+        # the axon tunnel reports platform "axon" for the same chip
+        assert platform in ("tpu", "axon"), f"worker got {jax.devices()}"
     n_dev = len(jax.devices())
     mesh = create_mesh(MeshConfig(dp=n_dev))
-    cfg = PRESETS["llama3-1b"]
-    batch_per_chip, seq = 8, 2048
+    cfg = dataclasses.replace(PRESETS[config["preset"]], remat_policy="attn")
+    batch_per_chip, seq = config["batch"], config["seq"]
 
     params = init_params(cfg, jax.random.PRNGKey(0))
     params = shard_params(params, param_axes(cfg), mesh)
     opt = optax.adafactor(1e-3)
     opt_state = jax.jit(opt.init)(params)
-    tokens = jax.random.randint(
-        jax.random.PRNGKey(1), (batch_per_chip * n_dev, seq), 0, cfg.vocab_size
-    )
-    batch = {"tokens": tokens}
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, batch, cfg, mesh=mesh)
+            lambda p: loss_fn(p, batch, cfg, mesh=mesh, chunk_tokens=2048)
         )(params)
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
+    shard = train.get_dataset_shard("train")
+    batches = shard.iter_batches(batch_size=batch_per_chip * n_dev, drop_last=True)
+
+    def next_batch():
+        host = next(batches)
+        return {"tokens": jax.device_put(np.asarray(host["tokens"], np.int32))}
+
     # warmup / compile. NOTE: under the axon tunnel block_until_ready is a
-    # no-op; device_get is the only reliable completion fence, so the loss
-    # scalar is fetched to host to close each timing region.
-    for _ in range(2):
-        params, opt_state, loss = train_step(params, opt_state, batch)
+    # no-op; device_get is the only reliable completion fence.
+    for _ in range(WARMUP_STEPS):
+        params, opt_state, loss = train_step(params, opt_state, next_batch())
     float(jax.device_get(loss))
 
-    steps = 10
     t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = train_step(params, opt_state, batch)
-    float(jax.device_get(loss))
+    for _ in range(config["steps"]):
+        params, opt_state, loss = train_step(params, opt_state, next_batch())
+    final_loss = float(jax.device_get(loss))
     dt = time.perf_counter() - t0
 
-    tokens_per_sec_per_chip = batch_per_chip * seq * steps / dt
+    tokens_per_sec_per_chip = batch_per_chip * seq * config["steps"] / dt
+    mfu = tokens_per_sec_per_chip * train_flops_per_token(cfg, seq) / 197e12
+
+    # checkpoint through the framework path (outside the timed region)
+    import tempfile
+
+    from ray_tpu.train import Checkpoint, save_pytree
+
+    with tempfile.TemporaryDirectory() as d:
+        save_pytree({"step": jnp.asarray(config["steps"])}, d)
+        train.report(
+            {"tokens_per_sec_per_chip": tokens_per_sec_per_chip, "mfu": mfu,
+             "loss": final_loss},
+            checkpoint=Checkpoint.from_directory(d),
+        )
+
+
+def run_framework() -> dict:
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    ray_tpu.init(num_cpus=4)
+    total_steps = WARMUP_STEPS + TIMED_STEPS
+    # synthetic token stream through the real Data path
+    # sized for up to 8 devices in the worker (the driver can't see the
+    # worker's device count; int32 tokens are cheap)
+    rows = (total_steps + 2) * BATCH * 8
+    tokens = np.random.randint(0, 128_256, size=(rows, SEQ), dtype=np.int32)
+    ds = data.from_numpy(tokens, column="tokens")
+
+    trainer = JaxTrainer(
+        train_fn,
+        train_loop_config={"preset": PRESET, "batch": BATCH, "seq": SEQ,
+                           "steps": TIMED_STEPS, "allow_cpu": ALLOW_CPU},
+        scaling_config=ScalingConfig(
+            num_workers=1,
+            resources_per_worker={"CPU": 1} if ALLOW_CPU else {"CPU": 1, "TPU": 1},
+            # the worker (not the driver) owns the chip
+            worker_runtime_env=None if ALLOW_CPU else {"env_vars": {"JAX_PLATFORMS": None}},
+        ),
+        run_config=RunConfig(name=f"bench_{int(time.time())}", storage_path="/tmp/ray_tpu/bench"),
+        datasets={"train": ds},
+    )
+    result = trainer.fit()
+    if result.error is not None:
+        raise result.error
+    out = dict(result.metrics)
+    ray_tpu.shutdown()
+    return out
+
+
+def run_raw() -> float:
+    """The same train step without the framework (overhead comparison)."""
+    import subprocess
+
+    code = r"""
+import dataclasses, functools, json, os, time
+import jax, jax.numpy as jnp, optax
+if os.environ.get("RAY_TPU_BENCH_ALLOW_CPU") == "1":
+    jax.config.update("jax_platforms", "cpu")
+from ray_tpu.models import PRESETS, init_params, loss_fn, param_axes
+from ray_tpu.parallel import MeshConfig, create_mesh
+from ray_tpu.parallel.sharding import shard_params
+n_dev = len(jax.devices())
+mesh = create_mesh(MeshConfig(dp=n_dev))
+cfg = dataclasses.replace(PRESETS["%s"], remat_policy="attn")
+params = shard_params(init_params(cfg, jax.random.PRNGKey(0)), param_axes(cfg), mesh)
+opt = optax.adafactor(1e-3)
+opt_state = jax.jit(opt.init)(params)
+tokens = jax.random.randint(jax.random.PRNGKey(1), (%d * n_dev, %d), 0, cfg.vocab_size)
+batch = {"tokens": tokens}
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def step(params, opt_state, batch):
+    loss, grads = jax.value_and_grad(lambda p: loss_fn(p, batch, cfg, mesh=mesh, chunk_tokens=2048))(params)
+    updates, opt_state = opt.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state, loss
+for _ in range(%d):
+    params, opt_state, loss = step(params, opt_state, batch)
+float(jax.device_get(loss))
+t0 = time.perf_counter()
+for _ in range(%d):
+    params, opt_state, loss = step(params, opt_state, batch)
+float(jax.device_get(loss))
+print(json.dumps({"raw": %d * %d * %d / (time.perf_counter() - t0)}))
+""" % (PRESET, BATCH, SEQ, WARMUP_STEPS, TIMED_STEPS, BATCH, SEQ, TIMED_STEPS)
+    env = dict(os.environ)
+    if not ALLOW_CPU:
+        env.pop("JAX_PLATFORMS", None)  # the raw subprocess owns the chip
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, env=env, timeout=900
+    )
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)["raw"]
+        except Exception:
+            continue
+    raise RuntimeError(f"raw benchmark failed: {out.stderr[-2000:]}")
+
+
+def main() -> None:
+    fw = run_framework()
+    try:
+        raw = run_raw()
+    except Exception as e:
+        print(f"raw comparison failed: {e}", file=sys.stderr)
+        raw = None
+    value = fw["tokens_per_sec_per_chip"]
     baseline = None
     if os.path.exists("BENCH_BASELINE.json"):
         try:
             baseline = json.load(open("BENCH_BASELINE.json")).get("value")
         except Exception:
             baseline = None
-    vs = tokens_per_sec_per_chip / baseline if baseline else 1.0
     print(json.dumps({
-        "metric": "train_tokens_per_sec_per_chip_llama3_1b",
-        "value": round(tokens_per_sec_per_chip, 2),
+        "metric": f"train_tokens_per_sec_per_chip_{PRESET.replace('-', '_')}",
+        "value": round(value, 2),
         "unit": "tokens/s/chip",
-        "vs_baseline": round(vs, 4),
+        "vs_baseline": round(value / baseline, 4) if baseline else 1.0,
+        "mfu": round(fw["mfu"], 4),
+        "loss": round(fw["loss"], 4),
+        "raw_tokens_per_sec": round(raw, 2) if raw else None,
+        "framework_overhead_pct": round(100 * (1 - value / raw), 2) if raw else None,
     }))
 
 

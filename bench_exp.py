@@ -1,0 +1,103 @@
+"""Experiment harness for train-step throughput tuning (not the official bench)."""
+import functools, json, sys, time
+import jax, jax.numpy as jnp
+import optax
+
+from ray_tpu.models import PRESETS, init_params, loss_fn, param_axes
+from ray_tpu.models import llama as llama_mod
+from ray_tpu.parallel import MeshConfig, create_mesh
+from ray_tpu.parallel.sharding import shard_params
+
+def run(preset="llama3-1b", batch=8, seq=2048, chunk=512, remat="full", opt_name="adafactor", steps=8):
+    n_dev = len(jax.devices())
+    print("device:", jax.devices()[0].device_kind, file=sys.stderr)
+    mesh = create_mesh(MeshConfig(dp=n_dev))
+    cfg = PRESETS[preset]
+    import dataclasses
+    if remat == "none":
+        cfg = dataclasses.replace(cfg, remat=False)
+    elif remat in ("dots", "attn"):
+        cfg = dataclasses.replace(cfg, remat=True, remat_policy=remat)
+    if getattr(run, "_attn", None):
+        cfg = dataclasses.replace(cfg, attn_impl=run._attn)
+    import os as _os
+    bq, bk = _os.environ.get("FLASH_BQ"), _os.environ.get("FLASH_BK")
+    if bq or bk:
+        from ray_tpu.ops import attention as _att
+        import functools as _ft
+        orig = _att.flash_attention
+        _att_wrapped = _ft.partial(orig, block_q=int(bq or 512), block_k=int(bk or 512))
+        llama_mod.flash_attention = _att_wrapped
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    params = shard_params(params, param_axes(cfg), mesh)
+    opt = optax.adafactor(1e-3) if opt_name == "adafactor" else optax.adamw(1e-3)
+    opt_state = jax.jit(opt.init)(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch * n_dev, seq), 0, cfg.vocab_size)
+    b = {"tokens": tokens}
+
+    mode = getattr(run, "_mode", "step")
+    if mode == "hidden":
+        from ray_tpu.models.llama import forward_hidden
+        @jax.jit
+        def train_step(params, opt_state, b):
+            h = forward_hidden(params, b["tokens"], cfg, mesh=mesh)
+            return params, opt_state, jnp.sum(h).astype(jnp.float32)
+    elif mode == "fwd":
+        @jax.jit
+        def train_step(params, opt_state, b):
+            return params, opt_state, loss_fn(params, b, cfg, mesh=mesh, chunk_tokens=chunk)
+    elif mode == "grad":
+        @jax.jit
+        def train_step(params, opt_state, b):
+            loss, grads = jax.value_and_grad(lambda p: loss_fn(p, b, cfg, mesh=mesh, chunk_tokens=chunk))(params)
+            return params, opt_state, loss + sum(jnp.sum(g).astype(jnp.float32) * 0 for g in jax.tree_util.tree_leaves(grads))
+    elif mode == "noembedgrad":
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def train_step(params, opt_state, b):
+            def lf(p):
+                p = dict(p); p["embed"] = jax.lax.stop_gradient(p["embed"])
+                return loss_fn(p, b, cfg, mesh=mesh, chunk_tokens=chunk)
+            loss, grads = jax.value_and_grad(lf)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+    else:
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def train_step(params, opt_state, b):
+            loss, grads = jax.value_and_grad(lambda p: loss_fn(p, b, cfg, mesh=mesh, chunk_tokens=chunk))(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+    for _ in range(2):
+        params, opt_state, loss = train_step(params, opt_state, b)
+    float(jax.device_get(loss))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = train_step(params, opt_state, b)
+    float(jax.device_get(loss))
+    dt = time.perf_counter() - t0
+    tps = batch * seq * steps / dt
+    # 6N model flops (layers + lm_head + embed-as-matmul excluded)
+    c = cfg
+    n_params = c.n_layers * (c.hidden * c.head_dim * (c.n_heads * 2 + c.n_kv_heads * 2) + 3 * c.hidden * c.intermediate) + c.hidden * c.vocab_size
+    attn_flops = 6 * c.n_layers * c.n_heads * c.head_dim * seq  # per token, causal ~ /2*... keep simple 6*L*H*D*S/2*2
+    flops_per_tok = 6 * n_params + attn_flops
+    mfu = tps * flops_per_tok / 197e12
+    print(json.dumps({"preset": preset, "batch": batch, "chunk": chunk, "remat": remat, "opt": opt_name,
+                      "mode": mode, "tok_s": round(tps, 1), "mfu": round(mfu, 4)}))
+
+if __name__ == "__main__":
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="llama3-1b")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=2048)
+    p.add_argument("--chunk", type=int, default=512)
+    p.add_argument("--remat", default="full")
+    p.add_argument("--opt", default="adafactor")
+    p.add_argument("--attn", default="")
+    p.add_argument("--mode", default="step")
+    a = p.parse_args()
+    if a.attn:
+        run._attn = a.attn
+    run._mode = a.mode
+    run(a.preset, a.batch, a.seq, a.chunk, a.remat, a.opt)

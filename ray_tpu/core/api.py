@@ -212,6 +212,7 @@ class RemoteFunction:
             scheduling_strategy=strategy,
             placement_group_id=pg_id,
             placement_group_bundle_index=bundle,
+            runtime_env=opts.get("runtime_env"),
         )
         return refs[0] if num_returns == 1 else refs
 
@@ -302,6 +303,7 @@ class ActorClass:
             scheduling_strategy=strategy,
             placement_group_id=pg_id,
             placement_group_bundle_index=bundle,
+            runtime_env=opts.get("runtime_env"),
         )
         # Non-detached actors — named or not — die when the creator's last
         # handle is GC'd (reference actor.py: only lifetime="detached"

@@ -47,6 +47,10 @@ class WorkerHandle:
     proc: subprocess.Popen | None = None
     state: str = "starting"  # starting | idle | leased | dedicated | dead
     actor_id: str = ""
+    # Hash of the runtime env this worker was started with ("" = default);
+    # leases only match workers with the same env (worker_pool.h:524
+    # runtime-env-hash matching).
+    env_hash: str = ""
     lease_resources: ResourceSet = field(default_factory=ResourceSet)
     # Bundle this lease draws from, if the task runs in a placement group.
     bundle_key: tuple | None = None
@@ -205,11 +209,27 @@ class Raylet:
         self._workers.pop(w.worker_id, None)
 
     # ------------------------------------------------------------ worker pool
-    def _start_worker(self) -> WorkerHandle:
+    @staticmethod
+    def _env_hash(runtime_env: dict | None) -> str:
+        env_vars = (runtime_env or {}).get("env_vars") or {}
+        if not env_vars:
+            return ""
+        import hashlib
+        import json
+
+        return hashlib.sha1(json.dumps(env_vars, sort_keys=True).encode()).hexdigest()[:16]
+
+    def _start_worker(self, runtime_env: dict | None = None) -> WorkerHandle:
         worker_id = WorkerID.from_random().hex()
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = worker_id
         env.setdefault("JAX_PLATFORMS", "cpu")  # workers don't grab the TPU by default
+        env_vars = (runtime_env or {}).get("env_vars") or {}
+        for key, value in env_vars.items():
+            if value is None:
+                env.pop(key, None)
+            else:
+                env[key] = str(value)
         proc = subprocess.Popen(
             [
                 sys.executable,
@@ -232,7 +252,8 @@ class Raylet:
             stdout=open(os.path.join(self._session_dir, f"worker-{worker_id[:12]}.out"), "wb"),
             stderr=subprocess.STDOUT,
         )
-        handle = WorkerHandle(worker_id=worker_id, pid=proc.pid, proc=proc)
+        handle = WorkerHandle(worker_id=worker_id, pid=proc.pid, proc=proc,
+                              env_hash=self._env_hash(runtime_env))
         handle.registered = asyncio.get_running_loop().create_future() if _in_loop() else None
         self._workers[worker_id] = handle
         return handle
@@ -257,18 +278,23 @@ class Raylet:
         self._wake_lease_waiters()
         return {"node_id": self.node_id.hex()}
 
-    async def _get_idle_worker(self, timeout: float) -> WorkerHandle | None:
-        """Pop an idle registered worker, starting one if needed."""
+    async def _get_idle_worker(self, timeout: float, runtime_env: dict | None = None) -> WorkerHandle | None:
+        """Pop an idle registered worker whose env matches, starting one if
+        needed (reference: worker_pool runtime-env-hash matching)."""
+        want = self._env_hash(runtime_env)
         deadline = time.monotonic() + timeout
         while True:
-            while self._idle:
-                wid = self._idle.pop(0)
+            for wid in list(self._idle):
                 w = self._workers.get(wid)
-                if w is not None and w.state == "idle":
+                if w is not None and w.state == "idle" and w.env_hash == want:
+                    self._idle.remove(wid)
                     return w
-            starting = sum(1 for w in self._workers.values() if w.state == "starting")
+            starting = sum(
+                1 for w in self._workers.values()
+                if w.state == "starting" and w.env_hash == want
+            )
             if starting < get_config().maximum_startup_concurrency:
-                self._start_worker()
+                self._start_worker(runtime_env)
             fut: asyncio.Future = asyncio.get_running_loop().create_future()
             self._lease_waiters.append(fut)
             remaining = deadline - time.monotonic()
@@ -370,7 +396,9 @@ class Raylet:
             except asyncio.TimeoutError:
                 pass
 
-        worker = await self._get_idle_worker(get_config().worker_register_timeout_s)
+        worker = await self._get_idle_worker(
+            get_config().worker_register_timeout_s, spec.get("runtime_env")
+        )
         if worker is None:
             self.resources.release(request)
             return {"granted": False, "reason": "no worker available"}
@@ -410,7 +438,9 @@ class Raylet:
                 await asyncio.wait_for(fut, 0.5)
             except asyncio.TimeoutError:
                 pass
-        worker = await self._get_idle_worker(get_config().worker_register_timeout_s)
+        worker = await self._get_idle_worker(
+            get_config().worker_register_timeout_s, spec.get("runtime_env")
+        )
         if worker is None:
             b = self._pg_bundles.get(key)
             if b is not None:

@@ -581,6 +581,7 @@ class CoreWorker:
         scheduling_strategy: dict | None = None,
         placement_group_id: bytes = b"",
         placement_group_bundle_index: int = -1,
+        runtime_env: dict | None = None,
     ) -> list[ObjectRef]:
         cfg = get_config()
         fid = self.functions.export((fn, "task"))
@@ -600,6 +601,7 @@ class CoreWorker:
             scheduling_strategy=scheduling_strategy or {},
             placement_group_id=placement_group_id,
             placement_group_bundle_index=placement_group_bundle_index,
+            runtime_env=runtime_env or {},
         )
         return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(num_returns)]
         for rid in return_ids:
@@ -647,11 +649,13 @@ class CoreWorker:
             with self._counter_lock:
                 self._spread_salt += 1
                 salt = self._spread_salt
+        env_vars = (spec.runtime_env or {}).get("env_vars") or {}
         return (
             tuple(sorted(spec.required_resources().items())),
             spec.placement_group_id,
             spec.placement_group_bundle_index,
             tuple(sorted(strategy.items())) if strategy else (),
+            tuple(sorted(env_vars.items())),
             salt,
         )
 
@@ -833,6 +837,7 @@ class CoreWorker:
         scheduling_strategy: dict | None = None,
         placement_group_id: bytes = b"",
         placement_group_bundle_index: int = -1,
+        runtime_env: dict | None = None,
     ) -> bytes:
         with self._counter_lock:
             self._task_counter += 1
@@ -859,6 +864,7 @@ class CoreWorker:
             scheduling_strategy=scheduling_strategy or {},
             placement_group_id=placement_group_id,
             placement_group_bundle_index=placement_group_bundle_index,
+            runtime_env=runtime_env or {},
         )
         reply = self._gcs_call(
             "RegisterActor",

@@ -45,6 +45,11 @@ class LlamaConfig:
     # attention implementation: "flash" | "ring" | "reference"
     attn_impl: str = "flash"
     remat: bool = True
+    # "full": recompute the whole block in backward (min HBM);
+    # "dots": save matmul outputs, recompute elementwise only (XLA
+    # checkpoint_policies.dots_with_no_batch_dims_saveable) — trades HBM
+    # for ~1 forward less recompute per step.
+    remat_policy: str = "full"
 
 
 PRESETS: dict[str, LlamaConfig] = {
@@ -123,6 +128,9 @@ def _attention(q, k, v, config: LlamaConfig, mesh: Mesh | None):
         return fn(q, k, v)
     if config.attn_impl == "reference":
         return mha_reference(q, k, v, causal=True)
+    if config.attn_impl == "none":  # ablation: identity attention
+        g = q.shape[1] // k.shape[1]
+        return (q.reshape(q.shape[0], k.shape[1], g, *q.shape[2:]) * v[:, :, None]).reshape(q.shape)
     return flash_attention(q, k, v, causal=True)
 
 
@@ -133,14 +141,18 @@ def _block(x, layer, positions, config: LlamaConfig, mesh: Mesh | None):
     def sc(t, axes):
         return shard_constraint(t, mesh, axes) if mesh is not None else t
 
+    from jax.ad_checkpoint import checkpoint_name
+
     h = rms_norm(x, layer["attn_norm"], eps=c.norm_eps)
     q = jnp.einsum("bse,ehd->bhsd", h, layer["wq"])
     k = jnp.einsum("bse,ehd->bhsd", h, layer["wk"])
     v = jnp.einsum("bse,ehd->bhsd", h, layer["wv"])
     q = apply_rope(q, positions, theta=c.rope_theta)
     k = apply_rope(k, positions, theta=c.rope_theta)
-    q = sc(q, ("batch", "heads", "seq", "head_dim"))
-    attn = _attention(q, k, v, c, mesh)
+    q = checkpoint_name(sc(q, ("batch", "heads", "seq", "head_dim")), "q")
+    k = checkpoint_name(k, "k")
+    v = checkpoint_name(v, "v")
+    attn = checkpoint_name(_attention(q, k, v, c, mesh), "attn_out")
     attn_out = jnp.einsum("bhsd,hde->bse", attn, layer["wo"])
     x = x + sc(attn_out, ("batch", "seq", "embed_act"))
 
@@ -164,7 +176,23 @@ def forward_hidden(params, tokens, config: LlamaConfig, *, mesh: Mesh | None = N
 
     block = functools.partial(_block, positions=positions, config=c, mesh=mesh)
     if c.remat:
-        block = jax.checkpoint(block)
+        if c.remat_policy == "dots":
+            block = jax.checkpoint(
+                block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            )
+        elif c.remat_policy == "attn":
+            # save the attention path (q/k/v projections + kernel output,
+            # ~2.7 GB at 8x2048 for 1b) so the backward's recompute skips
+            # the attention forward entirely — the best HBM/FLOPs trade on
+            # a 16 GB chip
+            block = jax.checkpoint(
+                block,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "q", "k", "v", "attn_out"
+                ),
+            )
+        else:
+            block = jax.checkpoint(block)
 
     def scan_body(carry, layer):
         return block(carry, layer), None
@@ -179,6 +207,18 @@ def forward(params, tokens, config: LlamaConfig, *, mesh: Mesh | None = None):
     x = forward_hidden(params, tokens, config, mesh=mesh)
     logits = jnp.einsum("bse,ev->bsv", x, params["lm_head"])
     return logits.astype(jnp.float32)
+
+
+def train_flops_per_token(config: LlamaConfig, seq: int) -> float:
+    """Model FLOPs per trained token (6N matmul + causal attention), the
+    numerator of MFU. Embedding gather excluded (standard accounting)."""
+    c = config
+    n_params = c.n_layers * (
+        c.hidden * c.head_dim * (c.n_heads * 2 + c.n_kv_heads * 2)
+        + 3 * c.hidden * c.intermediate
+    ) + c.hidden * c.vocab_size
+    attn = 6 * c.n_layers * c.n_heads * c.head_dim * seq  # causal fwd+bwd
+    return 6.0 * n_params + attn
 
 
 def loss_fn(

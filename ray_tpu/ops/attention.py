@@ -66,9 +66,11 @@ def _flash_kernel(
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
+        # Keep q/k/v in bf16 for the MXU (f32 inputs would run the MXU at a
+        # fraction of peak); accumulate in f32 via preferred_element_type.
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -163,30 +165,29 @@ def _mha_backward_blocked(q, k, v, g, *, causal, sm_scale, block_q):
     if s % block_q:
         block_q = s  # unblocked fallback for ragged sizes
     nq = s // block_q
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
     k_pos = jnp.arange(s)
 
     def body(carry, xs):
         dk_acc, dv_acc = carry
         q_blk, g_blk, q0 = xs  # [B,H,bq,D], [B,H,bq,D], scalar block start
-        qf = q_blk.astype(jnp.float32)
-        gf = g_blk.astype(jnp.float32)
-        sblk = jnp.einsum("bhqd,bhkd->bhqk", qf, kf,
+        # bf16 operands on every dot (f32 inputs would cripple the MXU);
+        # f32 accumulation via preferred_element_type.
+        sblk = jnp.einsum("bhqd,bhkd->bhqk", q_blk, k,
                           preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = q0 + jnp.arange(block_q)
             mask = q_pos[:, None] >= k_pos[None, :]
             sblk = jnp.where(mask[None, None], sblk, NEG_INF)
         p = jax.nn.softmax(sblk, axis=-1)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf,
+        pb = p.astype(q.dtype)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", g_blk, v,
                         preferred_element_type=jnp.float32)
-        ds = p * (dp - jnp.sum(p * dp, axis=-1, keepdims=True))
-        dq_blk = jnp.einsum("bhqk,bhkd->bhqd", ds, kf,
+        ds = (p * (dp - jnp.sum(p * dp, axis=-1, keepdims=True))).astype(q.dtype)
+        dq_blk = jnp.einsum("bhqk,bhkd->bhqd", ds, k,
                             preferred_element_type=jnp.float32) * scale
-        dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds, qf,
+        dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds, q_blk,
                                      preferred_element_type=jnp.float32) * scale
-        dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", p, gf,
+        dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", pb, g_blk,
                                      preferred_element_type=jnp.float32)
         return (dk_acc, dv_acc), dq_blk
 
@@ -245,8 +246,8 @@ def flash_attention(
     *,
     causal: bool = True,
     sm_scale: float | None = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: bool | None = None,
 ):
     """Tiled attention. q [B,Hq,S,D], k/v [B,Hkv,S,D] (GQA folded by repeat).

@@ -36,8 +36,27 @@ def detect_num_tpu_chips() -> int:
         for d in dims:
             n *= d
         return n
-    # Fall back to asking JAX, but never initialize a backend implicitly on
-    # CPU-only hosts (jax.devices() is cheap when JAX_PLATFORMS=cpu).
+    # Environment-based detection works even when THIS process runs with
+    # JAX_PLATFORMS=cpu (the driver advertises the chip; a worker with a
+    # cleared override claims it) — asking JAX here would initialize the
+    # TPU backend in the driver, claiming the chip it must stay off.
+    if os.environ.get("PALLAS_AXON_TPU_GEN"):
+        return 1  # axon tunnel exposes one chip
+    acc = os.environ.get(_ENV_ACCEL_TYPE)
+    if acc:
+        override = os.environ.get("RAY_TPU_CHIPS_PER_HOST")
+        if override:
+            return int(override)
+        # best-effort: single-host slices expose all chips, pod slices 4
+        # per host; override via RAY_TPU_CHIPS_PER_HOST when this guesses
+        # wrong
+        try:
+            n = int(acc.rsplit("-", 1)[1])
+        except (IndexError, ValueError):
+            n = 4
+        return n if n <= 8 else 4
+    # Fall back to asking JAX (only reached when no TPU env markers exist,
+    # so this cannot initialize a TPU backend by surprise).
     try:
         import jax
 

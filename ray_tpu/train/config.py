@@ -29,6 +29,10 @@ class ScalingConfig:
     resources_per_worker: dict | None = None
     topology: str | None = None
     placement_strategy: str = "PACK"
+    # Per-worker runtime env ({"env_vars": {...}}). TPU idiom: the driver
+    # stays off the chip (JAX_PLATFORMS=cpu) and the train workers claim it
+    # by clearing that override.
+    worker_runtime_env: dict | None = None
 
     def worker_resources(self) -> dict:
         res = dict(self.resources_per_worker or {})

@@ -142,6 +142,7 @@ class WorkerGroup:
                     placement_group=pg, placement_group_bundle_index=i
                 ),
                 name=f"train_worker_{experiment_name}_{i}",
+                runtime_env=scaling_config.worker_runtime_env,
             ).remote(i, n, experiment_name, storage_path)
             for i in range(n)
         ]

@@ -123,3 +123,32 @@ def test_ref_passed_to_task():
 def test_cluster_resources():
     res = ray_tpu.cluster_resources()
     assert res.get("CPU", 0) >= 4
+
+
+def test_runtime_env_env_vars():
+    """Tasks with runtime_env={"env_vars"} run in workers started with
+    those vars (reference: runtime_env plugin env_vars; worker_pool
+    runtime-env-hash matching)."""
+    import os
+
+    @ray_tpu.remote
+    def read_env():
+        return os.environ.get("RAY_TPU_TEST_FLAVOR", "unset")
+
+    assert ray_tpu.get(read_env.remote(), timeout=60) == "unset"
+    tagged = read_env.options(runtime_env={"env_vars": {"RAY_TPU_TEST_FLAVOR": "special"}})
+    assert ray_tpu.get(tagged.remote(), timeout=60) == "special"
+    # default-env tasks must not land on the special worker
+    assert ray_tpu.get(read_env.remote(), timeout=60) == "unset"
+
+
+def test_runtime_env_actor():
+    import os
+
+    @ray_tpu.remote
+    class EnvActor:
+        def flavor(self):
+            return os.environ.get("RAY_TPU_TEST_FLAVOR", "unset")
+
+    a = EnvActor.options(runtime_env={"env_vars": {"RAY_TPU_TEST_FLAVOR": "actorenv"}}).remote()
+    assert ray_tpu.get(a.flavor.remote(), timeout=60) == "actorenv"
