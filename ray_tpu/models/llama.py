@@ -50,6 +50,14 @@ class LlamaConfig:
     # checkpoint_policies.dots_with_no_batch_dims_saveable) — trades HBM
     # for ~1 forward less recompute per step.
     remat_policy: str = "full"
+    # MoE: when n_experts > 0 the MLP becomes a top-k routed expert layer
+    # sharded over the ``ep`` mesh axis.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_aux_weight: float = 0.01
+    moe_capacity_factor: float = 1.25
+    # Pipeline parallelism: microbatches per step when the mesh has pp > 1.
+    pipeline_microbatches: int = 4
 
 
 PRESETS: dict[str, LlamaConfig] = {
@@ -62,11 +70,28 @@ PRESETS: dict[str, LlamaConfig] = {
                          n_kv_heads=2, intermediate=128, head_dim=16),
     "debug-128": LlamaConfig(vocab_size=512, hidden=128, n_layers=2, n_heads=4,
                              n_kv_heads=2, intermediate=256, head_dim=32),
+    # MoE family (Mixtral-style top-2 routing)
+    "llama-moe-debug": LlamaConfig(vocab_size=256, hidden=64, n_layers=2, n_heads=4,
+                                   n_kv_heads=2, intermediate=128, head_dim=16,
+                                   moe_experts=4),
+    "mixtral-8x7b-ish": LlamaConfig(hidden=4096, n_layers=32, n_heads=32,
+                                    n_kv_heads=8, intermediate=14_336, head_dim=128,
+                                    moe_experts=8),
 }
 
 
 def param_axes(config: LlamaConfig):
     """Tree of logical-axis tuples matching ``init_params`` output."""
+    if config.moe_experts > 0:
+        from .moe import moe_param_axes
+
+        mlp_axes = moe_param_axes(prefix=("layers",))
+    else:
+        mlp_axes = {
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        }
     return {
         "embed": ("vocab", "embed"),
         "layers": {
@@ -76,9 +101,7 @@ def param_axes(config: LlamaConfig):
             "wv": ("layers", "embed", "kv_heads", "head_dim"),
             "wo": ("layers", "heads", "head_dim", "embed"),
             "mlp_norm": ("layers", "norm"),
-            "w_gate": ("layers", "embed", "mlp"),
-            "w_up": ("layers", "embed", "mlp"),
-            "w_down": ("layers", "mlp", "embed"),
+            **mlp_axes,
         },
         "final_norm": ("norm",),
         "lm_head": ("embed", "vocab"),
@@ -96,6 +119,19 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
         return (jax.random.truncated_normal(k, -2, 2, shape, jnp.float32)
                 * (fan_in ** -0.5)).astype(c.dtype)
 
+    if c.moe_experts > 0:
+        from .moe import init_moe_params
+
+        mlp_params = init_moe_params(
+            keys[5], hidden=E, expert_mlp=M, n_experts=c.moe_experts,
+            dtype=c.dtype, n_layers=L,
+        )
+    else:
+        mlp_params = {
+            "w_gate": norm_init(keys[5], (L, E, M), E),
+            "w_up": norm_init(keys[6], (L, E, M), E),
+            "w_down": norm_init(keys[7], (L, M, E), M),
+        }
     return {
         "embed": norm_init(keys[0], (c.vocab_size, E), E),
         "layers": {
@@ -105,9 +141,7 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
             "wv": norm_init(keys[3], (L, E, KH, D), E),
             "wo": norm_init(keys[4], (L, H, D, E), H * D),
             "mlp_norm": jnp.ones((L, E), c.dtype),
-            "w_gate": norm_init(keys[5], (L, E, M), E),
-            "w_up": norm_init(keys[6], (L, E, M), E),
-            "w_down": norm_init(keys[7], (L, M, E), M),
+            **mlp_params,
         },
         "final_norm": jnp.ones((E,), c.dtype),
         "lm_head": norm_init(keys[8], (E, c.vocab_size), E),
@@ -134,8 +168,11 @@ def _attention(q, k, v, config: LlamaConfig, mesh: Mesh | None):
     return flash_attention(q, k, v, causal=True)
 
 
-def _block(x, layer, positions, config: LlamaConfig, mesh: Mesh | None):
-    """One decoder block. x: [B, S, E] in config.dtype."""
+def _block(x, layer, positions, config: LlamaConfig, mesh: Mesh | None,
+           ep_axis: str | None = None):
+    """One decoder block. x: [B, S, E] in config.dtype. ``ep_axis`` is set
+    only when running per-device inside the pipeline shard_map (expert
+    shard + psum combine)."""
     c = config
 
     def sc(t, axes):
@@ -157,16 +194,51 @@ def _block(x, layer, positions, config: LlamaConfig, mesh: Mesh | None):
     x = x + sc(attn_out, ("batch", "seq", "embed_act"))
 
     h = rms_norm(x, layer["mlp_norm"], eps=c.norm_eps)
-    gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"])
-    up = jnp.einsum("bse,em->bsm", h, layer["w_up"])
-    ff = jax.nn.silu(gate.astype(jnp.float32)).astype(c.dtype) * up
-    ff = sc(ff, ("batch", "seq", "mlp"))
-    down = jnp.einsum("bsm,me->bse", ff, layer["w_down"])
-    return x + sc(down, ("batch", "seq", "embed_act"))
+    aux = jnp.zeros((), jnp.float32)
+    if c.moe_experts > 0:
+        from .moe import moe_block
+
+        down, aux = moe_block(h, layer, top_k=c.moe_top_k, ep_axis=ep_axis,
+                              n_experts_global=c.moe_experts,
+                              capacity_factor=c.moe_capacity_factor)
+    else:
+        gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"])
+        up = jnp.einsum("bse,em->bsm", h, layer["w_up"])
+        ff = jax.nn.silu(gate.astype(jnp.float32)).astype(c.dtype) * up
+        ff = sc(ff, ("batch", "seq", "mlp"))
+        down = jnp.einsum("bsm,me->bse", ff, layer["w_down"])
+    return x + sc(down, ("batch", "seq", "embed_act")), aux
 
 
-def forward_hidden(params, tokens, config: LlamaConfig, *, mesh: Mesh | None = None):
-    """tokens [B, S] int32 -> final hidden states [B, S, E] in config.dtype."""
+def _apply_remat(block, c: LlamaConfig):
+    """Wrap a decoder block with the configured rematerialisation policy."""
+    if not c.remat:
+        return block
+    if c.remat_policy == "dots":
+        return jax.checkpoint(
+            block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    if c.remat_policy == "attn":
+        # save the attention path (q/k/v projections + kernel output,
+        # ~2.7 GB at 8x2048 for 1b) so the backward's recompute skips
+        # the attention forward entirely — the best HBM/FLOPs trade on
+        # a 16 GB chip
+        return jax.checkpoint(
+            block,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "q", "k", "v", "attn_out"
+            ),
+        )
+    return jax.checkpoint(block)
+
+
+def forward_hidden(params, tokens, config: LlamaConfig, *, mesh: Mesh | None = None,
+                   return_aux: bool = False):
+    """tokens [B, S] int32 -> final hidden states [B, S, E] in config.dtype.
+
+    ``return_aux=True`` additionally returns the summed MoE load-balancing
+    loss (always 0.0 for dense configs and on the pipelined path, which
+    does not thread aux through the schedule yet)."""
     c = config
     b, s = tokens.shape
     positions = jnp.arange(s, dtype=jnp.int32)
@@ -174,31 +246,50 @@ def forward_hidden(params, tokens, config: LlamaConfig, *, mesh: Mesh | None = N
     if mesh is not None:
         x = shard_constraint(x, mesh, ("batch", "seq", "embed_act"))
 
-    block = functools.partial(_block, positions=positions, config=c, mesh=mesh)
-    if c.remat:
-        if c.remat_policy == "dots":
-            block = jax.checkpoint(
-                block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            )
-        elif c.remat_policy == "attn":
-            # save the attention path (q/k/v projections + kernel output,
-            # ~2.7 GB at 8x2048 for 1b) so the backward's recompute skips
-            # the attention forward entirely — the best HBM/FLOPs trade on
-            # a 16 GB chip
-            block = jax.checkpoint(
-                block,
-                policy=jax.checkpoint_policies.save_only_these_names(
-                    "q", "k", "v", "attn_out"
-                ),
-            )
-        else:
-            block = jax.checkpoint(block)
+    if mesh is not None and "pp" in mesh.shape and mesh.shape["pp"] > 1:
+        # Pipelined path: stages over the pp axis, microbatch schedule via
+        # shard_map + ppermute (parallel/pipeline.py). Blocks run as pure
+        # per-device compute; MoE experts shard over ep inside the
+        # shard_map (psum combine).
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.pipeline import pipeline_apply
+
+        ep_axis = "ep" if c.moe_experts > 0 and mesh.shape.get("ep", 1) > 1 else None
+        raw_block = functools.partial(
+            _block, positions=positions, config=c, mesh=None, ep_axis=ep_axis
+        )
+        block = _apply_remat(lambda carry, layer: raw_block(carry, layer)[0], c)
+        # per-param specs: layers dim over pp; EXPERT WEIGHT dims over ep.
+        # The router stays replicated across ep — routing is global (every
+        # device scores all experts, then computes only its local shard).
+        expert_weights = ("w_gate", "w_up", "w_down")
+        param_specs = {
+            name: (P("pp", "ep") if (ep_axis and c.moe_experts > 0 and name in expert_weights)
+                   else P("pp"))
+            for name in param_axes(c)["layers"]
+        }
+        x = pipeline_apply(
+            block, params["layers"], x,
+            mesh=mesh, n_microbatches=c.pipeline_microbatches,
+            param_specs=param_specs,
+        )
+        out = rms_norm(x, params["final_norm"], eps=c.norm_eps)
+        return (out, jnp.zeros((), jnp.float32)) if return_aux else out
+
+    block = _apply_remat(
+        functools.partial(_block, positions=positions, config=c, mesh=mesh), c
+    )
 
     def scan_body(carry, layer):
-        return block(carry, layer), None
+        new_x, aux = block(carry, layer)
+        return new_x, aux
 
-    x, _ = lax.scan(scan_body, x, params["layers"])
-    return rms_norm(x, params["final_norm"], eps=c.norm_eps)
+    x, aux_per_layer = lax.scan(scan_body, x, params["layers"])
+    out = rms_norm(x, params["final_norm"], eps=c.norm_eps)
+    if return_aux:
+        return out, jnp.sum(aux_per_layer)
+    return out
 
 
 def forward(params, tokens, config: LlamaConfig, *, mesh: Mesh | None = None):
@@ -210,12 +301,16 @@ def forward(params, tokens, config: LlamaConfig, *, mesh: Mesh | None = None):
 
 
 def train_flops_per_token(config: LlamaConfig, seq: int) -> float:
-    """Model FLOPs per trained token (6N matmul + causal attention), the
-    numerator of MFU. Embedding gather excluded (standard accounting)."""
+    """Model FLOPs per trained token (6N active-param matmul + causal
+    attention), the numerator of MFU. Embedding gather excluded (standard
+    accounting); MoE counts the top_k ACTIVE experts plus the router."""
     c = config
+    if c.moe_experts > 0:
+        mlp = c.moe_top_k * 3 * c.hidden * c.intermediate + c.hidden * c.moe_experts
+    else:
+        mlp = 3 * c.hidden * c.intermediate
     n_params = c.n_layers * (
-        c.hidden * c.head_dim * (c.n_heads * 2 + c.n_kv_heads * 2)
-        + 3 * c.hidden * c.intermediate
+        c.hidden * c.head_dim * (c.n_heads * 2 + c.n_kv_heads * 2) + mlp
     ) + c.hidden * c.vocab_size
     attn = 6 * c.n_layers * c.n_heads * c.head_dim * seq  # causal fwd+bwd
     return 6.0 * n_params + attn
@@ -236,7 +331,11 @@ def loss_fn(
     vocab that tensor alone would OOM a v5e chip at batch 8 × 2048.
     """
     tokens = batch["tokens"]
-    hidden = forward_hidden(params, tokens, config, mesh=mesh)
+    aux = jnp.zeros((), jnp.float32)
+    if config.moe_experts > 0:
+        hidden, aux = forward_hidden(params, tokens, config, mesh=mesh, return_aux=True)
+    else:
+        hidden = forward_hidden(params, tokens, config, mesh=mesh)
     targets = tokens[:, 1:]
     hidden = hidden[:, :-1]
     mask = batch.get("mask")
@@ -277,4 +376,5 @@ def loss_fn(
         (flat_h.reshape(nc, chunk, e), flat_t.reshape(nc, chunk),
          flat_m.reshape(nc, chunk)),
     )
-    return -total / jnp.maximum(flat_m.sum(), 1.0)
+    ce = -total / jnp.maximum(flat_m.sum(), 1.0)
+    return ce + config.moe_aux_weight * aux

@@ -1,0 +1,133 @@
+"""Mixture-of-Experts block with expert parallelism over the ``ep`` axis.
+
+The reference delegates expert parallelism to vLLM
+(``vllm_models.py:117-168``); this is the TPU-native design: top-k routing
+with a static per-expert capacity, dense one-hot dispatch/combine einsums
+(no dynamic shapes — XLA turns the sharded dispatch into all-to-alls over
+``ep``), experts' weights sharded on their leading axis.
+
+Dispatch math (Switch/Mixtral style):
+    router_logits [N, X]  → top-k probs
+    dispatch      [N, X, C] one-hot (token n → slot c of expert x)
+    expert_in  = einsum("nd,nxc->xcd", tokens, dispatch)
+    expert_out = ffn(expert_in)                       # per-expert SwiGLU
+    out        = einsum("xcd,nxc->nd", expert_out, combine)
+Tokens over capacity C are dropped (standard capacity-factor semantics).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_param_axes(prefix: tuple = ()):
+    """Logical axes; ``prefix`` prepends e.g. ("layers",) for stacked use."""
+    return {
+        "router": prefix + ("embed", "experts"),
+        "w_gate": prefix + ("experts", "embed", "expert_mlp"),
+        "w_up": prefix + ("experts", "embed", "expert_mlp"),
+        "w_down": prefix + ("experts", "expert_mlp", "embed"),
+    }
+
+
+def init_moe_params(key, hidden: int, expert_mlp: int, n_experts: int, dtype,
+                    n_layers: int | None = None):
+    """The single source of MoE init (llama.py stacks it per layer via
+    ``n_layers``)."""
+    ks = jax.random.split(key, 4)
+    lead = () if n_layers is None else (n_layers,)
+
+    def init(k, shape, fan_in, out_dtype=dtype):
+        return (jax.random.truncated_normal(k, -2, 2, lead + shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(out_dtype)
+
+    return {
+        # router stays f32: routing logits are precision-sensitive
+        "router": init(ks[0], (hidden, n_experts), hidden, jnp.float32),
+        "w_gate": init(ks[1], (n_experts, hidden, expert_mlp), hidden),
+        "w_up": init(ks[2], (n_experts, hidden, expert_mlp), hidden),
+        "w_down": init(ks[3], (n_experts, expert_mlp, hidden), expert_mlp),
+    }
+
+
+def moe_block(x, params, *, top_k: int = 2, capacity_factor: float = 1.25,
+              ep_axis: str | None = None, n_experts_global: int | None = None):
+    """x: [B, S, E] → [B, S, E]. Routing in f32; expert FFN in x.dtype.
+
+    Two execution modes:
+      * jit path (``ep_axis=None``): full expert tensors; XLA lowers the
+        sharded dispatch einsum into all-to-alls over ``ep``.
+      * shard_map path (``ep_axis`` set, e.g. inside the pp pipeline):
+        ``params`` hold only this device's expert shard; routing is global
+        (router weights replicated), each device computes its local
+        experts' slice of the dispatch, and a psum over ``ep`` combines.
+    """
+    b, s, e = x.shape
+    n = b * s
+    tokens = x.reshape(n, e)
+    n_experts = n_experts_global or params["router"].shape[1]
+    capacity = max(1, int(capacity_factor * n * top_k / n_experts))
+
+    logits = jnp.einsum("nd,dx->nx", tokens.astype(jnp.float32), params["router"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, top_k)  # [N, K]
+    # renormalize the selected gates (Mixtral convention)
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    # position of each (token, k) within its expert's capacity buffer:
+    # cumulative count of earlier tokens routed to the same expert
+    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.int32)  # [N, K, X]
+    flat_choice = onehot.reshape(n * top_k, n_experts)
+    position = jnp.cumsum(flat_choice, axis=0) * flat_choice - 1  # [N*K, X]
+    position = position.reshape(n, top_k, n_experts)
+    pos_in_expert = (position * onehot).sum(-1)  # [N, K]
+    keep = pos_in_expert < capacity
+
+    # dispatch/combine tensors [N, X, C]
+    cap_onehot = jax.nn.one_hot(jnp.where(keep, pos_in_expert, capacity), capacity, dtype=x.dtype)
+    dispatch = jnp.einsum(
+        "nkx,nkc->nxc", onehot.astype(x.dtype), cap_onehot
+    )
+    combine = jnp.einsum(
+        "nkx,nkc,nk->nxc", onehot.astype(jnp.float32), cap_onehot.astype(jnp.float32),
+        gate_vals,
+    ).astype(x.dtype)
+
+    if ep_axis is not None:
+        # shard_map path: this device holds X/ep experts; slice its share
+        # of the dispatch/combine and psum the partial outputs.
+        x_local = params["w_gate"].shape[0]
+        rank = jax.lax.axis_index(ep_axis)
+        dispatch = jax.lax.dynamic_slice_in_dim(dispatch, rank * x_local, x_local, axis=1)
+        combine = jax.lax.dynamic_slice_in_dim(combine, rank * x_local, x_local, axis=1)
+
+    expert_in = jnp.einsum("nd,nxc->xcd", tokens, dispatch)  # [X, C, E]
+    gate = jnp.einsum("xcd,xdm->xcm", expert_in, params["w_gate"])
+    up = jnp.einsum("xcd,xdm->xcm", expert_in, params["w_up"])
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+    expert_out = jnp.einsum("xcm,xmd->xcd", act, params["w_down"])
+    out = jnp.einsum("xcd,nxc->nd", expert_out, combine)
+    if ep_axis is not None:
+        out = jax.lax.psum(out, ep_axis)
+    # load-balancing aux term from the same routing probabilities
+    # (Switch: X * sum(frac_tokens_to_expert * mean_prob_of_expert))
+    frac_tokens = jnp.mean(onehot.astype(jnp.float32).sum(1), axis=0) / top_k
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = n_experts * jnp.sum(frac_tokens * frac_probs)
+    return out.reshape(b, s, e), aux
+
+
+def moe_aux_loss(x, params, *, top_k: int = 2):
+    """Load-balancing auxiliary loss (Switch: X * sum(frac_tokens * frac_probs))."""
+    b, s, e = x.shape
+    tokens = x.reshape(b * s, e).astype(jnp.float32)
+    logits = jnp.einsum("nd,dx->nx", tokens, params["router"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    n_experts = probs.shape[-1]
+    _, expert_idx = jax.lax.top_k(probs, top_k)
+    frac_tokens = jnp.mean(
+        jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32).sum(1), axis=0
+    ) / top_k
+    frac_probs = jnp.mean(probs, axis=0)
+    return n_experts * jnp.sum(frac_tokens * frac_probs)

@@ -1,0 +1,131 @@
+"""Pipeline parallelism: microbatched stage execution over the ``pp`` axis.
+
+The reference delegates pipeline parallelism to vLLM GPU workers
+(``python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_models.py:117-168``);
+here it is TPU-native: layers are sharded into ``pp`` stages, activations
+flow stage→stage over ICI via ``lax.ppermute`` inside ``shard_map``, and a
+``lax.scan`` over pipeline ticks runs the classic microbatch schedule —
+tick t computes every stage in parallel on its current microbatch, then
+rotates. The forward is GPipe-shaped with bubble (pp-1)/(n_micro+pp-1);
+because the schedule is a differentiable scan, autodiff yields the
+interleaved backward (the 1F1B-equivalent compute order under XLA's
+scheduling) without a hand-written backward pass.
+
+Composes with dp/fsdp on the batch axes; combine with ep for MoE stages.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(
+    block_fn: Callable,
+    stage_params,
+    x,
+    *,
+    mesh: Mesh,
+    n_microbatches: int,
+    axis: str = "pp",
+    batch_axes: tuple = ("dp", "fsdp"),
+    param_specs=None,
+):
+    """Run stacked layers split into ``pp`` stages over microbatches.
+
+    block_fn(carry, layer) -> carry   — one decoder block, pure per-device
+    stage_params — pytree with leading dim [n_layers] (sharded over ``pp``)
+    x            — [B, S, E] activations (batch sharded over ``batch_axes``)
+
+    Returns [B, S, E] after all layers.
+    """
+    pp = mesh.shape[axis]
+    if pp == 1:
+        def scan_body(carry, layer):
+            return block_fn(carry, layer), None
+
+        out, _ = lax.scan(scan_body, x, stage_params)
+        return out
+
+    b, s, e = x.shape
+    # the requirement is on the PER-DEVICE batch shard, not the global one
+    shard = 1
+    for a in batch_axes:
+        shard *= mesh.shape.get(a, 1)
+    if (b // shard) % n_microbatches or b % shard:
+        raise ValueError(
+            f"per-device batch {b}/{shard}={b / shard:g} must be divisible by "
+            f"{n_microbatches} microbatches (global batch {b}, batch axes {batch_axes})"
+        )
+
+    def per_device(params_local, x_local):
+        """Runs on one device: params_local has this stage's layers
+        [L/pp, ...]; x_local is this device's batch shard."""
+        stage = lax.axis_index(axis)
+        bl = x_local.shape[0]
+        mbl = bl // n_microbatches
+        micro = x_local.reshape(n_microbatches, mbl, *x_local.shape[1:])
+
+        def apply_stage(act):
+            def body(carry, layer):
+                return block_fn(carry, layer), None
+
+            out, _ = lax.scan(body, act, params_local)
+            return out
+
+        n_ticks = n_microbatches + pp - 1
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def tick(carry, t):
+            state, outputs = carry  # state: [mbl, S, E] current activation
+            # stage 0 ingests microbatch t (garbage after the last one —
+            # masked out by the output gather below)
+            inject = micro[jnp.minimum(t, n_microbatches - 1)]
+            state = jnp.where(stage == 0, inject, state)
+            state = apply_stage(state)
+            # the last stage's result for microbatch t-(pp-1) is ready
+            out_idx = t - (pp - 1)
+            outputs = lax.cond(
+                out_idx >= 0,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, state, jnp.maximum(out_idx, 0), axis=0
+                ),
+                lambda o: o,
+                outputs,
+            )
+            # rotate stage outputs forward around the ring
+            state = lax.ppermute(state, axis, perm=perm)
+            return (state, outputs), None
+
+        outputs0 = jnp.zeros((n_microbatches, mbl) + x_local.shape[1:], x_local.dtype)
+        state0 = jnp.zeros((mbl,) + x_local.shape[1:], x_local.dtype)
+        (_, outputs), _ = lax.scan(tick, (state0, outputs0), jnp.arange(n_ticks))
+        # every stage ran the same schedule, but only the LAST stage's
+        # written outputs are the true results — broadcast them to all
+        # stages (mask + psum keeps it a single collective).
+        outputs = lax.psum(
+            jnp.where(stage == pp - 1, outputs, jnp.zeros_like(outputs)), axis
+        )
+        return outputs.reshape(bl, *x_local.shape[1:])
+
+    # batch sharded over dp/fsdp; params' layer axis over pp (callers may
+    # refine per-param specs, e.g. expert dims over ep); tp/sp must be 1
+    # in the pipelined path this round.
+    if param_specs is None:
+        param_spec = jax.tree.map(lambda _: P(axis), stage_params)
+    else:
+        param_spec = param_specs
+    x_spec = P(batch_axes, None, None)
+    fn = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(param_spec, x_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )
+    return fn(stage_params, x)

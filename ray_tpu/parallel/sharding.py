@@ -29,6 +29,7 @@ DEFAULT_RULES: LogicalAxisRules = {
     "vocab": "tp",
     "layers": "pp",
     "experts": "ep",
+    "expert_mlp": "tp",
     "kv_seq": "sp",
     "norm": None,
 }
