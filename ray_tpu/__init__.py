@@ -26,6 +26,7 @@ from .core.api import (
     put,
     remote,
     shutdown,
+    timeline,
     wait,
 )
 from .core.object_ref import ObjectRef

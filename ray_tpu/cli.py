@@ -1,0 +1,93 @@
+"""CLI: cluster state inspection (`python -m ray_tpu.cli ...`).
+
+Equivalent of the reference's `ray list ...` state CLI
+(``python/ray/util/state/state_cli.py``) and `ray timeline`
+(``python/ray/scripts/scripts.py``). Connects to a running cluster via
+``--address`` (GCS address).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _connect(address: str | None) -> None:
+    import ray_tpu
+
+    if address:
+        ray_tpu.init(address=address, num_cpus=0)
+    elif not ray_tpu.is_initialized():
+        print("error: pass --address GCS_HOST:PORT of a running cluster", file=sys.stderr)
+        raise SystemExit(2)
+
+
+def _print_table(rows: list[dict], columns: list[str]) -> None:
+    if not rows:
+        print("(none)")
+        return
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))[:48]) for r in rows)) for c in columns}
+    print("  ".join(c.upper().ljust(widths[c]) for c in columns))
+    for r in rows:
+        print("  ".join(str(r.get(c, ""))[:48].ljust(widths[c]) for c in columns))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
+    parser.add_argument("--address", help="GCS address of a running cluster")
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    list_p = sub.add_parser("list", help="list cluster entities")
+    list_p.add_argument("what", choices=["nodes", "actors", "tasks", "workers",
+                                         "objects", "placement-groups"])
+    sub.add_parser("summary", help="task counts by name and state")
+    tl = sub.add_parser("timeline", help="dump a chrome://tracing file")
+    tl.add_argument("-o", "--output", default="timeline.json")
+    sub.add_parser("metrics", help="aggregated metrics (Prometheus text format)")
+    sub.add_parser("status", help="cluster resource overview")
+
+    args = parser.parse_args(argv)
+    _connect(args.address)
+    import ray_tpu
+    from ray_tpu.util import state as st
+
+    if args.cmd == "list":
+        what = args.what
+        if what == "nodes":
+            rows, cols = st.list_nodes(), ["node_id", "address", "state"]
+        elif what == "actors":
+            rows, cols = st.list_actors(), ["actor_id", "name", "state", "address"]
+        elif what == "tasks":
+            rows, cols = st.list_tasks(), ["task_id", "name", "state", "node_id"]
+        elif what == "workers":
+            rows, cols = st.list_workers(), ["worker_id", "state", "pid", "node_id"]
+        elif what == "objects":
+            rows, cols = st.list_objects(), ["object_id", "size", "state", "node_id"]
+        else:
+            rows, cols = st.list_placement_groups(), ["pg_id", "state", "strategy"]
+        print(json.dumps(rows, indent=2, default=str) if args.as_json else "", end="")
+        if not args.as_json:
+            _print_table(rows, cols)
+    elif args.cmd == "summary":
+        print(json.dumps(st.summarize_tasks(), indent=2))
+    elif args.cmd == "timeline":
+        path = ray_tpu.timeline(args.output)
+        print(f"wrote {path}")
+    elif args.cmd == "metrics":
+        from ray_tpu.util.metrics import get_metrics, prometheus_text
+
+        print(prometheus_text(get_metrics()), end="")
+    elif args.cmd == "status":
+        total = ray_tpu.cluster_resources()
+        avail = ray_tpu.available_resources()
+        nodes = st.list_nodes()
+        print(f"nodes: {sum(1 for n in nodes if n['state'] == 'ALIVE')} alive / {len(nodes)}")
+        for k in sorted(total):
+            print(f"  {k}: {avail.get(k, 0.0):g} / {total[k]:g} available")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -172,6 +172,15 @@ def get_runtime_context() -> RuntimeContext:
     return RuntimeContext()
 
 
+def timeline(filename: str = "timeline.json") -> str:
+    """Dump a chrome://tracing / Perfetto trace of task execution
+    (reference ``ray.timeline``, ``python/ray/_private/state.py:965``)."""
+    from .task_events import write_chrome_trace
+
+    reply = global_worker()._gcs_call("Timeline", {})
+    return write_chrome_trace(reply["trace"], filename)
+
+
 # ----------------------------------------------------------------- @remote
 _ABSENT = object()
 

@@ -94,6 +94,14 @@ class GcsServer:
         self._kv: dict[str, bytes] = {}
         self._health_task: asyncio.Task | None = None
         self._placement_groups: dict[str, dict] = {}
+        # Observability: task-event ring (gcs_task_manager.h) + per-worker
+        # metric snapshots (stats/metric.h aggregation point).
+        from .task_events import GcsTaskEventStore
+
+        self.task_events = GcsTaskEventStore(
+            max_tasks=get_config().task_events_buffer_size
+        )
+        self._metrics: dict[str, tuple[float, list[dict]]] = {}  # worker -> (ts, snapshot)
 
     # ------------------------------------------------------------------ util
     async def start(self) -> None:
@@ -226,6 +234,58 @@ class GcsServer:
     async def handle_KvKeys(self, p: dict) -> dict:
         prefix = p.get("prefix", "")
         return {"keys": [k for k in self._kv if k.startswith(prefix)]}
+
+    # --------------------------------------------------------- observability
+    async def handle_AddTaskEvents(self, p: dict) -> dict:
+        self.task_events.add_events(p.get("events") or [], p.get("dropped", 0))
+        return {}
+
+    async def handle_ListTaskEvents(self, p: dict) -> dict:
+        return {"tasks": self.task_events.list_tasks(p.get("limit", 1000))}
+
+    async def handle_Timeline(self, p: dict) -> dict:
+        return {"trace": self.task_events.chrome_trace()}
+
+    async def handle_ListPlacementGroups(self, p: dict) -> dict:
+        return {
+            "placement_groups": [
+                {"pg_id": r["pg_id"], "state": r["state"], "strategy": r["strategy"],
+                 "bundles": r["bundles"], "name": r.get("name", "")}
+                for r in self._placement_groups.values()
+            ]
+        }
+
+    async def handle_ReportMetrics(self, p: dict) -> dict:
+        self._metrics[p["worker_id"]] = (time.time(), p.get("metrics") or [])
+        return {}
+
+    async def handle_GetMetrics(self, p: dict) -> dict:
+        """Aggregate across workers: counters/histogram sums add, gauges
+        add (per-worker gauges are usually disjoint by tags). Snapshots
+        from workers silent for >30s (dead) are dropped."""
+        now = time.time()
+        merged: dict[tuple, dict] = {}
+        for worker_id, (ts, snapshot) in list(self._metrics.items()):
+            if now - ts > 30.0:
+                del self._metrics[worker_id]
+                continue
+            for m in snapshot:
+                key = (m["name"], tuple(sorted((m.get("tags") or {}).items())))
+                cur = merged.get(key)
+                if cur is None:
+                    merged[key] = dict(m)
+                elif m.get("type") == "histogram":
+                    cur["value"] = cur.get("value", 0.0) + m.get("value", 0.0)
+                    cur["count"] = cur.get("count", 0) + m.get("count", 0)
+                    if cur.get("boundaries") == m.get("boundaries"):
+                        cur["buckets"] = [
+                            a + b for a, b in zip(cur.get("buckets", []), m.get("buckets", []))
+                        ]
+                    else:  # incompatible shapes: bucket detail unavailable
+                        cur.pop("buckets", None)
+                else:
+                    cur["value"] = cur.get("value", 0.0) + m.get("value", 0.0)
+        return {"metrics": list(merged.values())}
 
     # --------------------------------------------------------------- pub/sub
     async def handle_Publish(self, p: dict) -> dict:

@@ -105,6 +105,8 @@ class Raylet:
         self._fetching: dict[bytes, asyncio.Future] = {}
         self._session_dir = session_dir
         self._shutdown = False
+        # object_id -> {size, state} for the state API (ListObjects)
+        self._object_meta: dict[bytes, dict] = {}
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> None:
@@ -544,6 +546,7 @@ class Raylet:
     async def handle_PlasmaCreate(self, p: dict) -> dict:
         try:
             offset = self.store.create(p["id"], p["data_size"], p.get("meta_size", 0))
+            self._object_meta[p["id"]] = {"size": p["data_size"] + p.get("meta_size", 0)}
             return {"offset": offset}
         except StoreFullError as e:
             return {"error": "store_full", "detail": str(e)}
@@ -622,6 +625,7 @@ class Raylet:
         data_size, meta_size = first["data_size"], first["meta_size"]
         total = data_size + meta_size
         offset = self.store.create(oid, data_size, meta_size)
+        self._object_meta[oid] = {"size": total}
         chunk = first["data"]
         self.store.write(offset, chunk)
         pos = len(chunk)
@@ -660,7 +664,10 @@ class Raylet:
         return {}
 
     async def handle_PlasmaDelete(self, p: dict) -> dict:
-        return {"deleted": self.store.delete(p["id"], p.get("force", False))}
+        deleted = self.store.delete(p["id"], p.get("force", False))
+        if deleted:
+            self._object_meta.pop(p["id"], None)
+        return {"deleted": deleted}
 
     # --------------------------------------------------- placement-group 2PC
     async def handle_ReserveBundle(self, p: dict) -> dict:
@@ -691,6 +698,27 @@ class Raylet:
         return await self.handle_CancelBundle(p)
 
     # ----------------------------------------------------------------- debug
+    async def handle_ListWorkers(self, p: dict) -> dict:
+        return {
+            "workers": [
+                {"worker_id": w.worker_id, "state": w.state, "pid": w.pid,
+                 "address": w.address, "actor_id": w.actor_id,
+                 "lease": w.lease_resources.to_dict()}
+                for w in self._workers.values()
+            ]
+        }
+
+    async def handle_ListObjects(self, p: dict) -> dict:
+        limit = p.get("limit", 1000)
+        out = []
+        for oid, meta in list(self._object_meta.items())[:limit]:
+            state = self.store.contains(oid)
+            out.append({
+                "object_id": oid.hex(), "size": meta["size"],
+                "state": {0: "ABSENT", 1: "CREATED", 2: "SEALED"}.get(state, "?"),
+            })
+        return {"objects": out}
+
     async def handle_DebugState(self, p: dict) -> dict:
         return {
             "node_id": self.node_id.hex(),

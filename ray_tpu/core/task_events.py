@@ -1,0 +1,148 @@
+"""Task events: per-task status timestamps buffered and flushed to the GCS.
+
+Equivalent of the reference's ``TaskEventBuffer``
+(``src/ray/core_worker/task_event_buffer.h:224,300``) feeding
+``GcsTaskManager``: every worker batches status transitions
+(SUBMITTED/LEASED/RUNNING/FINISHED/FAILED) and flushes them on an
+interval; the GCS keeps a bounded ring of events that powers the state
+API (``list_tasks``) and the chrome-trace timeline (``ray_tpu.timeline()``,
+reference ``python/ray/_private/state.py:965``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any
+
+# Status transition names (reference rpc::TaskStatus).
+SUBMITTED = "SUBMITTED"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+
+
+class TaskEventBuffer:
+    """Worker-side bounded buffer of task status events."""
+
+    def __init__(self, worker_id: str, node_id: str, max_buffer: int = 10_000):
+        self._worker_id = worker_id
+        self._node_id = node_id
+        self._max = max_buffer
+        self._lock = threading.Lock()
+        self._events: list[dict] = []
+        self._dropped = 0
+
+    def record(self, task_id: bytes, name: str, status: str, *,
+               kind: int = 0, extra: dict | None = None) -> None:
+        ev = {
+            "task_id": task_id.hex() if isinstance(task_id, bytes) else task_id,
+            "name": name,
+            "status": status,
+            "ts": time.time(),
+            "worker_id": self._worker_id,
+            "node_id": self._node_id,
+            "kind": kind,
+        }
+        if extra:
+            ev.update(extra)
+        with self._lock:
+            if len(self._events) >= self._max:
+                self._dropped += 1
+                return
+            self._events.append(ev)
+
+    def drain(self) -> tuple[list[dict], int]:
+        with self._lock:
+            events, self._events = self._events, []
+            dropped, self._dropped = self._dropped, 0
+        return events, dropped
+
+
+class GcsTaskEventStore:
+    """GCS-side bounded event log + per-task aggregation
+    (reference ``gcs_task_manager.h``)."""
+
+    def __init__(self, max_tasks: int = 100_000):
+        self._lock = threading.Lock()
+        # dict insertion order IS the ring order: eviction pops the oldest
+        # key in O(1) instead of shifting a list under the lock
+        self._tasks: dict[str, dict] = {}
+        self._max = max_tasks
+        self.num_dropped = 0
+
+    def add_events(self, events: list[dict], dropped: int = 0) -> None:
+        with self._lock:
+            self.num_dropped += dropped
+            for ev in events:
+                tid = ev["task_id"]
+                rec = self._tasks.get(tid)
+                if rec is None:
+                    if len(self._tasks) >= self._max:
+                        self._tasks.pop(next(iter(self._tasks)), None)
+                    rec = self._tasks[tid] = {
+                        "task_id": tid,
+                        "name": ev.get("name", ""),
+                        "kind": ev.get("kind", 0),
+                        "events": {},
+                    }
+                rec["events"][ev["status"]] = ev["ts"]
+                rec["name"] = ev.get("name") or rec["name"]
+                for key in ("worker_id", "node_id", "error"):
+                    if ev.get(key):
+                        rec[key] = ev[key]
+
+    def list_tasks(self, limit: int = 1000) -> list[dict]:
+        with self._lock:
+            out = []
+            for tid in list(self._tasks)[-limit:]:
+                rec = self._tasks[tid]
+                events = rec["events"]
+                if FAILED in events:
+                    state = FAILED
+                elif FINISHED in events:
+                    state = FINISHED
+                elif RUNNING in events:
+                    state = RUNNING
+                else:
+                    state = SUBMITTED
+                out.append({
+                    "task_id": tid,
+                    "name": rec["name"],
+                    "state": state,
+                    "worker_id": rec.get("worker_id", ""),
+                    "node_id": rec.get("node_id", ""),
+                    "error": rec.get("error", ""),
+                    "events": dict(events),
+                })
+            return out
+
+    def chrome_trace(self) -> list[dict]:
+        """Events in the chrome://tracing (Perfetto) JSON array format
+        (reference ``state.py chrome_tracing_dump:442``)."""
+        trace: list[dict] = []
+        for rec in self.list_tasks(limit=self._max):
+            events = rec["events"]
+            start = events.get(RUNNING) or events.get(SUBMITTED)
+            end = events.get(FINISHED) or events.get(FAILED)
+            if start is None:
+                continue
+            dur_us = max(1.0, ((end or time.time()) - start) * 1e6)
+            trace.append({
+                "name": rec["name"],
+                "cat": "task",
+                "ph": "X",
+                "ts": start * 1e6,
+                "dur": dur_us,
+                "pid": f"node:{rec.get('node_id', '?')[:8]}",
+                "tid": f"worker:{rec.get('worker_id', '?')[:8]}",
+                "args": {"task_id": rec["task_id"], "state": rec["state"]},
+            })
+        return trace
+
+
+def write_chrome_trace(events: list[dict], filename: str) -> str:
+    with open(filename, "w") as f:
+        json.dump(events, f)
+    return filename

@@ -245,9 +245,16 @@ class CoreWorker:
         # RPC server for owner + executor duties.
         self.server = RpcServer("127.0.0.1", 0)
         self.server.register_service(self)
+        # Task-event buffer: status timestamps flushed to the GCS on an
+        # interval (task_event_buffer.h:224; powers list_tasks + timeline).
+        from .task_events import TaskEventBuffer
+
+        self.task_events = TaskEventBuffer(self.worker_id, self.node_id)
+
         self.io.run_sync(self.server.start())
         self.address = self.server.address
         self.io.run_coro(self._borrow_hold_sweeper())
+        self.io.run_coro(self._task_event_flusher())
 
         install_refcount_hooks(self._hook_add_local, self._hook_remove_local)
 
@@ -265,6 +272,13 @@ class CoreWorker:
 
     def shutdown(self) -> None:
         install_refcount_hooks(lambda r: None, lambda r: None)
+        # final event flush so short-lived drivers/workers leave a trace
+        try:
+            events, dropped = self.task_events.drain()
+            if events or dropped:
+                self._gcs_call("AddTaskEvents", {"events": events, "dropped": dropped}, timeout=5.0)
+        except Exception:
+            pass
 
         async def _close_all():
             await self.server.stop()
@@ -607,6 +621,7 @@ class CoreWorker:
         for rid in return_ids:
             self.refcounter.add_owned_object(rid)
         self.task_manager.add_pending(spec, return_ids)
+        self.task_events.record(spec.task_id, spec.name, "SUBMITTED", kind=spec.kind)
         self._enqueue_task(spec)
         return [ObjectRef(rid, self.address) for rid in return_ids]
 
@@ -811,6 +826,8 @@ class CoreWorker:
         self._release_submitted_refs(spec)
 
     def _fail_task(self, spec: TaskSpec, error: Exception) -> None:
+        self.task_events.record(spec.task_id, spec.name, "FAILED", kind=spec.kind,
+                                extra={"error": str(error)[:200]})
         task_id = TaskID(spec.task_id)
         metadata, blob, _ = serialization.serialize_error(
             RayTaskError(spec.name, str(error), error)
@@ -917,6 +934,7 @@ class CoreWorker:
         for rid in return_ids:
             self.refcounter.add_owned_object(rid)
         self.task_manager.add_pending(spec, return_ids)
+        self.task_events.record(spec.task_id, spec.name, "SUBMITTED", kind=spec.kind)
         self.io.run_coro(self._submit_actor_task_async(spec))
         return [ObjectRef(rid, self.address) for rid in return_ids]
 
@@ -1077,6 +1095,22 @@ class CoreWorker:
         self.refcounter.remove_borrower(ObjectID(p["id"]))
         return {}
 
+    async def _task_event_flusher(self) -> None:
+        import asyncio
+
+        interval = get_config().task_events_flush_interval_ms / 1000.0
+        while True:
+            await asyncio.sleep(interval)
+            events, dropped = self.task_events.drain()
+            if not events and not dropped:
+                continue
+            try:
+                await self.gcs.call(
+                    "AddTaskEvents", {"events": events, "dropped": dropped}, timeout=10.0
+                )
+            except Exception:
+                pass
+
     async def _borrow_hold_sweeper(self) -> None:
         """Failsafe: drop return-holds whose caller never registered (it
         died before processing the reply)."""
@@ -1144,6 +1178,7 @@ class CoreWorker:
         (_raylet.pyx:1726) equivalent."""
         prev_task_id = self.current_task_id
         self.current_task_id = TaskID(spec.task_id)
+        self.task_events.record(spec.task_id, spec.name, "RUNNING", kind=spec.kind)
         try:
             args, kwargs = self._deserialize_args(spec)
             if spec.kind == TASK_KIND_ACTOR_CREATION:
@@ -1174,9 +1209,13 @@ class CoreWorker:
             else:
                 fn, _tag = self.functions.get(spec.function_id)
                 result = _run_to_completion(fn(*args, **kwargs))
-            return {"returns": self._serialize_returns(spec, result)}
+            reply = {"returns": self._serialize_returns(spec, result)}
+            self.task_events.record(spec.task_id, spec.name, "FINISHED", kind=spec.kind)
+            return reply
         except Exception as e:
             tb = traceback.format_exc()
+            self.task_events.record(spec.task_id, spec.name, "FAILED", kind=spec.kind,
+                                    extra={"error": f"{type(e).__name__}: {e}"})
             if spec.kind == TASK_KIND_ACTOR_CREATION:
                 return {"error": f"{type(e).__name__}: {e}\n{tb}"}
             metadata, blob, _ = serialization.serialize_error(RayTaskError(spec.name, tb, e))

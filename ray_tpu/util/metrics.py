@@ -1,0 +1,160 @@
+"""Metrics: counters/gauges/histograms pushed to the GCS.
+
+Equivalent of the reference's C++ stats layer (``src/ray/stats/metric.h:105``
+Gauge/Count/Histogram on OpenCensus + per-node metrics agent): here every
+process keeps a local registry and a flusher thread pushes snapshots to the
+GCS (``ReportMetrics``), which aggregates per (name, tags) — queryable via
+``get_metrics()`` / the CLI, exportable in Prometheus text format.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Sequence
+
+
+class _Metric:
+    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._lock = threading.Lock()
+        self._values: dict[tuple, float] = {}
+        _registry_add(self)
+
+    def _key(self, tags: dict | None) -> tuple:
+        tags = tags or {}
+        return tuple(str(tags.get(k, "")) for k in self.tag_keys)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return [
+                {"name": self.name, "type": self.kind,
+                 "tags": dict(zip(self.tag_keys, key)), "value": value}
+                for key, value in self._values.items()
+            ]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: dict | None = None) -> None:
+        with self._lock:
+            key = self._key(tags)
+            self._values[key] = self._values.get(key, 0.0) + value
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: dict | None = None) -> None:
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+
+class Histogram(_Metric):
+    """Fixed-boundary histogram; stores per-bucket counts + sum/count."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "", boundaries: Sequence[float] = (),
+                 tag_keys: Sequence[str] = ()):
+        self.boundaries = tuple(boundaries) or (0.001, 0.01, 0.1, 1.0, 10.0, 100.0)
+        # set BEFORE super().__init__: registration makes this metric
+        # visible to the flusher thread, which may snapshot immediately
+        self._buckets: dict[tuple, list[int]] = {}
+        self._counts: dict[tuple, int] = {}
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float, tags: dict | None = None) -> None:
+        with self._lock:
+            key = self._key(tags)
+            buckets = self._buckets.setdefault(key, [0] * (len(self.boundaries) + 1))
+            idx = sum(1 for b in self.boundaries if value > b)
+            buckets[idx] += 1
+            self._values[key] = self._values.get(key, 0.0) + value  # sum
+            self._counts[key] = self._counts.get(key, 0) + 1
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            out = []
+            for key, total in self._values.items():
+                out.append({
+                    "name": self.name, "type": "histogram",
+                    "tags": dict(zip(self.tag_keys, key)),
+                    "value": total,
+                    "count": self._counts.get(key, 0),
+                    "buckets": list(self._buckets.get(key, [])),
+                    "boundaries": list(self.boundaries),
+                })
+            return out
+
+
+_registry_lock = threading.Lock()
+_registry: list[_Metric] = []
+_flusher: "_Flusher | None" = None
+
+
+def _registry_add(metric: _Metric) -> None:
+    with _registry_lock:
+        _registry.append(metric)
+    _ensure_flusher()
+
+
+def snapshot_all() -> list[dict]:
+    with _registry_lock:
+        metrics = list(_registry)
+    out: list[dict] = []
+    for m in metrics:
+        out.extend(m.snapshot())
+    return out
+
+
+class _Flusher:
+    def __init__(self, interval_s: float = 5.0):
+        self._interval = interval_s
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="raytpu-metrics")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        from ..core.worker import global_worker
+
+        while True:
+            time.sleep(self._interval)
+            try:
+                worker = global_worker()
+                snap = snapshot_all()
+                if not snap:
+                    continue
+                worker._gcs_call(
+                    "ReportMetrics",
+                    {"worker_id": worker.worker_id, "metrics": snap},
+                    timeout=10.0,
+                )
+            except Exception:
+                continue  # never let one bad cycle kill the flusher
+
+
+def _ensure_flusher() -> None:
+    global _flusher
+    with _registry_lock:
+        if _flusher is None:
+            _flusher = _Flusher()
+
+
+def get_metrics() -> list[dict]:
+    """Cluster-wide aggregated metrics from the GCS."""
+    from ..core.worker import global_worker
+
+    return global_worker()._gcs_call("GetMetrics", {})["metrics"]
+
+
+def prometheus_text(metrics: list[dict] | None = None) -> str:
+    """Render metrics in the Prometheus exposition format."""
+    lines = []
+    for m in metrics if metrics is not None else get_metrics():
+        tags = ",".join(f'{k}="{v}"' for k, v in sorted(m.get("tags", {}).items()))
+        label = f"{{{tags}}}" if tags else ""
+        lines.append(f"{m['name']}{label} {m['value']}")
+    return "\n".join(lines) + "\n"

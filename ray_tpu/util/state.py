@@ -1,0 +1,81 @@
+"""State API: list/summarize cluster entities.
+
+Equivalent of the reference's ``python/ray/util/state/api.py:110``
+(``StateApiClient``, list_actors:784, summarize_tasks:1368) minus the
+dashboard hop: queries go straight to the GCS, which is the single source
+of truth for nodes/actors/tasks/placement groups in this runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..core.worker import global_worker
+
+
+def _gcs(method: str, payload: dict | None = None) -> dict:
+    return global_worker()._gcs_call(method, payload or {})
+
+
+def list_nodes() -> list[dict]:
+    return _gcs("GetAllNodes")["nodes"]
+
+
+def list_actors() -> list[dict]:
+    return _gcs("ListActors")["actors"]
+
+
+def list_tasks(limit: int = 1000) -> list[dict]:
+    return _gcs("ListTaskEvents", {"limit": limit})["tasks"]
+
+
+def list_placement_groups() -> list[dict]:
+    return _gcs("ListPlacementGroups")["placement_groups"]
+
+
+def _fanout_raylets(method: str, payload: dict, result_key: str) -> list[dict]:
+    """Call a raylet RPC on every alive node concurrently; tag each row
+    with its node_id. Nodes that fail to answer are skipped."""
+    import asyncio
+
+    from ..core.rpc import RpcClient
+
+    nodes = [n for n in list_nodes() if n["state"] == "ALIVE"]
+    worker = global_worker()
+
+    async def _one(node):
+        client = RpcClient(node["address"])
+        try:
+            reply = await client.call(method, payload, timeout=10.0)
+            rows = reply.get(result_key, [])
+            for r in rows:
+                r["node_id"] = node["node_id"]
+            return rows
+        except Exception:
+            return []
+        finally:
+            await client.close()
+
+    async def _all():
+        return await asyncio.gather(*(_one(n) for n in nodes))
+
+    return [row for rows in worker.io.run_sync(_all()) for row in rows]
+
+
+def list_workers() -> list[dict]:
+    """Workers across all alive nodes (raylet worker-pool fan-out)."""
+    return _fanout_raylets("ListWorkers", {}, "workers")
+
+
+def list_objects(limit: int = 1000) -> list[dict]:
+    """Objects in each node's plasma store (store-level view)."""
+    return _fanout_raylets("ListObjects", {"limit": limit}, "objects")
+
+
+def summarize_tasks() -> dict:
+    """Counts by (name, state) — reference summarize_tasks:1368."""
+    summary: dict[str, dict[str, int]] = {}
+    for t in list_tasks(limit=100_000):
+        entry = summary.setdefault(t["name"], {})
+        entry[t["state"]] = entry.get(t["state"], 0) + 1
+    return summary

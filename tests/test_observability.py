@@ -1,0 +1,128 @@
+"""Observability: task events → state API + timeline; metrics; CLI.
+
+Mirrors the reference's state-API tests (``python/ray/tests/test_state_api*``)
+and ``ray.timeline`` (``_private/state.py:965``).
+"""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+
+
+@pytest.fixture(autouse=True)
+def _cluster(ray_cluster):
+    yield
+
+
+def test_task_events_reach_state_api():
+    @ray_tpu.remote
+    def traced_task(x):
+        return x * 2
+
+    assert ray_tpu.get(traced_task.remote(21), timeout=60) == 42
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        tasks = [t for t in state.list_tasks() if t["name"] == "traced_task"]
+        if tasks and tasks[-1]["state"] == "FINISHED":
+            break
+        time.sleep(0.3)
+    assert tasks, "task events never reached the GCS"
+    t = tasks[-1]
+    assert t["state"] == "FINISHED"
+    assert "SUBMITTED" in t["events"] and "FINISHED" in t["events"]
+
+
+def test_failed_task_recorded():
+    @ray_tpu.remote
+    def exploder():
+        raise ValueError("recorded")
+
+    with pytest.raises(ValueError):
+        ray_tpu.get(exploder.remote(), timeout=60)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        tasks = [t for t in state.list_tasks() if t["name"] == "exploder"]
+        if tasks and tasks[-1]["state"] == "FAILED":
+            break
+        time.sleep(0.3)
+    assert tasks and tasks[-1]["state"] == "FAILED"
+    assert "recorded" in tasks[-1]["error"]
+
+
+def test_timeline_dump(tmp_path):
+    @ray_tpu.remote
+    def timed():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([timed.remote() for _ in range(3)], timeout=60)
+    time.sleep(1.5)  # let the flusher run
+    path = ray_tpu.timeline(str(tmp_path / "trace.json"))
+    trace = json.load(open(path))
+    assert isinstance(trace, list) and trace
+    timed_events = [e for e in trace if e["name"] == "timed"]
+    assert len(timed_events) >= 3
+    for e in timed_events:
+        assert e["ph"] == "X" and e["dur"] > 0 and "pid" in e and "tid" in e
+
+
+def test_state_api_nodes_workers_objects():
+    nodes = state.list_nodes()
+    assert any(n["state"] == "ALIVE" for n in nodes)
+    workers = state.list_workers()
+    assert workers, "no workers listed"
+    import numpy as np
+
+    ref = ray_tpu.put(np.zeros(200_000, dtype=np.float32))
+    objs = state.list_objects()
+    assert any(o["state"] == "SEALED" for o in objs)
+    del ref
+
+
+def test_metrics_roundtrip():
+    from ray_tpu.util.metrics import Counter, Gauge, get_metrics, prometheus_text
+
+    c = Counter("test_requests_total", tag_keys=("kind",))
+    c.inc(3, {"kind": "a"})
+    g = Gauge("test_queue_len")
+    g.set(7)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        metrics = {m["name"]: m for m in get_metrics()}
+        if "test_requests_total" in metrics and "test_queue_len" in metrics:
+            break
+        time.sleep(0.5)
+    assert metrics["test_requests_total"]["value"] == 3
+    assert metrics["test_queue_len"]["value"] == 7
+    text = prometheus_text(list(metrics.values()))
+    assert 'test_requests_total{kind="a"} 3' in text
+
+
+def test_cli_list_and_status(capsys):
+    from ray_tpu.cli import main
+
+    assert main(["list", "nodes"]) == 0
+    out = capsys.readouterr().out
+    assert "NODE_ID" in out
+    assert main(["status"]) == 0
+    out = capsys.readouterr().out
+    assert "alive" in out and "CPU" in out
+
+
+def test_summarize_tasks():
+    @ray_tpu.remote
+    def summary_probe():
+        return 1
+
+    ray_tpu.get([summary_probe.remote() for _ in range(2)], timeout=60)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        summary = state.summarize_tasks()
+        if summary.get("summary_probe", {}).get("FINISHED", 0) >= 2:
+            break
+        time.sleep(0.3)
+    assert summary["summary_probe"]["FINISHED"] >= 2
