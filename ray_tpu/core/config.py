@@ -31,8 +31,14 @@ class RayTpuConfig:
     # Chunk size for inter-node object transfer (reference: 5 MiB chunks,
     # ``object_manager.h:117``).
     object_manager_chunk_size: int = 5 * 1024 * 1024
-    # Fraction of plasma that a single create may use before falling back.
+    # Proactive spill starts when store usage exceeds this fraction
+    # (reference ``object_spilling_threshold``).
     object_spilling_threshold: float = 0.8
+    # Node memory watcher (reference ``src/ray/common/memory_monitor.h:52``):
+    # above this fraction of node memory the newest retriable lease is killed.
+    memory_usage_threshold: float = 0.95
+    # 0 disables the watcher.
+    memory_monitor_refresh_ms: int = 250
 
     # --- scheduling ----------------------------------------------------------
     # Hybrid policy: pack onto nodes below this utilization score, then spread

@@ -56,6 +56,11 @@ class WorkerHandle:
     bundle_key: tuple | None = None
     registered: asyncio.Future | None = None
     last_idle_time: float = 0.0
+    # When the current lease was granted + whether its task is retriable —
+    # the memory monitor's OOM policy kills the newest retriable lease
+    # (reference worker_killing_policy.cc retriable-LIFO).
+    lease_time: float = 0.0
+    retriable: bool = False
 
 
 class Raylet:
@@ -108,6 +113,27 @@ class Raylet:
         # object_id -> {size, state} for the state API (ListObjects)
         self._object_meta: dict[bytes, dict] = {}
 
+        # --- spill manager (LocalObjectManager, local_object_manager.h:110):
+        # primary copies are pinned in the store; under memory pressure the
+        # oldest unreferenced pinned objects are written to disk and deleted
+        # from shm, then restored on the next Get/Fetch.
+        self._spill_dir = os.path.join(session_dir, f"spill-{self.node_id.hex()[:12]}")
+        self._spilled: dict[bytes, tuple[int, int]] = {}  # oid -> (data_size, meta_size)
+        self._spill_pending: dict[bytes, bytes] = {}  # disk write still in flight
+        self._pinned: dict[bytes, int] = {}  # oid -> total size, insertion-ordered
+        self._last_oom_kill = 0.0
+        self._spilled_bytes_total = 0
+        self._restored_bytes_total = 0
+        # Overridable for tests: returns fraction of node memory in use.
+        self._memory_usage_fn = _node_memory_usage_fraction
+        # Outstanding pin_read store refs per reader (worker_id), released
+        # in bulk if the reader dies mid-read.
+        self._read_refs: dict[str, dict[bytes, int]] = {}
+        # Unsealed creations per creator worker, force-deleted if the creator
+        # dies between PlasmaCreate and PlasmaSeal (else the creator ref
+        # leaks the arena bytes forever).
+        self._creating: dict[bytes, str] = {}
+
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> None:
         await self._server.start()
@@ -123,6 +149,7 @@ class Raylet:
         )
         self._tasks.append(spawn(self._heartbeat_loop()))
         self._tasks.append(spawn(self._worker_monitor_loop()))
+        self._tasks.append(spawn(self._memory_monitor_loop()))
         cfg = get_config()
         for _ in range(cfg.num_prestart_workers):
             self._start_worker()
@@ -209,6 +236,14 @@ class Raylet:
             self._idle.remove(w.worker_id)
         self._release_lease(w)
         self._workers.pop(w.worker_id, None)
+        for oid, count in self._read_refs.pop(w.worker_id, {}).items():
+            for _ in range(count):
+                self.store.release(oid)
+        for oid, creator in list(self._creating.items()):
+            if creator == w.worker_id:
+                self.store.delete(oid, force=True)
+                self._creating.pop(oid, None)
+                self._object_meta.pop(oid, None)
 
     # ------------------------------------------------------------ worker pool
     @staticmethod
@@ -288,7 +323,15 @@ class Raylet:
         while True:
             for wid in list(self._idle):
                 w = self._workers.get(wid)
-                if w is not None and w.state == "idle" and w.env_hash == want:
+                if w is None:
+                    self._idle.remove(wid)
+                    continue
+                if w.proc is not None and w.proc.poll() is not None:
+                    # Died while idle (e.g. OOM-killed between return and
+                    # re-lease) — reap now rather than leasing a corpse.
+                    self._on_worker_dead(w)
+                    continue
+                if w.state == "idle" and w.env_hash == want:
                     self._idle.remove(wid)
                     return w
             starting = sum(
@@ -406,6 +449,8 @@ class Raylet:
             return {"granted": False, "reason": "no worker available"}
         worker.lease_resources = request
         worker.state = "dedicated" if p.get("dedicated") else "leased"
+        worker.lease_time = time.monotonic()
+        worker.retriable = bool(spec.get("max_retries", 0)) and not p.get("dedicated")
         if p.get("dedicated"):
             actor_id = spec.get("actor_id", b"")
             worker.actor_id = actor_id.hex() if isinstance(actor_id, bytes) else actor_id
@@ -451,6 +496,8 @@ class Raylet:
         worker.lease_resources = request
         worker.bundle_key = key
         worker.state = "dedicated" if p.get("dedicated") else "leased"
+        worker.lease_time = time.monotonic()
+        worker.retriable = bool(spec.get("max_retries", 0)) and not p.get("dedicated")
         if p.get("dedicated"):
             actor_id = spec.get("actor_id", b"")
             worker.actor_id = actor_id.hex() if isinstance(actor_id, bytes) else actor_id
@@ -527,6 +574,10 @@ class Raylet:
         if w is None or w.state == "dead":
             return {}
         self._release_lease(w)
+        if w.proc is not None and w.proc.poll() is not None:
+            self._on_worker_dead(w)
+            self._wake_lease_waiters()
+            return {}
         if p.get("kill"):
             if w.proc is not None:
                 w.proc.terminate()
@@ -542,19 +593,197 @@ class Raylet:
     async def handle_HealthCheck(self, p: dict) -> dict:
         return {"node_id": self.node_id.hex()}
 
+    # ----------------------------------------------------------- spill manager
+    def _create_with_spill(self, oid: bytes, data_size: int, meta_size: int) -> int:
+        """Allocate, spilling pinned primaries to disk if LRU eviction of
+        secondary copies wasn't enough (local_object_manager.cc
+        SpillObjectsOfSize)."""
+        try:
+            return self.store.create(oid, data_size, meta_size)
+        except StoreFullError:
+            self._spill_objects(data_size + meta_size)
+            return self.store.create(oid, data_size, meta_size)
+
+    def _spill_objects(self, nbytes: int) -> int:
+        """Move the oldest unreferenced pinned objects out of shm until
+        ~`nbytes` are free. Space is reclaimed synchronously (callers need
+        it now); the disk write itself is offloaded to an executor thread so
+        the event loop — heartbeats, leases — never stalls on file I/O
+        (reference: spill runs in dedicated IO workers). Until the write
+        completes the blob is served from ``_spill_pending``."""
+        freed = 0
+        for oid in list(self._pinned):
+            if freed >= nbytes:
+                break
+            if self.store.contains(oid) != 2 or self.store.ref_count(oid) > 0:
+                continue  # mid-read or unsealed: not spillable right now
+            info = self.store.get_info(oid)
+            if info is None:
+                self._pinned.pop(oid, None)
+                continue
+            offset, data_size, meta_size = info
+            blob = bytes(self.store.read(offset, data_size + meta_size))
+            self.store.unpin(oid)
+            self.store.delete(oid, force=False)
+            self._pinned.pop(oid, None)
+            self._spilled[oid] = (data_size, meta_size)
+            self._spill_pending[oid] = blob
+            if _in_loop():
+                spawn(self._write_spill_file(oid, blob))
+            else:
+                self._write_file(self._spill_path(oid), blob)
+                self._spill_pending.pop(oid, None)
+            self._spilled_bytes_total += data_size + meta_size
+            meta = self._object_meta.get(oid)
+            if meta is not None:
+                meta["spilled"] = True
+            freed += data_size + meta_size
+        return freed
+
+    def _spill_path(self, oid: bytes) -> str:
+        return os.path.join(self._spill_dir, oid.hex())
+
+    def _write_file(self, path: str, blob: bytes) -> None:
+        os.makedirs(self._spill_dir, exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(blob)
+
+    async def _write_spill_file(self, oid: bytes, blob: bytes) -> None:
+        path = self._spill_path(oid)
+        await asyncio.get_running_loop().run_in_executor(None, self._write_file, path, blob)
+        # Identity check: a restore + re-spill while we were writing installs
+        # a new pending blob (and its own write task) — leave those alone.
+        if self._spill_pending.get(oid) is blob:
+            self._spill_pending.pop(oid, None)
+        if oid not in self._spilled:
+            # Deleted or restored while the write was in flight.
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    async def _restore_spilled(self, oid: bytes) -> bool:
+        """Bring a spilled object back into shm (restore-on-Get,
+        local_object_manager.cc AsyncRestoreSpilledObject)."""
+        sizes = self._spilled.get(oid)
+        if sizes is None:
+            return False
+        data_size, meta_size = sizes
+        blob = self._spill_pending.get(oid)
+        if blob is None:
+            path = self._spill_path(oid)
+            loop = asyncio.get_running_loop()
+            try:
+                blob = await loop.run_in_executor(None, lambda: open(path, "rb").read())
+            except OSError:
+                return False
+        if oid not in self._spilled:
+            return True  # a concurrent handler restored it during the read
+        offset = self._create_with_spill(oid, data_size, meta_size)
+        self.store.write(offset, blob)
+        self.store.seal(oid)
+        self.store.pin(oid)
+        self.store.release(oid)
+        self._pinned[oid] = data_size + meta_size
+        self._spilled.pop(oid, None)
+        self._spill_pending.pop(oid, None)
+        self._restored_bytes_total += data_size + meta_size
+        meta = self._object_meta.get(oid)
+        if meta is not None:
+            meta["spilled"] = False
+        try:
+            os.unlink(self._spill_path(oid))
+        except OSError:
+            pass
+        return True
+
+    async def _memory_monitor_loop(self) -> None:
+        """Two duties of the reference's memory safety net: proactive spill
+        above ``object_spilling_threshold`` (local_object_manager.cc) and the
+        node memory watcher that OOM-kills the newest retriable lease
+        (memory_monitor.h:52, worker_killing_policy.cc)."""
+        cfg = get_config()
+        if not cfg.memory_monitor_refresh_ms:
+            return
+        period = cfg.memory_monitor_refresh_ms / 1000.0
+        while True:
+            await asyncio.sleep(period)
+            try:
+                threshold = int(self.object_store_capacity * cfg.object_spilling_threshold)
+                if self.store.used() > threshold:
+                    self._spill_objects(self.store.used() - threshold)
+                usage = self._memory_usage_fn()
+                # Cooldown: give the kernel time to reap the last victim and
+                # publish the freed memory before killing again (reference
+                # memory monitor min-interval between kills).
+                if usage > cfg.memory_usage_threshold and (
+                    time.monotonic() - self._last_oom_kill > max(1.0, 4 * period)
+                ):
+                    if self._oom_kill_one(usage):
+                        self._last_oom_kill = time.monotonic()
+            except Exception:
+                logger.exception("memory monitor iteration failed")
+
+    def _oom_kill_one(self, usage: float) -> bool:
+        victims = [
+            w for w in self._workers.values()
+            if w.state in ("leased", "dedicated") and w.proc is not None and w.retriable
+        ]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda w: w.lease_time)
+        logger.warning(
+            "Node memory usage %.0f%% above threshold: killing newest retriable "
+            "lease (worker %s, pid %d) — the owner will retry it",
+            usage * 100, victim.worker_id[:12], victim.pid,
+        )
+        try:
+            victim.proc.kill()
+        except Exception:
+            pass
+        return True
+
     # ------------------------------------------------------- plasma service
     async def handle_PlasmaCreate(self, p: dict) -> dict:
+        from ..native.store import ObjectExistsError
+
+        oid = p["id"]
         try:
-            offset = self.store.create(p["id"], p["data_size"], p.get("meta_size", 0))
-            self._object_meta[p["id"]] = {"size": p["data_size"] + p.get("meta_size", 0)}
-            return {"offset": offset}
+            offset = self._create_with_spill(oid, p["data_size"], p.get("meta_size", 0))
         except StoreFullError as e:
             return {"error": "store_full", "detail": str(e)}
+        except ObjectExistsError:
+            # Deterministic return IDs: a retried task recreates the same
+            # object. Sealed (in shm or on disk) → idempotent success.
+            # Unsealed with a dead creator → reclaim and recreate.
+            if self.store.contains(oid) == 2 or oid in self._spilled:
+                return {"exists": True}
+            creator = self._creating.get(oid)
+            if creator is not None and creator in self._workers:
+                return {"error": "create_conflict",
+                        "detail": f"{oid.hex()} is being created by a live worker"}
+            self.store.delete(oid, force=True)
+            try:
+                offset = self._create_with_spill(oid, p["data_size"], p.get("meta_size", 0))
+            except StoreFullError as e:
+                return {"error": "store_full", "detail": str(e)}
+        if p.get("creator"):
+            self._creating[oid] = p["creator"]
+        self._object_meta[oid] = {"size": p["data_size"] + p.get("meta_size", 0)}
+        return {"offset": offset}
 
     async def handle_PlasmaSeal(self, p: dict) -> dict:
-        self.store.seal(p["id"])
-        self.store.release(p["id"])
-        fut = self._fetching.pop(p["id"], None)
+        """Seal + pin: objects sealed through the RPC service are primary
+        copies (created on this node by their owner) and must survive until
+        deleted — spilled under pressure, never silently evicted."""
+        oid = p["id"]
+        self.store.seal(oid)
+        self.store.pin(oid)
+        self.store.release(oid)
+        self._creating.pop(oid, None)
+        meta = self._object_meta.get(oid)
+        self._pinned[oid] = meta["size"] if meta else 0
+        fut = self._fetching.pop(oid, None)
         if fut is not None and not fut.done():
             fut.set_result(True)
         return {}
@@ -568,7 +797,21 @@ class Raylet:
         deadline = time.monotonic() + (timeout if timeout else 0)
         while True:
             info = self.store.get_info(oid)
+            if info is None and oid in self._spilled:
+                try:
+                    await self._restore_spilled(oid)
+                except StoreFullError:
+                    pass  # shm full of read-pinned objects: poll until free
+                info = self.store.get_info(oid)
             if info is not None:
+                if p.get("pin_read"):
+                    # Hold a store ref for the reader so the object cannot be
+                    # spilled/evicted while its views are alive; the reader
+                    # sends PlasmaRelease when the value is GC'd.
+                    self.store.add_ref(oid)
+                    reader = p.get("reader") or ""
+                    refs = self._read_refs.setdefault(reader, {})
+                    refs[oid] = refs.get(oid, 0) + 1
                 return {"found": True, "offset": info[0], "data_size": info[1], "meta_size": info[2]}
             if p.get("owner_address"):
                 pulled = await self._maybe_pull(oid, p["owner_address"])
@@ -624,7 +867,7 @@ class Raylet:
             raise KeyError(f"{oid.hex()} not on {node_address}")
         data_size, meta_size = first["data_size"], first["meta_size"]
         total = data_size + meta_size
-        offset = self.store.create(oid, data_size, meta_size)
+        offset = self._create_with_spill(oid, data_size, meta_size)
         self._object_meta[oid] = {"size": total}
         chunk = first["data"]
         self.store.write(offset, chunk)
@@ -643,6 +886,12 @@ class Raylet:
 
     async def handle_FetchObjectChunk(self, p: dict) -> dict:
         info = self.store.get_info(p["id"])
+        if info is None and p["id"] in self._spilled:
+            try:
+                await self._restore_spilled(p["id"])
+            except StoreFullError:
+                return {"found": False}  # puller retries other replicas / later
+            info = self.store.get_info(p["id"])
         if info is None:
             return {"found": False}
         store_offset, data_size, meta_size = info
@@ -660,13 +909,46 @@ class Raylet:
         return {}
 
     async def handle_PlasmaRelease(self, p: dict) -> dict:
-        self.store.release(p["id"])
+        reader = p.get("reader")
+        if reader is None:
+            self.store.release(p["id"])
+            return {}
+        # Reader-accounted release: only drop a ref this reader actually
+        # holds, so duplicate sends (RPC retry) or releases arriving after
+        # _on_worker_dead already reaped the reader can't drop refs owned
+        # by other readers.
+        refs = self._read_refs.get(reader)
+        if refs is not None and refs.get(p["id"], 0) > 0:
+            self.store.release(p["id"])
+            left = refs[p["id"]] - 1
+            if left > 0:
+                refs[p["id"]] = left
+            else:
+                refs.pop(p["id"], None)
+            if not refs:
+                self._read_refs.pop(reader, None)
         return {}
 
     async def handle_PlasmaDelete(self, p: dict) -> dict:
-        deleted = self.store.delete(p["id"], p.get("force", False))
+        oid = p["id"]
+        deleted = self.store.delete(oid, p.get("force", False))
         if deleted:
-            self._object_meta.pop(p["id"], None)
+            self._pinned.pop(oid, None)
+        elif self.store.contains(oid) and not p.get("force"):
+            # Still read-referenced: deferred delete — unpin so the last
+            # PlasmaRelease makes it LRU-evictable instead of leaking it.
+            self.store.unpin(oid)
+            self._pinned.pop(oid, None)
+            deleted = True
+        if self._spilled.pop(oid, None) is not None:
+            self._spill_pending.pop(oid, None)
+            try:
+                os.unlink(self._spill_path(oid))
+            except OSError:
+                pass
+            deleted = True
+        if deleted:
+            self._object_meta.pop(oid, None)
         return {"deleted": deleted}
 
     # --------------------------------------------------- placement-group 2PC
@@ -712,11 +994,12 @@ class Raylet:
         limit = p.get("limit", 1000)
         out = []
         for oid, meta in list(self._object_meta.items())[:limit]:
-            state = self.store.contains(oid)
-            out.append({
-                "object_id": oid.hex(), "size": meta["size"],
-                "state": {0: "ABSENT", 1: "CREATED", 2: "SEALED"}.get(state, "?"),
-            })
+            if oid in self._spilled:
+                state_name = "SPILLED"
+            else:
+                state = self.store.contains(oid)
+                state_name = {0: "ABSENT", 1: "CREATED", 2: "SEALED"}.get(state, "?")
+            out.append({"object_id": oid.hex(), "size": meta["size"], "state": state_name})
         return {"objects": out}
 
     async def handle_DebugState(self, p: dict) -> dict:
@@ -727,7 +1010,28 @@ class Raylet:
             "idle": len(self._idle),
             "store_used": self.store.used(),
             "store_objects": self.store.num_objects(),
+            "spilled_objects": len(self._spilled),
+            "spilled_bytes_total": self._spilled_bytes_total,
+            "restored_bytes_total": self._restored_bytes_total,
         }
+
+
+def _node_memory_usage_fraction() -> float:
+    """Fraction of node memory in use, from /proc/meminfo (reference
+    memory_monitor.cc GetLinuxMemoryBytes; cgroup limits not consulted)."""
+    try:
+        fields = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                name, _, rest = line.partition(":")
+                fields[name] = int(rest.split()[0])  # kB
+        total = fields.get("MemTotal", 0)
+        avail = fields.get("MemAvailable", total)
+        if total <= 0:
+            return 0.0
+        return 1.0 - avail / total
+    except OSError:
+        return 0.0
 
 
 def _in_loop() -> bool:

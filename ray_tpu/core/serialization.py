@@ -29,6 +29,49 @@ _ALIGN = 64
 _HEADER = struct.Struct("<II")
 _ENTRY = struct.Struct("<QQ")
 
+
+class PlasmaBuffer:
+    """Buffer-protocol wrapper tying a shm read to the deserialized value's
+    lifetime.
+
+    Values deserialized from plasma alias arena memory (zero-copy numpy);
+    the store must not spill or evict the object while any view is alive.
+    The reference solves this with plasma ``Buffer`` objects that hold a
+    client ref until GC'd (``python/ray/_private/serialization.py:122`` via
+    ``plasma::Buffer``); here the PEP-688 buffer protocol counts live
+    exports and fires ``on_release`` when the last derived view (including
+    pickle5-reconstructed arrays) is released.
+    """
+
+    __slots__ = ("_mv", "_on_release", "_exports")
+
+    def __init__(self, mv: memoryview, on_release: Callable[[], None] | None = None):
+        self._mv = mv
+        self._on_release = on_release
+        self._exports = 0
+
+    def __buffer__(self, flags: int) -> memoryview:
+        self._exports += 1
+        return memoryview(self._mv)
+
+    def __release_buffer__(self, view: memoryview) -> None:
+        self._exports -= 1
+        if self._exports == 0 and self._on_release is not None:
+            cb, self._on_release = self._on_release, None
+            try:
+                cb()
+            except Exception:
+                pass
+
+    def __del__(self):
+        # Never exported (e.g. deserialize raised before unframing).
+        if self._on_release is not None:
+            cb, self._on_release = self._on_release, None
+            try:
+                cb()
+            except Exception:
+                pass
+
 # Metadata tags (reference: ray_constants OBJECT_METADATA_TYPE_*).
 META_PICKLE5 = b"PICKLE5"
 META_ERROR = b"ERROR"

@@ -388,8 +388,11 @@ class CoreWorker:
     def _plasma_put(self, oid: ObjectID, metadata: bytes, blob: bytes) -> None:
         reply = self._raylet_call(
             "PlasmaCreate",
-            {"id": oid.binary(), "data_size": len(blob), "meta_size": len(metadata)},
+            {"id": oid.binary(), "data_size": len(blob), "meta_size": len(metadata),
+             "creator": self.worker_id},
         )
+        if reply.get("exists"):
+            return  # already sealed (e.g. a retried task's deterministic return)
         if reply.get("error"):
             from .status import ObjectStoreFullError
 
@@ -464,6 +467,10 @@ class CoreWorker:
                 "id": oid.binary(),
                 "owner_address": ref.owner_address or self.address,
                 "timeout": 3600.0 if remaining is None else remaining,
+                # The raylet holds a store ref for us until we release, so the
+                # object can't be spilled/evicted while views are alive.
+                "pin_read": True,
+                "reader": self.worker_id,
             },
             timeout=None if remaining is None else remaining + 10.0,
         )
@@ -475,10 +482,25 @@ class CoreWorker:
             raise ObjectLostError(oid, "not found on any node and not reconstructable")
         data = self.shm.read(reply["offset"], reply["data_size"])
         meta = bytes(self.shm.read(reply["offset"] + reply["data_size"], reply["meta_size"]))
-        try:
-            return self._deserialize(meta, data, oid)
-        finally:
-            del data
+        # Zero-copy deserialization aliases the arena; release the read ref
+        # only when the last derived view (e.g. a reconstructed numpy array)
+        # is GC'd, never before (plasma Buffer lifetime semantics).
+        buf = serialization.PlasmaBuffer(data, self._make_read_releaser(oid))
+        del data
+        return self._deserialize(meta, buf, oid)
+
+    def _make_read_releaser(self, oid: ObjectID):
+        binary = oid.binary()
+        reader = self.worker_id
+        io, raylet = self.io, self.raylet
+
+        def _release():
+            try:
+                io.run_coro(raylet.call("PlasmaRelease", {"id": binary, "reader": reader}, 10.0))
+            except Exception:
+                pass  # shutdown: the raylet reaps reader refs with the worker
+
+        return _release
 
     def _try_reconstruct(self, oid: ObjectID, deadline: float | None) -> bool:
         spec = self.task_manager.lineage_for(oid)
@@ -671,6 +693,10 @@ class CoreWorker:
             spec.placement_group_bundle_index,
             tuple(sorted(strategy.items())) if strategy else (),
             tuple(sorted(env_vars.items())),
+            # Retriable and non-retriable tasks never share a lease: the
+            # raylet's OOM policy kills leases whose probe spec was
+            # retriable, which must hold for every task pushed on them.
+            bool(spec.max_retries),
             salt,
         )
 
@@ -715,12 +741,17 @@ class CoreWorker:
                                 break
                             spec = self._task_queues[key].pop(0)
                         try:
-                            await self._push_and_complete(spec, worker, worker_id)
+                            worker_alive = await self._push_and_complete(spec, worker, worker_id)
                         except BaseException as e:
                             # Never lose a popped spec: cancellation and
                             # unexpected errors fail it visibly.
                             self._fail_task(spec, RayTpuError(f"task submission aborted: {type(e).__name__}: {e}"))
                             raise
+                        if not worker_alive:
+                            # Worker died mid-push: drop this lease and loop
+                            # back to _acquire_lease — retried specs must not
+                            # be pushed to the same corpse.
+                            break
                 finally:
                     await worker.close()
                     try:
@@ -784,7 +815,8 @@ class CoreWorker:
             if raylet is not self.raylet:
                 await raylet.close()
 
-    async def _push_and_complete(self, spec: TaskSpec, worker: RpcClient, worker_id: str) -> None:
+    async def _push_and_complete(self, spec: TaskSpec, worker: RpcClient, worker_id: str) -> bool:
+        """Returns False when the worker died (the caller must drop the lease)."""
         try:
             reply = await worker.call("PushTask", {"spec": spec.to_wire()}, timeout=None)
         except RpcError as e:
@@ -795,8 +827,9 @@ class CoreWorker:
                 self._enqueue_task(spec)
             else:
                 self._fail_task(spec, WorkerCrashedError(f"Worker died executing {spec.name}: {e}"))
-            return
+            return False
         self._handle_task_reply(spec, reply)
+        return True
 
     def _handle_task_reply(self, spec: TaskSpec, reply: dict) -> None:
         task_id = TaskID(spec.task_id)

@@ -41,7 +41,12 @@ struct Entry {
   uint64_t alloc_size = 0;
   int64_t ref_count = 0;
   ObjectState state = ObjectState::kCreated;
-  // Position in the LRU list when evictable (sealed && ref_count == 0).
+  // Pinned objects (primary copies, reference
+  // local_object_manager.h:110 PinObjectsAndWaitForFree) are never
+  // LRU-evicted; the embedding raylet must spill them to disk first.
+  bool pinned = false;
+  // Position in the LRU list when evictable (sealed && ref_count == 0 &&
+  // !pinned).
   bool in_lru = false;
   std::list<std::string>::iterator lru_it;
 };
@@ -212,6 +217,29 @@ class Store {
     return it->second.state == ObjectState::kSealed ? 2 : 1;
   }
 
+  int Pin(const std::string& id) {
+    auto it = table_.find(id);
+    if (it == table_.end()) return -1;
+    it->second.pinned = true;
+    RemoveFromLru(id, it->second);
+    return 0;
+  }
+
+  int Unpin(const std::string& id) {
+    auto it = table_.find(id);
+    if (it == table_.end()) return -1;
+    it->second.pinned = false;
+    if (it->second.state == ObjectState::kSealed && it->second.ref_count <= 0)
+      AddToLru(id, it->second);
+    return 0;
+  }
+
+  int64_t RefCount(const std::string& id) {
+    auto it = table_.find(id);
+    if (it == table_.end()) return -1;
+    return it->second.ref_count;
+  }
+
   uint64_t EvictUntil(uint64_t bytes_needed) {
     uint64_t freed = 0;
     while (freed < bytes_needed && !lru_.empty()) {
@@ -241,6 +269,7 @@ class Store {
     }
   }
   void AddToLru(const std::string& id, Entry& e) {
+    if (e.pinned) return;
     if (!e.in_lru) {
       e.lru_it = lru_.insert(lru_.end(), id);
       e.in_lru = true;
@@ -321,6 +350,21 @@ int store_delete(void* s, const uint8_t* id, uint32_t id_len, int force) {
 
 int store_contains(void* s, const uint8_t* id, uint32_t id_len) {
   return static_cast<Store*>(s)->Contains(
+      std::string(reinterpret_cast<const char*>(id), id_len));
+}
+
+int store_pin(void* s, const uint8_t* id, uint32_t id_len) {
+  return static_cast<Store*>(s)->Pin(
+      std::string(reinterpret_cast<const char*>(id), id_len));
+}
+
+int store_unpin(void* s, const uint8_t* id, uint32_t id_len) {
+  return static_cast<Store*>(s)->Unpin(
+      std::string(reinterpret_cast<const char*>(id), id_len));
+}
+
+int64_t store_ref_count(void* s, const uint8_t* id, uint32_t id_len) {
+  return static_cast<Store*>(s)->RefCount(
       std::string(reinterpret_cast<const char*>(id), id_len));
 }
 

@@ -50,10 +50,13 @@ def _load():
             lib.store_seal.argtypes = [vp, ctypes.c_char_p, u32]
             lib.store_get.restype = i32
             lib.store_get.argtypes = [vp, ctypes.c_char_p, u32, ctypes.POINTER(u64), ctypes.POINTER(u64), ctypes.POINTER(u64)]
-            for name in ("store_add_ref", "store_release", "store_contains"):
+            for name in ("store_add_ref", "store_release", "store_contains",
+                         "store_pin", "store_unpin"):
                 fn = getattr(lib, name)
                 fn.restype = i32
                 fn.argtypes = [vp, ctypes.c_char_p, u32]
+            lib.store_ref_count.restype = ctypes.c_int64
+            lib.store_ref_count.argtypes = [vp, ctypes.c_char_p, u32]
             lib.store_delete.restype = i32
             lib.store_delete.argtypes = [vp, ctypes.c_char_p, u32, i32]
             lib.store_evict.restype = u64
@@ -145,6 +148,21 @@ class ShmStore:
         """0 = absent, 1 = created/unsealed, 2 = sealed."""
         with self._lock:
             return self._lib.store_contains(self._handle, object_id, len(object_id))
+
+    def pin(self, object_id: bytes) -> None:
+        """Exclude a primary copy from LRU eviction (reference
+        ``local_object_manager.h:110`` pinned-object semantics)."""
+        with self._lock:
+            self._lib.store_pin(self._handle, object_id, len(object_id))
+
+    def unpin(self, object_id: bytes) -> None:
+        with self._lock:
+            self._lib.store_unpin(self._handle, object_id, len(object_id))
+
+    def ref_count(self, object_id: bytes) -> int:
+        """-1 if absent."""
+        with self._lock:
+            return self._lib.store_ref_count(self._handle, object_id, len(object_id))
 
     def evict(self, nbytes: int) -> int:
         with self._lock:
