@@ -1,0 +1,26 @@
+"""TPU-native LLM inference: continuous batching over a slot KV cache.
+
+Equivalent of the reference's ``ray.llm`` serving stack
+(``python/ray/llm/_internal/serve/deployments/llm/llm_server.py:415``,
+``vllm_engine.py``), which delegates the engine to vLLM. Here the engine is
+first-class and TPU-first: instead of vLLM's paged KV with dynamic page
+tables (a GPU-pointer-chasing design), the cache is a dense per-slot tensor
+— JetStream-style — so every prefill/decode step is a fixed-shape XLA
+program that stays on the MXU with zero recompilation at steady state.
+"""
+
+from .engine import InferenceEngine, Request
+from .model import decode_step, init_cache, prefill
+from .serving import LLMDeployment, build_llm_app
+from .tokenizer import ByteTokenizer
+
+__all__ = [
+    "InferenceEngine",
+    "Request",
+    "init_cache",
+    "prefill",
+    "decode_step",
+    "LLMDeployment",
+    "build_llm_app",
+    "ByteTokenizer",
+]

@@ -1,0 +1,210 @@
+"""Continuous-batching inference engine.
+
+The scheduler half of what the reference delegates to vLLM
+(``AsyncLLMEngine`` in
+``python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py``):
+requests arrive at any time, prefill is interleaved with batched decode,
+and finished sequences free their slot for waiting requests immediately
+(continuous batching, not static batching).
+
+TPU shape discipline: decode always runs the full ``[max_slots]`` batch
+(inactive slots compute garbage that is ignored — branchless, so one
+compiled program serves every occupancy), and prompts pad to power-of-two
+buckets so prefill compiles once per bucket, not once per prompt length.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.llama import LlamaConfig, PRESETS, init_params
+from .model import decode_step, init_cache, insert_kv, prefill
+
+
+@dataclass
+class Request:
+    request_id: str
+    prompt: list[int]
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    eos_id: int | None = None
+    # runtime state
+    generated: list[int] = field(default_factory=list)
+    slot: int = -1
+    pos: int = 0  # next position to write
+    done: bool = False
+    finish_reason: str = ""
+
+
+class InferenceEngine:
+    """Single-host engine; one slot-cache resident on the default device.
+
+    Thread-safety: ``add_request``/``cancel`` may be called from any
+    thread; ``step`` must be called from one driver thread (the serving
+    replica's engine loop).
+    """
+
+    def __init__(
+        self,
+        config: LlamaConfig | str = "debug",
+        params=None,
+        *,
+        max_slots: int = 8,
+        max_len: int = 512,
+        seed: int = 0,
+    ):
+        self.config = PRESETS[config] if isinstance(config, str) else config
+        if params is None:
+            params = init_params(self.config, jax.random.PRNGKey(seed))
+        self.params = params
+        self.max_slots = max_slots
+        self.max_len = max_len
+        self.cache = init_cache(self.config, max_slots, max_len)
+        self._free_slots = list(range(max_slots))
+        self._active: dict[int, Request] = {}
+        self._waiting: deque[Request] = deque()
+        self._lock = threading.Lock()
+        self._key = jax.random.PRNGKey(seed ^ 0x5EED)
+        self._counter = itertools.count()
+        # Host-side mirrors of the decode-step inputs.
+        self._tokens = np.zeros(max_slots, np.int32)
+        self._pos = np.zeros(max_slots, np.int32)
+        self.buckets = [b for b in (32, 64, 128, 256, 512, 1024, 2048, 4096) if b <= max_len]
+
+    # ------------------------------------------------------------- admission
+    def add_request(self, request: Request) -> None:
+        if len(request.prompt) >= self.max_len:
+            raise ValueError(
+                f"prompt of {len(request.prompt)} tokens >= max_len {self.max_len}"
+            )
+        with self._lock:
+            self._waiting.append(request)
+
+    def cancel(self, request_id: str) -> None:
+        with self._lock:
+            keep: deque[Request] = deque()
+            for r in self._waiting:
+                if r.request_id == request_id:
+                    r.done, r.finish_reason = True, "cancelled"
+                else:
+                    keep.append(r)
+            self._waiting = keep
+            for slot, r in list(self._active.items()):
+                if r.request_id == request_id:
+                    r.done, r.finish_reason = True, "cancelled"
+                    self._retire(slot)
+
+    @property
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self._waiting or self._active)
+
+    def _retire(self, slot: int) -> None:
+        # Idempotent: cancel() and _emit() can both observe a finished
+        # request; the slot must enter the free list exactly once.
+        if self._active.pop(slot, None) is not None:
+            self._free_slots.append(slot)
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.max_len
+
+    # ------------------------------------------------------------------ step
+    def step(self) -> list[dict]:
+        """Advance the engine: admit one waiting request (prefill) if a slot
+        is free, else run one batched decode step. Returns emission events
+        ``{"request_id", "token", "done", "finish_reason"}``."""
+        with self._lock:
+            admit = self._waiting.popleft() if self._waiting and self._free_slots else None
+        if admit is not None:
+            return self._prefill_one(admit)
+        if self._active:
+            return self._decode_all()
+        return []
+
+    def _sample(self, logits: jax.Array, temperature: float) -> int:
+        if temperature <= 0.0:
+            return int(jnp.argmax(logits))
+        self._key, sub = jax.random.split(self._key)
+        return int(jax.random.categorical(sub, logits / temperature))
+
+    def _prefill_one(self, r: Request) -> list[dict]:
+        bucket = self._bucket(len(r.prompt))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : len(r.prompt)] = r.prompt
+        ks, vs, hidden = prefill(self.params, jnp.asarray(padded), self.config)
+        with self._lock:
+            slot = self._free_slots.pop()
+            r.slot = slot
+            self._active[slot] = r
+        self.cache = insert_kv(self.cache, ks, vs, jnp.int32(slot), self.config, self.max_len)
+        last = hidden[0, len(r.prompt) - 1]
+        logits = (last @ self.params["lm_head"]).astype(jnp.float32)
+        token = self._sample(logits, r.temperature)
+        r.pos = len(r.prompt)
+        return [self._emit(r, token)]
+
+    def _decode_all(self) -> list[dict]:
+        with self._lock:
+            active = dict(self._active)
+        if not active:
+            return []
+        temps = np.ones(self.max_slots, np.float32)
+        for slot, r in active.items():
+            self._tokens[slot] = r.generated[-1]
+            self._pos[slot] = r.pos
+            temps[slot] = r.temperature
+        logits, self.cache = decode_step(
+            self.params, self.cache, jnp.asarray(self._tokens), jnp.asarray(self._pos), self.config
+        )
+        # One batched sample + one device->host transfer per step (not one
+        # per slot): greedy argmax and tempered categorical computed for
+        # all slots, picked per-slot by temperature.
+        self._key, sub = jax.random.split(self._key)
+        greedy = jnp.argmax(logits, axis=-1)
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+        sampled = jax.random.categorical(sub, scaled)
+        tokens = np.asarray(jnp.where(jnp.asarray(temps) > 0.0, sampled, greedy))
+        events = []
+        for slot, r in active.items():
+            r.pos += 1
+            events.append(self._emit(r, int(tokens[slot])))
+        return events
+
+    def _emit(self, r: Request, token: int) -> dict:
+        r.generated.append(token)
+        if r.eos_id is not None and token == r.eos_id:
+            r.done, r.finish_reason = True, "stop"
+        elif len(r.generated) >= r.max_new_tokens:
+            r.done, r.finish_reason = True, "length"
+        elif r.pos >= self.max_len - 1:
+            r.done, r.finish_reason = True, "max_len"
+        if r.done:
+            with self._lock:
+                self._retire(r.slot)  # idempotent if cancel() beat us to it
+        return {
+            "request_id": r.request_id,
+            "token": token,
+            "done": r.done,
+            "finish_reason": r.finish_reason,
+        }
+
+    # ------------------------------------------------------------ conveniences
+    def generate(self, prompt: list[int], max_new_tokens: int = 32,
+                 temperature: float = 0.0, eos_id: int | None = None) -> list[int]:
+        """Blocking single-prompt helper (tests / offline use)."""
+        rid = f"gen-{next(self._counter)}"
+        r = Request(rid, list(prompt), max_new_tokens, temperature, eos_id)
+        self.add_request(r)
+        while not r.done:
+            self.step()
+        return r.generated

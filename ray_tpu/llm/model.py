@@ -1,0 +1,144 @@
+"""Prefill / decode forward passes over a slot KV cache.
+
+Redesign of what the reference delegates to vLLM's paged attention
+(``python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_models.py``):
+on TPU, dynamic page tables defeat XLA's static-shape compilation, so the
+cache is a dense tensor ``[layers, slots, kv_heads, max_len, head_dim]``.
+A sequence owns one slot for its lifetime (JetStream's insert/generate
+layout); admission control in the engine replaces page allocation.
+
+Invariant: before a decode step for a sequence at position ``pos``, the
+cache holds K/V for positions ``[0, pos)``; the step writes position
+``pos`` and attends over ``[0, pos]``. Prefill pads prompts to a bucket
+length — padded garbage beyond ``true_len`` is progressively overwritten
+by decode before it ever enters an attention window, so no masking state
+is needed beyond the position counter.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models.llama import LlamaConfig
+from ..ops import apply_rope, rms_norm
+
+
+def init_cache(config: LlamaConfig, max_slots: int, max_len: int) -> dict:
+    c = config
+    shape = (c.n_layers, max_slots, c.n_kv_heads, max_len, c.head_dim)
+    return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+
+
+def _project_qkv(h, layer, c: LlamaConfig):
+    q = jnp.einsum("bse,ehd->bhsd", h, layer["wq"])
+    k = jnp.einsum("bse,ehd->bhsd", h, layer["wk"])
+    v = jnp.einsum("bse,ehd->bhsd", h, layer["wv"])
+    return q, k, v
+
+
+def _mlp(x, layer, c: LlamaConfig):
+    h = rms_norm(x, layer["mlp_norm"], eps=c.norm_eps)
+    gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"])
+    up = jnp.einsum("bse,em->bsm", h, layer["w_up"])
+    ff = jax.nn.silu(gate.astype(jnp.float32)).astype(c.dtype) * up
+    return x + jnp.einsum("bsm,me->bse", ff, layer["w_down"])
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def prefill(params, tokens, config: LlamaConfig):
+    """Full causal forward on one padded prompt, collecting per-layer K/V.
+
+    tokens: [1, S] int32 (S = a static bucket length).
+    Returns (k_layers [L, KH, S, D], v_layers, hidden [1, S, E]).
+    """
+    c = config
+    _, s = tokens.shape
+    positions = jnp.arange(s, dtype=jnp.int32)
+    x = params["embed"][tokens].astype(c.dtype)
+
+    def body(carry, layer):
+        h = rms_norm(carry, layer["attn_norm"], eps=c.norm_eps)
+        q, k, v = _project_qkv(h, layer, c)
+        q = apply_rope(q, positions, theta=c.rope_theta)
+        k = apply_rope(k, positions, theta=c.rope_theta)
+        # [1, H, S, D] x [1, KH, S, D] causal GQA in f32 scores.
+        kh, g = c.n_kv_heads, c.n_heads // c.n_kv_heads
+        qg = q.reshape(1, kh, g, s, c.head_dim)
+        scores = jnp.einsum("bkgsd,bktd->bkgst", qg, k).astype(jnp.float32)
+        scores *= c.head_dim ** -0.5
+        causal = positions[:, None] >= positions[None, :]
+        scores = jnp.where(causal[None, None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
+        attn = jnp.einsum("bkgst,bktd->bkgsd", probs, v).reshape(1, c.n_heads, s, c.head_dim)
+        out = jnp.einsum("bhsd,hde->bse", attn, layer["wo"])
+        x2 = _mlp(carry + out, layer, c)
+        return x2, (k[0], v[0])
+
+    x, (ks, vs) = lax.scan(body, x, params["layers"])
+    hidden = rms_norm(x, params["final_norm"], eps=c.norm_eps)
+    return ks, vs, hidden
+
+
+@functools.partial(jax.jit, static_argnames=("config", "max_len"),
+                   donate_argnames=("cache",))
+def insert_kv(cache: dict, k_layers, v_layers, slot, config: LlamaConfig, max_len: int) -> dict:
+    """Copy a prefilled prompt's K/V into the cache at ``slot``.
+    k_layers/v_layers: [L, KH, S, D] with S <= max_len (padded to bucket)."""
+    L, KH, S, D = k_layers.shape
+    pad = max_len - S
+    if pad:
+        k_layers = jnp.pad(k_layers, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v_layers = jnp.pad(v_layers, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    k = lax.dynamic_update_slice(cache["k"], k_layers[:, None], (0, slot, 0, 0, 0))
+    v = lax.dynamic_update_slice(cache["v"], v_layers[:, None], (0, slot, 0, 0, 0))
+    return {"k": k, "v": v}
+
+
+@functools.partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def decode_step(params, cache: dict, tokens, pos, config: LlamaConfig):
+    """One batched decode step over all slots.
+
+    tokens: [slots] int32 — the token at position ``pos[i]`` of each
+    sequence (garbage for inactive slots; the engine ignores their output).
+    pos:    [slots] int32 — write/attend position per slot.
+    Returns (logits [slots, vocab] f32, new cache).
+    """
+    c = config
+    n = tokens.shape[0]
+    max_len = cache["k"].shape[3]
+    x = params["embed"][tokens][:, None].astype(c.dtype)  # [slots, 1, E]
+    kh, g = c.n_kv_heads, c.n_heads // c.n_kv_heads
+
+    def write(cache_l, new, p):
+        # cache_l [KH, max_len, D], new [KH, D] -> write at position p
+        return lax.dynamic_update_slice(cache_l, new[:, None], (0, p, 0))
+
+    def body(carry, xs):
+        x = carry
+        layer, ck, cv = xs  # ck/cv: [slots, KH, max_len, D]
+        h = rms_norm(x, layer["attn_norm"], eps=c.norm_eps)
+        q, k, v = _project_qkv(h, layer, c)  # [slots, H|KH, 1, D]
+        q = apply_rope(q, pos[:, None], theta=c.rope_theta)
+        k = apply_rope(k, pos[:, None], theta=c.rope_theta)
+        ck = jax.vmap(write)(ck, k[:, :, 0], pos)
+        cv = jax.vmap(write)(cv, v[:, :, 0], pos)
+        qg = q[:, :, 0].reshape(n, kh, g, c.head_dim)
+        scores = jnp.einsum("nkgd,nktd->nkgt", qg, ck).astype(jnp.float32)
+        scores *= c.head_dim ** -0.5
+        live = jnp.arange(max_len)[None] <= pos[:, None]  # [slots, max_len]
+        scores = jnp.where(live[:, None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
+        attn = jnp.einsum("nkgt,nktd->nkgd", probs, cv).reshape(n, 1, c.n_heads * c.head_dim)
+        out = jnp.einsum("bsf,fe->bse", attn,
+                         layer["wo"].reshape(c.n_heads * c.head_dim, c.hidden))
+        x2 = _mlp(x + out, layer, c)
+        return x2, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    hidden = rms_norm(x, params["final_norm"], eps=c.norm_eps)  # [slots, 1, E]
+    logits = jnp.einsum("bse,ev->bsv", hidden, params["lm_head"])[:, 0]
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}

@@ -1,0 +1,125 @@
+"""LLM serving deployment: the engine behind a Serve replica.
+
+Equivalent of the reference's ``LLMServer``
+(``python/ray/llm/_internal/serve/deployments/llm/llm_server.py:415``):
+one engine per replica, concurrent HTTP/handle requests feed the shared
+continuous-batching loop, and each caller blocks only on its own
+completion. Scale-out happens at the Serve layer (num_replicas), exactly
+as the reference scales vLLM engine replicas.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .engine import InferenceEngine, Request
+from .tokenizer import ByteTokenizer
+
+
+class LLMDeployment:
+    """User-facing deployment class: wrap with ``serve.deployment`` (see
+    ``build_llm_app``). Methods run on replica executor threads; one
+    background thread drives the engine so requests batch continuously."""
+
+    def __init__(
+        self,
+        preset: str = "debug-128",
+        *,
+        max_slots: int = 8,
+        max_len: int = 256,
+        seed: int = 0,
+        request_timeout_s: float = 300.0,
+    ):
+        self.engine = InferenceEngine(preset, max_slots=max_slots, max_len=max_len, seed=seed)
+        self.tokenizer = ByteTokenizer()
+        if self.tokenizer.vocab_size > self.engine.config.vocab_size:
+            raise ValueError(
+                f"model vocab {self.engine.config.vocab_size} is smaller than "
+                f"tokenizer vocab {self.tokenizer.vocab_size}; pick a preset "
+                f"with vocab_size >= {self.tokenizer.vocab_size}"
+            )
+        self.request_timeout_s = request_timeout_s
+        self._events: dict[str, threading.Event] = {}
+        self._counter = 0
+        self._lock = threading.Lock()
+        self._running = True
+        self._loop_thread = threading.Thread(target=self._engine_loop, daemon=True)
+        self._loop_thread.start()
+
+    def _engine_loop(self) -> None:
+        while self._running:
+            if not self.engine.has_work:
+                time.sleep(0.002)
+                continue
+            for event in self.engine.step():
+                if event["done"]:
+                    done = self._events.pop(event["request_id"], None)
+                    if done is not None:
+                        done.set()
+
+    def close(self) -> None:
+        """Stop the engine loop. Serve replica teardown kills the worker
+        process anyway; this exists for in-process reuse (tests, notebooks)
+        — the loop thread holds a ref to self, so __del__ alone would never
+        fire."""
+        self._running = False
+        if self._loop_thread.is_alive():
+            self._loop_thread.join(timeout=5)
+
+    # --------------------------------------------------------------- methods
+    def generate(self, prompt: str, max_new_tokens: int = 16,
+                 temperature: float = 0.0) -> dict:
+        """Blocking completion; many calls run concurrently on replica
+        threads and share the engine's decode batch."""
+        ids = self.tokenizer.encode(prompt)
+        with self._lock:
+            self._counter += 1
+            rid = f"req-{self._counter}"
+        req = Request(rid, ids, max_new_tokens, temperature,
+                      eos_id=self.tokenizer.eos_id)
+        done = threading.Event()
+        self._events[rid] = done
+        self.engine.add_request(req)
+        if not done.wait(timeout=self.request_timeout_s):
+            # Cancel so the engine stops mutating req and the slot frees;
+            # drop our event entry (the loop pops it only on completion).
+            self.engine.cancel(rid)
+            self._events.pop(rid, None)
+            return {
+                "request_id": rid,
+                "text": self.tokenizer.decode(req.generated),
+                "tokens": list(req.generated),
+                "finish_reason": "timeout",
+                "num_generated": len(req.generated),
+            }
+        return {
+            "request_id": rid,
+            "text": self.tokenizer.decode(req.generated),
+            "tokens": list(req.generated),
+            "finish_reason": req.finish_reason,
+            "num_generated": len(req.generated),
+        }
+
+    def __call__(self, request) -> dict:
+        """HTTP entrypoint: /app?prompt=...&max_new_tokens=N."""
+        q = request.query_params
+        return self.generate(
+            q.get("prompt", ""),
+            max_new_tokens=int(q.get("max_new_tokens", 16)),
+            temperature=float(q.get("temperature", 0.0)),
+        )
+
+
+def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
+                  max_slots: int = 8, max_len: int = 256,
+                  max_ongoing_requests: int = 32):
+    """Build a Serve Application serving ``preset`` (serve.run-able)."""
+    from ..serve import deployment
+
+    dep = deployment(
+        LLMDeployment,
+        num_replicas=num_replicas,
+        max_ongoing_requests=max_ongoing_requests,
+    )
+    return dep.bind(preset, max_slots=max_slots, max_len=max_len)

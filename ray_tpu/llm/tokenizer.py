@@ -1,0 +1,22 @@
+"""Minimal byte-level tokenizer for tests and demos.
+
+The reference gets tokenizers from HuggingFace via vLLM; the engine here
+is tokenizer-agnostic (token-id lists in, token-id lists out). This
+byte-level fallback keeps the serving path runnable with zero model
+assets: ids 0..255 are raw bytes, 256 is BOS, 257 is EOS.
+"""
+
+from __future__ import annotations
+
+
+class ByteTokenizer:
+    vocab_size = 258
+    bos_id = 256
+    eos_id = 257
+
+    def encode(self, text: str, *, bos: bool = True) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        return [self.bos_id] + ids if bos else ids
+
+    def decode(self, ids: list[int]) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
