@@ -1,0 +1,32 @@
+"""RL training stack: Algorithm / Learner / LearnerGroup / EnvRunner.
+
+TPU-native equivalent of the reference's RLlib new API stack
+(``rllib/algorithms/algorithm.py:199``, ``rllib/core/learner/learner.py:111``,
+``rllib/core/learner/learner_group.py``, ``rllib/env/env_runner_group.py``).
+Differences by design, not omission: the Learner's update is one jitted
+JAX function (loss + grad + optimizer fused by XLA) rather than a torch
+module graph, learners data-parallelize with gradient averaging over the
+object store (ray collectives stand in for NCCL), and environments are
+vectorized numpy — rollouts stay on CPU actors while updates go to the
+accelerator.
+"""
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .env import CartPole, GridWorld
+from .env_runner import EnvRunner, EnvRunnerGroup
+from .learner import Learner
+from .learner_group import LearnerGroup
+from .ppo import PPO, PPOConfig
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "CartPole",
+    "GridWorld",
+    "EnvRunner",
+    "EnvRunnerGroup",
+    "Learner",
+    "LearnerGroup",
+    "PPO",
+    "PPOConfig",
+]

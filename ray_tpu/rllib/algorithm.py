@@ -1,0 +1,107 @@
+"""Algorithm base: config builder + train-iteration loop + checkpointing.
+
+Equivalent of ``rllib/algorithms/algorithm.py:199`` /
+``algorithm_config.py``: the fluent config (``.environment()``,
+``.training()``, ``.env_runners()``, ``.learners()``) builds an Algorithm
+that iterates sample → update and can save/restore its full state.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Any, Type
+
+
+class AlgorithmConfig:
+    def __init__(self):
+        self.env_cls: Any = None
+        self.num_env_runners = 0
+        self.num_envs_per_runner = 8
+        self.rollout_len = 64
+        self.num_learners = 0
+        self.lr = 3e-4
+        self.max_grad_norm = 0.5
+        self.seed = 0
+        self.train_kwargs: dict = {}
+
+    # ----------------------------------------------------- fluent builders
+    def environment(self, env_cls) -> "AlgorithmConfig":
+        self.env_cls = env_cls
+        return self
+
+    def env_runners(self, num_env_runners: int = 0, num_envs_per_runner: int = 8,
+                    rollout_len: int = 64) -> "AlgorithmConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_runner
+        self.rollout_len = rollout_len
+        return self
+
+    def learners(self, num_learners: int = 0) -> "AlgorithmConfig":
+        self.num_learners = num_learners
+        return self
+
+    def training(self, *, lr: float | None = None, max_grad_norm: float | None = None,
+                 **kwargs) -> "AlgorithmConfig":
+        if lr is not None:
+            self.lr = lr
+        if max_grad_norm is not None:
+            self.max_grad_norm = max_grad_norm
+        self.train_kwargs.update(kwargs)
+        return self
+
+    def seeding(self, seed: int) -> "AlgorithmConfig":
+        self.seed = seed
+        return self
+
+    def build(self) -> "Algorithm":
+        return self.algo_cls(self)  # set by subclass
+
+    algo_cls: Type["Algorithm"] = None  # type: ignore[assignment]
+
+
+class Algorithm:
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._setup()
+
+    def _setup(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def training_step(self) -> dict:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def train(self) -> dict:
+        """One training iteration (reference ``Algorithm.train``)."""
+        start = time.monotonic()
+        metrics = self.training_step()
+        self.iteration += 1
+        metrics["training_iteration"] = self.iteration
+        metrics["time_this_iter_s"] = time.monotonic() - start
+        return metrics
+
+    # --------------------------------------------------------- checkpointing
+    def get_state(self) -> dict:  # pragma: no cover - overridden
+        return {"iteration": self.iteration}
+
+    def set_state(self, state: dict) -> None:  # pragma: no cover - overridden
+        self.iteration = state["iteration"]
+
+    def save(self, checkpoint_dir: str) -> str:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "wb") as f:
+            pickle.dump(self.get_state(), f)
+        return path
+
+    def restore(self, checkpoint_dir: str) -> None:
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "rb") as f:
+            self.set_state(pickle.load(f))
+
+    def stop(self) -> None:
+        for group in ("learner_group", "env_runner_group"):
+            g = getattr(self, group, None)
+            if g is not None:
+                g.shutdown()

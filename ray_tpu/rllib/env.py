@@ -1,0 +1,116 @@
+"""Vectorized toy environments (no gym dependency).
+
+API mirrors gymnasium's vector env closely enough that a user can adapt
+real envs: ``reset() -> obs [N, obs_dim]``, ``step(actions [N]) ->
+(obs, rewards, dones, info)`` with auto-reset on done — ``obs`` for done
+envs is the NEW episode's first observation (the policy acts on it next
+step); the terminal observation and the terminated/truncated split live
+in ``info`` (``terminal_obs``, ``terminated``, ``truncated``) so GAE can
+bootstrap time-limit truncations instead of zeroing them. The
+reference's RLlib wraps gymnasium (``rllib/env/env_runner.py``); these
+numpy envs keep the stack self-contained and the tests hermetic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class CartPole:
+    """Classic cart-pole balance task, vectorized over N copies.
+
+    Physics constants match the canonical implementation so learning
+    curves are comparable to published PPO results.
+    """
+
+    obs_dim = 4
+    n_actions = 2
+    max_steps = 500
+
+    def __init__(self, num_envs: int = 1, seed: int = 0):
+        self.n = num_envs
+        self.rng = np.random.default_rng(seed)
+        self.state = np.zeros((num_envs, 4), np.float32)
+        self.steps = np.zeros(num_envs, np.int32)
+
+    def reset(self) -> np.ndarray:
+        self.state = self.rng.uniform(-0.05, 0.05, (self.n, 4)).astype(np.float32)
+        self.steps[:] = 0
+        return self.state.copy()
+
+    def _reset_where(self, mask: np.ndarray) -> None:
+        k = int(mask.sum())
+        if k:
+            self.state[mask] = self.rng.uniform(-0.05, 0.05, (k, 4)).astype(np.float32)
+            self.steps[mask] = 0
+
+    def step(self, actions: np.ndarray):
+        gravity, masscart, masspole = 9.8, 1.0, 0.1
+        total_mass, length = masscart + masspole, 0.5
+        polemass_length = masspole * length
+        force_mag, tau = 10.0, 0.02
+
+        x, x_dot, theta, theta_dot = self.state.T
+        force = np.where(actions == 1, force_mag, -force_mag)
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
+        thetaacc = (gravity * sintheta - costheta * temp) / (
+            length * (4.0 / 3.0 - masspole * costheta**2 / total_mass)
+        )
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        x = x + tau * x_dot
+        x_dot = x_dot + tau * xacc
+        theta = theta + tau * theta_dot
+        theta_dot = theta_dot + tau * thetaacc
+        self.state = np.stack([x, x_dot, theta, theta_dot], axis=1).astype(np.float32)
+        self.steps += 1
+
+        terminated = (np.abs(x) > 2.4) | (np.abs(theta) > 12 * np.pi / 180)
+        truncated = (self.steps >= self.max_steps) & ~terminated
+        done = terminated | truncated
+        rewards = np.ones(self.n, np.float32)
+        terminal_obs = self.state.copy()
+        self._reset_where(done)
+        info = {"terminated": terminated, "truncated": truncated,
+                "terminal_obs": terminal_obs}
+        return self.state.copy(), rewards, done, info
+
+
+class GridWorld:
+    """5x5 grid, reach the goal corner; -0.01 per step, +1 at goal.
+    Cheap deterministic env for unit tests of the rollout plumbing."""
+
+    obs_dim = 2
+    n_actions = 4
+    max_steps = 50
+    size = 5
+
+    def __init__(self, num_envs: int = 1, seed: int = 0):
+        self.n = num_envs
+        self.rng = np.random.default_rng(seed)
+        self.pos = np.zeros((num_envs, 2), np.int32)
+        self.steps = np.zeros(num_envs, np.int32)
+
+    def _obs(self) -> np.ndarray:
+        return (self.pos / (self.size - 1)).astype(np.float32)
+
+    def reset(self) -> np.ndarray:
+        self.pos[:] = 0
+        self.steps[:] = 0
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        moves = np.array([[0, 1], [0, -1], [1, 0], [-1, 0]], np.int32)
+        self.pos = np.clip(self.pos + moves[actions], 0, self.size - 1)
+        self.steps += 1
+        at_goal = (self.pos == self.size - 1).all(axis=1)
+        truncated = (self.steps >= self.max_steps) & ~at_goal
+        done = at_goal | truncated
+        rewards = np.where(at_goal, 1.0, -0.01).astype(np.float32)
+        terminal_obs = self._obs()
+        if done.any():
+            self.pos[done] = 0
+            self.steps[done] = 0
+        info = {"terminated": at_goal, "truncated": truncated,
+                "terminal_obs": terminal_obs}
+        return self._obs(), rewards, done, info

@@ -1,0 +1,125 @@
+"""EnvRunner / EnvRunnerGroup: parallel rollout collection.
+
+Equivalent of ``rllib/env/env_runner.py`` + ``env_runner_group.py``:
+each runner owns a vectorized env and a CPU copy of the policy, samples
+fixed-length fragments, and the group gathers them in parallel actors.
+Policy forward during rollout is numpy (batch of N envs, 2-layer MLP) —
+shipping obs to an accelerator per step would be all latency, no math.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import models
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    z = x - x.max(axis=-1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def _np_forward(params, obs: np.ndarray):
+    x = obs
+    for layer in params["torso"]:
+        x = np.tanh(x @ np.asarray(layer["w"]) + np.asarray(layer["b"]))
+    logits = x @ np.asarray(params["pi"]["w"]) + np.asarray(params["pi"]["b"])
+    value = (x @ np.asarray(params["vf"]["w"]) + np.asarray(params["vf"]["b"]))[:, 0]
+    return logits, value
+
+
+class EnvRunner:
+    """Collects fragments of ``rollout_len`` steps from ``num_envs``
+    parallel env copies. Returns flat arrays plus episode-return stats."""
+
+    def __init__(self, env_cls, num_envs: int = 8, rollout_len: int = 64, seed: int = 0):
+        self.env = env_cls(num_envs=num_envs, seed=seed)
+        self.num_envs = num_envs
+        self.rollout_len = rollout_len
+        self.rng = np.random.default_rng(seed ^ 0xA5)
+        self.obs = self.env.reset()
+        self._ep_return = np.zeros(num_envs, np.float32)
+        self._completed: list[float] = []
+
+    def sample(self, weights) -> dict:
+        T, N = self.rollout_len, self.num_envs
+        obs_buf = np.zeros((T, N, self.env.obs_dim), np.float32)
+        act_buf = np.zeros((T, N), np.int64)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.bool_)
+        # V(terminal_obs) at time-limit truncations (0 elsewhere): GAE
+        # bootstraps these instead of zeroing them — a balanced pole at the
+        # 500-step cap is worth ~1/(1-gamma), not 1.
+        trunc_val_buf = np.zeros((T, N), np.float32)
+
+        for t in range(T):
+            logits, value = _np_forward(weights, self.obs)
+            probs = _softmax(logits)
+            actions = (probs.cumsum(axis=1) > self.rng.random((N, 1))).argmax(axis=1)
+            logp = np.log(probs[np.arange(N), actions] + 1e-10)
+            obs_buf[t], act_buf[t] = self.obs, actions
+            logp_buf[t], val_buf[t] = logp, value
+            self.obs, rewards, dones, info = self.env.step(actions)
+            rew_buf[t], done_buf[t] = rewards, dones
+            truncated = info["truncated"]
+            if truncated.any():
+                _, v_term = _np_forward(weights, info["terminal_obs"])
+                trunc_val_buf[t, truncated] = v_term[truncated]
+            self._ep_return += rewards
+            for i in np.nonzero(dones)[0]:
+                self._completed.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+
+        _, last_value = _np_forward(weights, self.obs)
+        completed, self._completed = self._completed, []
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "logp": logp_buf,
+            "values": val_buf,
+            "rewards": rew_buf,
+            "dones": done_buf,
+            "trunc_values": trunc_val_buf,
+            "last_value": last_value,
+            "episode_returns": np.asarray(completed, np.float32),
+        }
+
+
+class EnvRunnerGroup:
+    """N EnvRunner actors sampling in parallel (``num_env_runners=0`` runs
+    one local runner in-process)."""
+
+    def __init__(self, env_cls, *, num_env_runners: int = 0, num_envs_per_runner: int = 8,
+                 rollout_len: int = 64, seed: int = 0):
+        if num_env_runners == 0:
+            self._local = EnvRunner(env_cls, num_envs_per_runner, rollout_len, seed)
+            self._actors = []
+        else:
+            from ..core import api as ray
+
+            self._local = None
+            cls = ray.remote(EnvRunner)
+            self._actors = [
+                cls.remote(env_cls, num_envs_per_runner, rollout_len, seed + 1000 * i)
+                for i in range(num_env_runners)
+            ]
+
+    def sample(self, weights) -> list[dict]:
+        if self._local is not None:
+            return [self._local.sample(weights)]
+        from ..core import api as ray
+
+        return ray.get([a.sample.remote(weights) for a in self._actors], timeout=300)
+
+    def shutdown(self) -> None:
+        from ..core import api as ray
+
+        for a in self._actors:
+            try:
+                ray.kill(a)
+            except Exception:
+                pass
+        self._actors = []

@@ -1,0 +1,107 @@
+"""Learner: owns params + optimizer state, applies jitted updates.
+
+Equivalent of the reference's ``rllib/core/learner/learner.py:111``
+(``Learner.update_from_batch``): the algorithm supplies a loss function;
+the Learner differentiates it, applies Adam, and reports metrics. Where
+the reference builds a torch autograd graph per call, here the whole
+loss→grad→optimizer chain is one XLA-compiled function, so a minibatch
+update is a single device dispatch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class Learner:
+    def __init__(
+        self,
+        loss_fn: Callable,
+        init_params_fn: Callable[[jax.Array], dict],
+        *,
+        lr: float = 3e-4,
+        max_grad_norm: float = 0.5,
+        seed: int = 0,
+    ):
+        self._loss_fn = loss_fn
+        self.params = init_params_fn(jax.random.PRNGKey(seed))
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(max_grad_norm),
+            optax.adam(lr),
+        )
+        self.opt_state = self.tx.init(self.params)
+
+        @jax.jit
+        def _update(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
+                params, batch
+            )
+            updates, new_opt = self.tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            metrics = dict(metrics)
+            metrics["total_loss"] = loss
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return new_params, new_opt, metrics
+
+        @jax.jit
+        def _grads(params, batch):
+            (loss, metrics), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
+                params, batch
+            )
+            metrics = dict(metrics)
+            metrics["total_loss"] = loss
+            return grads, metrics
+
+        @jax.jit
+        def _apply(params, opt_state, grads):
+            updates, new_opt = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt
+
+        self._update_jit = _update
+        self._grads_jit = _grads
+        self._apply_jit = _apply
+
+    # ------------------------------------------------------------- local API
+    def update(self, batch: dict) -> dict:
+        """Full local update; returns float metrics."""
+        self.params, self.opt_state, metrics = self._update_jit(
+            self.params, self.opt_state, batch
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    # --------------------------------------------------- distributed pieces
+    def compute_gradients(self, batch: dict):
+        """Half of a data-parallel step: grads on this learner's shard
+        (LearnerGroup averages them across learners)."""
+        grads, metrics = self._grads_jit(self.params, batch)
+        return jax.device_get(grads), {k: float(v) for k, v in metrics.items()}
+
+    def apply_gradients(self, grads) -> None:
+        self.params, self.opt_state = self._apply_jit(self.params, self.opt_state, grads)
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, params) -> None:
+        self.params = jax.tree.map(jnp.asarray, params)
+
+    def get_state(self) -> dict:
+        return {
+            "params": jax.device_get(self.params),
+            "opt_state": jax.device_get(self.opt_state),
+        }
+
+    def set_state(self, state: dict) -> None:
+        self.params = jax.tree.map(jnp.asarray, state["params"])
+        self.opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
+
+
+def average_gradients(grad_list: list) -> Any:
+    """Mean over learners' gradient pytrees (the all-reduce the reference
+    does with torch DDP/NCCL, here over the object store)."""
+    return jax.tree.map(lambda *gs: sum(gs) / len(gs), *grad_list)

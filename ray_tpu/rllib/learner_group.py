@@ -1,0 +1,131 @@
+"""LearnerGroup: one local Learner or N Learner actors, data-parallel.
+
+Equivalent of ``rllib/core/learner/learner_group.py``: ``num_learners=0``
+runs the Learner in-process (debug / single host); ``num_learners>=1``
+spawns Learner actors, shards each batch across them, averages their
+gradients, and applies the averaged update on every learner so weights
+stay bit-identical (synchronous DDP semantics without NCCL — gradients
+ride the object store).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .learner import Learner, average_gradients
+
+
+class _LearnerActor:
+    """Remote wrapper: built from pickled constructor pieces so the actor
+    process never imports algorithm modules."""
+
+    def __init__(self, loss_fn, init_params_fn, lr, max_grad_norm, seed):
+        self.learner = Learner(
+            loss_fn, init_params_fn, lr=lr, max_grad_norm=max_grad_norm, seed=seed
+        )
+
+    def compute_gradients(self, batch):
+        return self.learner.compute_gradients(batch)
+
+    def apply_gradients(self, grads):
+        self.learner.apply_gradients(grads)
+        return True
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_state(self, state):
+        self.learner.set_state(state)
+        return True
+
+    def get_state(self):
+        return self.learner.get_state()
+
+
+class LearnerGroup:
+    def __init__(
+        self,
+        loss_fn,
+        init_params_fn,
+        *,
+        num_learners: int = 0,
+        lr: float = 3e-4,
+        max_grad_norm: float = 0.5,
+        seed: int = 0,
+    ):
+        self.num_learners = num_learners
+        if num_learners == 0:
+            self._local = Learner(
+                loss_fn, init_params_fn, lr=lr, max_grad_norm=max_grad_norm, seed=seed
+            )
+            self._actors = []
+        else:
+            from ..core import api as ray
+
+            self._local = None
+            cls = ray.remote(_LearnerActor)
+            # Same seed everywhere: learners must start (and stay) identical.
+            self._actors = [
+                cls.remote(loss_fn, init_params_fn, lr, max_grad_norm, seed)
+                for _ in range(num_learners)
+            ]
+            ray.get([a.get_weights.remote() for a in self._actors], timeout=120)
+
+    def update(self, batch: dict) -> dict:
+        """One synchronous data-parallel update over the full batch."""
+        if self._local is not None:
+            return self._local.update(batch)
+        from ..core import api as ray
+
+        # Never hand an actor an empty shard (empty-mean NaNs would poison
+        # the average); idle actors still apply the averaged grads so all
+        # replicas stay identical.
+        size = len(next(iter(batch.values())))
+        n = max(1, min(len(self._actors), size))
+        shards = _shard_batch(batch, n)
+        outs = ray.get(
+            [a.compute_gradients.remote(s) for a, s in zip(self._actors[:n], shards)],
+            timeout=300,
+        )
+        grads = average_gradients([g for g, _ in outs])
+        ray.get([a.apply_gradients.remote(grads) for a in self._actors], timeout=300)
+        metrics_list = [m for _, m in outs]
+        return {k: float(np.mean([m[k] for m in metrics_list])) for k in metrics_list[0]}
+
+    def get_weights(self):
+        if self._local is not None:
+            return self._local.get_weights()
+        from ..core import api as ray
+
+        return ray.get(self._actors[0].get_weights.remote(), timeout=120)
+
+    def get_state(self) -> dict:
+        if self._local is not None:
+            return self._local.get_state()
+        from ..core import api as ray
+
+        return ray.get(self._actors[0].get_state.remote(), timeout=120)
+
+    def set_state(self, state: dict) -> None:
+        if self._local is not None:
+            self._local.set_state(state)
+            return
+        from ..core import api as ray
+
+        ray.get([a.set_state.remote(state) for a in self._actors], timeout=120)
+
+    def shutdown(self) -> None:
+        from ..core import api as ray
+
+        for a in self._actors:
+            try:
+                ray.kill(a)
+            except Exception:
+                pass
+        self._actors = []
+
+
+def _shard_batch(batch: dict, n: int) -> list[dict]:
+    size = len(next(iter(batch.values())))
+    idx = np.array_split(np.arange(size), n)
+    return [{k: v[i] for k, v in batch.items()} for i in idx]

@@ -1,0 +1,38 @@
+"""Actor-critic MLP in plain JAX pytrees.
+
+Plays the role of RLlib's RLModule (``rllib/core/rl_module/rl_module.py``):
+``forward(params, obs) -> (logits, value)``. Kept framework-free (no
+flax/haiku) to match the rest of the repo's param-tree convention — the
+Learner shards these trees with the same machinery as the Llama models.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_policy(key: jax.Array, obs_dim: int, n_actions: int, hidden: int = 64) -> dict:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+
+    def dense(k, i, o):
+        return {
+            "w": jax.random.normal(k, (i, o), jnp.float32) * (2.0 / i) ** 0.5,
+            "b": jnp.zeros((o,), jnp.float32),
+        }
+
+    return {
+        "torso": [dense(k1, obs_dim, hidden), dense(k2, hidden, hidden)],
+        "pi": dense(k3, hidden, n_actions),
+        "vf": dense(k4, hidden, 1),
+    }
+
+
+def forward(params: dict, obs: jax.Array):
+    """obs [B, obs_dim] -> (logits [B, A], value [B])."""
+    x = obs
+    for layer in params["torso"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["vf"]["w"] + params["vf"]["b"])[:, 0]
+    return logits, value

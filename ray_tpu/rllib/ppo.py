@@ -1,0 +1,167 @@
+"""PPO (clipped surrogate) on the Learner/EnvRunner stack.
+
+Equivalent of ``rllib/algorithms/ppo/ppo.py`` + ``ppo_learner.py``: GAE
+on the host (cheap, sequential over time), the clipped policy + value +
+entropy loss as one jitted function on the Learner, several epochs of
+shuffled minibatches per iteration.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import models
+from .algorithm import Algorithm, AlgorithmConfig
+from .env_runner import EnvRunnerGroup
+from .learner_group import LearnerGroup
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.gamma = 0.99
+        self.gae_lambda = 0.95
+        self.clip_eps = 0.2
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.num_epochs = 4
+        self.minibatch_size = 256
+        self.hidden = 64
+
+    def training(self, *, gamma=None, gae_lambda=None, clip_eps=None, vf_coeff=None,
+                 entropy_coeff=None, num_epochs=None, minibatch_size=None,
+                 hidden=None, **kwargs):
+        for name, val in (("gamma", gamma), ("gae_lambda", gae_lambda),
+                          ("clip_eps", clip_eps), ("vf_coeff", vf_coeff),
+                          ("entropy_coeff", entropy_coeff), ("num_epochs", num_epochs),
+                          ("minibatch_size", minibatch_size), ("hidden", hidden)):
+            if val is not None:
+                setattr(self, name, val)
+        return super().training(**kwargs)
+
+
+def make_ppo_loss(clip_eps: float, vf_coeff: float, entropy_coeff: float):
+    """Build the jittable PPO loss. batch: obs, actions, logp_old,
+    advantages, returns — all flat [B, ...]."""
+
+    def loss_fn(params, batch):
+        logits, value = models.forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, batch["actions"][:, None], axis=1)[:, 0]
+        ratio = jnp.exp(logp - batch["logp_old"])
+        adv = batch["advantages"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        surr = jnp.minimum(
+            ratio * adv, jnp.clip(ratio, 1 - clip_eps, 1 + clip_eps) * adv
+        )
+        policy_loss = -surr.mean()
+        vf_loss = jnp.mean((value - batch["returns"]) ** 2)
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=1).mean()
+        total = policy_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+        metrics = {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "clip_frac": (jnp.abs(ratio - 1.0) > clip_eps).mean(),
+        }
+        return total, metrics
+
+    return loss_fn
+
+
+def compute_gae(sample: dict, gamma: float, lam: float):
+    """Generalized advantage estimation over a [T, N] fragment. Done
+    boundaries cut the recursion (auto-reset envs); time-limit truncations
+    still bootstrap with V(terminal_obs) (``trunc_values``) — only true
+    terminations zero the tail value."""
+    rewards, values, dones = sample["rewards"], sample["values"], sample["dones"]
+    trunc_values = sample.get("trunc_values")
+    if trunc_values is None:
+        trunc_values = np.zeros_like(rewards)
+    T, N = rewards.shape
+    adv = np.zeros((T, N), np.float32)
+    last_gae = np.zeros(N, np.float32)
+    next_value = sample["last_value"]
+    for t in reversed(range(T)):
+        not_done = 1.0 - dones[t].astype(np.float32)
+        bootstrap = next_value * not_done + trunc_values[t]
+        delta = rewards[t] + gamma * bootstrap - values[t]
+        last_gae = delta + gamma * lam * not_done * last_gae
+        adv[t] = last_gae
+        next_value = values[t]
+    returns = adv + values
+    return adv, returns
+
+
+class PPO(Algorithm):
+    def _setup(self) -> None:
+        c: PPOConfig = self.config  # type: ignore[assignment]
+        env_probe = c.env_cls(num_envs=1)
+        obs_dim, n_actions = env_probe.obs_dim, env_probe.n_actions
+        hidden = c.hidden
+
+        def init_params_fn(key):
+            return models.init_policy(key, obs_dim, n_actions, hidden)
+
+        self.learner_group = LearnerGroup(
+            make_ppo_loss(c.clip_eps, c.vf_coeff, c.entropy_coeff),
+            init_params_fn,
+            num_learners=c.num_learners,
+            lr=c.lr,
+            max_grad_norm=c.max_grad_norm,
+            seed=c.seed,
+        )
+        self.env_runner_group = EnvRunnerGroup(
+            c.env_cls,
+            num_env_runners=c.num_env_runners,
+            num_envs_per_runner=c.num_envs_per_runner,
+            rollout_len=c.rollout_len,
+            seed=c.seed,
+        )
+        self.rng = np.random.default_rng(c.seed)
+        self._recent_returns: list[float] = []
+
+    def training_step(self) -> dict:
+        c: PPOConfig = self.config  # type: ignore[assignment]
+        weights = self.learner_group.get_weights()
+        samples = self.env_runner_group.sample(weights)
+
+        flat = {"obs": [], "actions": [], "logp_old": [], "advantages": [], "returns": []}
+        for s in samples:
+            adv, ret = compute_gae(s, c.gamma, c.gae_lambda)
+            T, N = s["rewards"].shape
+            flat["obs"].append(s["obs"].reshape(T * N, -1))
+            flat["actions"].append(s["actions"].reshape(-1))
+            flat["logp_old"].append(s["logp"].reshape(-1))
+            flat["advantages"].append(adv.reshape(-1))
+            flat["returns"].append(ret.reshape(-1))
+            self._recent_returns.extend(s["episode_returns"].tolist())
+        batch = {k: np.concatenate(v) for k, v in flat.items()}
+        size = len(batch["actions"])
+
+        metrics: dict = {}
+        for _ in range(c.num_epochs):
+            order = self.rng.permutation(size)
+            for start in range(0, size, c.minibatch_size):
+                idx = order[start : start + c.minibatch_size]
+                mb = {k: v[idx] for k, v in batch.items()}
+                metrics = self.learner_group.update(mb)
+
+        self._recent_returns = self._recent_returns[-100:]
+        metrics["episode_return_mean"] = (
+            float(np.mean(self._recent_returns)) if self._recent_returns else 0.0
+        )
+        metrics["num_env_steps_sampled"] = size
+        return metrics
+
+    def get_state(self) -> dict:
+        return {"iteration": self.iteration, "learner": self.learner_group.get_state()}
+
+    def set_state(self, state: dict) -> None:
+        self.iteration = state["iteration"]
+        self.learner_group.set_state(state["learner"])
+
+
+PPOConfig.algo_cls = PPO
