@@ -1,0 +1,125 @@
+"""util layer: ActorPool, distributed Queue, multiprocessing.Pool.
+
+Reference surfaces: ``python/ray/util/actor_pool.py``, ``util/queue.py``,
+``util/multiprocessing/pool.py``.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.multiprocessing import Pool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@ray_tpu.remote
+class Doubler:
+    def double(self, x):
+        return 2 * x
+
+
+def test_actor_pool_ordered(ray_cluster):
+    pool = ActorPool([Doubler.remote(), Doubler.remote()])
+    results = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert results == [2 * i for i in range(8)]
+
+
+def test_actor_pool_unordered_and_backpressure(ray_cluster):
+    pool = ActorPool([Doubler.remote(), Doubler.remote()])
+    for i in range(6):  # more submits than actors: queued internally
+        pool.submit(lambda a, v: a.double.remote(v), i)
+    out = set()
+    while pool.has_next():
+        out.add(pool.get_next_unordered(timeout=60))
+    assert out == {2 * i for i in range(6)}
+
+
+def test_actor_pool_survives_task_errors(ray_cluster):
+    """A raising task must surface its error AND return the actor to the
+    pool; later submits still run (no actor leak / deadlock)."""
+
+    @ray_tpu.remote
+    class Worker:
+        def run(self, x):
+            if x == 1:
+                raise ValueError("boom")
+            return x
+
+    pool = ActorPool([Worker.remote()])
+    for i in range(3):
+        pool.submit(lambda a, v: a.run.remote(v), i)
+    results, errors = [], 0
+    while pool.has_next():
+        try:
+            results.append(pool.get_next(timeout=60))
+        except ValueError:
+            errors += 1
+    assert errors == 1 and results == [0, 2]
+
+
+def test_queue_batch_ops_are_all_or_nothing(ray_cluster):
+    q = Queue(maxsize=3)
+    q.put(0)
+    with pytest.raises(Full):
+        q.put_nowait_batch([1, 2, 3])  # would exceed maxsize
+    assert q.qsize() == 1  # nothing partially inserted
+    q.put_nowait_batch([1, 2])
+    with pytest.raises(Empty):
+        q.get_nowait_batch(4)  # only 3 available
+    assert q.qsize() == 3  # nothing discarded
+    assert q.get_nowait_batch(3) == [0, 1, 2]
+    q.shutdown()
+
+
+def test_queue_fifo_and_batches(ray_cluster):
+    q = Queue()
+    for i in range(5):
+        q.put(i)
+    assert q.qsize() == 5
+    assert [q.get(timeout=10) for _ in range(5)] == [0, 1, 2, 3, 4]
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.put_nowait_batch([1, 2, 3])
+    assert q.get_nowait_batch(3) == [1, 2, 3]
+    q.shutdown()
+
+
+def test_queue_maxsize(ray_cluster):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    assert q.get(timeout=10) == 1
+    q.put(3, timeout=10)  # space freed
+    q.shutdown()
+
+
+def test_queue_cross_actor(ray_cluster):
+    """The queue handle pickles into actors; producer and consumer see one
+    FIFO order."""
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return True
+
+    ray_tpu.get(producer.remote(q, 4), timeout=60)
+    assert [q.get(timeout=10) for _ in range(4)] == [0, 1, 2, 3]
+    q.shutdown()
+
+
+def test_mp_pool_map_and_imap(ray_cluster):
+    # closure (not module-level): cloudpickle ships it by value, the pool
+    # workers need no importable test module
+    def sq(x):
+        return x * x
+
+    with Pool(2) as p:
+        assert p.map(sq, range(6)) == [0, 1, 4, 9, 16, 25]
+        assert sorted(p.imap_unordered(sq, range(6), chunksize=2)) == [0, 1, 4, 9, 16, 25]
+        r = p.apply_async(sq, (7,))
+        assert r.get(timeout=60) == 49
+        assert p.apply(sq, (3,)) == 9
