@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import functools
 import inspect
+import os
 import threading
 import time
 from typing import Any, Callable, Sequence
@@ -45,6 +46,10 @@ def init(
             get_config().apply_dict(_system_config)
         from .node import Node
 
+        if address is None:
+            # Submitted-job drivers connect to the running cluster via env
+            # (reference: RAY_ADDRESS set by the job manager for entrypoints).
+            address = os.environ.get("RAY_TPU_ADDRESS") or None
         if address is None:
             _node = Node(
                 head=True,

@@ -248,25 +248,35 @@ class Raylet:
     # ------------------------------------------------------------ worker pool
     @staticmethod
     def _env_hash(runtime_env: dict | None) -> str:
-        env_vars = (runtime_env or {}).get("env_vars") or {}
-        if not env_vars:
+        renv = runtime_env or {}
+        env_vars = renv.get("env_vars") or {}
+        working_dir = renv.get("working_dir") or ""
+        if not env_vars and not working_dir:
             return ""
         import hashlib
         import json
 
-        return hashlib.sha1(json.dumps(env_vars, sort_keys=True).encode()).hexdigest()[:16]
+        blob = json.dumps({"env_vars": env_vars, "working_dir": working_dir},
+                          sort_keys=True)
+        return hashlib.sha1(blob.encode()).hexdigest()[:16]
 
     def _start_worker(self, runtime_env: dict | None = None) -> WorkerHandle:
         worker_id = WorkerID.from_random().hex()
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = worker_id
         env.setdefault("JAX_PLATFORMS", "cpu")  # workers don't grab the TPU by default
-        env_vars = (runtime_env or {}).get("env_vars") or {}
-        for key, value in env_vars.items():
-            if value is None:
-                env.pop(key, None)
-            else:
-                env[key] = str(value)
+        from .runtime_env import apply_runtime_env
+
+        # working_dir: tasks run with this cwd and import modules from it
+        # (reference runtime_env working_dir, minus the remote upload —
+        # single-host path semantics).
+        working_dir = apply_runtime_env(env, runtime_env)
+        if working_dir is not None and not os.path.isdir(working_dir):
+            # Popen(cwd=missing) would raise AFTER the lease reserved
+            # resources; run without the cwd instead — the task's import
+            # error is visible, a leaked reservation is not.
+            logger.warning("runtime_env working_dir %s does not exist; ignoring", working_dir)
+            working_dir = None
         proc = subprocess.Popen(
             [
                 sys.executable,
@@ -286,6 +296,7 @@ class Raylet:
                 str(self.object_store_capacity),
             ],
             env=env,
+            cwd=working_dir,
             stdout=open(os.path.join(self._session_dir, f"worker-{worker_id[:12]}.out"), "wb"),
             stderr=subprocess.STDOUT,
         )
@@ -441,9 +452,13 @@ class Raylet:
             except asyncio.TimeoutError:
                 pass
 
-        worker = await self._get_idle_worker(
-            get_config().worker_register_timeout_s, spec.get("runtime_env")
-        )
+        try:
+            worker = await self._get_idle_worker(
+                get_config().worker_register_timeout_s, spec.get("runtime_env")
+            )
+        except Exception as e:
+            self.resources.release(request)  # never leak the reservation
+            return {"granted": False, "reason": f"worker start failed: {e}"}
         if worker is None:
             self.resources.release(request)
             return {"granted": False, "reason": "no worker available"}
@@ -485,14 +500,20 @@ class Raylet:
                 await asyncio.wait_for(fut, 0.5)
             except asyncio.TimeoutError:
                 pass
-        worker = await self._get_idle_worker(
-            get_config().worker_register_timeout_s, spec.get("runtime_env")
-        )
+        try:
+            worker = await self._get_idle_worker(
+                get_config().worker_register_timeout_s, spec.get("runtime_env")
+            )
+        except Exception as e:
+            worker = None
+            reason = f"worker start failed: {e}"
+        else:
+            reason = "no worker available"
         if worker is None:
             b = self._pg_bundles.get(key)
             if b is not None:
                 b["used"] = b["used"].subtract(request, allow_negative=True)
-            return {"granted": False, "reason": "no worker available"}
+            return {"granted": False, "reason": reason}
         worker.lease_resources = request
         worker.bundle_key = key
         worker.state = "dedicated" if p.get("dedicated") else "leased"

@@ -1,0 +1,33 @@
+"""Runtime-env application shared by the raylet worker pool and the job
+manager (reference ``python/ray/_private/runtime_env/``): env_vars merge
+(``None`` unsets) and working_dir with PYTHONPATH threading so spawned
+processes can still import ray_tpu from its source tree.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def package_root() -> str:
+    """Directory containing the ``ray_tpu`` package (the repo root)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def apply_runtime_env(env: dict, runtime_env: dict | None) -> str | None:
+    """Mutate ``env`` per ``runtime_env``; returns the working_dir to use
+    as the subprocess cwd (or None). Does not validate the directory —
+    callers decide whether a missing dir warns or fails."""
+    renv = runtime_env or {}
+    for key, value in (renv.get("env_vars") or {}).items():
+        if value is None:
+            env.pop(key, None)
+        else:
+            env[key] = str(value)
+    working_dir = renv.get("working_dir") or None
+    if working_dir is not None:
+        paths = [working_dir, package_root()]
+        if env.get("PYTHONPATH"):
+            paths.append(env["PYTHONPATH"])
+        env["PYTHONPATH"] = os.pathsep.join(paths)
+    return working_dir

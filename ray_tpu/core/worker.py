@@ -188,6 +188,7 @@ class CoreWorker:
         self.worker_id = worker_id or WorkerID.from_random().hex()
         self.job_id = job_id or JobID.from_int(1)
         self.io = EventLoopThread(f"raytpu-io-{mode}")
+        self.gcs_address = gcs_address
         self.gcs = RetryableRpcClient(gcs_address)
         self.raylet = RetryableRpcClient(raylet_address)
         self.raylet_address = raylet_address
