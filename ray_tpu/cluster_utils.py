@@ -12,6 +12,7 @@ failed health checks, as it would a crashed host.
 
 from __future__ import annotations
 
+import os
 import time
 
 from .core.config import get_config
@@ -26,11 +27,23 @@ class Cluster:
         initialize_head: bool = True,
         head_node_args: dict | None = None,
         _system_config: dict | None = None,
+        enable_gcs_ft: bool = False,
     ):
         if _system_config:
             get_config().apply_dict(_system_config)
         self._loop = EventLoopThread("raytpu-cluster")
-        self.gcs = GcsServer()
+        self._gcs_storage = None
+        self._gcs_ft_dir: str | None = None
+        if enable_gcs_ft:
+            import tempfile
+
+            from .core.gcs_storage import FileStorage
+
+            self._gcs_ft_dir = tempfile.mkdtemp(prefix="raytpu-gcs-ft-")
+            self._gcs_storage = FileStorage(
+                os.path.join(self._gcs_ft_dir, "gcs_tables.msgpack")
+            )
+        self.gcs = GcsServer(storage=self._gcs_storage)
         self._loop.run_sync(self.gcs.start())
         self.nodes: list[Raylet] = []
         self.head_node: Raylet | None = None
@@ -65,6 +78,19 @@ class Cluster:
         else:
             self._loop.run_sync(raylet.kill(), timeout=15)
 
+    def crash_gcs(self) -> None:
+        """Kill the GCS abruptly (no final snapshot flush) — reference
+        equivalent: SIGKILL the gcs_server process in FT tests."""
+        self._loop.run_sync(self.gcs.crash(), timeout=10)
+
+    def restart_gcs(self) -> None:
+        """Start a fresh GCS on the SAME port with the same storage; it
+        restores durable tables and raylets re-register on heartbeat.
+        Requires enable_gcs_ft=True for state to survive."""
+        port = self.gcs.port
+        self.gcs = GcsServer(port=port, storage=self._gcs_storage)
+        self._loop.run_sync(self.gcs.start())
+
     def wait_for_nodes(self, count: int, timeout: float = 30.0) -> None:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
@@ -96,3 +122,8 @@ class Cluster:
         except Exception:
             pass
         self._loop.stop()
+        if self._gcs_ft_dir is not None:
+            import shutil
+
+            shutil.rmtree(self._gcs_ft_dir, ignore_errors=True)
+            self._gcs_ft_dir = None

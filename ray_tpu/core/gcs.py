@@ -11,9 +11,11 @@ gcs_server.h:89``) composed of the same managers:
   * Publisher          — long-poll pub/sub (``src/ray/pubsub/publisher.h:300``)
   * HealthCheckManager — periodic raylet pings (``gcs_health_check_manager.h:61``)
 
-Storage is in-memory (the reference's default ``InMemoryStoreClient``); a
-Redis-style external backend can be slotted behind ``_kv`` later for GCS
-fault tolerance.
+Storage defaults to in-memory (the reference's ``InMemoryStoreClient``);
+with ``gcs_storage_backend=file`` the durable tables snapshot to disk
+(``gcs_storage.py``) and a restarted GCS recovers them — the raylets
+re-register on heartbeat, standing in for the reference's Redis-backed
+fault tolerance (``redis_store_client.h:107``).
 """
 
 from __future__ import annotations
@@ -78,10 +80,22 @@ class Publisher:
 
 
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, storage=None):
         self._server = RpcServer(host, port)
         self._server.register_service(self)
         self.publisher = Publisher()
+        # Fault tolerance (redis_store_client.h equivalent): durable tables
+        # snapshot through `storage`; a restarted GCS restores them and
+        # raylets re-register on their next heartbeat.
+        from .gcs_storage import MemoryStorage
+
+        self._storage = storage or MemoryStorage()
+        self._last_snapshot: bytes = b""
+        self._persist_task: asyncio.Task | None = None
+        # Every background coroutine (actor creation, PG scheduling) is
+        # tracked so crash()/stop() can cancel them — a "dead" GCS must not
+        # keep leasing workers on the shared test event loop (split-brain).
+        self._bg_tasks: set[asyncio.Task] = set()
         # node_id(hex) -> {address, resources{total,available,labels}, state,
         #                  last_heartbeat}
         self._nodes: dict[str, dict] = {}
@@ -104,14 +118,100 @@ class GcsServer:
         self._metrics: dict[str, tuple[float, list[dict]]] = {}  # worker -> (ts, snapshot)
 
     # ------------------------------------------------------------------ util
-    async def start(self) -> None:
-        await self._server.start()
-        self._health_task = spawn(self._health_check_loop())
+    def _spawn(self, coro) -> asyncio.Task:
+        task = spawn(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        return task
 
-    async def stop(self) -> None:
+    def _cancel_bg(self) -> None:
         if self._health_task:
             self._health_task.cancel()
+        if self._persist_task:
+            self._persist_task.cancel()
+        for task in list(self._bg_tasks):
+            task.cancel()
+
+    async def start(self) -> None:
+        self._restore()
+        await self._server.start()
+        self._health_task = spawn(self._health_check_loop())
+        self._persist_task = spawn(self._persist_loop())
+
+    async def stop(self) -> None:
+        self._cancel_bg()
+        self._flush()
         await self._server.stop()
+
+    async def crash(self) -> None:
+        """Die WITHOUT a final flush — simulates abrupt GCS process death
+        for fault-tolerance tests (only snapshots the persist loop already
+        wrote survive)."""
+        self._cancel_bg()
+        await self._server.stop(grace=0.0)
+
+    @property
+    def port(self) -> int:
+        return int(self.address.rsplit(":", 1)[1])
+
+    # -------------------------------------------------------- fault tolerance
+    def _tables(self) -> dict:
+        return {
+            "kv": self._kv,
+            "jobs": self._jobs,
+            "next_job": self._next_job,
+            "actors": self._actors,
+            "named_actors": self._named_actors,
+            "placement_groups": self._placement_groups,
+        }
+
+    def _flush(self) -> None:
+        """Snapshot the durable tables if they changed. Change detection by
+        comparing the packed blob — cheaper than instrumenting every
+        mutation site and can never miss one."""
+        if not self._storage.persistent:
+            return
+        from .gcs_storage import pack_tables
+
+        try:
+            blob = pack_tables(self._tables())
+            if blob != self._last_snapshot:
+                self._storage.save_blob(blob)
+                self._last_snapshot = blob
+        except Exception:
+            logger.exception("GCS table snapshot failed")
+
+    def _restore(self) -> None:
+        tables = self._storage.load()
+        if not tables:
+            return
+        self._kv = tables.get("kv", {})
+        self._jobs = tables.get("jobs", {})
+        self._next_job = tables.get("next_job", 1)
+        self._named_actors = tables.get("named_actors", {})
+        self._placement_groups = tables.get("placement_groups", {})
+        # Restored ALIVE actors keep their addresses — the processes are
+        # still running and clients reconnect transparently. Actors that
+        # were mid-creation or mid-restart lost their coroutine with the
+        # old GCS; their specs are durable, so creation is re-driven
+        # (reference gcs_actor_manager reconstruction on restart).
+        self._actors = tables.get("actors", {})
+        for record in self._actors.values():
+            if record["state"] in (PENDING_CREATION, RESTARTING):
+                self._spawn(self._create_actor(record))
+        for record in self._placement_groups.values():
+            if record["state"] == "PENDING":
+                self._spawn(self._schedule_pg_loop(record))
+        logger.info(
+            "GCS restored %d kv keys, %d actors, %d jobs, %d placement groups",
+            len(self._kv), len(self._actors), len(self._jobs),
+            len(self._placement_groups),
+        )
+
+    async def _persist_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.2)
+            self._flush()
 
     @property
     def address(self) -> str:
@@ -322,7 +422,7 @@ class GcsServer:
             "death_cause": "",
         }
         self._actors[actor_id] = record
-        spawn(self._create_actor(record))
+        self._spawn(self._create_actor(record))
         return {"actor_id": actor_id}
 
     async def _create_actor(self, record: dict) -> None:
@@ -380,6 +480,8 @@ class GcsServer:
                     await _return_lease(kill=True)
                     record["state"] = DEAD
                     record["death_cause"] = f"creation task failed: {reply['error']}"
+                    if record.get("name"):
+                        self._named_actors.pop(record["name"], None)
                     await self._publish_actor(record)
                     return
             except Exception as e:
@@ -483,7 +585,7 @@ class GcsServer:
             record["state"] = RESTARTING
             record["address"] = ""
             await self._publish_actor(record)
-            spawn(self._create_actor(record))
+            self._spawn(self._create_actor(record))
         else:
             record["state"] = DEAD
             record["death_cause"] = reason
@@ -503,7 +605,7 @@ class GcsServer:
             "name": p.get("name", ""),
         }
         self._placement_groups[pg_id] = record
-        spawn(self._schedule_pg_loop(record))
+        self._spawn(self._schedule_pg_loop(record))
         return {"pg_id": pg_id, "state": record["state"]}
 
     async def _schedule_pg_loop(self, record: dict) -> None:

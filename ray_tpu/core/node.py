@@ -39,7 +39,9 @@ class Node:
         self.services_loop = EventLoopThread("raytpu-services")
         self.gcs: GcsServer | None = None
         if head:
-            self.gcs = GcsServer()
+            from .gcs_storage import storage_from_config
+
+            self.gcs = GcsServer(storage=storage_from_config(self.session_dir))
             self.services_loop.run_sync(self.gcs.start())
             gcs_address = self.gcs.address
         assert gcs_address is not None

@@ -190,18 +190,38 @@ class Raylet:
         while True:
             await asyncio.sleep(cfg.health_check_period_ms / 1000.0)
             try:
-                await self._gcs.call(
+                reply = await self._gcs.call(
                     "Heartbeat",
                     {"node_id": self.node_id.hex(), "resources": self.resources.to_dict()},
                     timeout=5.0,
                 )
+                if reply.get("unknown"):
+                    # The GCS restarted and lost the node table: re-register
+                    # (gcs_client reconnection path in the reference).
+                    logger.info("GCS does not know us — re-registering node %s",
+                                self.node_id.hex()[:8])
+                    await self._gcs.call(
+                        "RegisterNode",
+                        {
+                            "node_id": self.node_id.hex(),
+                            "address": self.address,
+                            "object_store_path": self.store_path,
+                            "object_store_capacity": self.object_store_capacity,
+                            "resources": self.resources.to_dict(),
+                        },
+                        timeout=10.0,
+                    )
                 await self._refresh_node_table()
             except Exception:
                 pass
 
     async def _worker_monitor_loop(self) -> None:
         """Detect worker process exits (reference: raylet detects via
-        socket close; we poll pids)."""
+        socket close; we poll pids). Actor-death reports that fail (e.g.
+        the GCS is down) are queued and retried — a death observed during
+        a GCS outage must still reach the restarted GCS, or the restored
+        record stays ALIVE forever."""
+        pending_deaths: list[dict] = []
         while True:
             await asyncio.sleep(0.2)
             for w in list(self._workers.values()):
@@ -209,14 +229,17 @@ class Raylet:
                     prev_state = w.state
                     self._on_worker_dead(w)
                     if prev_state == "dedicated" and w.actor_id:
-                        try:
-                            await self._gcs.call(
-                                "ReportActorDeath",
-                                {"actor_id": w.actor_id, "reason": f"worker process exited with code {w.proc.returncode}"},
-                                timeout=5.0,
-                            )
-                        except Exception:
-                            pass
+                        pending_deaths.append({
+                            "actor_id": w.actor_id,
+                            "reason": f"worker process exited with code {w.proc.returncode}",
+                        })
+            still_pending = []
+            for report in pending_deaths:
+                try:
+                    await self._gcs.call("ReportActorDeath", report, timeout=5.0)
+                except Exception:
+                    still_pending.append(report)
+            pending_deaths = still_pending
 
     def _release_lease(self, w: WorkerHandle) -> None:
         if w.lease_resources.is_empty():
