@@ -1,0 +1,22 @@
+"""Autoscaler: demand-driven reconciliation of TPU worker pools.
+
+Equivalent of the reference's autoscaler v2
+(``python/ray/autoscaler/v2/scheduler.py:624`` ResourceDemandScheduler +
+``instance_manager``): pending lease shapes (reported by raylets in
+heartbeats), unplaceable placement groups, and explicit
+``request_resources`` floors are bin-packed against live capacity; the
+shortfall launches typed nodes through a NodeProvider, and idle nodes
+above ``min_workers`` are terminated after a timeout.
+"""
+
+from .autoscaler import Autoscaler, NodeTypeConfig
+from .node_provider import LocalNodeProvider, NodeProvider
+from .sdk import request_resources
+
+__all__ = [
+    "Autoscaler",
+    "NodeTypeConfig",
+    "NodeProvider",
+    "LocalNodeProvider",
+    "request_resources",
+]

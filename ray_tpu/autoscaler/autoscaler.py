@@ -1,0 +1,250 @@
+"""The reconciler: demand + capacity -> launch/terminate decisions.
+
+Equivalent of the reference's
+``autoscaler/v2/scheduler.py:624`` (ResourceDemandScheduler.schedule):
+each round it
+  1. reads live nodes (+ per-node pending lease shapes) from the GCS,
+  2. gathers demand: pending shapes, PENDING/INFEASIBLE placement-group
+     bundles, and the ``request_resources`` floor,
+  3. first-fit bin-packs demand onto current AVAILABLE capacity,
+  4. launches the cheapest node type that fits each unmet shape (bounded
+     by ``max_workers``),
+  5. terminates nodes idle past ``idle_timeout_s`` (bounded by
+     ``min_workers``).
+Deliberately synchronous and stateless between rounds (modulo launch
+cooldown): every decision is derivable from cluster state, as in v2's
+instance-manager reconciler.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .node_provider import NodeProvider
+from .sdk import REQUEST_KEY, get_requested_resources
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class NodeTypeConfig:
+    name: str
+    resources: dict
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+@dataclass
+class _Decision:
+    launch: list[str] = field(default_factory=list)      # node type names
+    terminate: list[str] = field(default_factory=list)   # instance ids
+
+
+def _fits(shape: dict, available: dict) -> bool:
+    return all(available.get(k, 0.0) >= v for k, v in shape.items() if v > 0)
+
+
+def _consume(shape: dict, available: dict) -> None:
+    for k, v in shape.items():
+        available[k] = available.get(k, 0.0) - v
+
+
+class Autoscaler:
+    def __init__(
+        self,
+        gcs_call,
+        provider: NodeProvider,
+        node_types: list[NodeTypeConfig],
+        *,
+        idle_timeout_s: float = 5.0,
+        launch_cooldown_s: float = 1.0,
+    ):
+        """``gcs_call(method, payload) -> dict`` — a synchronous GCS RPC
+        (the driver worker's `_gcs_call` or a Cluster-loop closure)."""
+        self._gcs_call = gcs_call
+        self.provider = provider
+        self.node_types = {t.name: t for t in node_types}
+        self.idle_timeout_s = idle_timeout_s
+        self.launch_cooldown_s = launch_cooldown_s
+        self._idle_since: dict[str, float] = {}  # instance_id -> ts
+        self._last_launch = 0.0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self, period_s: float = 0.5) -> None:
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.reconcile_once()
+                except Exception:
+                    logger.exception("autoscaler reconcile failed")
+                self._stop.wait(period_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True, name="autoscaler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------- one round
+    def _collect_demand(self, nodes: list[dict]) -> list[dict]:
+        demand: list[dict] = []
+        for node in nodes:
+            if node.get("state") != "ALIVE":
+                continue
+            for entry in node.get("pending_demand") or []:
+                demand.extend([dict(entry["shape"])] * int(entry["count"]))
+        # Unplaced placement groups: every bundle is a demand shape.
+        pgs = self._gcs_call("ListPlacementGroups", {}).get("placement_groups", [])
+        for pg in pgs:
+            if pg.get("state") in ("PENDING", "INFEASIBLE"):
+                demand.extend([dict(b) for b in pg.get("bundles", [])])
+        return demand
+
+    def _capacity_views(self, nodes: list[dict]):
+        available, total = [], []
+        for node in nodes:
+            if node.get("state") != "ALIVE":
+                continue
+            res = node.get("resources") or {}
+            available.append(dict(res.get("available") or {}))
+            total.append(dict(res.get("total") or {}))
+        return available, total
+
+    def reconcile_once(self) -> _Decision:
+        nodes = self._gcs_call("GetAllNodes", {}).get("nodes", [])
+        decision = _Decision()
+
+        demand = self._collect_demand(nodes)
+        available, total = self._capacity_views(nodes)
+
+        # Explicit floor: bundles that must fit in TOTAL capacity.
+        floor = get_requested_resources(
+            lambda key: self._gcs_call("KvGet", {"key": key}).get("value")
+        )
+        floor_unmet = []
+        total_copy = [dict(t) for t in total]
+        for bundle in floor:
+            for cap in total_copy:
+                if _fits(bundle, cap):
+                    _consume(bundle, cap)
+                    break
+            else:
+                floor_unmet.append(bundle)
+
+        # Load demand: bundles that must fit in AVAILABLE capacity.
+        unmet = list(floor_unmet)
+        for shape in demand:
+            for cap in available:
+                if _fits(shape, cap):
+                    _consume(shape, cap)
+                    break
+            else:
+                unmet.append(shape)
+
+        # Launch for unmet shapes (respecting per-type max and cooldown).
+        if unmet and time.time() - self._last_launch >= self.launch_cooldown_s:
+            counts: dict[str, int] = {}
+            for t in self.provider.non_terminated_nodes().values():
+                counts[t] = counts.get(t, 0) + 1
+            pending_capacity: list[dict] = []
+            for shape in unmet:
+                placed = False
+                for cap in pending_capacity:  # a node just decided on may absorb more
+                    if _fits(shape, cap):
+                        _consume(shape, cap)
+                        placed = True
+                        break
+                if placed:
+                    continue
+                for t in self.node_types.values():
+                    if counts.get(t.name, 0) + decision.launch.count(t.name) >= t.max_workers:
+                        continue
+                    if _fits(shape, dict(t.resources)):
+                        decision.launch.append(t.name)
+                        cap = dict(t.resources)
+                        _consume(shape, cap)
+                        pending_capacity.append(cap)
+                        placed = True
+                        break
+                if not placed:
+                    logger.warning("autoscaler: no node type fits shape %s", shape)
+            for name in decision.launch:
+                self.provider.create_node(name, self.node_types[name].resources)
+            if decision.launch:
+                self._last_launch = time.time()
+                logger.info("autoscaler launched: %s", decision.launch)
+
+        # min_workers floor: keep at least min_workers of each type.
+        # (provider counts already include this round's launches)
+        counts = {}
+        for t in self.provider.non_terminated_nodes().values():
+            counts[t] = counts.get(t, 0) + 1
+        for t in self.node_types.values():
+            for _ in range(t.min_workers - counts.get(t.name, 0)):
+                self.provider.create_node(t.name, t.resources)
+                decision.launch.append(t.name)
+
+        # Idle termination with per-node busy tracking: a node's timer only
+        # resets when THAT node is busy — unrelated trickle load elsewhere
+        # must not immortalize an idle node. Nodes holding the
+        # request_resources floor are exempt.
+        node_by_id = {n["node_id"]: n for n in nodes if n.get("state") == "ALIVE"}
+        counts = {}
+        for t in self.provider.non_terminated_nodes().values():
+            counts[t] = counts.get(t, 0) + 1
+        floor_held = self._floor_held_instances(floor, node_by_id)
+        now = time.time()
+        for iid, type_name in list(self.provider.non_terminated_nodes().items()):
+            node = node_by_id.get(self.provider.node_id_of(iid))
+            if node is None:
+                continue
+            res = node.get("resources") or {}
+            avail, tot = res.get("available") or {}, res.get("total") or {}
+            busy = any(avail.get(k, 0.0) < v for k, v in tot.items()) or (
+                node.get("pending_demand") or []
+            )
+            if busy:
+                self._idle_since.pop(iid, None)
+                continue
+            first_idle = self._idle_since.setdefault(iid, now)
+            if unmet:
+                continue  # capacity crunch: don't shrink (timers keep running)
+            cfg = self.node_types.get(type_name)
+            if (
+                cfg is not None
+                and iid not in floor_held
+                and counts.get(type_name, 0) > cfg.min_workers
+                and now - first_idle >= self.idle_timeout_s
+            ):
+                logger.info("autoscaler terminating idle node %s (%s)", iid, type_name)
+                self.provider.terminate_node(iid)
+                self._idle_since.pop(iid, None)
+                counts[type_name] -= 1
+        return decision
+
+    def _floor_held_instances(self, floor: list[dict], node_by_id: dict) -> set[str]:
+        """Greedy-pack the request_resources floor onto provider nodes:
+        every node that absorbs a floor bundle is exempt from idle
+        termination (else the floor churns launch/terminate forever)."""
+        held: set[str] = set()
+        if not floor:
+            return held
+        remaining = [dict(b) for b in floor]
+        for iid in self.provider.non_terminated_nodes():
+            node = node_by_id.get(self.provider.node_id_of(iid))
+            if node is None:
+                continue
+            cap = dict((node.get("resources") or {}).get("total") or {})
+            for bundle in list(remaining):
+                if _fits(bundle, cap):
+                    _consume(bundle, cap)
+                    remaining.remove(bundle)
+                    held.add(iid)
+        return held

@@ -1,0 +1,66 @@
+"""NodeProvider: the cloud-side interface the reconciler drives.
+
+Equivalent of the reference's ``python/ray/autoscaler/node_provider.py``
+(create/terminate/list). A real TPU deployment implements this against
+its pod/VM API (e.g. GKE or queued resources); ``LocalNodeProvider``
+backs it with in-process raylets on the Cluster harness so autoscaling
+is testable end-to-end — launched "nodes" really join the GCS and run
+work.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class NodeProvider:
+    def create_node(self, node_type: str, resources: dict) -> str:
+        """Launch a node of `node_type`; returns a provider instance id."""
+        raise NotImplementedError
+
+    def terminate_node(self, instance_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> dict[str, str]:
+        """instance_id -> node_type for nodes this provider launched."""
+        raise NotImplementedError
+
+    def node_id_of(self, instance_id: str) -> str | None:
+        """Cluster node id (hex) for a launched instance, once known."""
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Launch raylets on a ``cluster_utils.Cluster`` (the harness plays the
+    role of the cloud API)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self._lock = threading.Lock()
+        self._instances: dict[str, dict] = {}  # instance_id -> {type, raylet}
+        self._counter = 0
+
+    def create_node(self, node_type: str, resources: dict) -> str:
+        res = dict(resources)
+        num_cpus = res.pop("CPU", 0)
+        raylet = self.cluster.add_node(wait=False, num_cpus=num_cpus, resources=res)
+        with self._lock:
+            self._counter += 1
+            iid = f"local-{node_type}-{self._counter}"
+            self._instances[iid] = {"type": node_type, "raylet": raylet}
+        return iid
+
+    def terminate_node(self, instance_id: str) -> None:
+        with self._lock:
+            inst = self._instances.pop(instance_id, None)
+        if inst is not None:
+            self.cluster.remove_node(inst["raylet"], allow_graceful=True)
+
+    def non_terminated_nodes(self) -> dict[str, str]:
+        with self._lock:
+            return {iid: inst["type"] for iid, inst in self._instances.items()}
+
+    def node_id_of(self, instance_id: str) -> str | None:
+        with self._lock:
+            inst = self._instances.get(instance_id)
+        return inst["raylet"].node_id.hex() if inst else None

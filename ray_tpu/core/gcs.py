@@ -241,6 +241,13 @@ class GcsServer:
         }
         await self.publisher.publish("node", {"node_id": node_id, "state": "ALIVE"})
         logger.info("Node %s registered at %s", node_id[:8], p["address"])
+        # New capacity invalidates INFEASIBLE verdicts: re-run scheduling
+        # for groups that timed out waiting (the autoscaler may have
+        # launched this node precisely for them).
+        for record in self._placement_groups.values():
+            if record["state"] == "INFEASIBLE":
+                record["state"] = "PENDING"
+                self._spawn(self._schedule_pg_loop(record))
         return {"node_id": node_id}
 
     async def handle_Heartbeat(self, p: dict) -> dict:
@@ -250,6 +257,7 @@ class GcsServer:
         node["last_heartbeat"] = time.time()
         if "resources" in p and p["resources"]:
             node["resources"] = p["resources"]
+        node["pending_demand"] = p.get("pending_demand", [])
         return {}
 
     async def handle_GetAllNodes(self, p: dict) -> dict:

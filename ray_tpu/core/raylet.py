@@ -129,6 +129,10 @@ class Raylet:
         # Outstanding pin_read store refs per reader (worker_id), released
         # in bulk if the reader dies mid-read.
         self._read_refs: dict[str, dict[bytes, int]] = {}
+        # Resource shapes of lease requests currently waiting for capacity,
+        # reported in heartbeats as autoscaler demand (reference: resource
+        # load in raylet heartbeats feeding autoscaler/v2).
+        self._pending_lease_demand: dict[tuple, int] = {}
         # Unsealed creations per creator worker, force-deleted if the creator
         # dies between PlasmaCreate and PlasmaSeal (else the creator ref
         # leaks the arena bytes forever).
@@ -192,7 +196,14 @@ class Raylet:
             try:
                 reply = await self._gcs.call(
                     "Heartbeat",
-                    {"node_id": self.node_id.hex(), "resources": self.resources.to_dict()},
+                    {
+                        "node_id": self.node_id.hex(),
+                        "resources": self.resources.to_dict(),
+                        "pending_demand": [
+                            {"shape": dict(shape), "count": count}
+                            for shape, count in self._pending_lease_demand.items()
+                        ],
+                    },
                     timeout=5.0,
                 )
                 if reply.get("unknown"):
@@ -390,6 +401,26 @@ class Raylet:
             if not fut.done():
                 fut.set_result(True)
 
+    def _track_demand(self, request: ResourceSet):
+        """Count this request's shape in `_pending_lease_demand` for the
+        scope of a wait (heartbeats report it as autoscaler demand)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def scope():
+            shape = tuple(sorted(request.to_dict().items()))
+            self._pending_lease_demand[shape] = self._pending_lease_demand.get(shape, 0) + 1
+            try:
+                yield
+            finally:
+                left = self._pending_lease_demand.get(shape, 1) - 1
+                if left > 0:
+                    self._pending_lease_demand[shape] = left
+                else:
+                    self._pending_lease_demand.pop(shape, None)
+
+        return scope()
+
     # ---------------------------------------------------------- lease service
     async def handle_RequestWorkerLease(self, p: dict) -> dict:
         """ClusterTaskManager::QueueAndScheduleTask equivalent
@@ -440,17 +471,18 @@ class Raylet:
             if grant_only_local:
                 return {"granted": False, "reason": "infeasible on this node"}
             # Infeasible locally: wait (bounded) for a feasible peer — the
-            # node table may be stale or a node may be joining (reference:
-            # infeasible tasks queue until the cluster changes).
+            # node table may be stale, a node may be joining, or the
+            # autoscaler may launch one for the demand we report here.
             deadline = time.monotonic() + get_config().worker_register_timeout_s
-            while True:
-                await self._refresh_node_table()
-                node = self._pick_remote_node(request)
-                if node is not None:
-                    return {"spillback": True, "node_address": node["address"], "node_id": node["node_id"]}
-                if time.monotonic() > deadline:
-                    return {"granted": False, "reason": "infeasible everywhere"}
-                await asyncio.sleep(0.5)
+            with self._track_demand(request):
+                while True:
+                    await self._refresh_node_table()
+                    node = self._pick_remote_node(request)
+                    if node is not None:
+                        return {"spillback": True, "node_address": node["address"], "node_id": node["node_id"]}
+                    if time.monotonic() > deadline:
+                        return {"granted": False, "reason": "infeasible everywhere"}
+                    await asyncio.sleep(0.5)
 
         # Spillback decision before queuing (hybrid policy): if we cannot fit
         # now but another node can, send the lease there.
@@ -462,18 +494,27 @@ class Raylet:
         # Reserve resources BEFORE any await so concurrent lease handlers
         # can't double-acquire (LocalResourceManager semantics).
         deadline = time.monotonic() + get_config().worker_register_timeout_s
-        while True:
-            if self.resources.can_fit(request):
-                self.resources.acquire(request)
-                break
-            if time.monotonic() > deadline:
-                return {"granted": False, "reason": "timed out waiting for resources"}
-            fut: asyncio.Future = asyncio.get_running_loop().create_future()
-            self._lease_waiters.append(fut)
-            try:
-                await asyncio.wait_for(fut, 0.5)
-            except asyncio.TimeoutError:
-                pass
+        import contextlib
+
+        with contextlib.ExitStack() as demand_scope:
+            waiting = False
+            while True:
+                if self.resources.can_fit(request):
+                    self.resources.acquire(request)
+                    break
+                if time.monotonic() > deadline:
+                    return {"granted": False, "reason": "timed out waiting for resources"}
+                if not waiting:
+                    # Register demand lazily: only requests that actually
+                    # wait should show up in autoscaler heartbeats.
+                    waiting = True
+                    demand_scope.enter_context(self._track_demand(request))
+                fut: asyncio.Future = asyncio.get_running_loop().create_future()
+                self._lease_waiters.append(fut)
+                try:
+                    await asyncio.wait_for(fut, 0.5)
+                except asyncio.TimeoutError:
+                    pass
 
         try:
             worker = await self._get_idle_worker(
