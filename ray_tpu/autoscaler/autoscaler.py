@@ -71,6 +71,13 @@ class Autoscaler:
         self.launch_cooldown_s = launch_cooldown_s
         self._idle_since: dict[str, float] = {}  # instance_id -> ts
         self._last_launch = 0.0
+        # Launched instances not yet registered with the GCS: their
+        # capacity counts during bin-packing so slow node boots don't
+        # trigger a re-launch storm (reference: instance-manager pending
+        # instances). Entries expire after `boot_timeout_s`.
+        self._pending_launches: dict[str, tuple[str, float]] = {}  # iid -> (type, ts)
+        self.boot_timeout_s = 120.0
+        self._warned_unfittable: set = set()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -117,12 +124,37 @@ class Autoscaler:
             total.append(dict(res.get("total") or {}))
         return available, total
 
+    def _type_counts(self) -> dict[str, int]:
+        counts: dict[str, int] = {}
+        for t in self.provider.non_terminated_nodes().values():
+            counts[t] = counts.get(t, 0) + 1
+        return counts
+
+    def _fits_some_type(self, shape: dict) -> bool:
+        return any(_fits(shape, dict(t.resources)) for t in self.node_types.values())
+
+    def _expire_pending_launches(self, nodes: list[dict]) -> None:
+        registered = {n["node_id"] for n in nodes if n.get("state") == "ALIVE"}
+        now = time.time()
+        for iid, (_type, ts) in list(self._pending_launches.items()):
+            if self.provider.node_id_of(iid) in registered or now - ts > self.boot_timeout_s:
+                self._pending_launches.pop(iid, None)
+
     def reconcile_once(self) -> _Decision:
         nodes = self._gcs_call("GetAllNodes", {}).get("nodes", [])
         decision = _Decision()
 
+        self._expire_pending_launches(nodes)
         demand = self._collect_demand(nodes)
         available, total = self._capacity_views(nodes)
+        # Booting nodes count as capacity (they haven't registered yet),
+        # else every reconcile round until registration re-launches for
+        # the same demand.
+        for _iid, (type_name, _ts) in self._pending_launches.items():
+            cfg = self.node_types.get(type_name)
+            if cfg is not None:
+                available.append(dict(cfg.resources))
+                total.append(dict(cfg.resources))
 
         # Explicit floor: bundles that must fit in TOTAL capacity.
         floor = get_requested_resources(
@@ -148,11 +180,23 @@ class Autoscaler:
             else:
                 unmet.append(shape)
 
+        # Shapes no node type can EVER satisfy are hopeless, not pending:
+        # drop them from `unmet` (warn once per shape) so they can't
+        # immortalize idle nodes via the scale-down guard below.
+        satisfiable = []
+        for shape in unmet:
+            if self._fits_some_type(shape):
+                satisfiable.append(shape)
+            else:
+                key = tuple(sorted(shape.items()))
+                if key not in self._warned_unfittable:
+                    self._warned_unfittable.add(key)
+                    logger.warning("autoscaler: no node type fits shape %s — ignoring", shape)
+        unmet = satisfiable
+
         # Launch for unmet shapes (respecting per-type max and cooldown).
         if unmet and time.time() - self._last_launch >= self.launch_cooldown_s:
-            counts: dict[str, int] = {}
-            for t in self.provider.non_terminated_nodes().values():
-                counts[t] = counts.get(t, 0) + 1
+            counts = self._type_counts()
             pending_capacity: list[dict] = []
             for shape in unmet:
                 placed = False
@@ -174,21 +218,21 @@ class Autoscaler:
                         placed = True
                         break
                 if not placed:
-                    logger.warning("autoscaler: no node type fits shape %s", shape)
+                    pass  # at max_workers for every fitting type: wait
             for name in decision.launch:
-                self.provider.create_node(name, self.node_types[name].resources)
+                iid = self.provider.create_node(name, self.node_types[name].resources)
+                self._pending_launches[iid] = (name, time.time())
             if decision.launch:
                 self._last_launch = time.time()
                 logger.info("autoscaler launched: %s", decision.launch)
 
         # min_workers floor: keep at least min_workers of each type.
         # (provider counts already include this round's launches)
-        counts = {}
-        for t in self.provider.non_terminated_nodes().values():
-            counts[t] = counts.get(t, 0) + 1
+        counts = self._type_counts()
         for t in self.node_types.values():
             for _ in range(t.min_workers - counts.get(t.name, 0)):
-                self.provider.create_node(t.name, t.resources)
+                iid = self.provider.create_node(t.name, t.resources)
+                self._pending_launches[iid] = (t.name, time.time())
                 decision.launch.append(t.name)
 
         # Idle termination with per-node busy tracking: a node's timer only
@@ -196,9 +240,7 @@ class Autoscaler:
         # must not immortalize an idle node. Nodes holding the
         # request_resources floor are exempt.
         node_by_id = {n["node_id"]: n for n in nodes if n.get("state") == "ALIVE"}
-        counts = {}
-        for t in self.provider.non_terminated_nodes().values():
-            counts[t] = counts.get(t, 0) + 1
+        counts = self._type_counts()
         floor_held = self._floor_held_instances(floor, node_by_id)
         now = time.time()
         for iid, type_name in list(self.provider.non_terminated_nodes().items()):

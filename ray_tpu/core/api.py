@@ -254,6 +254,13 @@ class ActorMethod:
     def options(self, num_returns: int = 1) -> "ActorMethod":
         return ActorMethod(self._handle, self._name, num_returns)
 
+    def bind(self, *args, **kwargs):
+        """Build a compiled-graph node instead of submitting now
+        (reference ``dag/class_node.py`` ClassMethodNode)."""
+        from ..dag.nodes import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
+
 
 class ActorHandle:
     """Reference: actor.py:1273. Pickles to the actor id; any process with
@@ -271,6 +278,10 @@ class ActorHandle:
             pass
 
     def __getattr__(self, item: str) -> ActorMethod:
+        if item == "__ray_call__":
+            # Internal: run a shipped function on the actor (compiled DAGs
+            # install their executor loops through this).
+            return ActorMethod(self, item)
         if item.startswith("_"):
             raise AttributeError(item)
         return ActorMethod(self, item)

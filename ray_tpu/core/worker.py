@@ -19,6 +19,7 @@ worker (as in the reference).
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import inspect
 import logging
@@ -1229,7 +1230,14 @@ class CoreWorker:
             if spec.kind == TASK_KIND_ACTOR_TASK:
                 if self.actor_instance is None:
                     return {"error": "actor instance not initialized"}
-                method = getattr(self.actor_instance, spec.actor_method)
+                if spec.actor_method == "__ray_call__":
+                    # Internal escape hatch (reference: actor __ray_call__):
+                    # run a shipped function with the instance as first arg.
+                    # Compiled DAGs use it to install their executor loop.
+                    fn, args = args[0], args[1:]
+                    method = functools.partial(fn, self.actor_instance)
+                else:
+                    method = getattr(self.actor_instance, spec.actor_method)
                 sem = self._actor_sem
                 if sem is not None:
                     with sem:

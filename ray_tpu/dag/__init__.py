@@ -1,0 +1,17 @@
+"""Compiled graphs: pre-wired actor pipelines over mutable shm channels.
+
+Equivalent of the reference's accelerated DAGs
+(``python/ray/dag/compiled_dag_node.py:795`` + experimental mutable-
+object channels): build a DAG with ``actor.method.bind(...)``, compile
+it once, then ``execute()`` repeatedly with NO per-call task submission
+— each actor runs a resident executor loop that spins on its input
+channels, so steady-state latency is channel write + compute + channel
+read. The channel is a seqlock'd mmap in /dev/shm (``channel.py``)
+standing in for the reference's versioned mutable plasma objects.
+"""
+
+from .channel import Channel
+from .compiled import CompiledDAG
+from .nodes import ClassMethodNode, InputNode, MultiOutputNode
+
+__all__ = ["Channel", "CompiledDAG", "ClassMethodNode", "InputNode", "MultiOutputNode"]

@@ -1,0 +1,99 @@
+"""Mutable single-writer channel over an mmap'd /dev/shm file.
+
+Equivalent of the reference's mutable-object channels
+(``src/ray/core_worker/experimental_mutable_object_manager.h``): a
+fixed-capacity buffer a writer overwrites in place, readers follow a
+sequence counter. Layout:
+
+    [u64 seq][u64 len][payload ... capacity]
+
+``seq`` is odd WHILE a write is in progress (seqlock): readers that see
+an odd seq, or whose second seq read differs from the first, retry — so
+a torn read is impossible without any cross-process lock. A ``len`` of
+``STOP`` tears the channel down (executor loops exit).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import time
+
+_HEADER = struct.Struct("<QQ")
+STOP = 0xFFFFFFFFFFFFFFFF
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class Channel:
+    def __init__(self, path: str, capacity: int, create: bool = False):
+        self.path = path
+        self.capacity = capacity
+        total = _HEADER.size + capacity
+        if create:
+            fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+            os.ftruncate(fd, total)
+        else:
+            fd = os.open(path, os.O_RDWR)
+        self._fd = fd
+        self._mm = mmap.mmap(fd, total)
+        self._view = memoryview(self._mm)
+
+    # ------------------------------------------------------------------ write
+    def write(self, payload: bytes) -> None:
+        if len(payload) > self.capacity:
+            raise ValueError(
+                f"message of {len(payload)} bytes exceeds channel capacity "
+                f"{self.capacity} (raise max_buffer_size at compile time)"
+            )
+        seq, _ = _HEADER.unpack_from(self._view, 0)
+        _HEADER.pack_into(self._view, 0, seq + 1, len(payload))  # odd: in progress
+        self._view[_HEADER.size : _HEADER.size + len(payload)] = payload
+        _HEADER.pack_into(self._view, 0, seq + 2, len(payload))  # even: committed
+
+    def close_writer(self) -> None:
+        # Same two-phase seqlock as write(): a reader must never observe
+        # the new seq paired with the old length (it would re-consume the
+        # final payload and skip the STOP forever).
+        seq, length = _HEADER.unpack_from(self._view, 0)
+        _HEADER.pack_into(self._view, 0, seq + 1, length)  # odd: in progress
+        _HEADER.pack_into(self._view, 0, seq + 2, STOP)
+
+    # ------------------------------------------------------------------- read
+    def read(self, last_seq: int, timeout: float | None = None) -> tuple[bytes, int]:
+        """Block (spin) until a version newer than ``last_seq`` commits;
+        returns (payload, seq). Raises ChannelClosed on teardown."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 1e-5
+        while True:
+            seq, length = _HEADER.unpack_from(self._view, 0)
+            if seq % 2 == 0 and seq > last_seq:
+                if length == STOP:
+                    raise ChannelClosed(self.path)
+                payload = bytes(self._view[_HEADER.size : _HEADER.size + length])
+                seq2, _ = _HEADER.unpack_from(self._view, 0)
+                if seq2 == seq:
+                    return payload, seq
+                continue  # torn read: writer advanced mid-copy
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel {self.path} idle past {timeout}s")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.001)
+
+    def close(self) -> None:
+        try:
+            self._view.release()
+            self._mm.close()
+            os.close(self._fd)
+        except Exception:
+            pass
+
+    def unlink(self) -> None:
+        self.close()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass

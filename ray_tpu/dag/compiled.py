@@ -1,0 +1,310 @@
+"""DAG compilation + the resident per-actor executor loop.
+
+Reference ``python/ray/dag/compiled_dag_node.py:795`` (CompiledDAG):
+compile() walks the graph, allocates one channel per producing node,
+and installs a loop on every participating actor via ``__ray_call__``.
+``execute()`` is then a channel write + channel read — zero task
+submissions at steady state. Errors serialize through the channels and
+re-raise at the driver; ``teardown()`` closes the input channels, which
+cascades ChannelClosed through every loop.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import tempfile
+import uuid
+
+from ..core import serialization
+from ..core.status import RayTaskError
+from .channel import Channel, ChannelClosed
+from .nodes import ClassMethodNode, DAGNode, InputNode, MultiOutputNode
+
+# Channel payload = [u32 meta_len][meta][blob] using the core serializer,
+# so DAG values get the same encoding (and error framing) as every other
+# object in the system — one format, not two.
+_LEN = struct.Struct("<I")
+
+
+def _pack(value) -> bytes:
+    meta, blob, _ = serialization.serialize(value)
+    return _LEN.pack(len(meta)) + meta + bytes(blob)
+
+
+def _pack_error(error: BaseException) -> bytes:
+    meta, blob, _ = serialization.serialize_error(error)
+    return _LEN.pack(len(meta)) + meta + bytes(blob)
+
+
+def _unpack(payload: bytes):
+    n = _LEN.unpack_from(payload)[0]
+    meta = bytes(payload[_LEN.size : _LEN.size + n])
+    value = serialization.deserialize(meta, payload[_LEN.size + n :])
+    return value, meta == serialization.META_ERROR
+
+
+def _actor_loop(instance, method_name: str, in_specs: list, out_path: str,
+                capacity: int) -> str:
+    """Runs ON the actor (shipped via __ray_call__): spin on input
+    channels, apply the bound method, write the result. ``in_specs`` is a
+    list of ("chan", path) / ("const", value) in positional order."""
+    channels = {
+        path: Channel(path, capacity) for kind, path in in_specs if kind == "chan"
+    }
+    # Readiness marker: compile() blocks until every loop has one, so
+    # execute() timeouts never race actor-creation latency.
+    with open(out_path + ".ready", "w") as f:
+        f.write("1")
+    out = Channel(out_path, capacity)
+    cursors = {path: 0 for path in channels}
+    method = getattr(instance, method_name)
+    try:
+        while True:
+            args, upstream_error = [], None
+            for kind, item in in_specs:
+                if kind == "const":
+                    args.append(item)
+                    continue
+                payload, seq = channels[item].read(cursors[item])
+                cursors[item] = seq
+                value, is_error = _unpack(payload)
+                if is_error and upstream_error is None:
+                    upstream_error = value
+                args.append(value)
+            if upstream_error is not None:
+                out.write(_pack_error(upstream_error))
+                continue
+            try:
+                result = method(*args)
+                payload = _pack(result)  # inside try: unpicklable results
+                if len(payload) > capacity:
+                    raise ValueError(
+                        f"{method_name} result of {len(payload)} bytes exceeds "
+                        f"channel capacity {capacity}; raise max_buffer_size"
+                    )
+            except Exception as e:  # serialize through the pipe, keep looping
+                import traceback
+
+                payload = _pack_error(RayTaskError(method_name, traceback.format_exc(), e))
+            out.write(payload)
+    except ChannelClosed:
+        out.close_writer()  # cascade teardown downstream
+        return "closed"
+    finally:
+        for ch in channels.values():
+            ch.close()
+        out.close()
+
+
+class CompiledDAG:
+    def __init__(self, output_node: DAGNode, max_buffer_size: int = 1 << 20):
+        self.capacity = max_buffer_size
+        self._dir: str | None = None
+        self._input_node: InputNode | None = None
+        self._outputs: list[ClassMethodNode] = []
+        self._loop_refs = []
+        self._channels: dict[int, str] = {}  # id(node) -> channel path
+        self._torn_down = False
+
+        if isinstance(output_node, MultiOutputNode):
+            self._outputs = list(output_node.outputs)
+        else:
+            self._outputs = [output_node]
+        for out in self._outputs:
+            if not isinstance(out, ClassMethodNode):
+                raise TypeError("DAG outputs must be actor method nodes")
+
+        # Validate the whole graph BEFORE allocating anything in /dev/shm —
+        # a rejected compile must not leak RAM-backed files.
+        order = self._toposort()
+        if self._input_node is None:
+            raise ValueError("compiled DAG needs an InputNode")
+        # One node per actor: each node parks a never-returning executor
+        # task on its actor, so a second node on the same actor could never
+        # start (max_concurrency=1 sequencing) — reject early instead of
+        # hanging compile.
+        seen_actors: dict[bytes, str] = {}
+        for node in order:
+            if not isinstance(node, ClassMethodNode):
+                continue
+            actor_id = node.actor._actor_id
+            if actor_id in seen_actors:
+                raise ValueError(
+                    f"actor used by both '{seen_actors[actor_id]}' and "
+                    f"'{node.method_name}' — a compiled DAG supports one node "
+                    "per actor (create a separate actor per stage)"
+                )
+            seen_actors[actor_id] = node.method_name
+
+        self._dir = tempfile.mkdtemp(prefix="raytpu_dag_", dir="/dev/shm")
+        # One channel per producer (InputNode + every method node).
+        for node in order:
+            path = os.path.join(self._dir, f"ch_{uuid.uuid4().hex[:10]}")
+            Channel(path, self.capacity, create=True).close()
+            self._channels[id(node)] = path
+        self._input = Channel(self._channels[id(self._input_node)], self.capacity)
+        self._out_channels = [
+            Channel(self._channels[id(node)], self.capacity) for node in self._outputs
+        ]
+        self._out_cursors = [0] * len(self._outputs)
+
+        # Install executor loops (upstream-last so consumers are listening
+        # before producers can emit — order doesn't actually matter since
+        # channels buffer one message, but deterministic is nicer).
+        for node in order:
+            if not isinstance(node, ClassMethodNode):
+                continue
+            in_specs = []
+            for arg in node.args:
+                if isinstance(arg, DAGNode):
+                    in_specs.append(("chan", self._channels[id(arg)]))
+                else:
+                    in_specs.append(("const", arg))
+            ref = node.actor.__ray_call__.remote(
+                _actor_loop, node.method_name, in_specs,
+                self._channels[id(node)], self.capacity,
+            )
+            self._loop_refs.append(ref)
+        self._wait_ready(timeout=120.0)
+
+    def _wait_ready(self, timeout: float) -> None:
+        """Block until every executor loop has opened its channels.
+        Actor creation can take seconds under load (worker churn); gating
+        here keeps execute() timeouts about execution, and surfaces loop
+        install failures (e.g. actor died) as real errors, not timeouts."""
+        import time
+
+        from ..core import api as ray
+
+        markers = [
+            self._channels[id(node)] + ".ready"
+            for node in self._channels_nodes()
+        ]
+        deadline = time.monotonic() + timeout
+        while True:
+            if all(os.path.exists(m) for m in markers):
+                return
+            # A loop ref completing at this stage means its install DIED.
+            done, _ = ray.wait(list(self._loop_refs), num_returns=1, timeout=0)
+            if done:
+                ray.get(done[0])  # raises the real cause
+                raise RuntimeError("DAG executor loop exited during compile")
+            if time.monotonic() > deadline:
+                missing = [m for m in markers if not os.path.exists(m)]
+                raise TimeoutError(
+                    f"{len(missing)} DAG executor loop(s) not ready after "
+                    f"{timeout}s (actor creation starved?): {missing[:3]}"
+                )
+            time.sleep(0.01)
+
+    def _channels_nodes(self) -> list[ClassMethodNode]:
+        return [n for n in self._iter_nodes() if isinstance(n, ClassMethodNode)]
+
+    def _iter_nodes(self):
+        seen: set[int] = set()
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            yield node
+            if isinstance(node, ClassMethodNode):
+                for up in node.upstream():
+                    yield from visit(up)
+
+        for out in self._outputs:
+            yield from visit(out)
+
+    def _toposort(self) -> list[DAGNode]:
+        order: list[DAGNode] = []
+        seen: set[int] = set()
+
+        def visit(node: DAGNode) -> None:
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            if isinstance(node, InputNode):
+                if self._input_node is not None and self._input_node is not node:
+                    raise ValueError("a compiled DAG supports one InputNode")
+                self._input_node = node
+                order.append(node)
+                return
+            if isinstance(node, ClassMethodNode):
+                if not node.upstream():
+                    raise ValueError(
+                        f"{node.method_name}.bind(...) has no upstream node — "
+                        "a compiled node needs at least one DAG input or it "
+                        "would loop forever"
+                    )
+                for up in node.upstream():
+                    visit(up)
+                order.append(node)
+                return
+            raise TypeError(f"unsupported DAG node {type(node).__name__}")
+
+        for out in self._outputs:
+            visit(out)
+        return order
+
+    # ---------------------------------------------------------------- execute
+    def execute(self, value, timeout: float = 60.0):
+        """Push one input through the graph; returns the output (or tuple
+        of outputs for MultiOutputNode). Synchronous: one round at a time."""
+        if self._torn_down:
+            raise RuntimeError("DAG has been torn down")
+        self._input.write(_pack(value))
+        # Drain EVERY output before raising: skipping channels on error
+        # would leave their cursors one round behind and desync all later
+        # executes (they would read this round's stale payloads).
+        results, first_error = [], None
+        for i, ch in enumerate(self._out_channels):
+            try:
+                payload, seq = ch.read(self._out_cursors[i], timeout=timeout)
+            except TimeoutError:
+                # Surface a dead loop's real error instead of the timeout.
+                from ..core import api as ray
+
+                done, _ = ray.wait(list(self._loop_refs), num_returns=1, timeout=0)
+                if done:
+                    ray.get(done[0])
+                raise
+            self._out_cursors[i] = seq
+            result, is_error = _unpack(payload)
+            if is_error and first_error is None:
+                first_error = result
+            results.append(result)
+        if first_error is not None:
+            raise (first_error.as_instanceof_cause()
+                   if isinstance(first_error, RayTaskError) else first_error)
+        return results[0] if len(results) == 1 else tuple(results)
+
+    # --------------------------------------------------------------- teardown
+    def teardown(self, timeout: float = 30.0) -> None:
+        if self._torn_down:
+            return
+        self._torn_down = True
+        # Defensive getattr: __del__ may run on a DAG whose __init__ raised
+        # partway (validation errors) — clean what exists.
+        input_ch = getattr(self, "_input", None)
+        if input_ch is not None:
+            input_ch.close_writer()  # ChannelClosed cascades through loops
+            from ..core import api as ray
+
+            try:
+                ray.get(self._loop_refs, timeout=timeout)
+            except Exception:
+                pass
+            input_ch.close()
+        for ch in getattr(self, "_out_channels", []):
+            ch.close()
+        if self._dir is not None:
+            import shutil
+
+            shutil.rmtree(self._dir, ignore_errors=True)
+
+    def __del__(self):
+        try:
+            self.teardown(timeout=1.0)
+        except Exception:
+            pass

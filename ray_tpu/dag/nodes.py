@@ -1,0 +1,39 @@
+"""DAG node types (reference ``python/ray/dag/dag_node.py`` family)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class DAGNode:
+    def experimental_compile(self, max_buffer_size: int = 1 << 20):
+        from .compiled import CompiledDAG
+
+        return CompiledDAG(self, max_buffer_size=max_buffer_size)
+
+
+class InputNode(DAGNode):
+    """The driver-supplied input (``with InputNode() as inp:``)."""
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, actor, method_name: str, args: tuple, kwargs: dict):
+        if kwargs:
+            raise ValueError("compiled DAGs support positional args only")
+        self.actor = actor
+        self.method_name = method_name
+        self.args = args
+
+    def upstream(self) -> list[DAGNode]:
+        return [a for a in self.args if isinstance(a, DAGNode)]
+
+
+class MultiOutputNode(DAGNode):
+    def __init__(self, outputs: list[DAGNode]):
+        self.outputs = list(outputs)

@@ -29,10 +29,14 @@ def pytest_sessionstart(session):
     # shm segments leaked by previously killed runs exhaust /dev/shm and
     # poison every store allocation in this run — clear them up front
     import glob
+    import shutil
 
     for f in glob.glob("/dev/shm/raytpu_*"):
         try:
-            os.unlink(f)
+            if os.path.isdir(f):
+                shutil.rmtree(f, ignore_errors=True)
+            else:
+                os.unlink(f)
         except OSError:
             pass
 
