@@ -1,0 +1,174 @@
+"""Dashboard: an HTTP window onto cluster state.
+
+Equivalent of the reference's ``dashboard/`` (head-node web UI +
+``dashboard/modules/*`` REST endpoints), scoped to what a TPU-cluster
+operator actually debugs with: nodes, actors, tasks, objects, workers,
+placement groups, jobs, metrics, and a downloadable Perfetto timeline.
+Redesign: a stdlib ThreadingHTTPServer thread inside the driver process
+serving JSON from the state API — no Node.js build, no agent processes.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_INDEX = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body {{ font-family: monospace; margin: 2em; }}
+ h1 {{ font-size: 1.2em; }}
+ a {{ display: inline-block; margin-right: 1em; }}
+ pre {{ background: #f5f5f5; padding: 1em; overflow-x: auto; }}
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div>
+ {links}
+</div>
+<pre id="out">loading /api/nodes ...</pre>
+<script>
+ async function load(path) {{
+   const r = await fetch(path);
+   document.getElementById('out').textContent =
+     JSON.stringify(await r.json(), null, 2);
+ }}
+ document.querySelectorAll('a[data-api]').forEach(a =>
+   a.addEventListener('click', e => {{ e.preventDefault(); load(a.dataset.api); }}));
+ load('/api/nodes');
+</script>
+</body></html>
+"""
+
+_ENDPOINTS = [
+    "nodes", "actors", "tasks", "objects", "workers",
+    "placement_groups", "jobs", "metrics", "cluster_resources", "timeline",
+]
+
+
+def _collect(endpoint: str):
+    from .core import api as core_api
+    from .util import state
+
+    if endpoint == "nodes":
+        return state.list_nodes()
+    if endpoint == "actors":
+        return state.list_actors()
+    if endpoint == "tasks":
+        return state.list_tasks()
+    if endpoint == "objects":
+        return state.list_objects()
+    if endpoint == "workers":
+        return state.list_workers()
+    if endpoint == "placement_groups":
+        return state.list_placement_groups()
+    if endpoint == "jobs":
+        from .job.job_manager import JOB_MANAGER_NAME
+
+        try:
+            mgr = core_api.get_actor(JOB_MANAGER_NAME)
+        except ValueError:
+            return []
+        return core_api.get(mgr.list.remote(), timeout=30)
+    if endpoint == "metrics":
+        from .util.metrics import get_metrics
+
+        return get_metrics()
+    if endpoint == "cluster_resources":
+        return core_api.cluster_resources()
+    if endpoint == "timeline":
+        # Chrome-trace JSON, loadable in Perfetto (reference ray.timeline).
+        # Unique temp file per request: ThreadingHTTPServer handles
+        # requests concurrently and the trace write is not atomic.
+        import os
+        import tempfile
+
+        from . import timeline as dump_timeline
+
+        fd, path = tempfile.mkstemp(prefix="raytpu_timeline_", suffix=".json")
+        os.close(fd)
+        try:
+            dump_timeline(path)
+            with open(path) as f:
+                return json.load(f)
+        finally:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    raise KeyError(endpoint)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send(self, code: int, body: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path in ("", "/index.html"):
+            links = "".join(
+                f'<a href="#" data-api="/api/{e}">{e}</a>' for e in _ENDPOINTS
+            )
+            self._send(200, _INDEX.format(links=links).encode(), "text/html")
+            return
+        if path == "/-/healthz":
+            self._send(200, b'"ok"', "application/json")
+            return
+        if path.startswith("/api/"):
+            endpoint = path[len("/api/"):]
+            if endpoint not in _ENDPOINTS:
+                self._send(404, json.dumps({"error": f"unknown endpoint {endpoint}"}).encode(),
+                           "application/json")
+                return
+            try:
+                data = _collect(endpoint)
+                self._send(200, json.dumps(data, default=str).encode(), "application/json")
+            except Exception as e:
+                self._send(500, json.dumps({"error": f"{type(e).__name__}: {e}"}).encode(),
+                           "application/json")
+            return
+        self._send(404, b'{"error": "not found"}', "application/json")
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="raytpu-dashboard"
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+_dashboard: Dashboard | None = None
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> str:
+    """Start (or return) the dashboard; returns its URL."""
+    global _dashboard
+    if _dashboard is None:
+        _dashboard = Dashboard(host, port)
+    return _dashboard.url
+
+
+def stop_dashboard() -> None:
+    global _dashboard
+    if _dashboard is not None:
+        _dashboard.stop()
+        _dashboard = None

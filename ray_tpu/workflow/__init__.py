@@ -1,0 +1,13 @@
+"""Workflows: durable DAG execution with exactly-once step semantics.
+
+Equivalent of the reference's ``python/ray/workflow/``: a DAG of steps
+runs as cluster tasks with every step result checkpointed to storage;
+re-running (``resume``) after a crash skips completed steps, so side
+effects execute once per workflow id. Dynamic workflows (steps that
+return more steps) are intentionally out of scope — static DAGs cover
+the checkpoint/resume contract the reference's tests exercise.
+"""
+
+from .api import StepNode, get_output, get_status, list_all, resume, run, step
+
+__all__ = ["step", "run", "resume", "get_output", "get_status", "list_all", "StepNode"]

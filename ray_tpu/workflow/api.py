@@ -1,0 +1,195 @@
+"""Workflow engine: step DAG -> checkpointed cluster execution.
+
+Reference: ``python/ray/workflow/api.py`` (run/resume),
+``workflow_executor.py`` (step scheduling), ``workflow_storage.py``
+(checkpoint layout). Redesign: steps persist to a local/NFS directory
+as pickled results keyed by deterministic step ids (DFS order + name);
+the executor is a synchronous driver loop — workflow control flow does
+not need an actor of its own at this scale, and crash recovery falls
+out of storage alone.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+from typing import Any, Callable
+
+import cloudpickle
+
+_DEFAULT_STORAGE = os.path.expanduser("~/.ray_tpu_workflows")
+
+STATUS_RUNNING = "RUNNING"
+STATUS_SUCCESSFUL = "SUCCESSFUL"
+STATUS_FAILED = "FAILED"
+
+
+class StepNode:
+    def __init__(self, fn: Callable, args: tuple, kwargs: dict, name: str | None = None):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.name = name or getattr(fn, "__name__", "step")
+
+    def options(self, name: str) -> "StepNode":
+        return StepNode(self.fn, self.args, self.kwargs, name)
+
+
+def step(fn: Callable):
+    """``workflow.step(fn)(*args)`` builds a StepNode; args may contain
+    other StepNodes (upstream dependencies)."""
+
+    def bind(*args, **kwargs) -> StepNode:
+        return StepNode(fn, args, kwargs)
+
+    return bind
+
+
+class _Storage:
+    def __init__(self, base: str, workflow_id: str, create: bool = True):
+        self.dir = os.path.join(base, workflow_id)
+        if create:
+            os.makedirs(self.dir, exist_ok=True)
+
+    def _step_path(self, step_id: str) -> str:
+        return os.path.join(self.dir, f"step-{step_id}.pkl")
+
+    def has_step(self, step_id: str) -> bool:
+        return os.path.exists(self._step_path(step_id))
+
+    def load_step(self, step_id: str):
+        with open(self._step_path(step_id), "rb") as f:
+            return pickle.load(f)
+
+    def save_step(self, step_id: str, result: Any) -> None:
+        tmp = self._step_path(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(result, f)
+        os.replace(tmp, self._step_path(step_id))  # atomic: no torn checkpoints
+
+    def set_status(self, status: str, error: str = "") -> None:
+        blob = {"status": status, "error": error, "ts": time.time()}
+        tmp = os.path.join(self.dir, "status.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(blob, f)
+        os.replace(tmp, os.path.join(self.dir, "status.json"))
+
+    def get_status(self) -> dict | None:
+        try:
+            with open(os.path.join(self.dir, "status.json")) as f:
+                return json.load(f)
+        except OSError:
+            return None
+
+    def save_dag(self, root: StepNode) -> None:
+        path = os.path.join(self.dir, "dag.pkl")
+        if not os.path.exists(path):
+            with open(path, "wb") as f:
+                cloudpickle.dump(root, f)
+
+    def load_dag(self) -> StepNode:
+        with open(os.path.join(self.dir, "dag.pkl"), "rb") as f:
+            return pickle.load(f)
+
+
+def _execute(root: StepNode, storage: _Storage, step_timeout_s: float | None) -> Any:
+    """DFS-evaluate the step DAG. Step ids are assigned in deterministic
+    DFS order, so a resumed run maps steps to the same checkpoints."""
+    from ..core import api as ray
+
+    counter = [0]
+    memo: dict[int, Any] = {}
+
+    def resolve(value):
+        """Evaluate StepNodes anywhere in the argument tree — nested nodes
+        in lists/tuples/dicts are dependencies too."""
+        if isinstance(value, StepNode):
+            return evaluate(value)
+        if isinstance(value, list):
+            return [resolve(v) for v in value]
+        if isinstance(value, tuple):
+            return tuple(resolve(v) for v in value)
+        if isinstance(value, dict):
+            return {k: resolve(v) for k, v in value.items()}
+        return value
+
+    def evaluate(node: StepNode):
+        if id(node) in memo:
+            return memo[id(node)]
+        # Children first: ids follow argument order (stable across runs).
+        args = [resolve(a) for a in node.args]
+        kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+        step_id = f"{counter[0]:04d}-{node.name}"
+        counter[0] += 1
+        if storage.has_step(step_id):
+            result = storage.load_step(step_id)
+        else:
+            remote_fn = ray.remote(node.fn) if not hasattr(node.fn, "remote") else node.fn
+            result = ray.get(remote_fn.remote(*args, **kwargs), timeout=step_timeout_s)
+            storage.save_step(step_id, result)
+        memo[id(node)] = result
+        return result
+
+    return evaluate(root)
+
+
+def run(dag: StepNode, *, workflow_id: str, storage: str | None = None,
+        step_timeout_s: float | None = None) -> Any:
+    """Run (or continue) a workflow to completion; returns the root step's
+    result. Completed steps are skipped — side effects happen once.
+    ``step_timeout_s`` bounds each step (default: unbounded — training
+    steps legitimately run for hours)."""
+    st = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    st.save_dag(dag)
+    st.set_status(STATUS_RUNNING)
+    try:
+        result = _execute(dag, st, step_timeout_s)
+    except Exception as e:
+        st.set_status(STATUS_FAILED, error=f"{type(e).__name__}: {e}")
+        raise
+    # Output BEFORE status: a crash between the two must never yield a
+    # SUCCESSFUL workflow whose output is missing.
+    st.save_step("__output__", result)
+    st.set_status(STATUS_SUCCESSFUL)
+    return result
+
+
+def resume(workflow_id: str, *, storage: str | None = None,
+           step_timeout_s: float | None = None) -> Any:
+    """Continue a crashed/failed workflow from its persisted DAG and
+    checkpoints (reference ``workflow.resume``)."""
+    st = _Storage(storage or _DEFAULT_STORAGE, workflow_id, create=False)
+    dag = st.load_dag()
+    return run(dag, workflow_id=workflow_id, storage=storage,
+               step_timeout_s=step_timeout_s)
+
+
+def get_output(workflow_id: str, *, storage: str | None = None) -> Any:
+    st = _Storage(storage or _DEFAULT_STORAGE, workflow_id, create=False)
+    if not st.has_step("__output__"):
+        raise ValueError(f"workflow {workflow_id} has no output (not finished?)")
+    return st.load_step("__output__")
+
+
+def get_status(workflow_id: str, *, storage: str | None = None) -> str | None:
+    st = _Storage(storage or _DEFAULT_STORAGE, workflow_id, create=False)
+    blob = st.get_status()
+    return blob["status"] if blob else None
+
+
+def list_all(*, storage: str | None = None) -> list[tuple[str, str]]:
+    base = storage or _DEFAULT_STORAGE
+    out = []
+    try:
+        entries = os.listdir(base)
+    except OSError:
+        return out
+    for wf_id in sorted(entries):
+        if not os.path.isdir(os.path.join(base, wf_id)):
+            continue  # stray files in the storage dir are not workflows
+        status = get_status(wf_id, storage=base)
+        if status is not None:
+            out.append((wf_id, status))
+    return out

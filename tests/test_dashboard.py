@@ -1,0 +1,66 @@
+"""Dashboard HTTP endpoints over the state API.
+
+Reference surface: ``dashboard/modules/*`` REST endpoints (+ the
+timeline download the reference serves via ``ray timeline``).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture()
+def dashboard(ray_cluster):
+    url = start_dashboard()
+    yield url
+    stop_dashboard()
+
+
+def test_dashboard_state_endpoints(dashboard):
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return "pong"
+
+    p = Pinger.options(name="dash-actor").remote()
+    assert ray_tpu.get(p.ping.remote(), timeout=60) == "pong"
+
+    nodes = _get(dashboard + "/api/nodes")
+    assert nodes and any(n["state"] == "ALIVE" for n in nodes)
+
+    actors = _get(dashboard + "/api/actors")
+    assert any(a.get("name") == "dash-actor" for a in actors)
+
+    resources = _get(dashboard + "/api/cluster_resources")
+    assert resources.get("CPU", 0) > 0
+
+    tasks = _get(dashboard + "/api/tasks")
+    assert isinstance(tasks, list)
+
+    assert _get(dashboard + "/-/healthz") == "ok"
+
+
+def test_dashboard_timeline_is_chrome_trace(dashboard):
+    @ray_tpu.remote
+    def traced():
+        return 1
+
+    assert ray_tpu.get(traced.remote(), timeout=60) == 1
+    trace = _get(dashboard + "/api/timeline")
+    events = trace if isinstance(trace, list) else trace.get("traceEvents", [])
+    assert isinstance(events, list)
+
+
+def test_dashboard_unknown_endpoint_404(dashboard):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(dashboard + "/api/nope")
+    assert e.value.code == 404

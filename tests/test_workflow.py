@@ -1,0 +1,126 @@
+"""Durable workflows: checkpointed steps, crash resume, exactly-once.
+
+Reference surface: ``python/ray/workflow/tests/test_basic_workflows.py``
+(run/resume/get_output/list_all semantics).
+"""
+
+import os
+
+import pytest
+
+from ray_tpu import workflow
+
+
+def test_workflow_runs_dag_and_persists_output(ray_cluster, tmp_path):
+    def load():
+        return [1, 2, 3]
+
+    def double(xs):
+        return [2 * x for x in xs]
+
+    def total(xs):
+        return sum(xs)
+
+    dag = workflow.step(total)(workflow.step(double)(workflow.step(load)()))
+    result = workflow.run(dag, workflow_id="wf-basic", storage=str(tmp_path))
+    assert result == 12
+    assert workflow.get_output("wf-basic", storage=str(tmp_path)) == 12
+    assert workflow.get_status("wf-basic", storage=str(tmp_path)) == "SUCCESSFUL"
+    assert ("wf-basic", "SUCCESSFUL") in workflow.list_all(storage=str(tmp_path))
+
+
+def test_workflow_resume_skips_completed_steps(ray_cluster, tmp_path):
+    """A step that crashed mid-workflow is retried on resume; steps that
+    already checkpointed must NOT re-execute (exactly-once side effects)."""
+    marker_dir = tmp_path / "markers"
+    marker_dir.mkdir()
+
+    def effect(name):
+        # counts executions via filesystem side effect
+        path = marker_dir / name
+        with open(path, "a") as f:
+            f.write("x")
+        return name
+
+    def fragile(dep):
+        if not os.path.exists(marker_dir / "fixed"):
+            raise RuntimeError("transient failure")
+        return dep + "-done"
+
+    dag = workflow.step(fragile)(workflow.step(effect)("a"))
+    with pytest.raises(RuntimeError, match="transient"):
+        workflow.run(dag, workflow_id="wf-crash", storage=str(tmp_path))
+    assert workflow.get_status("wf-crash", storage=str(tmp_path)) == "FAILED"
+    assert (marker_dir / "a").stat().st_size == 1  # step "a" ran once
+
+    (marker_dir / "fixed").touch()
+    result = workflow.resume("wf-crash", storage=str(tmp_path))
+    assert result == "a-done"
+    assert (marker_dir / "a").stat().st_size == 1  # NOT re-executed on resume
+    assert workflow.get_status("wf-crash", storage=str(tmp_path)) == "SUCCESSFUL"
+
+
+def test_workflow_diamond_shares_upstream(ray_cluster, tmp_path):
+    """A diamond DAG evaluates the shared upstream once (memoized) and
+    checkpoints each step separately."""
+    calls = tmp_path / "calls"
+
+    def src():
+        with open(calls, "a") as f:
+            f.write("s")
+        return 10
+
+    def left(x):
+        return x + 1
+
+    def right(x):
+        return x + 2
+
+    def join(a, b):
+        return a * b
+
+    shared = workflow.step(src)()
+    dag = workflow.step(join)(workflow.step(left)(shared), workflow.step(right)(shared))
+    assert workflow.run(dag, workflow_id="wf-diamond", storage=str(tmp_path)) == 11 * 12
+    assert calls.stat().st_size == 1
+
+
+def test_workflow_nested_container_steps_resolve(ray_cluster, tmp_path):
+    """StepNodes nested in lists/dicts are dependencies too."""
+
+    def make(v):
+        return v
+
+    def merge(items, named):
+        return sum(items) + named["extra"]
+
+    dag = workflow.step(merge)(
+        [workflow.step(make)(1), workflow.step(make)(2)],
+        {"extra": workflow.step(make)(10)},
+    )
+    assert workflow.run(dag, workflow_id="wf-nested", storage=str(tmp_path)) == 13
+
+
+def test_workflow_listing_ignores_stray_files(ray_cluster, tmp_path):
+    (tmp_path / "README.md").write_text("not a workflow")
+    dag = workflow.step(lambda: 1)().options("one")
+    workflow.run(dag, workflow_id="wf-real", storage=str(tmp_path))
+    listing = workflow.list_all(storage=str(tmp_path))
+    assert listing == [("wf-real", "SUCCESSFUL")]
+    # read-only status probe must not create directories for unknown ids
+    assert workflow.get_status("never-existed", storage=str(tmp_path)) is None
+    assert not (tmp_path / "never-existed").exists()
+
+
+def test_workflow_rerun_same_id_returns_checkpointed(ray_cluster, tmp_path):
+    ticks = tmp_path / "ticks"
+
+    def effect():
+        with open(ticks, "a") as f:
+            f.write("t")
+        return 7
+
+    dag = workflow.step(effect)()
+    assert workflow.run(dag, workflow_id="wf-idem", storage=str(tmp_path)) == 7
+    assert workflow.run(dag, workflow_id="wf-idem", storage=str(tmp_path)) == 7
+    assert ticks.stat().st_size == 1  # second run fully served from storage
