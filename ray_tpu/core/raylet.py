@@ -300,17 +300,21 @@ class Raylet:
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = worker_id
         env.setdefault("JAX_PLATFORMS", "cpu")  # workers don't grab the TPU by default
-        if env.get("JAX_PLATFORMS") == "cpu":
-            # Some images hook accelerator-plugin registration (a multi-
-            # second jax import) into sitecustomize, gated on this var.
-            # CPU-only workers skip it: ~4s -> ~0.4s cold start.
-            env.pop("PALLAS_AXON_POOL_IPS", None)
         from .runtime_env import apply_runtime_env
 
         # working_dir: tasks run with this cwd and import modules from it
         # (reference runtime_env working_dir, minus the remote upload —
         # single-host path semantics).
         working_dir = apply_runtime_env(env, runtime_env)
+        explicit_vars = (runtime_env or {}).get("env_vars") or {}
+        if env.get("JAX_PLATFORMS") == "cpu" and "PALLAS_AXON_POOL_IPS" not in explicit_vars:
+            # Some images hook accelerator-plugin registration (a multi-
+            # second jax import) into sitecustomize, gated on this var.
+            # CPU-only workers skip it: ~4s -> ~0.4s cold start. Runs AFTER
+            # runtime_env (a TPU worker unsets JAX_PLATFORMS via env_vars
+            # and needs the plugin boot) but never overrides an explicit
+            # user-supplied value.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         if working_dir is not None and not os.path.isdir(working_dir):
             # Popen(cwd=missing) would raise AFTER the lease reserved
             # resources; run without the cwd instead — the task's import
