@@ -39,6 +39,10 @@ class RayTpuConfig:
     memory_usage_threshold: float = 0.95
     # 0 disables the watcher.
     memory_monitor_refresh_ms: int = 250
+    # Stream worker stdout/stderr lines to the driver via the GCS log
+    # channel (reference ``log_monitor.py`` + worker log redirection).
+    log_to_driver: bool = True
+    log_monitor_poll_ms: int = 500
 
     # --- scheduling ----------------------------------------------------------
     # Hybrid policy: pack onto nodes below this utilization score, then spread

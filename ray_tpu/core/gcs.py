@@ -58,6 +58,9 @@ class Publisher:
                 del buf[: len(buf) // 2]
             self._cond.notify_all()
 
+    def current_seq(self, channel: str) -> int:
+        return self._seqs.get(channel, 0)
+
     async def poll(self, cursors: dict[str, int], timeout: float) -> dict[str, list]:
         """Long-poll: block until any channel has messages past its cursor."""
         deadline = time.monotonic() + timeout
@@ -262,6 +265,27 @@ class GcsServer:
 
     async def handle_GetAllNodes(self, p: dict) -> dict:
         return {"nodes": list(self._nodes.values())}
+
+    async def handle_PublishLogs(self, p: dict) -> dict:
+        """Raylet log monitors forward worker output here; drivers long-
+        poll it via PollLogs (reference: log pubsub through the GCS)."""
+        await self.publisher.publish(
+            "logs", {"node_id": p["node_id"], "batch": p["batch"]}
+        )
+        return {}
+
+    async def handle_PollLogs(self, p: dict) -> dict:
+        cursor = p.get("cursor")
+        if cursor is None:
+            # Baseline request: a newly connected driver starts at the
+            # CURRENT end so it doesn't replay other drivers' history.
+            return {"cursor": self.publisher.current_seq("logs"), "messages": []}
+        out = await self.publisher.poll({"logs": cursor}, p.get("timeout", 10.0))
+        msgs = out.get("logs", [])
+        return {
+            "cursor": msgs[-1][0] if msgs else cursor,
+            "messages": [m for _, m in msgs],
+        }
 
     async def handle_DrainNode(self, p: dict) -> dict:
         await self._mark_node_dead(p["node_id"], "drained")

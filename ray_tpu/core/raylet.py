@@ -155,6 +155,8 @@ class Raylet:
         self._tasks.append(spawn(self._heartbeat_loop()))
         self._tasks.append(spawn(self._worker_monitor_loop()))
         self._tasks.append(spawn(self._memory_monitor_loop()))
+        if get_config().log_to_driver:
+            self._tasks.append(spawn(self._log_monitor_loop()))
         cfg = get_config()
         for _ in range(cfg.num_prestart_workers):
             self._start_worker()
@@ -299,6 +301,10 @@ class Raylet:
         worker_id = WorkerID.from_random().hex()
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = worker_id
+        # Worker stdout goes to a file the log monitor tails; without this
+        # it would be 8KB block-buffered and prints from long-lived workers
+        # would never reach the driver.
+        env["PYTHONUNBUFFERED"] = "1"
         env.setdefault("JAX_PLATFORMS", "cpu")  # workers don't grab the TPU by default
         from .runtime_env import apply_runtime_env
 
@@ -784,6 +790,51 @@ class Raylet:
         except OSError:
             pass
         return True
+
+    async def _log_monitor_loop(self) -> None:
+        """Tail this node's worker log files and forward new lines to the
+        GCS log channel (reference ``log_monitor.py``: per-node agent
+        tailing worker logs for the driver)."""
+        import glob
+
+        offsets: dict[str, int] = {}
+        period = get_config().log_monitor_poll_ms / 1000.0
+        while True:
+            await asyncio.sleep(period)
+            batch = []
+            for path in glob.glob(os.path.join(self._session_dir, "worker-*.out")):
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    continue
+                start = offsets.get(path, 0)
+                if size <= start:
+                    continue
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(start)
+                        chunk = f.read(min(size - start, 256 * 1024))
+                except OSError:
+                    continue
+                # forward whole lines only; carry partial tails to next tick
+                cut = chunk.rfind(b"\n") + 1
+                if cut == 0:
+                    if len(chunk) < 256 * 1024:
+                        continue
+                    cut = len(chunk)  # giant single line: forward truncated
+                offsets[path] = start + cut
+                worker_tag = os.path.basename(path)[len("worker-"):-len(".out")]
+                lines = chunk[:cut].decode("utf-8", errors="replace").splitlines()
+                batch.append({"worker": worker_tag, "lines": lines})
+            if batch:
+                try:
+                    await self._gcs.call(
+                        "PublishLogs",
+                        {"node_id": self.node_id.hex(), "batch": batch},
+                        timeout=5.0,
+                    )
+                except Exception:
+                    pass
 
     async def _memory_monitor_loop(self) -> None:
         """Two duties of the reference's memory safety net: proactive spill

@@ -271,6 +271,31 @@ class CoreWorker:
                 "is_driver": self.mode == MODE_DRIVER,
             },
         )
+        if self.mode == MODE_DRIVER and get_config().log_to_driver:
+            self.io.run_coro(self._stream_logs_to_driver())
+
+    async def _stream_logs_to_driver(self) -> None:
+        """Long-poll the GCS log channel and echo worker output with a
+        ``(worker=..., node=...)`` prefix (reference: driver-side
+        print_logs over the log pubsub)."""
+        import sys
+
+        cursor = None  # None = "start at the current end" (no history replay)
+        while True:
+            try:
+                reply = await self.gcs.call(
+                    "PollLogs", {"cursor": cursor, "timeout": 10.0}, timeout=20.0
+                )
+            except Exception:
+                await asyncio.sleep(1.0)
+                continue
+            cursor = reply.get("cursor", cursor)
+            for msg in reply.get("messages", []):
+                node = msg["node_id"][:8]
+                for entry in msg["batch"]:
+                    prefix = f"({entry['worker'][:8]}, node={node}) "
+                    for line in entry["lines"]:
+                        print(prefix + line, file=sys.stderr)
 
     def shutdown(self) -> None:
         install_refcount_hooks(lambda r: None, lambda r: None)

@@ -8,6 +8,7 @@ store blocks, and train-worker stream splits. TPU-relevant surface:
 
 from .block import BlockAccessor
 from .dataset import (
+    ActorPoolStrategy,
     Dataset,
     MaterializedDataset,
     from_arrow,
@@ -15,14 +16,17 @@ from .dataset import (
     from_numpy,
     from_pandas,
     range,  # noqa: A004
+    read_binary_files,
     read_csv,
     read_json,
     read_numpy,
     read_parquet,
+    read_text,
 )
 from .iterator import DataIterator
 
 __all__ = [
+    "ActorPoolStrategy",
     "BlockAccessor",
     "DataIterator",
     "Dataset",
@@ -32,8 +36,10 @@ __all__ = [
     "from_numpy",
     "from_pandas",
     "range",
+    "read_binary_files",
     "read_csv",
     "read_json",
     "read_numpy",
     "read_parquet",
+    "read_text",
 ]

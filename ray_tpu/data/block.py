@@ -45,13 +45,20 @@ def batch_to_block(batch: Any) -> pa.Table:
     if isinstance(batch, dict):
         cols = {}
         for k, v in batch.items():
-            arr = np.asarray(v)
-            if arr.ndim > 1:
+            if not isinstance(v, np.ndarray):
+                v = list(v)
+                if any(isinstance(x, bytes) for x in v):
+                    # Binary stays off the numpy path: fixed-width S dtype
+                    # silently truncates values at NUL bytes.
+                    cols[k] = pa.array(v)
+                    continue
+                v = np.asarray(v)  # lists-of-lists -> 2D -> FixedSizeList
+            if v.ndim > 1:
                 cols[k] = pa.FixedSizeListArray.from_arrays(
-                    pa.array(arr.reshape(-1)), int(np.prod(arr.shape[1:]))
+                    pa.array(v.reshape(-1)), int(np.prod(v.shape[1:]))
                 )
             else:
-                cols[k] = pa.array(arr)
+                cols[k] = pa.array(v)
         return pa.table(cols)
     if isinstance(batch, list):
         return build_block(batch)

@@ -18,6 +18,14 @@ from .executor import StreamingExecutor, plan
 from .iterator import DataIterator, SplitCoordinator, batches_from_blocks
 
 
+class ActorPoolStrategy:
+    """Actor-pool compute for map_batches (reference
+    ``ray.data.ActorPoolStrategy``): ``size`` stateful worker actors."""
+
+    def __init__(self, size: int = 1, **_compat):
+        self.size = max(1, int(_compat.get("max_size", size)))
+
+
 class Dataset:
     def __init__(self, last_op: L.LogicalOp):
         self._last_op = last_op
@@ -27,10 +35,28 @@ class Dataset:
         return Dataset(op)
 
     def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
-                    fn_kwargs: dict | None = None, **_ignored) -> "Dataset":
+                    fn_kwargs: dict | None = None, compute=None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: dict | None = None,
+                    **_ignored) -> "Dataset":
+        """``compute=ActorPoolStrategy(size=n)`` runs the fn on a pool of
+        stateful actors — pass a CLASS and it is constructed once per
+        actor (the model-inference pattern). A class fn without an
+        explicit compute defaults to a single-actor pool."""
+        if compute is None and isinstance(fn, type):
+            compute = ActorPoolStrategy(size=1)
         return self._chain(L.MapBatches(
             "map_batches", self._last_op, fn=fn, batch_format=batch_format,
-            fn_kwargs=fn_kwargs or {}))
+            fn_kwargs=fn_kwargs or {}, compute=compute,
+            fn_constructor_args=fn_constructor_args,
+            fn_constructor_kwargs=fn_constructor_kwargs or {}))
+
+    def union(self, *others: "Dataset") -> "MaterializedDataset":
+        """Concatenate datasets (materializes each input's blocks)."""
+        refs = []
+        for part in (self, *others):
+            refs.extend(part.iter_internal_ref_bundles())
+        return MaterializedDataset(refs)
 
     def map(self, fn: Callable) -> "Dataset":
         return self._chain(L.MapRows("map", self._last_op, fn=fn))
@@ -134,6 +160,21 @@ class Dataset:
         for i, block in enumerate(self._iter_blocks()):
             pq.write_table(block, os.path.join(path, f"part-{i:05d}.parquet"))
 
+    def write_json(self, path: str) -> None:
+        import json
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self._iter_blocks()):
+            def encode(o):
+                if hasattr(o, "tolist"):
+                    return o.tolist()  # numpy arrays round-trip as JSON lists
+                return str(o)
+
+            with open(os.path.join(path, f"part-{i:05d}.json"), "w") as f:
+                for row in BlockAccessor.for_block(block).iter_rows():
+                    f.write(json.dumps(row, default=encode) + "\n")
+
     def write_csv(self, path: str) -> None:
         import os
 
@@ -209,3 +250,13 @@ def from_pandas(df) -> MaterializedDataset:
 
 def from_arrow(table) -> MaterializedDataset:
     return MaterializedDataset([ray.put(table)])
+
+
+def read_text(paths) -> Dataset:
+    """One row per line, column ``text`` (reference ``read_text``)."""
+    return Dataset(L.Read("read_text", read_tasks=ds.text_tasks(paths)))
+
+
+def read_binary_files(paths) -> Dataset:
+    """One row per file: columns ``path`` and ``bytes``."""
+    return Dataset(L.Read("read_binary", read_tasks=ds.binary_tasks(paths)))

@@ -100,6 +100,32 @@ def json_tasks(paths) -> list[Callable]:
     return [make(f) for f in files]
 
 
+def text_tasks(paths) -> list[Callable]:
+    files = _expand_paths(paths)
+
+    def make(f):
+        def read():
+            with open(f, "r", encoding="utf-8", errors="replace") as fh:
+                return {"text": [line.rstrip("\n") for line in fh]}
+
+        return read
+
+    return [make(f) for f in files]
+
+
+def binary_tasks(paths) -> list[Callable]:
+    files = _expand_paths(paths)
+
+    def make(f):
+        def read():
+            with open(f, "rb") as fh:
+                return {"path": [f], "bytes": [fh.read()]}
+
+        return read
+
+    return [make(f) for f in files]
+
+
 def numpy_tasks(paths, column: str = "data") -> list[Callable]:
     files = _expand_paths(paths)
 

@@ -68,6 +68,21 @@ def _map_task(stages: list[MapStage], block):
     return _apply_stages(block, stages)
 
 
+class _MapWorker:
+    """Stateful map_batches worker (reference: actor-pool map operator —
+    ``_internal/execution/operators/actor_pool_map_operator.py``). A class
+    fn is constructed ONCE per actor (e.g. loads a model); plain callables
+    pass through."""
+
+    def __init__(self, fn, constructor_args: tuple, constructor_kwargs: dict):
+        self.fn = fn(*constructor_args, **constructor_kwargs) if isinstance(fn, type) else fn
+
+    def apply(self, batch_format: str, fn_kwargs: dict, block):
+        return _apply_stages(
+            block, [MapStage("batches", self.fn, batch_format, fn_kwargs)]
+        )
+
+
 def _consolidate_task(op_kind: str, num_out: int, seed, sort_key, descending, *blocks):
     merged = concat_blocks(list(blocks))
     n = merged.num_rows
@@ -123,6 +138,9 @@ class PhysicalOp:
             self.output_queue.append(self._completed.pop(self._emit_seq))
             self._emit_seq += 1
 
+    def close(self) -> None:
+        """Release operator resources (actor pools) at stream end."""
+
 
 class ReadPhysicalOp(PhysicalOp):
     def __init__(self, read_tasks):
@@ -146,6 +164,66 @@ class MapPhysicalOp(PhysicalOp):
     def launch_one(self):
         block_ref = self.input_queue.pop(0)
         return self._track([self._remote.remote(self._stages, block_ref)])
+
+
+class ActorPoolMapPhysicalOp(PhysicalOp):
+    """map_batches over a pool of stateful actors: the fn (usually a
+    class holding a model) is constructed once per actor; blocks route to
+    the least-loaded actor. Reference:
+    ``actor_pool_map_operator.py`` + ``ActorPoolStrategy``."""
+
+    def __init__(self, fn, batch_format: str, fn_kwargs: dict, *,
+                 pool_size: int, constructor_args: tuple = (),
+                 constructor_kwargs: dict | None = None,
+                 max_tasks_per_actor: int = 2):
+        super().__init__(f"ActorPoolMap[{getattr(fn, '__name__', 'fn')}x{pool_size}]")
+        self._fn = fn
+        self._batch_format = batch_format
+        self._fn_kwargs = fn_kwargs
+        self._pool_size = pool_size
+        self._ctor = (constructor_args, constructor_kwargs or {})
+        self._max_per_actor = max_tasks_per_actor
+        self._actors: list = []
+        self._actor_load: dict[int, int] = {}  # actor index -> in-flight
+        self._ref_to_actor: dict = {}
+
+    def _ensure_pool(self) -> None:
+        if self._actors:
+            return
+        cls = ray.remote(_MapWorker)
+        args, kwargs = self._ctor
+        self._actors = [cls.remote(self._fn, args, kwargs) for _ in range(self._pool_size)]
+        self._actor_load = {i: 0 for i in range(self._pool_size)}
+
+    def can_launch(self) -> bool:
+        if not self.input_queue:
+            return False
+        if not self._actors:
+            return True  # pool created on first launch
+        return min(self._actor_load.values()) < self._max_per_actor
+
+    def launch_one(self):
+        self._ensure_pool()
+        idx = min(self._actor_load, key=self._actor_load.get)
+        block_ref = self.input_queue.pop(0)
+        ref = self._actors[idx].apply.remote(self._batch_format, self._fn_kwargs, block_ref)
+        self._actor_load[idx] += 1
+        self._ref_to_actor[ref] = idx
+        return self._track([ref])
+
+    def on_complete(self, ref) -> None:
+        idx = self._ref_to_actor.pop(ref, None)
+        if idx is not None:
+            self._actor_load[idx] -= 1
+        super().on_complete(ref)
+
+    def close(self) -> None:
+        for a in self._actors:
+            try:
+                ray.kill(a)
+            except Exception:
+                pass
+        self._actors = []
 
 
 class AllToAllPhysicalOp(PhysicalOp):
@@ -236,7 +314,18 @@ def plan(last_op: L.LogicalOp) -> list[PhysicalOp]:
         if isinstance(lop, L.Read):
             ops.append(ReadPhysicalOp(lop.read_tasks))
         elif isinstance(lop, L.MapBatches):
-            pending_stages.append(MapStage("batches", lop.fn, lop.batch_format, lop.fn_kwargs))
+            if lop.compute is not None:
+                # Actor-pool compute is a fusion barrier: the stateful fn
+                # lives on dedicated actors, not fused into block tasks.
+                flush_maps()
+                ops.append(ActorPoolMapPhysicalOp(
+                    lop.fn, lop.batch_format, lop.fn_kwargs,
+                    pool_size=lop.compute.size,
+                    constructor_args=lop.fn_constructor_args,
+                    constructor_kwargs=lop.fn_constructor_kwargs,
+                ))
+            else:
+                pending_stages.append(MapStage("batches", lop.fn, lop.batch_format, lop.fn_kwargs))
         elif isinstance(lop, L.MapRows):
             pending_stages.append(MapStage("rows", lop.fn))
         elif isinstance(lop, L.FlatMap):
@@ -280,6 +369,13 @@ class StreamingExecutor:
         self._per_op = per_op_concurrency
 
     def run(self) -> Iterator[Any]:
+        try:
+            yield from self._run_inner()
+        finally:
+            for op in self._ops:
+                op.close()
+
+    def _run_inner(self) -> Iterator[Any]:
         ops = self._ops
         last = ops[-1]
         while True:

@@ -37,6 +37,10 @@ class MapBatches(LogicalOp):
     fn: Callable = None
     batch_format: str = "numpy"
     fn_kwargs: dict = dataclasses.field(default_factory=dict)
+    # Actor-pool compute (ActorPoolStrategy) for stateful fns; None = tasks.
+    compute: Any = None
+    fn_constructor_args: tuple = ()
+    fn_constructor_kwargs: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass

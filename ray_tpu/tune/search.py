@@ -88,6 +88,12 @@ class BasicVariantGenerator:
         self._rng = random.Random(seed)
 
     def generate(self, param_space: dict, num_samples: int) -> list[dict]:
+        # Reference-compatible dict form: {"grid_search": [...]}.
+        param_space = {
+            k: (GridSearch(v["grid_search"])
+                if isinstance(v, dict) and set(v) == {"grid_search"} else v)
+            for k, v in param_space.items()
+        }
         grid_keys = [k for k, v in param_space.items() if isinstance(v, GridSearch)]
         grids = [param_space[k].values for k in grid_keys]
         configs: list[dict] = []

@@ -90,28 +90,105 @@ class Tuner:
         self._param_space = param_space or {}
         self._tune_config = tune_config or TuneConfig()
         self._run_config = run_config or RunConfig()
+        self._restore_dir: str | None = None
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable[[dict], None], *,
+                tune_config: TuneConfig | None = None,
+                run_config: RunConfig | None = None) -> "Tuner":
+        """Reattach to an interrupted experiment (reference
+        ``Tuner.restore``): completed trials keep their results; pending,
+        running, and errored trials re-run, resuming from their latest
+        checkpoint when one was registered. Pass the SAME tune_config /
+        run_config as the original run — scheduler and checkpoint policy
+        are code, not persisted state (defaults: FIFO scheduler, default
+        checkpoint retention)."""
+        tuner = cls(trainable, tune_config=tune_config, run_config=run_config)
+        tuner._restore_dir = path
+        return tuner
+
+    # ------------------------------------------------------- state snapshot
+    @staticmethod
+    def _save_experiment_state(exp_dir: str, trials: list[Trial]) -> None:
+        import cloudpickle
+
+        state = [
+            {
+                "trial_id": t.trial_id,
+                "config": t.config,
+                "dir": t.dir,
+                "state": t.state,
+                "last_metrics": t.last_metrics,
+                "metrics_history": t.metrics_history,
+                "error": t.error,
+                "latest_checkpoint": (
+                    t.ckpt_manager.latest.path
+                    if t.ckpt_manager and t.ckpt_manager.latest else None
+                ),
+            }
+            for t in trials
+        ]
+        tmp = os.path.join(exp_dir, "experiment_state.pkl.tmp")
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(state, f)
+        os.replace(tmp, os.path.join(exp_dir, "experiment_state.pkl"))
+
+    def _load_trials_for_restore(self, ckpt_cfg) -> list[Trial]:
+        import pickle
+
+        with open(os.path.join(self._restore_dir, "experiment_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        trials = []
+        for entry in state:
+            t = Trial(entry["config"], entry["dir"])
+            t.trial_id = entry["trial_id"]
+            t.metrics_history = entry["metrics_history"]
+            t.last_metrics = entry["last_metrics"]
+            t.ckpt_manager = CheckpointManager(ckpt_cfg)
+            if entry["latest_checkpoint"] and os.path.exists(entry["latest_checkpoint"]):
+                t.ckpt_manager.register(
+                    Checkpoint(entry["latest_checkpoint"]), entry["last_metrics"] or {}
+                )
+            if entry["state"] == "TERMINATED" and not entry["error"]:
+                t.state = "TERMINATED"  # keep its result; don't re-run
+            else:
+                t.state = "PENDING"
+                t.error = None
+                t.resume_path = entry["latest_checkpoint"]
+                # Fresh attempt: stale history would double-count and make
+                # schedulers see training_iteration jump backwards.
+                t.metrics_history = []
+                t.last_metrics = None
+            trials.append(t)
+        return trials
 
     def fit(self) -> ResultGrid:
         tc = self._tune_config
-        name = self._run_config.name or f"tune_{int(time.time())}"
-        storage = self._run_config.storage_path or "/tmp/ray_tpu/results"
-        exp_dir = os.path.join(storage, name)
-        os.makedirs(exp_dir, exist_ok=True)
+        ckpt_cfg = self._run_config.checkpoint_config or CheckpointConfig()
+        if self._restore_dir is not None:
+            exp_dir = self._restore_dir
+            name = os.path.basename(exp_dir.rstrip("/"))
+            trials = self._load_trials_for_restore(ckpt_cfg)
+            scheduler = tc.scheduler or FIFOScheduler()
+        else:
+            name = self._run_config.name or f"tune_{int(time.time())}"
+            storage = self._run_config.storage_path or "/tmp/ray_tpu/results"
+            exp_dir = os.path.join(storage, name)
+            os.makedirs(exp_dir, exist_ok=True)
 
-        search = tc.search_alg or BasicVariantGenerator(seed=tc.seed)
-        configs = search.generate(self._param_space, tc.num_samples)
-        scheduler = tc.scheduler or FIFOScheduler()
+            search = tc.search_alg or BasicVariantGenerator(seed=tc.seed)
+            configs = search.generate(self._param_space, tc.num_samples)
+            scheduler = tc.scheduler or FIFOScheduler()
 
-        trials = [
-            Trial(cfg, os.path.join(exp_dir, f"trial_{i:05d}")) for i, cfg in enumerate(configs)
-        ]
-        for t in trials:
-            os.makedirs(t.dir, exist_ok=True)
-            t.ckpt_manager = CheckpointManager(
-                self._run_config.checkpoint_config or CheckpointConfig()
-            )
+            trials = [
+                Trial(cfg, os.path.join(exp_dir, f"trial_{i:05d}")) for i, cfg in enumerate(configs)
+            ]
+            for t in trials:
+                os.makedirs(t.dir, exist_ok=True)
+                t.ckpt_manager = CheckpointManager(ckpt_cfg)
+        self._save_experiment_state(exp_dir, trials)
 
-        pending = list(trials)
+        pending = [t for t in trials if t.state == "PENDING"]
         running: list[Trial] = []
         worker_cls = ray.remote(TrainWorker)
 
@@ -169,16 +246,20 @@ class Tuner:
                     trial.state = "TERMINATED"
                     ray.kill(trial.actor)
                     running.remove(trial)
+                    self._save_experiment_state(exp_dir, trials)
                 elif poll.get("error"):
                     trial.state = "ERROR"
                     trial.error = poll["error"]
                     ray.kill(trial.actor)
                     running.remove(trial)
+                    self._save_experiment_state(exp_dir, trials)
                 elif poll.get("done"):
                     trial.state = "TERMINATED"
                     ray.kill(trial.actor)
                     running.remove(trial)
+                    self._save_experiment_state(exp_dir, trials)
 
+        self._save_experiment_state(exp_dir, trials)
         results = [
             Result(
                 metrics=t.last_metrics,

@@ -126,3 +126,25 @@ def test_summarize_tasks():
             break
         time.sleep(0.3)
     assert summary["summary_probe"]["FINISHED"] >= 2
+
+
+def test_worker_logs_stream_to_driver(ray_cluster, capfd):
+    """Worker prints surface on the driver's stderr with a worker/node
+    prefix (reference log_monitor + print_logs)."""
+    import time
+
+    @ray_tpu.remote
+    def speak():
+        print("log-monitor-test-line")
+        return True
+
+    assert ray_tpu.get(speak.remote(), timeout=60)
+    deadline = time.time() + 10
+    seen = ""
+    while time.time() < deadline:
+        seen += capfd.readouterr().err
+        if "log-monitor-test-line" in seen:
+            break
+        time.sleep(0.25)
+    assert "log-monitor-test-line" in seen
+    assert "node=" in seen.split("log-monitor-test-line")[0].rsplit("(", 1)[-1]

@@ -1,6 +1,8 @@
 """Tune tests: search spaces, Tuner end-to-end, ASHA early stopping, PBT
 exploit (reference patterns: python/ray/tune/tests/)."""
 
+import os
+
 import pytest
 
 import ray_tpu
@@ -104,3 +106,46 @@ def test_pbt_exploit_logic():
     assert new_cfg["lr"] in (0.1, 0.01)
     # good trial does not exploit
     assert pbt.maybe_exploit(good, {"training_iteration": 2, "score": 10.0}, [good, bad]) is None
+
+
+def test_tuner_restore_reruns_only_incomplete(ray_cluster, tmp_path):
+    """Tuner.restore: finished trials keep their results without
+    re-running; the failed trial retries (reference Tuner.restore)."""
+    from ray_tpu import train
+    from ray_tpu.tune import TuneConfig, Tuner
+    from ray_tpu.train.config import RunConfig
+
+    marker_dir = str(tmp_path / "markers")
+    os.makedirs(marker_dir, exist_ok=True)
+
+    def trainable(config):
+        import os as _os
+
+        mark = _os.path.join(config["marker_dir"], f"ran-{config['x']}")
+        with open(mark, "a") as f:
+            f.write("x")
+        if config["x"] == 2 and not _os.path.exists(
+            _os.path.join(config["marker_dir"], "fixed")
+        ):
+            raise RuntimeError("flaky trial")
+        train.report({"score": float(config["x"] * 10)})
+
+    exp_name = "restore_exp"
+    tuner = Tuner(
+        trainable,
+        param_space={"x": {"grid_search": [1, 2, 3]}, "marker_dir": marker_dir},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name=exp_name, storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid.errors) == 1  # trial x=2 failed
+
+    open(os.path.join(marker_dir, "fixed"), "w").close()
+    restored = Tuner.restore(str(tmp_path / exp_name), trainable)
+    grid2 = restored.fit()
+    assert not grid2.errors
+    assert grid2.get_best_result("score").metrics["score"] == 30.0
+    # completed trials ran exactly once; the flaky one ran twice
+    assert os.path.getsize(os.path.join(marker_dir, "ran-1")) == 1
+    assert os.path.getsize(os.path.join(marker_dir, "ran-3")) == 1
+    assert os.path.getsize(os.path.join(marker_dir, "ran-2")) == 2
