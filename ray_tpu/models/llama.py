@@ -93,7 +93,7 @@ def param_axes(config: LlamaConfig):
             "w_down": ("layers", "mlp", "embed"),
         }
     return {
-        "embed": ("vocab", "embed"),
+        "embed": ("vocab_in", "embed"),
         "layers": {
             "attn_norm": ("layers", "norm"),
             "wq": ("layers", "embed", "heads", "head_dim"),
@@ -153,7 +153,7 @@ def _attention(q, k, v, config: LlamaConfig, mesh: Mesh | None):
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
-        spec = P(("dp", "fsdp"), "tp", "sp", None)
+        spec = P(("dcn", "dp", "fsdp"), "tp", "sp", None)
         fn = shard_map(
             functools.partial(ring_attention, axis="sp", causal=True),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

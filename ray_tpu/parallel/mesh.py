@@ -12,6 +12,10 @@ Axis vocabulary (every downstream component uses these names):
   the reference has no equivalent).
 - ``pp``   — pipeline parallel: shard layers into stages.
 - ``ep``   — expert parallel: shard MoE experts.
+- ``dcn``  — multi-slice data parallel: the outermost axis spans TPU
+  slices connected over the data-center network. Only per-step gradient
+  all-reduces cross it; everything latency-bound stays on ICI inside a
+  slice (the scaling-book multi-slice recipe).
 
 The reference delegates TP/PP/EP to vLLM via placement-group GPU bundles
 (``vllm_models.py:117-168``); here they are first-class mesh axes and XLA
@@ -28,11 +32,11 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "ep", "tp")
+AXIS_ORDER = ("dcn", "pp", "dp", "fsdp", "sp", "ep", "tp")
 # tp innermost: tensor-parallel collectives are per-layer and latency-bound,
-# so they must ride the fastest ICI links (adjacent devices); dp/pp
-# outermost, their collectives are per-step and bandwidth-tolerant (DCN-safe
-# for multi-slice).
+# so they must ride the fastest ICI links (adjacent devices); dcn/pp/dp
+# outermost, their collectives are per-step and bandwidth-tolerant — dcn
+# traffic crosses slices over the data-center network.
 
 
 @dataclasses.dataclass(frozen=True)
@@ -46,6 +50,7 @@ class MeshConfig:
     sp: int = 1
     pp: int = 1
     ep: int = 1
+    dcn: int = 1
 
     def sizes(self) -> dict[str, int]:
         return {a: getattr(self, a) for a in AXIS_ORDER}
@@ -85,13 +90,31 @@ def create_mesh(
 
     Device order: JAX's default device list already follows the physical
     torus enumeration on TPU, so a reshape keeps tp-adjacent devices
-    physically adjacent on ICI. Multi-slice (DCN) setups should put dp/pp
-    outermost so cross-slice traffic is per-step gradient sync only.
+    physically adjacent on ICI. For multi-slice, set ``MeshConfig.dcn``:
+    the dcn axis is aligned to slice boundaries (hybrid mesh) so only its
+    per-step gradient sync crosses the data-center network.
     """
     devices = list(devices if devices is not None else jax.devices())
     config = mesh_shape_for(len(devices), config)
     sizes = config.sizes()
     shape = tuple(sizes[a] for a in AXIS_ORDER)
+    if sizes["dcn"] > 1 and hasattr(devices[0], "slice_index"):
+        # Real multi-slice pod: group devices by slice so the dcn axis is
+        # EXACTLY the slice boundary. Shapes must be same-rank (per-axis
+        # split between ICI and DCN); a rank mismatch would make np.block
+        # concatenate slices along the innermost axis and silently put
+        # latency-bound collectives on DCN. Config errors (e.g. dcn !=
+        # number of slices) propagate — a misaligned fallback mesh would
+        # be an order-of-magnitude silent regression.
+        from jax.experimental import mesh_utils
+
+        ici_shape = tuple(1 if a == "dcn" else sizes[a] for a in AXIS_ORDER)
+        dcn_shape = tuple(sizes["dcn"] if a == "dcn" else 1 for a in AXIS_ORDER)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices,
+        )
+        return Mesh(dev_array, AXIS_ORDER)
+    # Single slice / virtual devices (no slice_index): plain torus reshape.
     dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, AXIS_ORDER)
 

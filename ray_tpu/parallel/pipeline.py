@@ -33,7 +33,7 @@ def pipeline_apply(
     mesh: Mesh,
     n_microbatches: int,
     axis: str = "pp",
-    batch_axes: tuple = ("dp", "fsdp"),
+    batch_axes: tuple = ("dcn", "dp", "fsdp"),
     param_specs=None,
 ):
     """Run stacked layers split into ``pp`` stages over microbatches.

@@ -16,8 +16,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 LogicalAxisRules = dict[str, object]
 
 DEFAULT_RULES: LogicalAxisRules = {
-    # activations
-    "batch": ("dp", "fsdp"),
+    # activations: batch shards across slices (dcn) then within-slice dp
+    "batch": ("dcn", "dp", "fsdp"),
     "seq": "sp",
     "embed_act": None,
     # params: fsdp shards the embed dim (ZeRO-3); tp shards heads/mlp/vocab
@@ -27,6 +27,13 @@ DEFAULT_RULES: LogicalAxisRules = {
     "head_dim": None,
     "mlp": "tp",
     "vocab": "tp",
+    # Embedding-table vocab dim: REPLICATED. Sharding it over tp makes
+    # params["embed"][tokens] a cross-shard gather that XLA can only
+    # partition by full rematerialization (replicate-at-runtime anyway,
+    # VERDICT weak #6); replicating up front costs the same memory and
+    # removes the per-step reshard. lm_head keeps "vocab"→tp — the logits
+    # matmul DOES partition well.
+    "vocab_in": None,
     "layers": "pp",
     "experts": "ep",
     "expert_mlp": "tp",

@@ -28,13 +28,41 @@ def test_mesh_resolve():
 
 def test_create_mesh_shapes():
     mesh = create_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
-    assert mesh.shape == {"pp": 1, "dp": 2, "fsdp": 2, "sp": 1, "ep": 1, "tp": 2}
+    assert mesh.shape == {"dcn": 1, "pp": 1, "dp": 2, "fsdp": 2, "sp": 1, "ep": 1, "tp": 2}
 
 
 def test_spec_for_dedup():
-    # batch maps to (dp, fsdp); embed maps to fsdp -> must not repeat fsdp
+    # batch maps to (dcn, dp, fsdp); embed maps to fsdp -> no repeat fsdp
     spec = spec_for(("batch", "embed"), DEFAULT_RULES)
-    assert spec == P(("dp", "fsdp"),)
+    assert spec == P(("dcn", "dp", "fsdp"),)
+
+
+def test_dcn_multislice_mesh_train_step():
+    """Multi-slice: dcn=2 x fsdp=2 x tp=2 — batch shards across slices and
+    the sharded loss matches the unsharded model (CPU virtual devices)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import PRESETS, init_params, loss_fn
+
+    mesh = create_mesh(MeshConfig(dcn=2, fsdp=2, tp=2))
+    assert mesh.shape["dcn"] == 2
+    cfg = dataclasses.replace(PRESETS["debug"], dtype=jnp.float32, attn_impl="reference")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 32)), jnp.int32)
+
+    ref = float(loss_fn(params, {"tokens": tokens}, cfg))
+    from ray_tpu.models.llama import param_axes
+    from ray_tpu.parallel import shard_params
+
+    sharded = shard_params(params, param_axes(cfg), mesh)
+    out = float(jax.jit(
+        lambda p, t: loss_fn(p, {"tokens": t}, cfg, mesh=mesh)
+    )(sharded, tokens))
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
 
 
 def test_logical_sharding_places_array():
