@@ -30,6 +30,7 @@ from .core.api import (
     wait,
 )
 from .core.object_ref import ObjectRef
+from .core.generator import ObjectRefGenerator
 from .core import status as exceptions
 from .core.status import (
     ActorDiedError,

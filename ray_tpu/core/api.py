@@ -227,7 +227,10 @@ class RemoteFunction:
             placement_group_id=pg_id,
             placement_group_bundle_index=bundle,
             runtime_env=opts.get("runtime_env"),
+            generator_backpressure=opts.get("_generator_backpressure_num_objects") or 0,
         )
+        if num_returns == "streaming":
+            return refs  # ObjectRefGenerator
         return refs[0] if num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):
@@ -240,19 +243,27 @@ class RemoteFunction:
 class ActorMethod:
     """Reference: actor.py:116."""
 
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int | str = 1,
+                 generator_backpressure: int = 0):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._generator_backpressure = generator_backpressure
 
     def remote(self, *args, **kwargs):
         refs = global_worker().submit_actor_task(
-            self._handle._actor_id, self._name, args, kwargs, num_returns=self._num_returns
+            self._handle._actor_id, self._name, args, kwargs,
+            num_returns=self._num_returns,
+            generator_backpressure=self._generator_backpressure,
         )
+        if self._num_returns == "streaming":
+            return refs  # ObjectRefGenerator
         return refs[0] if self._num_returns == 1 else refs
 
-    def options(self, num_returns: int = 1) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns: int | str = 1,
+                _generator_backpressure_num_objects: int = 0) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns,
+                           _generator_backpressure_num_objects)
 
     def bind(self, *args, **kwargs):
         """Build a compiled-graph node instead of submitting now

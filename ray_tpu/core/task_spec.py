@@ -28,7 +28,10 @@ class TaskSpec:
     #   {"t": "v", "meta": bytes, "blob": bytes}                — inline value
     #   {"t": "r", "id": bytes, "owner": str}                   — ObjectRef arg
     args: list = field(default_factory=list)
+    # -1 = streaming generator (``num_returns="streaming"``): returns are
+    # reported item-by-item while the task runs (reference _raylet.pyx:294).
     num_returns: int = 1
+    generator_backpressure: int = 0  # 0 = unbounded
     resources: dict = field(default_factory=dict)
     max_retries: int = 0
     retry_exceptions: bool = False
@@ -57,6 +60,7 @@ class TaskSpec:
             "kind": self.kind,
             "args": self.args,
             "num_returns": self.num_returns,
+            "generator_backpressure": self.generator_backpressure,
             "resources": self.resources,
             "max_retries": self.max_retries,
             "retry_exceptions": self.retry_exceptions,

@@ -34,6 +34,7 @@ import cloudpickle
 
 from . import serialization
 from .config import get_config
+from .generator import ObjectRefGenerator, StreamState
 from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from .memory_store import MemoryStore
 from .object_ref import ObjectRef, install_refcount_hooks
@@ -223,8 +224,12 @@ class CoreWorker:
         # temporary holds on owned objects we returned to a caller that has
         # not yet registered as a borrower (expiring failsafe).
         self._borrow_clients: dict[str, RetryableRpcClient] = {}
+        self._borrow_clients_lock = threading.Lock()
         self._borrow_holds: dict[bytes, list[float]] = {}
         self._borrow_holds_lock = threading.Lock()
+        # Owner-side streaming-generator state, keyed by task id
+        # (reference task_manager.h:212 ObjectRefStream map).
+        self._streams: dict[bytes, StreamState] = {}
 
         # Executor-side state (worker mode).
         self.actor_instance: Any = None
@@ -344,12 +349,18 @@ class CoreWorker:
     def _hook_remove_local(self, ref: ObjectRef) -> None:
         self.refcounter.remove_local_ref(ref.id())
 
-    async def _send_borrow(self, owner_address: str, method: str, oid: ObjectID) -> None:
-        try:
+    def _owner_client(self, owner_address: str) -> RetryableRpcClient:
+        """One ordered connection per owner (shared by the borrowing
+        protocol and generator-item reports, so neither can race)."""
+        with self._borrow_clients_lock:
             client = self._borrow_clients.get(owner_address)
             if client is None:
-                # One ordered connection per owner so Add/Remove can't race.
                 client = self._borrow_clients[owner_address] = RetryableRpcClient(owner_address)
+            return client
+
+    async def _send_borrow(self, owner_address: str, method: str, oid: ObjectID) -> None:
+        try:
+            client = self._owner_client(owner_address)
             await client.call(method, {"id": oid.binary(), "borrower": self.worker_id}, timeout=30.0)
         except Exception:
             pass  # owner died: its state is gone anyway
@@ -638,15 +649,18 @@ class CoreWorker:
         kwargs: dict,
         *,
         name: str | None = None,
-        num_returns: int = 1,
+        num_returns: int | str = 1,
         resources: dict | None = None,
         max_retries: int | None = None,
         scheduling_strategy: dict | None = None,
         placement_group_id: bytes = b"",
         placement_group_bundle_index: int = -1,
         runtime_env: dict | None = None,
-    ) -> list[ObjectRef]:
+        generator_backpressure: int = 0,
+    ) -> list[ObjectRef] | ObjectRefGenerator:
         cfg = get_config()
+        streaming = num_returns == "streaming"
+        n_returns = -1 if streaming else num_returns
         fid = self.functions.export((fn, "task"))
         task_id = self.next_task_id()
         spec = TaskSpec(
@@ -656,7 +670,8 @@ class CoreWorker:
             function_id=fid,
             kind=TASK_KIND_NORMAL,
             args=self._serialize_args(args, kwargs),
-            num_returns=num_returns,
+            num_returns=n_returns,
+            generator_backpressure=generator_backpressure,
             resources=resources or {},
             max_retries=cfg.task_max_retries if max_retries is None else max_retries,
             owner_address=self.address,
@@ -666,6 +681,8 @@ class CoreWorker:
             placement_group_bundle_index=placement_group_bundle_index,
             runtime_env=runtime_env or {},
         )
+        if streaming:
+            return self._submit_streaming(spec)
         return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(num_returns)]
         for rid in return_ids:
             self.refcounter.add_owned_object(rid)
@@ -673,6 +690,17 @@ class CoreWorker:
         self.task_events.record(spec.task_id, spec.name, "SUBMITTED", kind=spec.kind)
         self._enqueue_task(spec)
         return [ObjectRef(rid, self.address) for rid in return_ids]
+
+    def _submit_streaming(self, spec: TaskSpec) -> ObjectRefGenerator:
+        stream = StreamState(spec.task_id)
+        self._streams[spec.task_id] = stream
+        self.task_manager.add_pending(spec, [])
+        self.task_events.record(spec.task_id, spec.name, "SUBMITTED", kind=spec.kind)
+        if spec.kind == TASK_KIND_ACTOR_TASK:
+            self.io.run_coro(self._submit_actor_task_async(spec))
+        else:
+            self._enqueue_task(spec)
+        return ObjectRefGenerator(self, stream, self.address)
 
     def _serialize_args(self, args: tuple, kwargs: dict) -> list:
         cfg = get_config()
@@ -858,30 +886,52 @@ class CoreWorker:
         self._handle_task_reply(spec, reply)
         return True
 
+    def _store_return_item(self, rid: ObjectID, ret: dict) -> None:
+        """Store one executor-reported return (inline value or plasma
+        marker) and register nested-ref containment/borrowing."""
+        # The return value embeds nested refs: record containment (they
+        # live while the return object lives here) and register as a
+        # borrower with their owners (reference: nested-ref borrowing).
+        contained = ret.get("contained") or []
+        if contained:
+            child_ids = []
+            for c in contained:
+                cid = ObjectID(c["id"])
+                child_ids.append(cid)
+                owner = c.get("owner", "")
+                if owner and owner != self.address and self.refcounter.note_borrowed(cid, owner):
+                    self.io.run_coro(self._send_borrow(owner, "AddBorrower", cid))
+            self.refcounter.add_containment(rid, child_ids)
+        if ret["t"] == "v":
+            self.memory_store.put(rid, ret["meta"], ret["blob"])
+        else:  # in plasma on executor's node
+            node_id = ret["node_id"]
+            self.refcounter.add_location(rid, node_id)
+            self.memory_store.put_plasma_marker(rid, node_id.encode() if isinstance(node_id, str) else node_id)
+
     def _handle_task_reply(self, spec: TaskSpec, reply: dict) -> None:
         task_id = TaskID(spec.task_id)
-        returns = reply.get("returns", [])
-        for i, ret in enumerate(returns):
+        if spec.num_returns == -1:
+            # Streaming task finished: items arrived via ReportGeneratorItem;
+            # the reply only carries the final count (races with the last
+            # report are fine — both paths are idempotent). The error fallback
+            # covers a lost error report (owner briefly unreachable).
+            stream = self._streams.get(spec.task_id)
+            if stream is not None:
+                err_wire = reply.get("stream_error")
+                if err_wire:
+                    err = serialization.deserialize(err_wire["meta"], err_wire["blob"])
+                    if isinstance(err, RayTaskError):
+                        err = err.as_instanceof_cause()
+                    stream.fail(err)
+                else:
+                    stream.finish(reply.get("streamed", 0))
+            self.task_manager.complete(spec.task_id)
+            self._release_submitted_refs(spec)
+            return
+        for i, ret in enumerate(reply.get("returns", [])):
             rid = ObjectID.for_task_return(task_id, i + 1)
-            # The return value embeds nested refs: record containment (they
-            # live while the return object lives here) and register as a
-            # borrower with their owners (reference: nested-ref borrowing).
-            contained = ret.get("contained") or []
-            if contained:
-                child_ids = []
-                for c in contained:
-                    cid = ObjectID(c["id"])
-                    child_ids.append(cid)
-                    owner = c.get("owner", "")
-                    if owner and owner != self.address and self.refcounter.note_borrowed(cid, owner):
-                        self.io.run_coro(self._send_borrow(owner, "AddBorrower", cid))
-                self.refcounter.add_containment(rid, child_ids)
-            if ret["t"] == "v":
-                self.memory_store.put(rid, ret["meta"], ret["blob"])
-            else:  # in plasma on executor's node
-                node_id = ret["node_id"]
-                self.refcounter.add_location(rid, node_id)
-                self.memory_store.put_plasma_marker(rid, node_id.encode() if isinstance(node_id, str) else node_id)
+            self._store_return_item(rid, ret)
         self.task_manager.complete(spec.task_id)
         self._release_submitted_refs(spec)
 
@@ -889,6 +939,13 @@ class CoreWorker:
         self.task_events.record(spec.task_id, spec.name, "FAILED", kind=spec.kind,
                                 extra={"error": str(error)[:200]})
         task_id = TaskID(spec.task_id)
+        if spec.num_returns == -1:
+            stream = self._streams.get(spec.task_id)
+            if stream is not None:
+                stream.fail(error)
+            self.task_manager.fail(spec.task_id)
+            self._release_submitted_refs(spec)
+            return
         metadata, blob, _ = serialization.serialize_error(
             RayTaskError(spec.name, str(error), error)
         )
@@ -965,9 +1022,11 @@ class CoreWorker:
         args: tuple,
         kwargs: dict,
         *,
-        num_returns: int = 1,
-    ) -> list[ObjectRef]:
+        num_returns: int | str = 1,
+        generator_backpressure: int = 0,
+    ) -> list[ObjectRef] | ObjectRefGenerator:
         state = self._actor_state(actor_id)
+        streaming = num_returns == "streaming"
         with self._counter_lock:
             self._task_counter += 1
             counter = self._task_counter
@@ -983,13 +1042,16 @@ class CoreWorker:
             function_id=b"",
             kind=TASK_KIND_ACTOR_TASK,
             args=self._serialize_args(args, kwargs),
-            num_returns=num_returns,
+            num_returns=-1 if streaming else num_returns,
+            generator_backpressure=generator_backpressure,
             owner_address=self.address,
             actor_id=actor_id,
             actor_method=method_name,
             seq_no=seq_no,
         )
         spec._incarnation = incarnation
+        if streaming:
+            return self._submit_streaming(spec)
         return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(num_returns)]
         for rid in return_ids:
             self.refcounter.add_owned_object(rid)
@@ -1155,6 +1217,70 @@ class CoreWorker:
         self.refcounter.remove_borrower(ObjectID(p["id"]))
         return {}
 
+    # ------------------------------------------------- streaming generators
+    def release_stream(self, task_id: bytes) -> None:
+        """Consumer is done with (or abandoned) a stream: drop the owner-side
+        state and the stored-but-never-consumed items. The producer learns
+        via its next report (``cancel``) and stops generating."""
+        stream = self._streams.pop(task_id, None)
+        if stream is None:
+            return
+        with stream.cond:
+            consumed, num_items = stream.consumed, stream.num_items
+        if not stream.finished:
+            stream.fail(RayTpuError("streaming generator abandoned by consumer"))
+        tid = TaskID(task_id)
+        for i in range(consumed, num_items):
+            # Unconsumed items never got a consumer-side ObjectRef, so the
+            # refcounter will not free them — drop the store entries here
+            # (plasma copies fall to LRU eviction).
+            self.memory_store.delete(ObjectID.for_task_return(tid, i + 1))
+
+    async def handle_ReportGeneratorItem(self, p: dict) -> dict:
+        """Executor reports one yielded item (or stream end/error) for a
+        streaming task this worker owns (reference
+        ``HandleReportGeneratorItemReturns``, task_manager.h:212)."""
+        task_id = p["task_id"]
+        stream = self._streams.get(task_id)
+        if stream is None:
+            # Unknown stream: the consumer abandoned it (or this owner
+            # restarted) — tell the producer to stop generating.
+            return {"consumed": p.get("index", 0) + 1, "cancel": True}
+        if p.get("done"):
+            if "error" in p:
+                err = serialization.deserialize(p["error"]["meta"], p["error"]["blob"])
+                if isinstance(err, RayTaskError):
+                    err = err.as_instanceof_cause()
+                stream.fail(err)
+            else:
+                stream.finish(p.get("total", 0))
+            return {"consumed": stream.consumed}
+        index = p["index"]
+        rid = ObjectID.for_task_return(TaskID(task_id), index + 1)
+        self.refcounter.add_owned_object(rid)
+        self._store_return_item(rid, p["item"])
+        stream.report_item(index)
+        return {"consumed": stream.consumed}
+
+    async def handle_WaitGeneratorConsumed(self, p: dict) -> dict:
+        """Executor-side backpressure long-poll: resolve once the consumer
+        has taken ``until`` items, the stream ends, or a timeout passes.
+        Parks an asyncio future on the stream — no thread per waiter."""
+        import asyncio
+
+        stream = self._streams.get(p["task_id"])
+        if stream is None:
+            return {"consumed": p.get("until", 0), "cancel": True}
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        if stream.add_async_waiter(p["until"], loop, fut):
+            try:
+                await asyncio.wait_for(fut, min(p.get("timeout", 10.0), 60.0))
+            except asyncio.TimeoutError:
+                pass
+        with stream.cond:
+            return {"consumed": stream.consumed, "cancel": stream.error is not None}
+
     async def _task_event_flusher(self) -> None:
         import asyncio
 
@@ -1276,6 +1402,10 @@ class CoreWorker:
             else:
                 fn, _tag = self.functions.get(spec.function_id)
                 result = _run_to_completion(fn(*args, **kwargs))
+            if spec.num_returns == -1:
+                # Streaming generator: iterate + report items; the reply
+                # carries only the final count (events recorded inside).
+                return self._stream_generator_results(spec, result)
             reply = {"returns": self._serialize_returns(spec, result)}
             self.task_events.record(spec.task_id, spec.name, "FINISHED", kind=spec.kind)
             return reply
@@ -1286,6 +1416,21 @@ class CoreWorker:
             if spec.kind == TASK_KIND_ACTOR_CREATION:
                 return {"error": f"{type(e).__name__}: {e}\n{tb}"}
             metadata, blob, _ = serialization.serialize_error(RayTaskError(spec.name, tb, e))
+            if spec.num_returns == -1:
+                # Failure before the generator started (bad args, arity,
+                # missing function): surface it on the stream — the normal
+                # per-index error path never ran.
+                try:
+                    self.io.run_sync(self._owner_client(spec.owner_address).call(
+                        "ReportGeneratorItem",
+                        {"task_id": spec.task_id, "done": True, "total": 0,
+                         "error": {"meta": metadata, "blob": blob}},
+                        timeout=30.0,
+                    ))
+                except Exception:
+                    pass
+                return {"returns": [], "streamed": 0,
+                        "stream_error": {"meta": metadata, "blob": blob}}
             return {"returns": [{"t": "v", "meta": metadata, "blob": blob} for _ in range(spec.num_returns)]}
         finally:
             self.current_task_id = prev_task_id
@@ -1314,21 +1459,97 @@ class CoreWorker:
             results = list(result)
             if len(results) != spec.num_returns:
                 raise ValueError(f"Task {spec.name} returned {len(results)} values, expected {spec.num_returns}")
-        out = []
         task_id = TaskID(spec.task_id)
-        for i, value in enumerate(results):
-            metadata, blob, contained = serialization.serialize(value)
-            wire_contained = self._hold_returned_refs(contained)
-            if len(blob) <= cfg.max_inline_object_size:
-                entry = {"t": "v", "meta": metadata, "blob": blob}
-            else:
-                rid = ObjectID.for_task_return(task_id, i + 1)
-                self._plasma_put(rid, metadata, blob)
-                entry = {"t": "p", "node_id": self.node_id}
-            if wire_contained:
-                entry["contained"] = wire_contained
-            out.append(entry)
-        return out
+        return [self._serialize_return_value(task_id, i, v) for i, v in enumerate(results)]
+
+    def _serialize_return_value(self, task_id: TaskID, index: int, value: Any) -> dict:
+        """Serialize one task return: inline entry for small values, shm
+        store + plasma marker for large ones."""
+        cfg = get_config()
+        metadata, blob, contained = serialization.serialize(value)
+        wire_contained = self._hold_returned_refs(contained)
+        if len(blob) <= cfg.max_inline_object_size:
+            entry = {"t": "v", "meta": metadata, "blob": blob}
+        else:
+            rid = ObjectID.for_task_return(task_id, index + 1)
+            self._plasma_put(rid, metadata, blob)
+            entry = {"t": "p", "node_id": self.node_id}
+        if wire_contained:
+            entry["contained"] = wire_contained
+        return entry
+
+    def _stream_generator_results(self, spec: TaskSpec, gen: Any) -> dict:
+        """Execute a streaming task's generator, reporting every yielded
+        item to the owner as it is produced (reference: streaming-generator
+        executor protocol, _raylet.pyx execute_streaming_generator).
+
+        Runs in the executor thread AFTER the task function returned its
+        generator. Item object IDs are deterministic task-return IDs, so a
+        retried execution re-reports idempotently."""
+        task_id = TaskID(spec.task_id)
+        client = self._owner_client(spec.owner_address)
+        count = 0
+        consumed = 0
+        bp = spec.generator_backpressure
+        cancelled = False
+        try:
+            it = _iter_generator(gen)
+            for value in it:
+                entry = self._serialize_return_value(task_id, count, value)
+                reply = self.io.run_sync(client.call(
+                    "ReportGeneratorItem",
+                    {"task_id": spec.task_id, "index": count, "item": entry},
+                    timeout=30.0,
+                ))
+                consumed = reply.get("consumed", consumed)
+                count += 1
+                if reply.get("cancel"):
+                    # Consumer abandoned the stream: stop producing.
+                    cancelled = True
+                    it.close()
+                    break
+                # Backpressure: pause once `bp` reported items sit unconsumed
+                # (reference _generator_backpressure_num_objects).
+                while bp > 0 and count - consumed >= bp:
+                    r2 = self.io.run_sync(client.call(
+                        "WaitGeneratorConsumed",
+                        {"task_id": spec.task_id, "until": count - bp + 1, "timeout": 10.0},
+                        timeout=40.0,
+                    ))
+                    consumed = r2.get("consumed", consumed)
+                    if r2.get("cancel"):
+                        cancelled = True
+                        it.close()
+                        break
+                if cancelled:
+                    break
+        except Exception as e:
+            tb = traceback.format_exc()
+            self.task_events.record(spec.task_id, spec.name, "FAILED", kind=spec.kind,
+                                    extra={"error": f"{type(e).__name__}: {e}"})
+            metadata, blob, _ = serialization.serialize_error(RayTaskError(spec.name, tb, e))
+            try:
+                self.io.run_sync(client.call(
+                    "ReportGeneratorItem",
+                    {"task_id": spec.task_id, "done": True, "total": count,
+                     "error": {"meta": metadata, "blob": blob}},
+                    timeout=30.0,
+                ))
+            except Exception:
+                pass  # owner gone: nothing to report to
+            return {"returns": [], "streamed": count,
+                    "stream_error": {"meta": metadata, "blob": blob}}
+        if not cancelled:
+            try:
+                self.io.run_sync(client.call(
+                    "ReportGeneratorItem",
+                    {"task_id": spec.task_id, "done": True, "total": count},
+                    timeout=30.0,
+                ))
+            except Exception:
+                pass
+        self.task_events.record(spec.task_id, spec.name, "FINISHED", kind=spec.kind)
+        return {"returns": [], "streamed": count}
 
     def _hold_returned_refs(self, contained: list) -> list[dict]:
         """A return value embeds ObjectRefs: take a temporary borrower hold
@@ -1359,6 +1580,29 @@ def asyncio_sleep(t: float):
     import asyncio
 
     return asyncio.sleep(t)
+
+
+def _iter_generator(gen):
+    """Drive a sync or async generator from the executor thread, yielding
+    items synchronously (async generators get a private event loop)."""
+    if hasattr(gen, "__anext__"):
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+        try:
+            while True:
+                try:
+                    yield loop.run_until_complete(gen.__anext__())
+                except StopAsyncIteration:
+                    break
+        finally:
+            loop.close()
+    elif hasattr(gen, "__next__") or hasattr(gen, "__iter__"):
+        yield from gen
+    else:
+        raise TypeError(
+            f"Task declared num_returns='streaming' must return a generator, got {type(gen).__name__}"
+        )
 
 
 def _run_to_completion(result):
