@@ -489,6 +489,12 @@ class GcsServer:
             if lease.get("spillback"):
                 continue  # re-select with fresh view
             if not lease.get("granted"):
+                # Only a resource WAIT suggests capacity pinned by garbage
+                # (un-collected actor-handle cycles) — infeasible requests
+                # and worker-start failures would just churn gc.collect()
+                # cluster-wide for nothing.
+                if "waiting for resources" in lease.get("reason", ""):
+                    await self._maybe_global_gc("actor_pending")
                 await asyncio.sleep(0.2)
                 continue
             worker_addr = lease["worker_address"]
@@ -673,7 +679,39 @@ class GcsServer:
                     )
                     if placement is not None and await self._try_reserve(record, placement):
                         return
+                    # Feasible on totals but unplaceable on available
+                    # resources: capacity may be pinned by garbage (e.g.
+                    # actor handles stuck in exception→frame reference
+                    # cycles in some driver). Broadcast a global GC so every
+                    # worker runs gc.collect() (reference:
+                    # ``ray._private.internal_api.global_gc``,
+                    # ``core_worker.cc`` TriggerGlobalGC on PG pending).
+                    await self._maybe_global_gc("pg_pending")
             await asyncio.sleep(0.25)
+
+    async def _maybe_global_gc(self, reason: str) -> None:
+        """Publish a rate-limited global-GC broadcast (at most every 5s)."""
+        now = time.time()
+        if now - getattr(self, "_last_global_gc", 0.0) < 5.0:
+            return
+        self._last_global_gc = now
+        await self.publisher.publish("global_gc", {"reason": reason})
+
+    async def handle_PollGlobalGc(self, p: dict) -> dict:
+        """Worker long-poll for global-GC broadcasts. ``cursor=None`` means
+        "start at the current end" (no replay of old triggers)."""
+        cursor = p.get("cursor")
+        current = self.publisher.current_seq("global_gc")
+        if cursor is None or cursor > current:
+            # None = "start at the end". A cursor PAST the end means this
+            # GCS restarted (fresh Publisher, seqs reset): clamp, or the
+            # worker would filter every future broadcast forever.
+            return {"cursor": current, "triggered": False}
+        out = await self.publisher.poll({"global_gc": cursor}, p.get("timeout", 10.0))
+        msgs = out.get("global_gc", [])
+        if msgs:
+            return {"cursor": msgs[-1][0], "triggered": True}
+        return {"cursor": cursor, "triggered": False}
 
     async def _try_reserve(self, record: dict, placement: list[str]) -> bool:
         """2PC: reserve every bundle, then commit; cancel all on any failure."""

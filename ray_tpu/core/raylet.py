@@ -104,6 +104,13 @@ class Raylet:
         self._workers: dict[str, WorkerHandle] = {}
         self._idle: list[str] = []
         self._lease_waiters: list[asyncio.Future] = []
+        # Resource-admission queue: (priority, seq)-ordered waiters; the
+        # releaser hands reservations to the head directly, so a flood of
+        # new task leases can never starve a parked actor creation
+        # (fixes the scheduler-fairness starvation; reference:
+        # cluster_task_manager.cc queue ordering).
+        self._admission_queue: list[dict] = []
+        self._admission_seq = 0
         self._pg_bundles: dict[tuple[str, int], dict] = {}  # (pg_id, idx) -> {resources, committed}
         self._tasks: list[asyncio.Task] = []
         self._node_table: dict[str, dict] = {}
@@ -239,6 +246,19 @@ class Raylet:
         while True:
             await asyncio.sleep(0.2)
             for w in list(self._workers.values()):
+                # Drivers register without a proc handle but always live on
+                # this host: poll their pid so a driver that exits with
+                # unreleased pin_read refs (or mid-create objects) is reaped
+                # like any worker — leaked read refs make objects
+                # unspillable forever.
+                if w.proc is None and w.state == "driver" and w.pid:
+                    try:
+                        os.kill(w.pid, 0)
+                    except ProcessLookupError:
+                        self._on_worker_dead(w)
+                    except OSError:
+                        pass  # EPERM etc: process exists
+                    continue
                 if w.proc is not None and w.proc.poll() is not None and w.state != "dead":
                     prev_state = w.state
                     self._on_worker_dead(w)
@@ -412,10 +432,69 @@ class Raylet:
                 return None
 
     def _wake_lease_waiters(self) -> None:
+        # Hand freed resources to parked admission waiters FIRST (in
+        # priority+FIFO order), then wake idle-worker/bundle waiters.
+        self._dispatch_admission()
         waiters, self._lease_waiters = self._lease_waiters, []
         for fut in waiters:
             if not fut.done():
                 fut.set_result(True)
+
+    def _dispatch_admission(self) -> None:
+        """Grant queued resource reservations in (priority, seq) order.
+        Strict head-of-line: a request never overtakes an earlier one it
+        could outrace — that race was the actor-creation starvation."""
+        while self._admission_queue:
+            entry = self._admission_queue[0]
+            if entry["fut"].done():  # timed out / cancelled waiter
+                self._admission_queue.pop(0)
+                continue
+            if not self.resources.can_fit(entry["request"]):
+                break
+            self.resources.acquire(entry["request"])
+            self._admission_queue.pop(0)
+            entry["fut"].set_result(True)
+
+    async def _acquire_resources_queued(self, request: ResourceSet, priority: int, deadline: float) -> bool:
+        """Reserve ``request`` against the node pool, waiting FIFO within
+        priority class (0 = actor creation, 1 = normal tasks). Returns False
+        on deadline. On True the reservation is held by the caller."""
+        if not self._admission_queue and self.resources.can_fit(request):
+            self.resources.acquire(request)
+            return True
+        self._admission_seq += 1
+        entry = {
+            "prio": priority,
+            "seq": self._admission_seq,
+            "request": request,
+            "fut": asyncio.get_running_loop().create_future(),
+        }
+        # Insert in (priority, seq) order: earlier same-priority requests
+        # stay ahead; higher-priority (lower number) requests go first.
+        at = len(self._admission_queue)
+        for i, e in enumerate(self._admission_queue):
+            if (entry["prio"], entry["seq"]) < (e["prio"], e["seq"]):
+                at = i
+                break
+        self._admission_queue.insert(at, entry)
+        self._dispatch_admission()  # we may be admissible right now
+        with self._track_demand(request):
+            while not entry["fut"].done():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    try:
+                        self._admission_queue.remove(entry)
+                    except ValueError:
+                        pass
+                    # Lost race: granted between the deadline check and
+                    # removal — keep the reservation and proceed.
+                    return entry["fut"].done()
+                try:
+                    # Periodic re-dispatch guards against a missed wake.
+                    await asyncio.wait_for(asyncio.shield(entry["fut"]), min(remaining, 0.5))
+                except asyncio.TimeoutError:
+                    self._dispatch_admission()
+        return True
 
     @contextlib.contextmanager
     def _track_demand(self, request: ResourceSet):
@@ -502,28 +581,13 @@ class Raylet:
             if node is not None and node["node_id"] != self.node_id.hex():
                 return {"spillback": True, "node_address": node["address"], "node_id": node["node_id"]}
 
-        # Reserve resources BEFORE any await so concurrent lease handlers
-        # can't double-acquire (LocalResourceManager semantics).
+        # Reserve resources through the admission queue: actor creations
+        # (dedicated leases) rank ahead of normal tasks, FIFO within class,
+        # and the releaser grants directly to the head — no wake-and-race.
         deadline = time.monotonic() + get_config().worker_register_timeout_s
-        with contextlib.ExitStack() as demand_scope:
-            waiting = False
-            while True:
-                if self.resources.can_fit(request):
-                    self.resources.acquire(request)
-                    break
-                if time.monotonic() > deadline:
-                    return {"granted": False, "reason": "timed out waiting for resources"}
-                if not waiting:
-                    # Register demand lazily: only requests that actually
-                    # wait should show up in autoscaler heartbeats.
-                    waiting = True
-                    demand_scope.enter_context(self._track_demand(request))
-                fut: asyncio.Future = asyncio.get_running_loop().create_future()
-                self._lease_waiters.append(fut)
-                try:
-                    await asyncio.wait_for(fut, 0.5)
-                except asyncio.TimeoutError:
-                    pass
+        priority = 0 if (p.get("dedicated") or spec.get("kind", 0) == 1) else 1
+        if not await self._acquire_resources_queued(request, priority, deadline):
+            return {"granted": False, "reason": "timed out waiting for resources"}
 
         try:
             worker = await self._get_idle_worker(
@@ -802,6 +866,7 @@ class Raylet:
         while True:
             await asyncio.sleep(period)
             batch = []
+            staged: dict[str, int] = {}  # offsets commit only after publish
             for path in glob.glob(os.path.join(self._session_dir, "worker-*.out")):
                 try:
                     size = os.path.getsize(path)
@@ -822,10 +887,10 @@ class Raylet:
                     if len(chunk) < 256 * 1024:
                         continue
                     cut = len(chunk)  # giant single line: forward truncated
-                offsets[path] = start + cut
                 worker_tag = os.path.basename(path)[len("worker-"):-len(".out")]
                 lines = chunk[:cut].decode("utf-8", errors="replace").splitlines()
                 batch.append({"worker": worker_tag, "lines": lines})
+                staged[path] = start + cut
             if batch:
                 try:
                     await self._gcs.call(
@@ -834,7 +899,8 @@ class Raylet:
                         timeout=5.0,
                     )
                 except Exception:
-                    pass
+                    continue  # don't commit offsets: re-read and retry next tick
+                offsets.update(staged)
 
     async def _memory_monitor_loop(self) -> None:
         """Two duties of the reference's memory safety net: proactive spill
@@ -897,6 +963,9 @@ class Raylet:
             # Unsealed with a dead creator → reclaim and recreate.
             if self.store.contains(oid) == 2 or oid in self._spilled:
                 return {"exists": True}
+            # `_workers` covers raylet-spawned workers AND drivers (both
+            # register; dead drivers are reaped by the pid monitor), so a
+            # live creator of either kind is recognized here.
             creator = self._creating.get(oid)
             if creator is not None and creator in self._workers:
                 return {"error": "create_conflict",
@@ -1113,10 +1182,20 @@ class Raylet:
         b = self._pg_bundles.pop((p["pg_id"], p["bundle_index"]), None)
         if b is not None:
             self.resources.release(b["resources"])
+            self._wake_lease_waiters()  # freed capacity: admit parked leases
         return {}
 
     async def handle_ReturnBundle(self, p: dict) -> dict:
         return await self.handle_CancelBundle(p)
+
+    async def handle_ReleaseReader(self, p: dict) -> dict:
+        """Drop ALL read refs held by a reader (clean shutdown path: a
+        driver flushes its pins in one call instead of per-object releases
+        racing its io-loop teardown)."""
+        for oid, count in self._read_refs.pop(p.get("reader") or "", {}).items():
+            for _ in range(count):
+                self.store.release(oid)
+        return {}
 
     # ----------------------------------------------------------------- debug
     async def handle_ListWorkers(self, p: dict) -> dict:

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import pickle
 import struct
+import sys
 import threading
 from typing import Any, Callable
 
@@ -71,6 +72,22 @@ class PlasmaBuffer:
                 cb()
             except Exception:
                 pass
+
+    def copy_and_release(self) -> bytes:
+        """Pre-3.12 fallback (no PEP-688 ``__buffer__``): copy out of the
+        arena and release the read pin eagerly — loses zero-copy, keeps
+        correctness."""
+        data = bytes(self._mv)
+        if self._on_release is not None:
+            cb, self._on_release = self._on_release, None
+            try:
+                cb()
+            except Exception:
+                pass
+        return data
+
+
+_HAS_PEP688 = sys.version_info >= (3, 12)
 
 # Metadata tags (reference: ray_constants OBJECT_METADATA_TYPE_*).
 META_PICKLE5 = b"PICKLE5"
@@ -182,6 +199,10 @@ def _frame(buffers: list) -> bytes:
 
 
 def _unframe(blob: bytes | memoryview) -> list[memoryview]:
+    if not _HAS_PEP688 and isinstance(blob, PlasmaBuffer):
+        # memoryview(PlasmaBuffer) needs PEP-688 (__buffer__, 3.12+); on
+        # older interpreters copy out and release the read pin eagerly.
+        blob = blob.copy_and_release()
     mv = memoryview(blob)
     magic, n = _HEADER.unpack_from(mv, 0)
     if magic != _MAGIC:

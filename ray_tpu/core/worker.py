@@ -262,6 +262,7 @@ class CoreWorker:
         self.address = self.server.address
         self.io.run_coro(self._borrow_hold_sweeper())
         self.io.run_coro(self._task_event_flusher())
+        self.io.run_coro(self._global_gc_poller())
 
         install_refcount_hooks(self._hook_add_local, self._hook_remove_local)
 
@@ -309,6 +310,13 @@ class CoreWorker:
             events, dropped = self.task_events.drain()
             if events or dropped:
                 self._gcs_call("AddTaskEvents", {"events": events, "dropped": dropped}, timeout=5.0)
+        except Exception:
+            pass
+        # Flush read-ref pins in one call BEFORE stopping the io loop:
+        # per-object PlasmaRelease from GC'd buffers would race teardown
+        # and leak pins on the raylet (objects become unspillable).
+        try:
+            self._raylet_call("ReleaseReader", {"reader": self.worker_id}, timeout=5.0)
         except Exception:
             pass
 
@@ -1296,6 +1304,28 @@ class CoreWorker:
                 )
             except Exception:
                 pass
+
+    async def _global_gc_poller(self) -> None:
+        """Run ``gc.collect()`` when the GCS broadcasts a global GC —
+        scheduling is starved by resources that garbage may be pinning
+        (reference ``ray._private.internal_api.global_gc`` / core_worker
+        TriggerGlobalGC). Typical culprit: actor handles captured in
+        exception→traceback→frame reference cycles."""
+        import asyncio
+        import gc
+
+        cursor = None
+        while True:
+            try:
+                reply = await self.gcs.call(
+                    "PollGlobalGc", {"cursor": cursor, "timeout": 30.0}, timeout=40.0
+                )
+            except Exception:
+                await asyncio.sleep(1.0)
+                continue
+            cursor = reply.get("cursor", cursor)
+            if reply.get("triggered"):
+                gc.collect()
 
     async def _borrow_hold_sweeper(self) -> None:
         """Failsafe: drop return-holds whose caller never registered (it

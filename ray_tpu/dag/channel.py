@@ -55,12 +55,15 @@ class Channel:
         _HEADER.pack_into(self._view, 0, seq + 2, len(payload))  # even: committed
 
     def close_writer(self) -> None:
-        # Same two-phase seqlock as write(): a reader must never observe
-        # the new seq paired with the old length (it would re-consume the
-        # final payload and skip the STOP forever).
-        seq, length = _HEADER.unpack_from(self._view, 0)
-        _HEADER.pack_into(self._view, 0, seq + 1, length)  # odd: in progress
-        _HEADER.pack_into(self._view, 0, seq + 2, STOP)
+        # Two-phase, but the STOP length lands while seq is still ODD and
+        # the commit touches ONLY the seq word: a torn header can therefore
+        # never pair the new even seq with the stale length (which would
+        # re-consume the final payload and skip the STOP forever). write()
+        # is safe with its wider commit because its odd phase pre-writes
+        # the same length the commit re-writes.
+        seq, _length = _HEADER.unpack_from(self._view, 0)
+        _HEADER.pack_into(self._view, 0, seq + 1, STOP)  # odd: STOP staged
+        struct.pack_into("<Q", self._view, 0, seq + 2)   # commit seq alone
 
     # ------------------------------------------------------------------- read
     def read(self, last_seq: int, timeout: float | None = None) -> tuple[bytes, int]:

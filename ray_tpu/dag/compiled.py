@@ -169,10 +169,12 @@ class CompiledDAG:
         self._wait_ready(timeout=120.0)
 
     def _wait_ready(self, timeout: float) -> None:
-        """Block until every executor loop has opened its channels.
-        Actor creation can take seconds under load (worker churn); gating
-        here keeps execute() timeouts about execution, and surfaces loop
-        install failures (e.g. actor died) as real errors, not timeouts."""
+        """Block until every executor loop has opened its channels, so
+        execute() timeouts are about execution and loop-install failures
+        (e.g. actor died) surface as real errors. Actor creation cannot be
+        starved by task load anymore — the raylet admits actor-creation
+        leases ahead of task leases (raylet._acquire_resources_queued) —
+        so a miss here indicates a real failure, not scheduler unfairness."""
         import time
 
         from ..core import api as ray
@@ -194,7 +196,7 @@ class CompiledDAG:
                 missing = [m for m in markers if not os.path.exists(m)]
                 raise TimeoutError(
                     f"{len(missing)} DAG executor loop(s) not ready after "
-                    f"{timeout}s (actor creation starved?): {missing[:3]}"
+                    f"{timeout}s: {missing[:3]}"
                 )
             time.sleep(0.01)
 

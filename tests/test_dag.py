@@ -170,6 +170,11 @@ def test_unpicklable_result_propagates_as_error(ray_cluster):
         with pytest.raises(Exception) as exc_info:
             compiled.execute(1, timeout=30)
         assert "lock" in str(exc_info.value).lower() or "pickle" in str(exc_info.value).lower()
+        # Break the exc_info→traceback→frame cycle NOW: it captures `bad`
+        # in its frame locals, and until the cycle GC runs the actor handle
+        # stays alive — holding its dedicated CPU lease and starving
+        # whatever test runs next (the round-2 "starvation" flake).
+        del exc_info
         assert compiled.execute(5) == 5  # loop survived
     finally:
         compiled.teardown()
