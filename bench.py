@@ -192,6 +192,104 @@ print(json.dumps({"raw": %d * %d * %d / (time.perf_counter() - t0)}))
     raise RuntimeError(f"raw benchmark failed: {out.stderr[-2000:]}")
 
 
+def run_serve_bench() -> dict:
+    """Serve p50 TTFT north star (BASELINE.json): concurrent streaming
+    completions through the REAL stack — HTTP proxy → pow-2 router →
+    replica → paged continuous-batching engine on the chip — measuring
+    time-to-first-SSE-token and aggregate decode throughput."""
+    import statistics
+    import threading
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm import build_llm_app
+
+    preset = os.environ.get("RAY_TPU_SERVE_PRESET", "llama3-1b" if not ALLOW_CPU else "debug-128")
+    n_clients = int(os.environ.get("RAY_TPU_SERVE_CLIENTS", "8"))
+    reqs_per_client = int(os.environ.get("RAY_TPU_SERVE_REQS", "3"))
+    max_tokens = int(os.environ.get("RAY_TPU_SERVE_MAX_TOKENS", "64"))
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    app = build_llm_app(
+        preset,
+        max_slots=8,
+        max_len=512,
+        page_size=64,
+        prefill_chunk_size=256,
+        decode_steps_per_dispatch=16,
+        max_ongoing_requests=32,
+        ray_actor_options=None if ALLOW_CPU else {
+            "resources": {"TPU": 1},
+            "runtime_env": {"env_vars": {"JAX_PLATFORMS": None}},
+        },
+    )
+    serve.run(app, name="llm-bench")
+    addr = serve.http_address()
+
+    def one_request(prompt: str, timeout: float = 600.0):
+        body = json.dumps({"prompt": prompt, "max_tokens": max_tokens,
+                           "stream": True}).encode()
+        req = urllib.request.Request(
+            addr + "/v1/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        ttft = None
+        n_tokens = 0
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            for line in resp:
+                line = line.decode().strip()
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    if ttft is None:
+                        ttft = time.perf_counter() - t0
+                    n_tokens += 1
+        return ttft, n_tokens, time.perf_counter() - t0
+
+    # Warmup: compile prefill buckets + decode program.
+    one_request("w" * 90)
+    one_request("x" * 200)
+
+    ttfts: list[float] = []
+    token_counts: list[int] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+
+    def client(cid: int) -> None:
+        for j in range(reqs_per_client):
+            prompt = f"client {cid} request {j}: " + "abcdefgh" * (8 + (cid + j) % 12)
+            try:
+                ttft, n_tok, _ = one_request(prompt)
+            except Exception as e:
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                return
+            with lock:
+                if ttft is not None:
+                    ttfts.append(ttft)
+                token_counts.append(n_tok)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    serve.shutdown()
+    ray_tpu.shutdown()
+    if errors or not ttfts:
+        raise RuntimeError(f"serve bench failed: {errors[:3]}")
+    ttfts.sort()
+    return {
+        "serve_p50_ttft_ms": round(1000 * statistics.median(ttfts), 1),
+        "serve_p95_ttft_ms": round(1000 * ttfts[max(0, int(len(ttfts) * 0.95) - 1)], 1),
+        "serve_tokens_per_sec": round(sum(token_counts) / wall, 1),
+        "serve_requests": len(token_counts),
+        "serve_concurrency": n_clients,
+        "serve_preset": preset,
+    }
+
+
 def main() -> None:
     fw = run_framework()
     try:
@@ -199,6 +297,11 @@ def main() -> None:
     except Exception as e:
         print(f"raw comparison failed: {e}", file=sys.stderr)
         raw = None
+    try:
+        serve_metrics = run_serve_bench()
+    except Exception as e:
+        print(f"serve bench failed: {e}", file=sys.stderr)
+        serve_metrics = {"serve_error": f"{type(e).__name__}: {e}"}
     value = fw["tokens_per_sec_per_chip"]
     baseline = None
     if os.path.exists("BENCH_BASELINE.json"):
@@ -215,6 +318,7 @@ def main() -> None:
         "loss": round(fw["loss"], 4),
         "raw_tokens_per_sec": round(raw, 2) if raw else None,
         "framework_overhead_pct": round(100 * (1 - value / raw), 2) if raw else None,
+        **serve_metrics,
     }))
 
 

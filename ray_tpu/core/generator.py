@@ -43,6 +43,10 @@ class StreamState:
         # Producer backpressure long-polls park asyncio futures here instead
         # of blocking an executor thread: (until, loop, future).
         self._async_waiters: list[tuple[int, Any, Any]] = []
+        # Consumer-side async item waits: (cursor, loop, future) fired when
+        # item `cursor` is reported (or the stream ends) — lets async
+        # consumers (Serve proxy) wait loop-natively, no thread per stream.
+        self._item_waiters: list[tuple[int, Any, Any]] = []
 
     def _fire_async_waiters_locked(self) -> None:
         remaining = []
@@ -62,11 +66,30 @@ class StreamState:
             self._async_waiters.append((until, loop, fut))
             return True
 
+    def _fire_item_waiters_locked(self) -> None:
+        remaining = []
+        for cursor, loop, fut in self._item_waiters:
+            if cursor < self.num_items or self.finished:
+                loop.call_soon_threadsafe(lambda f=fut: f.done() or f.set_result(True))
+            else:
+                remaining.append((cursor, loop, fut))
+        self._item_waiters = remaining
+
+    def add_item_waiter(self, cursor: int, loop, fut) -> bool:
+        """Register a loop-native waiter for item ``cursor`` (or stream
+        end). Returns False if it is already available."""
+        with self.cond:
+            if cursor < self.num_items or self.finished:
+                return False
+            self._item_waiters.append((cursor, loop, fut))
+            return True
+
     def report_item(self, index: int) -> None:
         with self.cond:
             if index + 1 > self.num_items:
                 self.num_items = index + 1
             self.cond.notify_all()
+            self._fire_item_waiters_locked()
 
     def finish(self, total: int) -> None:
         with self.cond:
@@ -77,6 +100,7 @@ class StreamState:
                 self.num_items = self.total
             self.cond.notify_all()
             self._fire_async_waiters_locked()
+            self._fire_item_waiters_locked()
 
     def fail(self, error: Exception) -> None:
         with self.cond:
@@ -85,6 +109,7 @@ class StreamState:
             self.finished = True
             self.cond.notify_all()
             self._fire_async_waiters_locked()
+            self._fire_item_waiters_locked()
 
     def mark_consumed(self) -> int:
         with self.cond:
@@ -148,20 +173,17 @@ class ObjectRefGenerator:
     async def __anext__(self):
         import asyncio
 
-        _END = object()
-
-        def _next_or_end():
-            # StopIteration cannot cross a Future boundary: map to a sentinel.
-            try:
-                return self._next_sync(None)
-            except StopIteration:
-                return _END
-
         loop = asyncio.get_running_loop()
-        result = await loop.run_in_executor(None, _next_or_end)
-        if result is _END:
+        # Loop-native wait for the next item: no executor thread is parked
+        # per waiting stream (matters with many concurrent token streams).
+        fut = loop.create_future()
+        if self._stream.add_item_waiter(self._cursor, loop, fut):
+            await fut
+        try:
+            # Item (or end) is available: _next_sync returns without blocking.
+            return self._next_sync(timeout=30.0)
+        except StopIteration:
             raise StopAsyncIteration
-        return result
 
     def completed(self) -> bool:
         with self._stream.cond:

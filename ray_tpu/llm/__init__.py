@@ -1,24 +1,28 @@
-"""TPU-native LLM inference: continuous batching over a slot KV cache.
+"""TPU-native LLM inference: continuous batching over a paged KV cache.
 
 Equivalent of the reference's ``ray.llm`` serving stack
 (``python/ray/llm/_internal/serve/deployments/llm/llm_server.py:415``,
 ``vllm_engine.py``), which delegates the engine to vLLM. Here the engine is
-first-class and TPU-first: instead of vLLM's paged KV with dynamic page
-tables (a GPU-pointer-chasing design), the cache is a dense per-slot tensor
-— JetStream-style — so every prefill/decode step is a fixed-shape XLA
-program that stays on the MXU with zero recompilation at steady state.
+first-class and TPU-first: the KV cache is a shared page pool indexed by
+per-sequence block tables (vLLM's paged attention, recovered with static
+shapes: block tables are data, not shapes, so XLA compiles one decode
+program and one prefill program per chunk bucket). Chunked prefill bounds
+TTFT impact on running streams; hash-matched prompt prefixes reuse pages
+without recomputation; token streaming rides the core streaming-generator
+protocol through Serve.
 """
 
-from .engine import InferenceEngine, Request
-from .model import decode_step, init_cache, prefill
+from .engine import InferenceEngine, PageAllocator, Request
+from .model import decode_step, init_pages, prefill_chunk
 from .serving import LLMDeployment, build_llm_app
 from .tokenizer import ByteTokenizer
 
 __all__ = [
     "InferenceEngine",
+    "PageAllocator",
     "Request",
-    "init_cache",
-    "prefill",
+    "init_pages",
+    "prefill_chunk",
     "decode_step",
     "LLMDeployment",
     "build_llm_app",

@@ -1,31 +1,35 @@
-"""Continuous-batching inference engine.
+"""Continuous-batching inference engine over a paged KV cache.
 
 The scheduler half of what the reference delegates to vLLM
-(``AsyncLLMEngine`` in
-``python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py``):
-requests arrive at any time, prefill is interleaved with batched decode,
-and finished sequences free their slot for waiting requests immediately
-(continuous batching, not static batching).
+(``AsyncLLMEngine`` in ``python/ray/llm/_internal/serve/deployments/llm/
+vllm/vllm_engine.py:250``): requests arrive at any time, page-aligned
+**chunked prefill** interleaves with batched decode (bounding TTFT impact
+on running streams), finished sequences free their pages immediately, and
+hash-matched prompt prefixes reuse previously computed pages without
+recomputation (prefix caching / automatic prefix reuse).
 
 TPU shape discipline: decode always runs the full ``[max_slots]`` batch
-(inactive slots compute garbage that is ignored — branchless, so one
-compiled program serves every occupancy), and prompts pad to power-of-two
-buckets so prefill compiles once per bucket, not once per prompt length.
+(inactive slots write to private trash pages — branchless, one compiled
+program for every occupancy), and prefill chunks are fixed-size buckets so
+XLA compiles one program per bucket, not per prompt length.
 """
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
+from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..models.llama import LlamaConfig, PRESETS, init_params
-from .model import decode_step, init_cache, insert_kv, prefill
+from .model import decode_loop, init_pages, prefill_chunk, sample_first_batch
 
 
 @dataclass
@@ -35,21 +39,91 @@ class Request:
     max_new_tokens: int = 32
     temperature: float = 0.0
     eos_id: int | None = None
+    stop_ids: list[int] = field(default_factory=list)
     # runtime state
     generated: list[int] = field(default_factory=list)
     slot: int = -1
-    pos: int = 0  # next position to write
+    pos: int = 0                 # next position to write
+    prefill_pos: int = 0         # prompt tokens already prefilled
+    block_table: list[int] = field(default_factory=list)
     done: bool = False
     finish_reason: str = ""
+    arrived_at: float = field(default_factory=time.monotonic)
+    first_token_at: float | None = None
+    cached_prefix_tokens: int = 0
+
+
+class PageAllocator:
+    """Page pool bookkeeping: free list, per-page refcounts, and the
+    content-hash prefix cache (pages are immutable once full, so a page
+    whose chain-hash matches can be shared read-only between sequences —
+    the reference's automatic prefix caching)."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self.free: list[int] = list(range(num_pages))
+        self.refcount: dict[int, int] = {}
+        # chain-hash of tokens[0:(i+1)*page] -> page_id, + LRU stamps for
+        # eviction of refcount-0 cached pages.
+        self.prefix_map: dict[bytes, int] = {}
+        self.page_hash: dict[int, bytes] = {}
+        self.last_used: dict[int, float] = {}
+
+    def available(self) -> int:
+        return len(self.free) + sum(
+            1 for h, p in self.prefix_map.items() if self.refcount.get(p, 0) == 0
+        )
+
+    def alloc(self, n: int) -> list[int] | None:
+        if self.available() < n:
+            return None
+        out = []
+        for _ in range(n):
+            if self.free:
+                pid = self.free.pop()
+            else:
+                pid = self._evict_one()
+            self.refcount[pid] = 1
+            out.append(pid)
+        return out
+
+    def _evict_one(self) -> int:
+        victim_hash, victim = min(
+            ((h, p) for h, p in self.prefix_map.items() if self.refcount.get(p, 0) == 0),
+            key=lambda hp: self.last_used.get(hp[1], 0.0),
+        )
+        self.prefix_map.pop(victim_hash, None)
+        self.page_hash.pop(victim, None)
+        return victim
+
+    def share(self, page_id: int) -> None:
+        self.refcount[page_id] = self.refcount.get(page_id, 0) + 1
+        self.last_used[page_id] = time.monotonic()
+
+    def release(self, page_id: int) -> None:
+        count = self.refcount.get(page_id, 1) - 1
+        self.refcount[page_id] = count
+        if count <= 0:
+            self.refcount.pop(page_id, None)
+            if page_id in self.page_hash:
+                self.last_used[page_id] = time.monotonic()  # evictable, cached
+            else:
+                self.free.append(page_id)
+
+    def register_prefix(self, page_id: int, chain_hash: bytes) -> None:
+        if chain_hash not in self.prefix_map:
+            self.prefix_map[chain_hash] = page_id
+            self.page_hash[page_id] = chain_hash
+            self.last_used[page_id] = time.monotonic()
+
+    def lookup_prefix(self, chain_hash: bytes) -> int | None:
+        return self.prefix_map.get(chain_hash)
 
 
 class InferenceEngine:
-    """Single-host engine; one slot-cache resident on the default device.
-
-    Thread-safety: ``add_request``/``cancel`` may be called from any
-    thread; ``step`` must be called from one driver thread (the serving
-    replica's engine loop).
-    """
+    """Single-host paged-KV engine; the page pool lives on the default
+    device. ``add_request``/``cancel`` are thread-safe; ``step`` must be
+    called from one driver thread (the serving replica's engine loop)."""
 
     def __init__(
         self,
@@ -58,6 +132,11 @@ class InferenceEngine:
         *,
         max_slots: int = 8,
         max_len: int = 512,
+        page_size: int = 16,
+        num_pages: int | None = None,
+        prefill_chunk_size: int = 128,
+        decode_steps_per_dispatch: int = 8,
+        enable_prefix_cache: bool = True,
         seed: int = 0,
     ):
         self.config = PRESETS[config] if isinstance(config, str) else config
@@ -65,18 +144,45 @@ class InferenceEngine:
             params = init_params(self.config, jax.random.PRNGKey(seed))
         self.params = params
         self.max_slots = max_slots
+        self.page_size = page_size
+        assert max_len % page_size == 0, "max_len must be a multiple of page_size"
         self.max_len = max_len
-        self.cache = init_cache(self.config, max_slots, max_len)
+        self.max_pages_per_seq = max_len // page_size
+        self.prefill_chunk_size = min(prefill_chunk_size, max_len)
+        assert self.prefill_chunk_size % page_size == 0
+        self.enable_prefix_cache = enable_prefix_cache
+        # Decode steps fused into one device dispatch (lax.scan): a host
+        # sync costs a full round trip (~150ms over a remote-dispatch
+        # tunnel), so syncing once per K tokens is the difference between
+        # 7 tok/s/slot and wire-speed decode.
+        self.decode_steps_per_dispatch = max(1, decode_steps_per_dispatch)
+        # Pool: per-slot trash pages + usable pages (default: enough for
+        # every slot to hold a full-length sequence — shrink for memory).
+        usable = num_pages if num_pages is not None else max_slots * self.max_pages_per_seq
+        self.num_pages = max_slots + usable
+        self.pages = init_pages(self.config, self.num_pages, page_size)
+        self.allocator = PageAllocator(self.num_pages)
+        # Trash pages 0..max_slots-1 are permanently owned by their slot.
+        for s in range(max_slots):
+            self.allocator.free.remove(s)
         self._free_slots = list(range(max_slots))
-        self._active: dict[int, Request] = {}
+        self._active: dict[int, Request] = {}       # decoding
+        self._prefilling: deque[Request] = deque()  # admitted, chunks pending
+        # Prefilled requests awaiting their (batched) first-token sample:
+        # a burst of arrivals costs ONE sampling sync, not one each.
+        self._pending_first: list[tuple[Request, Any]] = []
         self._waiting: deque[Request] = deque()
         self._lock = threading.Lock()
         self._key = jax.random.PRNGKey(seed ^ 0x5EED)
         self._counter = itertools.count()
-        # Host-side mirrors of the decode-step inputs.
+        # Host-side mirrors of decode-step inputs. Block tables default to
+        # the slot's trash page so inactive slots never corrupt live pages.
         self._tokens = np.zeros(max_slots, np.int32)
         self._pos = np.zeros(max_slots, np.int32)
-        self.buckets = [b for b in (32, 64, 128, 256, 512, 1024, 2048, 4096) if b <= max_len]
+        self._block_tables = np.tile(
+            np.arange(max_slots, dtype=np.int32)[:, None], (1, self.max_pages_per_seq)
+        )
+        self.metrics = {"prefix_hit_pages": 0, "prefill_chunks": 0, "decode_steps": 0}
 
     # ------------------------------------------------------------- admission
     def add_request(self, request: Request) -> None:
@@ -84,6 +190,8 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt of {len(request.prompt)} tokens >= max_len {self.max_len}"
             )
+        if not request.prompt:
+            raise ValueError("empty prompt")
         with self._lock:
             self._waiting.append(request)
 
@@ -96,62 +204,199 @@ class InferenceEngine:
                 else:
                     keep.append(r)
             self._waiting = keep
+            keep = deque()
+            for r in self._prefilling:
+                if r.request_id == request_id:
+                    r.done, r.finish_reason = True, "cancelled"
+                    self._retire_locked(r)
+                else:
+                    keep.append(r)
+            self._prefilling = keep
             for slot, r in list(self._active.items()):
                 if r.request_id == request_id:
                     r.done, r.finish_reason = True, "cancelled"
-                    self._retire(slot)
+                    self._retire_locked(r)
+            for r, _h in self._pending_first:
+                if r.request_id == request_id and not r.done:
+                    r.done, r.finish_reason = True, "cancelled"
+                    self._retire_locked(r)  # flush skips done entries
 
     @property
     def has_work(self) -> bool:
         with self._lock:
-            return bool(self._waiting or self._active)
+            return bool(self._waiting or self._prefilling or self._active
+                        or self._pending_first)
 
-    def _retire(self, slot: int) -> None:
-        # Idempotent: cancel() and _emit() can both observe a finished
-        # request; the slot must enter the free list exactly once.
-        if self._active.pop(slot, None) is not None:
-            self._free_slots.append(slot)
-
-    def _bucket(self, n: int) -> int:
-        for b in self.buckets:
-            if n <= b:
-                return b
-        return self.max_len
+    def _retire_locked(self, r: Request) -> None:
+        """Free the request's slot and pages (idempotent). Full PROMPT
+        pages enter the prefix cache instead of the free list."""
+        if r.slot >= 0 and r.slot in self._active:
+            self._active.pop(r.slot, None)
+            self._free_slots.append(r.slot)
+            self._block_tables[r.slot, :] = r.slot  # back to trash page
+        elif r.slot >= 0 and r.slot in self._free_slots:
+            pass  # already retired
+        elif r.slot >= 0:
+            self._free_slots.append(r.slot)
+            self._block_tables[r.slot, :] = r.slot
+        if r.block_table:
+            if self.enable_prefix_cache and r.finish_reason != "admission_failed":
+                # Register only pages whose K/V was actually COMPUTED: a
+                # cancel mid-prefill leaves later prompt pages holding
+                # garbage — caching them would poison future prefix hits.
+                full_prompt_pages = min(len(r.prompt), r.prefill_pos) // self.page_size
+                h = hashlib.sha1()
+                for i in range(full_prompt_pages):
+                    h.update(bytes(np.asarray(
+                        r.prompt[i * self.page_size:(i + 1) * self.page_size],
+                        np.int32).tobytes()))
+                    self.allocator.register_prefix(r.block_table[i], h.digest())
+            for pid in r.block_table:
+                self.allocator.release(pid)
+            r.block_table = []
+        r.slot = -1
 
     # ------------------------------------------------------------------ step
     def step(self) -> list[dict]:
-        """Advance the engine: admit one waiting request (prefill) if a slot
-        is free, else run one batched decode step. Returns emission events
-        ``{"request_id", "token", "done", "finish_reason"}``."""
+        """Advance the engine one tick: admit waiting requests while slots
+        and pages allow; run ONE prefill chunk if any admitted prompt has
+        chunks pending; flush batched first-token samples once the prefill
+        queue drains; else run ONE batched decode burst. Returns emission
+        events ``{"request_id", "token", "done", "finish_reason"}``."""
+        self._admit()
         with self._lock:
-            admit = self._waiting.popleft() if self._waiting and self._free_slots else None
-        if admit is not None:
-            return self._prefill_one(admit)
+            r = self._prefilling[0] if self._prefilling else None
+        if r is not None:
+            events = self._prefill_chunk_one(r)
+            with self._lock:
+                drained = not self._prefilling
+            if drained and self._pending_first:
+                events = events + self._flush_first_samples()
+            return events
+        if self._pending_first:
+            return self._flush_first_samples()
         if self._active:
             return self._decode_all()
         return []
 
-    def _sample(self, logits: jax.Array, temperature: float) -> int:
-        if temperature <= 0.0:
-            return int(jnp.argmax(logits))
-        self._key, sub = jax.random.split(self._key)
-        return int(jax.random.categorical(sub, logits / temperature))
-
-    def _prefill_one(self, r: Request) -> list[dict]:
-        bucket = self._bucket(len(r.prompt))
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, : len(r.prompt)] = r.prompt
-        ks, vs, hidden = prefill(self.params, jnp.asarray(padded), self.config)
+    def _admit(self) -> None:
         with self._lock:
-            slot = self._free_slots.pop()
-            r.slot = slot
-            self._active[slot] = r
-        self.cache = insert_kv(self.cache, ks, vs, jnp.int32(slot), self.config, self.max_len)
-        last = hidden[0, len(r.prompt) - 1]
-        logits = (last @ self.params["lm_head"]).astype(jnp.float32)
-        token = self._sample(logits, r.temperature)
-        r.pos = len(r.prompt)
-        return [self._emit(r, token)]
+            while self._waiting and self._free_slots:
+                r = self._waiting[0]
+                # Worst-case pages so a running request can never OOM the
+                # pool mid-decode (admission control replaces page faults).
+                total_tokens = len(r.prompt) + r.max_new_tokens
+                n_pages = min(
+                    (total_tokens + self.page_size - 1) // self.page_size,
+                    self.max_pages_per_seq,
+                )
+                hits: list[int] = []
+                if self.enable_prefix_cache:
+                    hits = self._prefix_hits(r)
+                if self.allocator.available() < n_pages - len(hits):
+                    break  # head-of-line: wait for pages to free
+                self._waiting.popleft()
+                # Bump hit refcounts BEFORE alloc: alloc's LRU eviction only
+                # skips refcount>0 pages, so an unshared hit page could be
+                # evicted and handed back as "fresh" — the same physical
+                # page at two block-table positions (silent KV corruption).
+                for pid in hits:
+                    self.allocator.share(pid)
+                fresh = self.allocator.alloc(n_pages - len(hits))
+                if fresh is None:  # race-free under lock, but be safe
+                    for pid in hits:
+                        self.allocator.release(pid)
+                    r.done, r.finish_reason = True, "admission_failed"
+                    continue
+                r.block_table = hits + fresh
+                r.prefill_pos = len(hits) * self.page_size
+                r.cached_prefix_tokens = r.prefill_pos
+                self.metrics["prefix_hit_pages"] += len(hits)
+                r.slot = self._free_slots.pop()
+                self._block_tables[r.slot, :len(r.block_table)] = r.block_table
+                self._prefilling.append(r)
+
+    def _prefix_hits(self, r: Request) -> list[int]:
+        """Longest run of cached pages covering the prompt, capped so at
+        least one prompt token is always computed (its hidden state seeds
+        sampling — the reference caps identically)."""
+        max_hit_pages = (len(r.prompt) - 1) // self.page_size
+        hits: list[int] = []
+        h = hashlib.sha1()
+        for i in range(max_hit_pages):
+            h.update(bytes(np.asarray(
+                r.prompt[i * self.page_size:(i + 1) * self.page_size],
+                np.int32).tobytes()))
+            pid = self.allocator.lookup_prefix(h.digest())
+            if pid is None:
+                break
+            hits.append(pid)
+        return hits
+
+    def _chunk_bucket(self, n: int) -> int:
+        b = self.page_size
+        while b < n and b < self.prefill_chunk_size:
+            b *= 2
+        return min(b, self.prefill_chunk_size)
+
+    def _prefill_chunk_one(self, r: Request) -> list[dict]:
+        remaining = len(r.prompt) - r.prefill_pos
+        # Bucket, clamped so the chunk's pages never run past the table
+        # (both operands are page-aligned).
+        chunk = min(self._chunk_bucket(remaining), self.max_len - r.prefill_pos)
+        tokens = np.zeros(chunk, np.int32)
+        take = min(remaining, chunk)
+        tokens[:take] = r.prompt[r.prefill_pos:r.prefill_pos + take]
+        bt = np.full(self.max_pages_per_seq, r.slot, np.int32)  # trash-pad
+        bt[:len(r.block_table)] = r.block_table
+        self.pages, hidden = prefill_chunk(
+            self.params, self.pages, jnp.asarray(bt), jnp.asarray(tokens),
+            jnp.int32(r.prefill_pos), self.config, self.page_size,
+        )
+        self.metrics["prefill_chunks"] += 1
+        r.prefill_pos += take
+        if r.prefill_pos < len(r.prompt):
+            return []  # more chunks to go
+        # Prompt complete: queue the last real position's hidden state for
+        # BATCHED first-token sampling (device array stays on device — no
+        # sync here; a burst of prefills costs one sampling sync total).
+        with self._lock:
+            if r.done:  # cancelled mid-prefill
+                if self._prefilling and self._prefilling[0] is r:
+                    self._prefilling.popleft()
+                return []
+            self._prefilling.popleft()
+        self._pending_first.append((r, hidden[take - 1]))
+        return []
+
+    def _flush_first_samples(self) -> list[dict]:
+        """One dispatch + one sync samples the first token for every
+        pending just-prefilled request."""
+        pending, self._pending_first = self._pending_first, []
+        pending = [(r, h) for r, h in pending if not r.done]
+        if not pending:
+            return []
+        # Pad to max_slots so sample_first_batch compiles ONCE, not per
+        # distinct batch size.
+        m = len(pending)
+        hiddens = jnp.stack([h for _, h in pending]
+                            + [pending[0][1]] * (self.max_slots - m))
+        temps = np.zeros(self.max_slots, np.float32)
+        temps[:m] = [r.temperature for r, _ in pending]
+        toks, self._key = sample_first_batch(
+            hiddens, self.params["lm_head"], jnp.asarray(temps), self._key)
+        tokens = np.asarray(toks)  # the one sync
+        events = []
+        now = time.monotonic()
+        for i, (r, _) in enumerate(pending):
+            with self._lock:
+                if r.done:  # cancelled while sampling
+                    continue
+                self._active[r.slot] = r
+            r.pos = len(r.prompt)
+            r.first_token_at = now
+            events.append(self._emit(r, int(tokens[i])))
+        return events
 
     def _decode_all(self) -> list[dict]:
         with self._lock:
@@ -159,38 +404,51 @@ class InferenceEngine:
         if not active:
             return []
         temps = np.ones(self.max_slots, np.float32)
+        eos_ids = np.full(self.max_slots, -1, np.int32)
+        remaining = np.zeros(self.max_slots, np.int32)
         for slot, r in active.items():
             self._tokens[slot] = r.generated[-1]
             self._pos[slot] = r.pos
             temps[slot] = r.temperature
-        logits, self.cache = decode_step(
-            self.params, self.cache, jnp.asarray(self._tokens), jnp.asarray(self._pos), self.config
+            eos_ids[slot] = -1 if r.eos_id is None else r.eos_id
+            remaining[slot] = min(
+                r.max_new_tokens - len(r.generated),
+                len(r.block_table) * self.page_size - r.pos,
+            )
+        # K fused decode+sample steps in ONE dispatch, ONE host sync
+        # (on-device lax.scan). Finished slots redirect writes to trash;
+        # their surplus tokens are discarded below.
+        K = self.decode_steps_per_dispatch
+        toks, self._key, self.pages = decode_loop(
+            self.params, self.pages, jnp.asarray(self._block_tables),
+            jnp.asarray(self._tokens), jnp.asarray(self._pos),
+            jnp.asarray(temps), jnp.asarray(eos_ids), jnp.asarray(remaining),
+            self._key, self.config, self.page_size, K,
         )
-        # One batched sample + one device->host transfer per step (not one
-        # per slot): greedy argmax and tempered categorical computed for
-        # all slots, picked per-slot by temperature.
-        self._key, sub = jax.random.split(self._key)
-        greedy = jnp.argmax(logits, axis=-1)
-        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-        sampled = jax.random.categorical(sub, scaled)
-        tokens = np.asarray(jnp.where(jnp.asarray(temps) > 0.0, sampled, greedy))
+        self.metrics["decode_steps"] += K
+        tokens = np.asarray(toks)  # [K, slots] — the one sync
         events = []
-        for slot, r in active.items():
-            r.pos += 1
-            events.append(self._emit(r, int(tokens[slot])))
+        for k in range(K):
+            for slot, r in active.items():
+                if r.done:
+                    continue
+                r.pos += 1
+                if r.first_token_at is None:
+                    r.first_token_at = time.monotonic()
+                events.append(self._emit(r, int(tokens[k, slot])))
         return events
 
     def _emit(self, r: Request, token: int) -> dict:
         r.generated.append(token)
-        if r.eos_id is not None and token == r.eos_id:
+        if (r.eos_id is not None and token == r.eos_id) or token in r.stop_ids:
             r.done, r.finish_reason = True, "stop"
         elif len(r.generated) >= r.max_new_tokens:
             r.done, r.finish_reason = True, "length"
-        elif r.pos >= self.max_len - 1:
+        elif r.pos >= min(self.max_len, len(r.block_table) * self.page_size) - 1:
             r.done, r.finish_reason = True, "max_len"
         if r.done:
             with self._lock:
-                self._retire(r.slot)  # idempotent if cancel() beat us to it
+                self._retire_locked(r)  # idempotent if cancel() beat us
         return {
             "request_id": r.request_id,
             "token": token,

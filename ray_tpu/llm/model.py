@@ -1,18 +1,37 @@
-"""Prefill / decode forward passes over a slot KV cache.
+"""Paged-KV prefill / decode forward passes.
 
-Redesign of what the reference delegates to vLLM's paged attention
-(``python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_models.py``):
-on TPU, dynamic page tables defeat XLA's static-shape compilation, so the
-cache is a dense tensor ``[layers, slots, kv_heads, max_len, head_dim]``.
-A sequence owns one slot for its lifetime (JetStream's insert/generate
-layout); admission control in the engine replaces page allocation.
+TPU-native redesign of the paged attention the reference delegates to
+vLLM (``python/ray/llm/_internal/serve/deployments/llm/vllm/
+vllm_engine.py:250``): the KV cache is a shared **page pool**
 
-Invariant: before a decode step for a sequence at position ``pos``, the
-cache holds K/V for positions ``[0, pos)``; the step writes position
-``pos`` and attends over ``[0, pos]``. Prefill pads prompts to a bucket
-length — padded garbage beyond ``true_len`` is progressively overwritten
-by decode before it ever enters an attention window, so no masking state
-is needed beyond the position counter.
+    k_pages / v_pages: [layers, num_pages, kv_heads, page_size, head_dim]
+
+and each sequence owns an int32 **block table** of page indices. All shapes
+are static — block tables are data, not shapes — so XLA compiles one
+program per (chunk bucket) and one decode program total, while the
+allocator moves pages between sequences at runtime (the property vLLM
+gets from CUDA kernels, recovered here through gather/scatter that XLA
+tiles natively).
+
+Design points:
+  * **Chunked prefill** (``prefill_chunk``): a prompt is processed in
+    page-aligned chunks; each chunk attends over the pages written so far
+    plus itself (causal), then scatters its K/V into the pool. Bounded
+    chunk size keeps decode TTFT for other requests bounded — the
+    reference's chunked-prefill scheduling.
+  * **Prefix reuse**: because chunk starts are page-aligned, a prompt
+    whose leading pages hash-match previously computed pages skips them
+    entirely — the block table points at the shared pages (engine-side
+    refcounting; pages are immutable once full).
+  * **Decode** (``decode_step``): one batched step over all slots;
+    context K/V is gathered per-slot via the block tables. Inactive slots
+    point at a per-slot trash page so their (ignored) writes never
+    corrupt live pages — branchless, one compiled program for every
+    occupancy.
+
+Invariant (same as the reference's page model): before any step at
+position ``pos``, pages hold K/V for ``[0, pos)``; the step writes
+``pos`` and attends over ``[0, pos]``; garbage beyond ``pos`` is masked.
 """
 
 from __future__ import annotations
@@ -27,13 +46,13 @@ from ..models.llama import LlamaConfig
 from ..ops import apply_rope, rms_norm
 
 
-def init_cache(config: LlamaConfig, max_slots: int, max_len: int) -> dict:
+def init_pages(config: LlamaConfig, num_pages: int, page_size: int) -> dict:
     c = config
-    shape = (c.n_layers, max_slots, c.n_kv_heads, max_len, c.head_dim)
+    shape = (c.n_layers, num_pages, c.n_kv_heads, page_size, c.head_dim)
     return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
 
 
-def _project_qkv(h, layer, c: LlamaConfig):
+def _project_qkv(h, layer):
     q = jnp.einsum("bse,ehd->bhsd", h, layer["wq"])
     k = jnp.einsum("bse,ehd->bhsd", h, layer["wk"])
     v = jnp.einsum("bse,ehd->bhsd", h, layer["wv"])
@@ -48,97 +67,231 @@ def _mlp(x, layer, c: LlamaConfig):
     return x + jnp.einsum("bsm,me->bse", ff, layer["w_down"])
 
 
-@functools.partial(jax.jit, static_argnames=("config",))
-def prefill(params, tokens, config: LlamaConfig):
-    """Full causal forward on one padded prompt, collecting per-layer K/V.
+def _gather_ctx(pages_l, block_table):
+    """pages_l [P, KH, page, D] + block_table [B] -> [KH, B*page, D]."""
+    g = pages_l[block_table]                       # [B, KH, page, D]
+    g = jnp.swapaxes(g, 0, 1)                      # [KH, B, page, D]
+    return g.reshape(g.shape[0], -1, g.shape[-1])  # [KH, ctx, D]
 
-    tokens: [1, S] int32 (S = a static bucket length).
-    Returns (k_layers [L, KH, S, D], v_layers, hidden [1, S, E]).
+
+@functools.partial(jax.jit, static_argnames=("config", "page_size"),
+                   donate_argnames=("pages",))
+def prefill_chunk(params, pages: dict, block_table, tokens, start_pos,
+                  config: LlamaConfig, page_size: int):
+    """Process one page-aligned prompt chunk.
+
+    tokens:      [C] int32, C a multiple of ``page_size`` (static bucket).
+    block_table: [max_pages_per_seq] int32 — this sequence's pages.
+    start_pos:   scalar int32, multiple of ``page_size``.
+
+    Attends over previously-written context ``[0, start_pos)`` (gathered
+    via the block table) plus the chunk itself (causal), writes the
+    chunk's K/V into its pages, and returns (pages, hidden [C, E]).
     """
     c = config
-    _, s = tokens.shape
-    positions = jnp.arange(s, dtype=jnp.int32)
-    x = params["embed"][tokens].astype(c.dtype)
-
-    def body(carry, layer):
-        h = rms_norm(carry, layer["attn_norm"], eps=c.norm_eps)
-        q, k, v = _project_qkv(h, layer, c)
-        q = apply_rope(q, positions, theta=c.rope_theta)
-        k = apply_rope(k, positions, theta=c.rope_theta)
-        # [1, H, S, D] x [1, KH, S, D] causal GQA in f32 scores.
-        kh, g = c.n_kv_heads, c.n_heads // c.n_kv_heads
-        qg = q.reshape(1, kh, g, s, c.head_dim)
-        scores = jnp.einsum("bkgsd,bktd->bkgst", qg, k).astype(jnp.float32)
-        scores *= c.head_dim ** -0.5
-        causal = positions[:, None] >= positions[None, :]
-        scores = jnp.where(causal[None, None, None], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
-        attn = jnp.einsum("bkgst,bktd->bkgsd", probs, v).reshape(1, c.n_heads, s, c.head_dim)
-        out = jnp.einsum("bhsd,hde->bse", attn, layer["wo"])
-        x2 = _mlp(carry + out, layer, c)
-        return x2, (k[0], v[0])
-
-    x, (ks, vs) = lax.scan(body, x, params["layers"])
-    hidden = rms_norm(x, params["final_norm"], eps=c.norm_eps)
-    return ks, vs, hidden
-
-
-@functools.partial(jax.jit, static_argnames=("config", "max_len"),
-                   donate_argnames=("cache",))
-def insert_kv(cache: dict, k_layers, v_layers, slot, config: LlamaConfig, max_len: int) -> dict:
-    """Copy a prefilled prompt's K/V into the cache at ``slot``.
-    k_layers/v_layers: [L, KH, S, D] with S <= max_len (padded to bucket)."""
-    L, KH, S, D = k_layers.shape
-    pad = max_len - S
-    if pad:
-        k_layers = jnp.pad(k_layers, ((0, 0), (0, 0), (0, pad), (0, 0)))
-        v_layers = jnp.pad(v_layers, ((0, 0), (0, 0), (0, pad), (0, 0)))
-    k = lax.dynamic_update_slice(cache["k"], k_layers[:, None], (0, slot, 0, 0, 0))
-    v = lax.dynamic_update_slice(cache["v"], v_layers[:, None], (0, slot, 0, 0, 0))
-    return {"k": k, "v": v}
-
-
-@functools.partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
-def decode_step(params, cache: dict, tokens, pos, config: LlamaConfig):
-    """One batched decode step over all slots.
-
-    tokens: [slots] int32 — the token at position ``pos[i]`` of each
-    sequence (garbage for inactive slots; the engine ignores their output).
-    pos:    [slots] int32 — write/attend position per slot.
-    Returns (logits [slots, vocab] f32, new cache).
-    """
-    c = config
-    n = tokens.shape[0]
-    max_len = cache["k"].shape[3]
-    x = params["embed"][tokens][:, None].astype(c.dtype)  # [slots, 1, E]
+    C = tokens.shape[0]
+    n_chunk_pages = C // page_size
+    positions = start_pos + jnp.arange(C, dtype=jnp.int32)
+    max_ctx = block_table.shape[0] * page_size
+    ctx_pos = jnp.arange(max_ctx, dtype=jnp.int32)
+    ctx_live = ctx_pos < start_pos                      # [ctx]
+    causal = jnp.arange(C)[:, None] >= jnp.arange(C)[None, :]
     kh, g = c.n_kv_heads, c.n_heads // c.n_kv_heads
+    # Pages this chunk writes: block_table[start_pos//page : +n_chunk_pages].
+    first = start_pos // page_size
+    write_ids = lax.dynamic_slice(block_table, (first,), (n_chunk_pages,))
 
-    def write(cache_l, new, p):
-        # cache_l [KH, max_len, D], new [KH, D] -> write at position p
-        return lax.dynamic_update_slice(cache_l, new[:, None], (0, p, 0))
+    x = params["embed"][tokens][None].astype(c.dtype)   # [1, C, E]
 
     def body(carry, xs):
         x = carry
-        layer, ck, cv = xs  # ck/cv: [slots, KH, max_len, D]
+        layer, kp, vp = xs                              # kp/vp [P, KH, page, D]
         h = rms_norm(x, layer["attn_norm"], eps=c.norm_eps)
-        q, k, v = _project_qkv(h, layer, c)  # [slots, H|KH, 1, D]
+        q, k, v = _project_qkv(h, layer)                # [1, H|KH, C, D]
+        q = apply_rope(q, positions, theta=c.rope_theta)
+        k = apply_rope(k, positions, theta=c.rope_theta)
+        ck = _gather_ctx(kp, block_table)               # [KH, ctx, D]
+        cv = _gather_ctx(vp, block_table)
+        qg = q[0].reshape(kh, g, C, c.head_dim)
+        # context scores [KH, G, C, ctx] + in-chunk causal scores [.., C]
+        s_ctx = jnp.einsum("kgcd,ktd->kgct", qg, ck).astype(jnp.float32)
+        s_self = jnp.einsum("kgcd,ktd->kgct", qg, k[0]).astype(jnp.float32)
+        scale = c.head_dim ** -0.5
+        s_ctx = jnp.where(ctx_live[None, None, None], s_ctx * scale, -jnp.inf)
+        s_self = jnp.where(causal[None, None], s_self * scale, -jnp.inf)
+        probs = jax.nn.softmax(jnp.concatenate([s_ctx, s_self], axis=-1), axis=-1)
+        p_ctx, p_self = probs[..., :max_ctx].astype(c.dtype), probs[..., max_ctx:].astype(c.dtype)
+        attn = jnp.einsum("kgct,ktd->kgcd", p_ctx, cv) + jnp.einsum(
+            "kgct,ktd->kgcd", p_self, v[0])
+        attn = attn.reshape(1, c.n_heads, C, c.head_dim)
+        out = jnp.einsum("bhsd,hde->bse", attn, layer["wo"])
+        x2 = _mlp(x + out, layer, c)
+        # Scatter the chunk's K/V into its pages: [KH, C, D] ->
+        # [n_pages, KH, page, D] at distinct page ids (no conflicts).
+        k_pages = jnp.swapaxes(
+            k[0].reshape(kh, n_chunk_pages, page_size, c.head_dim), 0, 1)
+        v_pages = jnp.swapaxes(
+            v[0].reshape(kh, n_chunk_pages, page_size, c.head_dim), 0, 1)
+        kp = kp.at[write_ids].set(k_pages)
+        vp = vp.at[write_ids].set(v_pages)
+        return x2, (kp, vp)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], pages["k"], pages["v"]))
+    hidden = rms_norm(x, params["final_norm"], eps=c.norm_eps)[0]  # [C, E]
+    return {"k": new_k, "v": new_v}, hidden
+
+
+def _decode_logits(params, pages: dict, block_tables, tokens, pos,
+                   config: LlamaConfig, page_size: int, write_page_idx=None):
+    """One batched decode step over all slots.
+
+    block_tables: [slots, max_pages_per_seq] int32 (inactive slots must
+                  point at their private trash page).
+    tokens:       [slots] int32 — token at ``pos[i]`` of each sequence.
+    pos:          [slots] int32 — write/attend position.
+    write_page_idx: optional [slots] override of the page each slot writes
+                  to (the multi-step loop redirects finished slots to
+                  their trash page).
+    Returns (logits [slots, vocab] f32, new pages).
+    """
+    c = config
+    n = tokens.shape[0]
+    max_ctx = block_tables.shape[1] * page_size
+    kh, g = c.n_kv_heads, c.n_heads // c.n_kv_heads
+    x = params["embed"][tokens][:, None].astype(c.dtype)   # [slots, 1, E]
+    if write_page_idx is None:
+        write_page_idx = jnp.take_along_axis(
+            block_tables, (pos // page_size)[:, None], axis=1)[:, 0]  # [slots]
+    page_idx = write_page_idx
+    offset = pos % page_size
+    live = jnp.arange(max_ctx)[None] <= pos[:, None]       # [slots, ctx]
+
+    def body(carry, xs):
+        x = carry
+        layer, kp, vp = xs                                 # [P, KH, page, D]
+        h = rms_norm(x, layer["attn_norm"], eps=c.norm_eps)
+        q, k, v = _project_qkv(h, layer)                   # [slots, H|KH, 1, D]
         q = apply_rope(q, pos[:, None], theta=c.rope_theta)
         k = apply_rope(k, pos[:, None], theta=c.rope_theta)
-        ck = jax.vmap(write)(ck, k[:, :, 0], pos)
-        cv = jax.vmap(write)(cv, v[:, :, 0], pos)
+        # Write each slot's new K/V at (its current page, offset). Distinct
+        # slots own distinct pages (trash pages for inactive slots), so
+        # the scatter has no conflicting indices.
+        kp = kp.at[page_idx, :, offset, :].set(k[:, :, 0])
+        vp = vp.at[page_idx, :, offset, :].set(v[:, :, 0])
+        ck = jax.vmap(_gather_ctx, in_axes=(None, 0))(kp, block_tables)  # [slots, KH, ctx, D]
+        cv = jax.vmap(_gather_ctx, in_axes=(None, 0))(vp, block_tables)
         qg = q[:, :, 0].reshape(n, kh, g, c.head_dim)
         scores = jnp.einsum("nkgd,nktd->nkgt", qg, ck).astype(jnp.float32)
         scores *= c.head_dim ** -0.5
-        live = jnp.arange(max_len)[None] <= pos[:, None]  # [slots, max_len]
         scores = jnp.where(live[:, None, None], scores, -jnp.inf)
         probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
-        attn = jnp.einsum("nkgt,nktd->nkgd", probs, cv).reshape(n, 1, c.n_heads * c.head_dim)
+        attn = jnp.einsum("nkgt,nktd->nkgd", probs, cv).reshape(
+            n, 1, c.n_heads * c.head_dim)
         out = jnp.einsum("bsf,fe->bse", attn,
                          layer["wo"].reshape(c.n_heads * c.head_dim, c.hidden))
         x2 = _mlp(x + out, layer, c)
-        return x2, (ck, cv)
+        return x2, (kp, vp)
 
-    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
-    hidden = rms_norm(x, params["final_norm"], eps=c.norm_eps)  # [slots, 1, E]
+    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], pages["k"], pages["v"]))
+    hidden = rms_norm(x, params["final_norm"], eps=c.norm_eps)     # [slots, 1, E]
     logits = jnp.einsum("bse,ev->bsv", hidden, params["lm_head"])[:, 0]
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+decode_step = functools.partial(
+    jax.jit, static_argnames=("config", "page_size"), donate_argnames=("pages",)
+)(_decode_logits)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "page_size"),
+                   donate_argnames=("pages",))
+def decode_and_sample(params, pages: dict, block_tables, tokens, pos, temps, key,
+                      config: LlamaConfig, page_size: int):
+    """``decode_step`` + on-device sampling in ONE compiled program.
+
+    The engine drives the chip through a (possibly remote) dispatch
+    channel where every op launch and transfer costs real latency; doing
+    argmax/categorical host-side meant ~6 dispatches and a [slots, vocab]
+    f32 logits pull PER TOKEN. Here sampling (greedy for temp<=0,
+    tempered categorical otherwise) and the RNG split happen on device —
+    one dispatch, and only [slots] int32 tokens cross back.
+    """
+    logits, new_pages = _decode_logits(params, pages, block_tables, tokens, pos,
+                                       config, page_size)
+    key, sub = jax.random.split(key)
+    greedy = jnp.argmax(logits, axis=-1)
+    sampled = jax.random.categorical(sub, logits / jnp.maximum(temps, 1e-6)[:, None])
+    out = jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
+    return out, key, new_pages
+
+
+@jax.jit
+def sample_first_token(last_hidden, lm_head, temp, key):
+    """First-token sampling after prefill, on device (one dispatch)."""
+    logits = (last_hidden @ lm_head).astype(jnp.float32)
+    key, sub = jax.random.split(key)
+    greedy = jnp.argmax(logits)
+    sampled = jax.random.categorical(sub, logits / jnp.maximum(temp, 1e-6))
+    return jnp.where(temp > 0.0, sampled, greedy).astype(jnp.int32), key
+
+
+@jax.jit
+def sample_first_batch(hiddens, lm_head, temps, key):
+    """Batched first-token sampling for several just-prefilled requests
+    in ONE dispatch (the engine stacks pending prefills so a burst of
+    arrivals costs one host sync total, not one per request).
+
+    hiddens: [m, E] last-position hidden states (padded rows ignored).
+    Returns (tokens [m] int32, key).
+    """
+    logits = (hiddens @ lm_head).astype(jnp.float32)   # [m, vocab]
+    key, sub = jax.random.split(key)
+    greedy = jnp.argmax(logits, axis=-1)
+    sampled = jax.random.categorical(sub, logits / jnp.maximum(temps, 1e-6)[:, None])
+    return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32), key
+
+
+@functools.partial(jax.jit, static_argnames=("config", "page_size", "n_steps"),
+                   donate_argnames=("pages",))
+def decode_loop(params, pages: dict, block_tables, tokens, pos, temps, eos_ids,
+                remaining, key, config: LlamaConfig, page_size: int, n_steps: int):
+    """``n_steps`` decode+sample iterations in ONE dispatch (on-device
+    ``lax.scan`` generate loop, JetStream-style).
+
+    Per-token host syncs cost a full dispatch round trip — prohibitive
+    over a remote-dispatch channel (~150 ms each here). Scanning K steps
+    on device amortizes that to one sync per K tokens. Slots whose
+    sequence finishes mid-scan (EOS hit, or ``remaining`` steps
+    exhausted) keep computing branchlessly but redirect their KV writes
+    to their private trash page, so they can never overrun their page
+    allocation or corrupt shared prefix pages; the host discards their
+    surplus tokens.
+
+    eos_ids:   [slots] int32 (-1 = no EOS for that slot).
+    remaining: [slots] int32 — tokens the slot may still emit (bounds
+               both max_new_tokens and the page allocation).
+    Returns (tokens [n_steps, slots] int32, key, pages).
+    """
+    n = tokens.shape[0]
+    trash = jnp.arange(n, dtype=jnp.int32)  # slot i's trash page is page i
+
+    def body(carry, _):
+        tokens, pos, done, remaining, key, pages = carry
+        real_page = jnp.take_along_axis(
+            block_tables,
+            jnp.minimum(pos // page_size, block_tables.shape[1] - 1)[:, None],
+            axis=1)[:, 0]
+        write_idx = jnp.where(done, trash, real_page)
+        logits, pages = _decode_logits(params, pages, block_tables, tokens, pos,
+                                       config, page_size, write_page_idx=write_idx)
+        key, sub = jax.random.split(key)
+        greedy = jnp.argmax(logits, axis=-1)
+        sampled = jax.random.categorical(sub, logits / jnp.maximum(temps, 1e-6)[:, None])
+        new_tok = jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
+        remaining = remaining - jnp.where(done, 0, 1)
+        done = done | (new_tok == eos_ids) | (remaining <= 0)
+        return (new_tok, pos + 1, done, remaining, key, pages), new_tok
+
+    init = (tokens, pos, remaining <= 0, remaining, key, pages)
+    (_, _, _, _, key, pages), toks = lax.scan(body, init, None, length=n_steps)
+    return toks, key, pages

@@ -1,17 +1,22 @@
-"""LLM serving deployment: the engine behind a Serve replica.
+"""LLM serving deployment: OpenAI-compatible API over the paged engine.
 
-Equivalent of the reference's ``LLMServer``
-(``python/ray/llm/_internal/serve/deployments/llm/llm_server.py:415``):
-one engine per replica, concurrent HTTP/handle requests feed the shared
-continuous-batching loop, and each caller blocks only on its own
-completion. Scale-out happens at the Serve layer (num_replicas), exactly
-as the reference scales vLLM engine replicas.
+Equivalent of the reference's ``LLMServer`` + OpenAI router
+(``python/ray/llm/_internal/serve/deployments/llm/llm_server.py:415``,
+``.../routers/router.py:173``): one engine per replica, concurrent
+HTTP/handle requests feed the shared continuous-batching loop, and
+``/v1/completions`` + ``/v1/chat/completions`` (with ``"stream": true``
+SSE token streaming) ride the Serve streaming request path. Scale-out
+happens at the Serve layer (num_replicas), exactly as the reference
+scales vLLM engine replicas.
 """
 
 from __future__ import annotations
 
+import json
+import queue
 import threading
 import time
+import uuid
 
 from .engine import InferenceEngine, Request
 from .tokenizer import ByteTokenizer
@@ -26,12 +31,21 @@ class LLMDeployment:
         self,
         preset: str = "debug-128",
         *,
+        model_id: str | None = None,
         max_slots: int = 8,
         max_len: int = 256,
+        page_size: int = 16,
+        prefill_chunk_size: int = 64,
+        decode_steps_per_dispatch: int = 8,
         seed: int = 0,
         request_timeout_s: float = 300.0,
     ):
-        self.engine = InferenceEngine(preset, max_slots=max_slots, max_len=max_len, seed=seed)
+        self.engine = InferenceEngine(
+            preset, max_slots=max_slots, max_len=max_len, page_size=page_size,
+            prefill_chunk_size=prefill_chunk_size,
+            decode_steps_per_dispatch=decode_steps_per_dispatch, seed=seed,
+        )
+        self.model_id = model_id or (preset if isinstance(preset, str) else "custom")
         self.tokenizer = ByteTokenizer()
         if self.tokenizer.vocab_size > self.engine.config.vocab_size:
             raise ValueError(
@@ -40,7 +54,10 @@ class LLMDeployment:
                 f"with vocab_size >= {self.tokenizer.vocab_size}"
             )
         self.request_timeout_s = request_timeout_s
+        # Completion waiters (blocking path) and per-request token queues
+        # (streaming path), both fed by the engine loop.
         self._events: dict[str, threading.Event] = {}
+        self._token_queues: dict[str, queue.Queue] = {}
         self._counter = 0
         self._lock = threading.Lock()
         self._running = True
@@ -53,56 +70,200 @@ class LLMDeployment:
                 time.sleep(0.002)
                 continue
             for event in self.engine.step():
+                q = self._token_queues.get(event["request_id"])
+                if q is not None:
+                    q.put(event)
                 if event["done"]:
                     done = self._events.pop(event["request_id"], None)
                     if done is not None:
                         done.set()
 
     def close(self) -> None:
-        """Stop the engine loop. Serve replica teardown kills the worker
-        process anyway; this exists for in-process reuse (tests, notebooks)
-        — the loop thread holds a ref to self, so __del__ alone would never
-        fire."""
+        """Stop the engine loop (for in-process reuse — tests, notebooks)."""
         self._running = False
         if self._loop_thread.is_alive():
             self._loop_thread.join(timeout=5)
 
-    # --------------------------------------------------------------- methods
+    def _next_rid(self) -> str:
+        with self._lock:
+            self._counter += 1
+            return f"req-{self._counter}-{uuid.uuid4().hex[:8]}"
+
+    # ------------------------------------------------------ blocking path
     def generate(self, prompt: str, max_new_tokens: int = 16,
                  temperature: float = 0.0) -> dict:
         """Blocking completion; many calls run concurrently on replica
         threads and share the engine's decode batch."""
         ids = self.tokenizer.encode(prompt)
-        with self._lock:
-            self._counter += 1
-            rid = f"req-{self._counter}"
+        rid = self._next_rid()
         req = Request(rid, ids, max_new_tokens, temperature,
                       eos_id=self.tokenizer.eos_id)
         done = threading.Event()
-        self._events[rid] = done
-        self.engine.add_request(req)
+        self._events[rid] = done  # before add: the engine may finish fast
+        try:
+            self.engine.add_request(req)
+        except ValueError:
+            self._events.pop(rid, None)
+            raise
         if not done.wait(timeout=self.request_timeout_s):
-            # Cancel so the engine stops mutating req and the slot frees;
-            # drop our event entry (the loop pops it only on completion).
             self.engine.cancel(rid)
             self._events.pop(rid, None)
-            return {
-                "request_id": rid,
-                "text": self.tokenizer.decode(req.generated),
-                "tokens": list(req.generated),
-                "finish_reason": "timeout",
-                "num_generated": len(req.generated),
-            }
+            finish = "timeout"
+        else:
+            finish = req.finish_reason
         return {
             "request_id": rid,
             "text": self.tokenizer.decode(req.generated),
             "tokens": list(req.generated),
-            "finish_reason": req.finish_reason,
+            "finish_reason": finish,
             "num_generated": len(req.generated),
         }
 
-    def __call__(self, request) -> dict:
-        """HTTP entrypoint: /app?prompt=...&max_new_tokens=N."""
+    # ----------------------------------------------------- streaming path
+    def _stream_tokens(self, req: Request):
+        """Yield engine events for one request as they are produced; on
+        GeneratorExit (consumer gone) cancel the request so its pages and
+        slot free immediately."""
+        q: queue.Queue = queue.Queue()
+        self._token_queues[req.request_id] = q
+        try:
+            self.engine.add_request(req)
+        except ValueError:
+            self._token_queues.pop(req.request_id, None)
+            raise
+        deadline = time.monotonic() + self.request_timeout_s
+        try:
+            while True:
+                try:
+                    event = q.get(timeout=min(5.0, max(0.1, deadline - time.monotonic())))
+                except queue.Empty:
+                    if time.monotonic() > deadline:
+                        self.engine.cancel(req.request_id)
+                        return
+                    continue
+                yield event
+                if event["done"]:
+                    return
+        finally:
+            self._token_queues.pop(req.request_id, None)
+            if not req.done:
+                self.engine.cancel(req.request_id)
+
+    # ------------------------------------------------------- OpenAI routes
+    def completions(self, body: dict):
+        """POST /v1/completions (OpenAI-compatible; reference
+        ``routers/router.py:173``). ``"stream": true`` => SSE generator."""
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list):
+            prompt = prompt[0] if prompt else ""
+        max_tokens = int(body.get("max_tokens", 16))
+        temperature = float(body.get("temperature", 0.0))
+        cid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+        if not body.get("stream"):
+            out = self.generate(prompt, max_tokens, temperature)
+            return {
+                "id": cid, "object": "text_completion", "created": created,
+                "model": body.get("model", self.model_id),
+                "choices": [{
+                    "index": 0, "text": out["text"],
+                    "finish_reason": _openai_finish(out["finish_reason"]),
+                    "logprobs": None,
+                }],
+                "usage": {
+                    "prompt_tokens": len(self.tokenizer.encode(prompt)),
+                    "completion_tokens": out["num_generated"],
+                    "total_tokens": len(self.tokenizer.encode(prompt)) + out["num_generated"],
+                },
+            }
+        return self._sse_completion_stream(body, prompt, cid, created, chat=False)
+
+    def chat_completions(self, body: dict):
+        """POST /v1/chat/completions: flatten messages with a minimal
+        template, then the completion path."""
+        prompt = _render_chat(body.get("messages", []))
+        cid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+        if not body.get("stream"):
+            out = self.generate(
+                prompt, int(body.get("max_tokens", 16)),
+                float(body.get("temperature", 0.0)))
+            return {
+                "id": cid, "object": "chat.completion", "created": created,
+                "model": body.get("model", self.model_id),
+                "choices": [{
+                    "index": 0,
+                    "message": {"role": "assistant", "content": out["text"]},
+                    "finish_reason": _openai_finish(out["finish_reason"]),
+                }],
+                "usage": {
+                    "prompt_tokens": len(self.tokenizer.encode(prompt)),
+                    "completion_tokens": out["num_generated"],
+                    "total_tokens": len(self.tokenizer.encode(prompt)) + out["num_generated"],
+                },
+            }
+        return self._sse_completion_stream(body, prompt, cid, created, chat=True)
+
+    def _sse_completion_stream(self, body: dict, prompt: str, cid: str,
+                               created: int, chat: bool):
+        """SSE generator: one ``data:`` event per token, ``[DONE]`` last
+        (OpenAI stream framing; flows through Serve's streaming path to the
+        proxy as chunked ``text/event-stream``)."""
+        model = body.get("model", self.model_id)
+        max_tokens = int(body.get("max_tokens", 16))
+        temperature = float(body.get("temperature", 0.0))
+        obj = "chat.completion.chunk" if chat else "text_completion"
+        ids = self.tokenizer.encode(prompt)
+        rid = self._next_rid()
+        req = Request(rid, ids, max_tokens, temperature, eos_id=self.tokenizer.eos_id)
+
+        def gen():
+            yield {"__serve_response__": True, "content_type": "text/event-stream"}
+            if chat:
+                head = {"id": cid, "object": obj, "created": created, "model": model,
+                        "choices": [{"index": 0, "delta": {"role": "assistant"},
+                                     "finish_reason": None}]}
+                yield f"data: {json.dumps(head)}\n\n"
+            for event in self._stream_tokens(req):
+                text = self.tokenizer.decode([event["token"]])
+                if chat:
+                    choice = {"index": 0, "delta": {"content": text},
+                              "finish_reason": _openai_finish(event["finish_reason"]) if event["done"] else None}
+                else:
+                    choice = {"index": 0, "text": text, "logprobs": None,
+                              "finish_reason": _openai_finish(event["finish_reason"]) if event["done"] else None}
+                chunk = {"id": cid, "object": obj, "created": created,
+                         "model": model, "choices": [choice]}
+                yield f"data: {json.dumps(chunk)}\n\n"
+            yield "data: [DONE]\n\n"
+
+        return gen()
+
+    def models(self) -> dict:
+        return {"object": "list", "data": [{
+            "id": self.model_id, "object": "model", "created": 0,
+            "owned_by": "ray_tpu",
+        }]}
+
+    def engine_metrics(self) -> dict:
+        return dict(self.engine.metrics)
+
+    # ---------------------------------------------------------- HTTP entry
+    def __call__(self, request):
+        """HTTP ingress: OpenAI routes + the legacy ?prompt= GET."""
+        path = request.path
+        if path.endswith("/v1/models"):
+            return self.models()
+        try:
+            if path.endswith("/v1/completions"):
+                return self.completions(request.json())
+            if path.endswith("/v1/chat/completions"):
+                return self.chat_completions(request.json())
+        except ValueError as e:
+            # Invalid request (e.g. prompt >= max_len): OpenAI-style error
+            # body instead of a bare 500.
+            return {"error": {"message": str(e), "type": "invalid_request_error",
+                              "code": 400}}
         q = request.query_params
         return self.generate(
             q.get("prompt", ""),
@@ -111,15 +272,35 @@ class LLMDeployment:
         )
 
 
+def _openai_finish(reason: str) -> str:
+    return {"stop": "stop", "length": "length", "max_len": "length",
+            "timeout": "length", "cancelled": "stop"}.get(reason, reason or "stop")
+
+
+def _render_chat(messages: list) -> str:
+    """Minimal chat template (byte tokenizer has no special tokens)."""
+    parts = [f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages]
+    parts.append("assistant:")
+    return "\n".join(parts)
+
+
 def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
                   max_slots: int = 8, max_len: int = 256,
-                  max_ongoing_requests: int = 32):
-    """Build a Serve Application serving ``preset`` (serve.run-able)."""
+                  page_size: int = 16, prefill_chunk_size: int = 64,
+                  decode_steps_per_dispatch: int = 8,
+                  max_ongoing_requests: int = 32, model_id: str | None = None,
+                  ray_actor_options: dict | None = None):
+    """Build a Serve Application serving ``preset`` (serve.run-able).
+    Pass ``ray_actor_options={"resources": {"TPU": 1}, ...}`` to pin each
+    replica (engine) to a TPU chip."""
     from ..serve import deployment
 
     dep = deployment(
         LLMDeployment,
         num_replicas=num_replicas,
         max_ongoing_requests=max_ongoing_requests,
+        ray_actor_options=ray_actor_options,
     )
-    return dep.bind(preset, max_slots=max_slots, max_len=max_len)
+    return dep.bind(preset, model_id=model_id, max_slots=max_slots, max_len=max_len,
+                    page_size=page_size, prefill_chunk_size=prefill_chunk_size,
+                    decode_steps_per_dispatch=decode_steps_per_dispatch)

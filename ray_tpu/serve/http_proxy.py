@@ -16,7 +16,6 @@ import urllib.parse
 from typing import Any
 
 from ..core import api as ray
-from ..core.worker import global_worker
 from .long_poll import LongPollClient
 from .replica import Request
 from .router import CONTROLLER_NAME, DeploymentHandle
@@ -50,6 +49,14 @@ class ProxyActor:
     def _serve_forever(self) -> None:
         self._loop = asyncio.new_event_loop()
         asyncio.set_event_loop(self._loop)
+        # Deep executor: router assigns may BLOCK under backpressure; with
+        # the default ~5-thread pool a handful of saturated-replica waits
+        # would starve every other request's executor hops (deadlock spiral
+        # until timeouts clear it).
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._loop.set_default_executor(
+            ThreadPoolExecutor(max_workers=64, thread_name_prefix="serve-proxy"))
 
         async def _start():
             server = await asyncio.start_server(self._handle_conn, self._host, self._port)
@@ -86,13 +93,9 @@ class ProxyActor:
                 request = await self._read_request(reader)
                 if request is None:
                     break
-                status, body = await self._dispatch(request)
-                payload = (
-                    f"HTTP/1.1 {status}\r\nContent-Type: application/json\r\n"
-                    f"Content-Length: {len(body)}\r\nConnection: keep-alive\r\n\r\n"
-                ).encode() + body
-                writer.write(payload)
-                await writer.drain()
+                streamed = await self._dispatch(request, writer)
+                if not streamed:
+                    break  # streaming error mid-body: close the connection
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
@@ -100,6 +103,13 @@ class ProxyActor:
                 writer.close()
             except Exception:
                 pass
+
+    @staticmethod
+    def _write_full(writer, status: str, body: bytes, content_type: str = "application/json"):
+        writer.write((
+            f"HTTP/1.1 {status}\r\nContent-Type: {content_type}\r\n"
+            f"Content-Length: {len(body)}\r\nConnection: keep-alive\r\n\r\n"
+        ).encode() + body)
 
     async def _read_request(self, reader: asyncio.StreamReader) -> Request | None:
         try:
@@ -125,38 +135,92 @@ class ProxyActor:
         query = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
         return Request(method=method, path=parsed.path, query=query, headers=headers, body=body)
 
-    async def _dispatch(self, request: Request) -> tuple[str, bytes]:
+    async def _dispatch(self, request: Request, writer) -> bool:
+        """Route + drive one request. Every request flows through the
+        replica's streaming path (reference proxy.py:754 — ASGI messages
+        over a streaming generator task): the first wire message decides
+        between a buffered JSON reply and a chunked/SSE streamed body.
+        Returns False when the connection must close (error mid-stream)."""
         if request.path == "/-/healthz":
-            return "200 OK", b'"ok"'
+            self._write_full(writer, "200 OK", b'"ok"')
+            await writer.drain()
+            return True
         route = next((r for r in self._routes if request.path.startswith(r["prefix"])), None)
         if route is None:
-            return "404 Not Found", json.dumps({"error": f"no route for {request.path}"}).encode()
+            self._write_full(writer, "404 Not Found",
+                             json.dumps({"error": f"no route for {request.path}"}).encode())
+            await writer.drain()
+            return True
         key = (route["app"], route["deployment"])
         handle = self._handles.get(key)
         if handle is None:
             handle = self._handles[key] = DeploymentHandle(*key)
         loop = asyncio.get_running_loop()
+        stream = None
         try:
-            # assign + submit off-loop (the router may block on
-            # backpressure); await the reply via the owned-ref callback
-            response = await loop.run_in_executor(None, handle.remote, request)
-            result = await self._await_response(response, loop)
+            # assign + submit off-loop (the router may block on backpressure)
+            stream = await loop.run_in_executor(None, handle.remote_streaming, request)
+            head = await stream.__anext__()
+        except StopAsyncIteration:
+            self._write_full(writer, "500 Internal Server Error",
+                             json.dumps({"error": "empty response stream"}).encode())
+            await writer.drain()
+            return True
         except TimeoutError as e:
-            return "503 Service Unavailable", json.dumps({"error": str(e)}).encode()
+            if stream is not None:
+                stream.close()  # release the router slot, cancel the replica
+            self._write_full(writer, "503 Service Unavailable",
+                             json.dumps({"error": str(e)}).encode())
+            await writer.drain()
+            return True
         except Exception as e:
-            return "500 Internal Server Error", json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
-        if isinstance(result, bytes):
-            return "200 OK", result
-        return "200 OK", json.dumps(result).encode()
+            if stream is not None:
+                stream.close()
+            self._write_full(writer, "500 Internal Server Error",
+                             json.dumps({"error": f"{type(e).__name__}: {e}"}).encode())
+            await writer.drain()
+            return True
 
-    async def _await_response(self, response, loop):
-        worker = global_worker()
-        fut: asyncio.Future = loop.create_future()
-        oid = response.ref.id()
+        if head.get("kind") == "error":
+            stream.close()  # settle the router slot
+            self._write_full(writer, "500 Internal Server Error",
+                             json.dumps({"error": head["error"]}).encode())
+            await writer.drain()
+            return True
+        if head.get("kind") == "full":
+            stream.close()  # single-message stream: release the slot now
+            result = head.get("data")
+            body = result if isinstance(result, bytes) else json.dumps(result).encode()
+            self._write_full(writer, "200 OK", body)
+            await writer.drain()
+            return True
 
-        def _on_ready(_oid):
-            loop.call_soon_threadsafe(lambda: fut.done() or fut.set_result(True))
-
-        if worker.memory_store.add_callback(oid, _on_ready):
-            await asyncio.wait_for(fut, timeout=120.0)
-        return await loop.run_in_executor(None, response.result, 60.0)
+        # Streaming body: chunked transfer encoding, flushed per chunk
+        # (SSE works over this: content_type text/event-stream).
+        writer.write((
+            f"HTTP/1.1 {head.get('status', '200 OK')}\r\n"
+            f"Content-Type: {head.get('content_type', 'application/octet-stream')}\r\n"
+            "Transfer-Encoding: chunked\r\nConnection: keep-alive\r\n"
+            "Cache-Control: no-cache\r\n\r\n"
+        ).encode())
+        await writer.drain()
+        try:
+            async for msg in stream:
+                if msg.get("kind") == "error":
+                    # Headers already sent: close WITHOUT the chunked
+                    # terminator — a spec-compliant client then sees a
+                    # truncated (failed) body, not a well-formed success.
+                    return False
+                data = msg.get("data", b"")
+                if data:
+                    writer.write(b"%x\r\n" % len(data) + data + b"\r\n")
+                    await writer.drain()
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+            return True
+        except (ConnectionError, asyncio.CancelledError):
+            raise  # client went away: finally-close cancels the generator
+        except Exception:
+            return False
+        finally:
+            stream.close()  # settle the router slot; cancel if unfinished

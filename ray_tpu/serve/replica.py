@@ -98,3 +98,76 @@ class ReplicaActor:
         finally:
             with self._lock:
                 self._ongoing -= 1
+
+    def handle_request_streaming(self, method_name: str, args: tuple, kwargs: dict):
+        """Streaming request path (invoked with ``num_returns="streaming"``):
+        drives the user callable and yields response **wire messages** —
+        the reference proxy's ASGI-message stream over a generator task
+        (``python/ray/serve/_private/proxy.py:754``):
+
+          {"kind": "full", "data": value}          — non-streaming handler
+          {"kind": "start", "content_type": ...}    — streaming handler head
+          {"kind": "chunk", "data": bytes}          — one body chunk
+          {"kind": "error", "error": str}           — handler raised
+
+        A streaming handler is one whose result is a (sync/async)
+        generator; it may yield a leading ``{"__serve_response__": ...}``
+        dict to set status/content-type, then str/bytes/dict chunks.
+        """
+        import inspect
+        import json as _json
+
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            target = getattr(self._callable, method_name) if method_name else self._callable
+            result = target(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                import asyncio
+
+                result = asyncio.run(result)
+            if not (inspect.isgenerator(result) or hasattr(result, "__anext__")):
+                yield {"kind": "full", "data": result}
+                return
+            items = _drive(result)
+            first = next(items, None)
+            head = {"kind": "start", "status": "200 OK", "content_type": "application/octet-stream"}
+            if isinstance(first, dict) and first.get("__serve_response__"):
+                head["content_type"] = first.get("content_type", head["content_type"])
+                head["status"] = first.get("status", head["status"])
+                first = next(items, None)
+            yield head
+            import itertools
+
+            for item in itertools.chain([] if first is None else [first], items):
+                if isinstance(item, bytes):
+                    data = item
+                elif isinstance(item, str):
+                    data = item.encode()
+                else:
+                    data = _json.dumps(item).encode() + b"\n"
+                yield {"kind": "chunk", "data": data}
+        except Exception as e:
+            yield {"kind": "error", "error": f"{type(e).__name__}: {e}"}
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+
+def _drive(gen):
+    """Yield from a sync or async generator, synchronously."""
+    if hasattr(gen, "__anext__"):
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+        try:
+            while True:
+                try:
+                    yield loop.run_until_complete(gen.__anext__())
+                except StopAsyncIteration:
+                    break
+        finally:
+            loop.close()
+    else:
+        yield from gen

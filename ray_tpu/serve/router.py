@@ -157,6 +157,73 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentStreamingResponse:
+    """Iterable over a replica's streamed results (reference
+    DeploymentResponseGenerator): wraps the core ObjectRefGenerator;
+    the router slot is released when the stream ends or is closed."""
+
+    def __init__(self, gen, on_done):
+        self._gen = gen
+        self._on_done = on_done
+        self._settle_lock = threading.Lock()
+        self._settled = False
+
+    def _settle(self) -> None:
+        with self._settle_lock:
+            if self._settled:
+                return
+            self._settled = True
+        try:
+            self._on_done()
+        except Exception:
+            pass
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            ref = next(self._gen)
+            return ray.get(ref, timeout=120)
+        except StopIteration:
+            self._settle()
+            raise
+        except BaseException:
+            self._settle()  # a failed get must still release the slot
+            raise
+
+    async def __anext__(self):
+        try:
+            ref = await self._gen.__anext__()
+            entry = global_worker().memory_store.get_if_exists(ref.id())
+            if entry is not None and not entry.in_plasma:
+                # Just-reported inline item: the get is a dict lookup — run
+                # it on the loop rather than burning an executor hop.
+                return ray.get(ref, timeout=120)
+            # Plasma-backed (large) item: the shm fetch + raylet RPC would
+            # block the proxy loop and stall every other connection.
+            import asyncio
+
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(None, lambda: ray.get(ref, timeout=120))
+        except StopAsyncIteration:
+            self._settle()
+            raise
+        except BaseException:
+            self._settle()
+            raise
+
+    def __aiter__(self):
+        return self
+
+    def close(self) -> None:
+        """Abandon the stream: cancels the replica-side generator."""
+        try:
+            self._gen.close()
+        finally:
+            self._settle()
+
+
 class DeploymentHandle:
     """Client-side handle to a deployment (reference serve.handle.DeploymentHandle)."""
 
@@ -199,6 +266,21 @@ class DeploymentHandle:
             router.release(replica_id)
             raise
         return DeploymentResponse(ref, on_done=lambda: router.release(replica_id))
+
+    def remote_streaming(self, *args, **kwargs) -> DeploymentStreamingResponse:
+        """Invoke through the replica's streaming path: results arrive
+        item-by-item while the handler runs (token streaming, SSE)."""
+        router = self._get_router()
+        replica_id, actor = router.assign_replica()
+        try:
+            gen = actor.handle_request_streaming.options(
+                num_returns="streaming",
+                _generator_backpressure_num_objects=256,
+            ).remote(self._method_name, args, kwargs)
+        except Exception:
+            router.release(replica_id)
+            raise
+        return DeploymentStreamingResponse(gen, on_done=lambda: router.release(replica_id))
 
     def __reduce__(self):
         return (DeploymentHandle, (self.app_name, self.deployment_name, self._method_name))
