@@ -16,9 +16,11 @@ from .api import (
     start,
     status,
 )
+from .batching import batch
 from .deployment import Application, AutoscalingConfig, Deployment, deployment
+from .multiplex import get_multiplexed_model_id, multiplexed
 from .replica import Request
-from .router import DeploymentHandle, DeploymentResponse
+from .router import DeploymentHandle, DeploymentResponse, DeploymentStreamingResponse
 
 __all__ = [
     "Application",
@@ -26,12 +28,16 @@ __all__ = [
     "Deployment",
     "DeploymentHandle",
     "DeploymentResponse",
+    "DeploymentStreamingResponse",
     "Request",
+    "batch",
     "delete",
     "deployment",
     "get_app_handle",
     "get_deployment_handle",
+    "get_multiplexed_model_id",
     "http_address",
+    "multiplexed",
     "run",
     "shutdown",
     "start",

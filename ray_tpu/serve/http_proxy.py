@@ -155,6 +155,11 @@ class ProxyActor:
         handle = self._handles.get(key)
         if handle is None:
             handle = self._handles[key] = DeploymentHandle(*key)
+        # Multiplexing: the target model id rides a request header
+        # (reference serve_multiplexed_model_id) and biases routing.
+        model_id = request.headers.get("serve_multiplexed_model_id", "")
+        if model_id:
+            handle = handle.options(multiplexed_model_id=model_id)
         loop = asyncio.get_running_loop()
         stream = None
         try:

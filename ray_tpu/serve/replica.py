@@ -82,6 +82,9 @@ class ReplicaActor:
         return True
 
     def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+        from .multiplex import MULTIPLEXED_KWARG, set_multiplexed_model_id
+
+        set_multiplexed_model_id(kwargs.pop(MULTIPLEXED_KWARG, ""))
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -117,6 +120,9 @@ class ReplicaActor:
         import inspect
         import json as _json
 
+        from .multiplex import MULTIPLEXED_KWARG, set_multiplexed_model_id
+
+        set_multiplexed_model_id(kwargs.pop(MULTIPLEXED_KWARG, ""))
         with self._lock:
             self._ongoing += 1
             self._total += 1

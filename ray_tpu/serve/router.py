@@ -49,6 +49,8 @@ class Router:
         # replica_id -> {"actor": ActorHandle, "max_ongoing": int}
         self._replicas: dict[str, dict] = {}
         self._inflight: dict[str, int] = {}
+        # multiplexing cache affinity: model_id -> last replica that served it
+        self._model_affinity: dict[str, str] = {}
         controller = ray.get_actor(CONTROLLER_NAME)
         self._long_poll = LongPollClient(controller, {self._key: self._update_replicas})
         # prime with the current table so the first request needn't wait a
@@ -80,9 +82,12 @@ class Router:
             self._inflight = {rid: self._inflight.get(rid, 0) for rid in fresh}
             self._cond.notify_all()
 
-    def assign_replica(self, timeout: float = 60.0) -> tuple[str, Any]:
+    def assign_replica(self, timeout: float = 60.0,
+                       model_id: str = "") -> tuple[str, Any]:
         """Power-of-two choice among replicas below their cap; blocks while
-        every replica is saturated (backpressure)."""
+        every replica is saturated (backpressure). With a multiplexed
+        ``model_id``, replicas that served that model recently are
+        preferred (cache affinity — reference multiplex-aware routing)."""
         import time
 
         deadline = time.monotonic() + timeout
@@ -93,11 +98,21 @@ class Router:
                     if self._inflight.get(rid, 0) < r["max_ongoing"]
                 ]
                 if candidates:
-                    if len(candidates) == 1:
-                        pick = candidates[0]
-                    else:
-                        a, b = random.sample(candidates, 2)
-                        pick = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+                    pick = None
+                    if model_id:
+                        affine = self._model_affinity.get(model_id)
+                        if affine in candidates:
+                            pick = affine
+                    if pick is None:
+                        if len(candidates) == 1:
+                            pick = candidates[0]
+                        else:
+                            a, b = random.sample(candidates, 2)
+                            pick = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+                    if model_id:
+                        self._model_affinity[model_id] = pick
+                        while len(self._model_affinity) > 1024:
+                            self._model_affinity.pop(next(iter(self._model_affinity)))
                     self._inflight[pick] = self._inflight.get(pick, 0) + 1
                     return pick, self._replicas[pick]["actor"]
                 remaining = deadline - time.monotonic()
@@ -228,10 +243,12 @@ class DeploymentHandle:
     """Client-side handle to a deployment (reference serve.handle.DeploymentHandle)."""
 
     def __init__(self, app_name: str, deployment_name: str, method_name: str = "",
+                 multiplexed_model_id: str = "",
                  _router_holder: dict | None = None):
         self.app_name = app_name
         self.deployment_name = deployment_name
         self._method_name = method_name
+        self._multiplexed_model_id = multiplexed_model_id
         # Shared, mutable: every handle derived from this one (h.method)
         # must reuse ONE router — a router per derived handle would leak a
         # long-poll thread per request.
@@ -246,9 +263,12 @@ class DeploymentHandle:
                 self._router_holder["router"] = Router(self.app_name, self.deployment_name)
             return self._router_holder["router"]
 
-    def options(self, method_name: str = "") -> "DeploymentHandle":
+    def options(self, method_name: str = "",
+                multiplexed_model_id: str = "") -> "DeploymentHandle":
         return DeploymentHandle(
-            self.app_name, self.deployment_name, method_name,
+            self.app_name, self.deployment_name,
+            method_name or self._method_name,
+            multiplexed_model_id or self._multiplexed_model_id,
             _router_holder=self._router_holder,
         )
 
@@ -258,8 +278,12 @@ class DeploymentHandle:
         return self.options(method_name=item)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
+        from .multiplex import MULTIPLEXED_KWARG
+
         router = self._get_router()
-        replica_id, actor = router.assign_replica()
+        replica_id, actor = router.assign_replica(model_id=self._multiplexed_model_id)
+        if self._multiplexed_model_id:
+            kwargs[MULTIPLEXED_KWARG] = self._multiplexed_model_id
         try:
             ref = actor.handle_request.remote(self._method_name, args, kwargs)
         except Exception:
@@ -270,8 +294,12 @@ class DeploymentHandle:
     def remote_streaming(self, *args, **kwargs) -> DeploymentStreamingResponse:
         """Invoke through the replica's streaming path: results arrive
         item-by-item while the handler runs (token streaming, SSE)."""
+        from .multiplex import MULTIPLEXED_KWARG
+
         router = self._get_router()
-        replica_id, actor = router.assign_replica()
+        replica_id, actor = router.assign_replica(model_id=self._multiplexed_model_id)
+        if self._multiplexed_model_id:
+            kwargs[MULTIPLEXED_KWARG] = self._multiplexed_model_id
         try:
             gen = actor.handle_request_streaming.options(
                 num_returns="streaming",
@@ -283,4 +311,5 @@ class DeploymentHandle:
         return DeploymentStreamingResponse(gen, on_done=lambda: router.release(replica_id))
 
     def __reduce__(self):
-        return (DeploymentHandle, (self.app_name, self.deployment_name, self._method_name))
+        return (DeploymentHandle, (self.app_name, self.deployment_name,
+                                   self._method_name, self._multiplexed_model_id))

@@ -201,3 +201,79 @@ def test_delete_application(serve_instance):
             break
         time.sleep(0.2)
     assert "error" in _http_get(addr + "/gone?msg=z")
+
+
+def test_serve_batch_decorator(serve_instance):
+    """@serve.batch groups concurrent calls into one execution
+    (reference batching.py:80)."""
+
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        def handle(self, items):
+            self.batch_sizes.append(len(items))
+            return [x * 2 for x in items]
+
+        def __call__(self, request):
+            return self.handle(int(request.query_params.get("x", 0)))
+
+        def sizes(self, request=None):
+            return self.batch_sizes
+
+    serve.run(serve.deployment(Batched, max_ongoing_requests=16).bind(),
+              name="default", route_prefix="/")
+    handle = serve.get_app_handle("default")
+
+    results = {}
+
+    def call(i):
+        results[i] = handle.remote(serve.Request(query={"x": str(i)})).result(timeout=60)
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    assert results == {i: i * 2 for i in range(8)}
+    sizes = handle.options(method_name="sizes").remote(None).result(timeout=60)
+    # 8 calls with max_batch_size=4 must have been grouped (not 8x size-1).
+    assert sum(sizes) == 8 and max(sizes) > 1, sizes
+
+
+def test_serve_multiplexed_models(serve_instance):
+    """@serve.multiplexed loads per-model state on demand, LRU-evicts
+    beyond the cap, and routes by the request header
+    (reference multiplex.py:22)."""
+
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id, "scale": len(model_id)}
+
+        def __call__(self, request):
+            model_id = serve.get_multiplexed_model_id()
+            model = self.get_model(model_id)
+            return {"model": model["id"], "loads": list(self.loads)}
+
+    serve.run(serve.deployment(MultiModel, max_ongoing_requests=8).bind(),
+              name="default", route_prefix="/")
+    addr = serve.http_address()
+
+    def call(model_id):
+        req = urllib.request.Request(
+            addr + "/", headers={"serve_multiplexed_model_id": model_id})
+        return json.loads(urllib.request.urlopen(req, timeout=60).read())
+
+    assert call("m1")["model"] == "m1"
+    assert call("m1")["loads"].count("m1") == 1  # cached, not reloaded
+    assert call("m2")["model"] == "m2"
+    out = call("m3")  # cap 2: evicts LRU (m1)
+    assert out["loads"] == ["m1", "m2", "m3"]
+    out = call("m1")  # m1 was evicted: loads again
+    assert out["loads"].count("m1") == 2
