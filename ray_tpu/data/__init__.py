@@ -10,6 +10,7 @@ from .block import BlockAccessor
 from .dataset import (
     ActorPoolStrategy,
     Dataset,
+    GroupedData,
     MaterializedDataset,
     from_arrow,
     from_items,
@@ -30,6 +31,7 @@ __all__ = [
     "BlockAccessor",
     "DataIterator",
     "Dataset",
+    "GroupedData",
     "MaterializedDataset",
     "from_arrow",
     "from_items",

@@ -79,6 +79,56 @@ class Dataset:
     def limit(self, n: int) -> "Dataset":
         return self._chain(L.Limit("limit", self._last_op, limit=n))
 
+    def groupby(self, key: str) -> "GroupedData":
+        """Group rows by a column (reference ``grouped_data.py:21``); the
+        aggregation executes as a hash-partitioned map-reduce exchange."""
+        return GroupedData(self, key)
+
+    def join(self, other: "Dataset", on: str, *, how: str = "inner",
+             num_partitions: int | None = None) -> "Dataset":
+        """Hash join on column ``on`` (reference ``Dataset.join``). Both
+        sides are hash-partitioned on the key; each reduce joins one
+        partition pair with arrow's native join."""
+        right_refs = list(other.iter_internal_ref_bundles())
+        return self._chain(L.Join(
+            "join", self._last_op, key=on, join_type=how,
+            right_refs=right_refs, num_out=num_partitions))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Positionally merge columns of two datasets with equal row
+        counts (reference ``Dataset.zip``). Blocks are re-aligned on row
+        boundaries (a count pass, then one task per left block holding at
+        most the overlapping right blocks); overlapping column names from
+        ``other`` get a ``_1`` suffix."""
+        left_refs = list(self.iter_internal_ref_bundles())
+        right_refs = list(other.iter_internal_ref_bundles())
+        count_remote = ray.remote(_count_task)
+        left_counts = ray.get([count_remote.remote(r) for r in left_refs], timeout=300)
+        right_counts = ray.get([count_remote.remote(r) for r in right_refs], timeout=300)
+        if sum(left_counts) != sum(right_counts):
+            raise ValueError(
+                f"zip requires equal row counts: {sum(left_counts)} vs {sum(right_counts)}")
+        right_starts = [0]
+        for c in right_counts:
+            right_starts.append(right_starts[-1] + c)
+        zip_remote = ray.remote(_zip_task)
+        out = []
+        lo = 0
+        for i, ref in enumerate(left_refs):
+            hi = lo + left_counts[i]
+            # right blocks overlapping [lo, hi) + their slice offsets
+            overlaps = []
+            blocks = []
+            for j in builtins.range(len(right_refs)):
+                s, e = right_starts[j], right_starts[j + 1]
+                if e <= lo or s >= hi or s == e:
+                    continue
+                overlaps.append((max(lo, s) - s, min(hi, e) - s))
+                blocks.append(right_refs[j])
+            out.append(zip_remote.remote(ref, overlaps, *blocks))
+            lo = hi
+        return MaterializedDataset(out)
+
     # ------------------------------------------------------------ execution
     def iter_internal_ref_bundles(self) -> Iterator:
         executor = StreamingExecutor(plan(self._last_op))
@@ -186,6 +236,64 @@ class Dataset:
 
     def __repr__(self):
         return f"Dataset(ops={[o.name for o in self._last_op.chain()]})"
+
+
+def _count_task(block) -> int:
+    return block.num_rows
+
+
+def _zip_task(left, slices: list, *right_blocks):
+    """Concat the right-side slices aligned to this left block, then merge
+    columns (suffixing duplicates with ``_1``, reference zip semantics)."""
+    import pyarrow as pa
+
+    pieces = [b.slice(s, e - s) for b, (s, e) in zip(right_blocks, slices)]
+    right = concat_blocks(pieces) if pieces else left.slice(0, 0)
+    cols = {name: left.column(name) for name in left.column_names}
+    for name in right.column_names:
+        out_name = name if name not in cols else f"{name}_1"
+        cols[out_name] = right.column(name)
+    return pa.table(cols)
+
+
+class GroupedData:
+    """Result of ``Dataset.groupby`` (reference ``grouped_data.py:21``):
+    aggregations lower to a hash-partitioned exchange with map-side
+    partial aggregation."""
+
+    def __init__(self, dataset: Dataset, key: str):
+        self._dataset = dataset
+        self._key = key
+
+    def _agg(self, aggs: list) -> Dataset:
+        return self._dataset._chain(L.GroupByAggregate(
+            "groupby", self._dataset._last_op, key=self._key, aggs=aggs))
+
+    def count(self) -> Dataset:
+        return self._agg([(self._key, "count")])
+
+    def sum(self, on: str) -> Dataset:
+        return self._agg([(on, "sum")])
+
+    def min(self, on: str) -> Dataset:
+        return self._agg([(on, "min")])
+
+    def max(self, on: str) -> Dataset:
+        return self._agg([(on, "max")])
+
+    def mean(self, on: str) -> Dataset:
+        return self._agg([(on, "mean")])
+
+    def aggregate(self, *aggs: tuple) -> Dataset:
+        """``aggregate((col, "sum"), (col2, "max"), ...)``"""
+        return self._agg(list(aggs))
+
+    def map_groups(self, fn) -> Dataset:
+        """Apply ``fn(batch_dict) -> batch_dict | list[row]`` to each
+        group (reference ``GroupedData.map_groups``)."""
+        return self._dataset._chain(L.GroupByAggregate(
+            "groupby", self._dataset._last_op, key=self._key, aggs=None,
+            map_groups_fn=fn))
 
 
 class MaterializedDataset(Dataset):

@@ -8,9 +8,13 @@ and launches new tasks under per-op concurrency and a global in-flight
 cap (backpressure). Map chains are fused into one task per block
 (the optimizer's operator-fusion rule).
 
-All-to-all ops (shuffle/sort/repartition) currently run as single
-consolidation tasks, not a map-reduce exchange — fine for host-RAM-scale
-data; the exchange planner is a later widening.
+All-to-all ops (shuffle/sort/repartition/groupby/join) run as a push-based
+map-reduce partition exchange (reference ``_internal/planner/exchange/
+push_based_shuffle_task_scheduler.py``): map tasks partition each upstream
+block as it arrives (streaming — no barrier on the input side), reduce
+tasks merge one partition each, so no single task ever holds the whole
+dataset. Sort samples key ranges first (range partitioning); groupby and
+join hash-partition on the key with a cross-process-stable hash.
 """
 
 from __future__ import annotations
@@ -83,19 +87,168 @@ class _MapWorker:
         )
 
 
-def _consolidate_task(op_kind: str, num_out: int, seed, sort_key, descending, *blocks):
-    merged = concat_blocks(list(blocks))
-    n = merged.num_rows
-    if op_kind == "shuffle":
-        rng = np.random.default_rng(seed)
-        merged = merged.take(rng.permutation(n))
-    elif op_kind == "sort":
-        order = "descending" if descending else "ascending"
-        merged = merged.sort_by([(sort_key, order)])
-    if num_out <= 1:
-        return merged
-    bounds = [round(i * n / num_out) for i in range(num_out + 1)]
-    return tuple(merged.slice(bounds[i], bounds[i + 1] - bounds[i]) for i in range(num_out))
+# ------------------------------------------------------- exchange tasks
+
+
+def _stable_hash_partition(block, key: str, num_out: int) -> np.ndarray:
+    """Partition assignment by a hash that is STABLE across worker
+    processes (Python's builtin hash is salted per process, which would
+    scatter equal keys across partitions)."""
+    import pandas as pd
+
+    vals = block.column(key).to_pandas()
+    return (pd.util.hash_array(np.asarray(vals)) % num_out).astype(np.int64)
+
+
+def _exchange_map_task(kind: str, num_out: int, spec: dict, map_index: int, block):
+    """Partition one upstream block into ``num_out`` parts (the map half
+    of the exchange; reference ``exchange/shuffle_task_spec.py``)."""
+    n = block.num_rows
+    if kind == "shuffle":
+        rng = np.random.default_rng((spec.get("seed") or 0) + map_index * 7919)
+        assign = rng.integers(0, num_out, n)
+    elif kind == "repartition":
+        assign = (np.arange(n) + map_index) % num_out  # row round-robin
+    elif kind == "sort":
+        col = block.column(spec["sort_key"]).to_numpy(zero_copy_only=False)
+        assign = np.searchsorted(np.asarray(spec["boundaries"]), col, side="right")
+    elif kind in ("groupby", "join"):
+        assign = _stable_hash_partition(block, spec["key"], num_out)
+    else:
+        raise ValueError(kind)
+    parts = []
+    for i in range(num_out):
+        part = block.take(np.nonzero(assign == i)[0])
+        if kind == "groupby" and spec.get("aggs"):
+            part = _partial_aggregate(part, spec)  # map-side combine
+        parts.append(part)
+    return tuple(parts) if num_out > 1 else parts[0]
+
+
+# Aggregations decompose into (map-side partial, reduce-side merge) so the
+# reduce only sees one partial row per key per map task (reference
+# AggregateFn accumulate/merge/finalize).
+_AGG_PARTIAL = {"count": "count", "sum": "sum", "min": "min", "max": "max"}
+
+
+def _partial_aggregate(part, spec: dict):
+    key = spec["key"]
+    aggs = []
+    for col, op in spec["aggs"]:
+        if op == "mean":
+            aggs.append((col, "sum"))
+            aggs.append((col, "count"))
+        else:
+            aggs.append((col if op != "count" else key, _AGG_PARTIAL[op]))
+    return part.group_by(key).aggregate(_dedupe(aggs))
+
+
+def _dedupe(aggs: list[tuple]) -> list[tuple]:
+    seen, out = set(), []
+    for a in aggs:
+        if a not in seen:
+            seen.add(a)
+            out.append(a)
+    return out
+
+
+def _exchange_reduce_task(kind: str, spec: dict, part_index: int, n_left: int, *parts):
+    """Merge one partition's pieces from every map task (the reduce half).
+    For joins, ``parts[:n_left]`` are the left side's pieces and the rest
+    the right side's (same hash partition on both)."""
+    left_parts = list(parts[:n_left])
+    merged = _concat_keep_schema(left_parts)
+    if kind == "shuffle":
+        rng = np.random.default_rng((spec.get("seed") or 0) ^ (part_index + 1))
+        return merged.take(rng.permutation(merged.num_rows))
+    if kind == "sort":
+        order = "descending" if spec.get("descending") else "ascending"
+        return merged.sort_by([(spec["sort_key"], order)])
+    if kind == "groupby":
+        return _final_aggregate(merged, spec)
+    if kind == "join":
+        right_parts = list(parts[n_left:]) or [merged.slice(0, 0)]
+        right = _concat_keep_schema(right_parts)
+        return merged.join(right, keys=spec["key"], join_type=spec.get("join_type", "inner"))
+    return merged  # repartition
+
+
+def _concat_keep_schema(parts: list):
+    """concat that keeps the schema when every part is empty (an empty
+    hash/range partition must stay sortable/groupable downstream)."""
+    non_empty = [p for p in parts if p.num_rows]
+    if not non_empty:
+        return parts[0]
+    return concat_blocks(non_empty)
+
+
+def _final_aggregate(merged, spec: dict):
+    import pyarrow as pa
+
+    key = spec["key"]
+    if spec.get("map_groups_fn") is not None:
+        fn = spec["map_groups_fn"]
+        acc = BlockAccessor.for_block(merged)
+        groups: dict = {}
+        for row in acc.iter_rows():
+            groups.setdefault(row[key], []).append(row)
+        out_rows = []
+        for _, rows in sorted(groups.items(), key=lambda kv: str(kv[0])):
+            result = fn(_rows_to_batch(rows))
+            out_rows.extend(_batch_to_rows(result))
+        return build_block(out_rows)
+    # Merge map-side partials: count -> sum of counts, sum -> sum of sums,
+    # min/max idempotent, mean -> sum/count finalize.
+    merges = []
+    for col, op in spec["aggs"]:
+        if op == "count":
+            merges.append((f"{key}_count", "sum"))
+        elif op == "sum":
+            merges.append((f"{col}_sum", "sum"))
+        elif op == "min":
+            merges.append((f"{col}_min", "min"))
+        elif op == "max":
+            merges.append((f"{col}_max", "max"))
+        elif op == "mean":
+            merges.append((f"{col}_sum", "sum"))
+            merges.append((f"{col}_count", "sum"))
+    table = merged.group_by(key).aggregate(_dedupe(merges))
+    # Rename/finalize to the reference's output names: op(col).
+    cols = {key: table.column(key)}
+    for col, op in spec["aggs"]:
+        if op == "count":
+            cols["count()"] = table.column(f"{key}_count_sum")
+        elif op == "mean":
+            s = table.column(f"{col}_sum_sum").to_numpy(zero_copy_only=False)
+            c = table.column(f"{col}_count_sum").to_numpy(zero_copy_only=False)
+            cols[f"mean({col})"] = pa.array(s / np.maximum(c, 1))
+        else:
+            cols[f"{op}({col})"] = table.column(f"{col}_{op}_{'sum' if op == 'sum' else op}")
+    return pa.table(cols)
+
+
+def _rows_to_batch(rows: list[dict]) -> dict:
+    keys = rows[0].keys()
+    return {k: np.asarray([r[k] for r in rows]) for k in keys}
+
+
+def _batch_to_rows(result) -> list[dict]:
+    if isinstance(result, dict):
+        keys = list(result)
+        n = len(next(iter(result.values()))) if result else 0
+        return [{k: result[k][i] for k in keys} for i in range(n)]
+    if isinstance(result, list):
+        return result
+    raise TypeError(f"map_groups fn must return a dict batch or list of rows, got {type(result)}")
+
+
+def _sample_task(key: str, block):
+    """Sort pre-pass: sample up to 100 key values from a block."""
+    col = block.column(key).to_numpy(zero_copy_only=False)
+    if len(col) > 100:
+        idx = np.random.default_rng(0).choice(len(col), 100, replace=False)
+        col = col[idx]
+    return np.asarray(col)
 
 
 # ------------------------------------------------------------- physical ops
@@ -226,39 +379,139 @@ class ActorPoolMapPhysicalOp(PhysicalOp):
         self._actors = []
 
 
-class AllToAllPhysicalOp(PhysicalOp):
-    """Barrier op: waits for the whole upstream, then one consolidation
-    task emits num_out blocks."""
+class ExchangePhysicalOp(PhysicalOp):
+    """Push-based map-reduce partition exchange behind every all-to-all op
+    (reference ``push_based_shuffle_task_scheduler.py``).
+
+    Map tasks launch as upstream blocks ARRIVE (no input barrier); each
+    partitions its block into ``num_out`` pieces. Once the upstream and
+    all maps finish, ``num_out`` reduce tasks each merge one partition —
+    so peak per-task memory is one partition, not the dataset. Sort runs
+    a sampling pre-pass to pick range boundaries; join hash-partitions
+    the (pre-materialized) right side through the same maps."""
+
+    DEFAULT_PARTITIONS = 8
 
     def __init__(self, kind: str, *, num_out: int | None = None, seed=None,
-                 sort_key: str = "", descending: bool = False):
-        super().__init__(f"AllToAll[{kind}]")
+                 sort_key: str = "", descending: bool = False, key: str = "",
+                 aggs: list | None = None, map_groups_fn=None,
+                 right_refs: list | None = None, join_type: str = "inner"):
+        super().__init__(f"Exchange[{kind}]")
         self._kind = kind
-        self._num_out = num_out
-        self._seed = seed
-        self._sort_key = sort_key
-        self._descending = descending
-        self._launched = False
+        self._num_out = num_out or self.DEFAULT_PARTITIONS
+        self._spec = {
+            "seed": seed, "sort_key": sort_key, "descending": descending,
+            "key": key, "aggs": aggs, "map_groups_fn": map_groups_fn,
+            "join_type": join_type,
+        }
+        self._map_remote = ray.remote(_exchange_map_task).options(num_returns=self._num_out) \
+            if self._num_out > 1 else ray.remote(_exchange_map_task)
+        self._reduce_remote = ray.remote(_exchange_reduce_task)
+        self._sample_remote = ray.remote(_sample_task)
+        self._internal: dict = {}           # ref -> ("sample"|"map", ...)
+        self._pending_sample: list = []     # block refs awaiting boundaries (sort)
+        self._samples: list = []
+        self._boundaries_ready = kind != "sort"
+        self._map_outputs: list[list] = []  # per map: [num_out refs]
+        self._map_index = 0
+        self._maps_in_flight = 0
+        self._right_refs = list(right_refs or [])
+        self._right_outputs: list[list] = []
+        self._reduces_launched = 0
 
+    # Upstream blocks stack in input_queue; right-side blocks are seeded
+    # into the map queue too (tagged).
     def can_launch(self) -> bool:
-        return self.upstream_done and not self._launched and bool(self.input_queue)
+        if self._kind == "sort" and not self._boundaries_ready:
+            # Sampling phase: one sample task per arriving block.
+            return bool(self.input_queue) or self._maybe_finish_sampling()
+        if self.input_queue or self._right_refs:
+            return True
+        return self._can_reduce()
+
+    def _maybe_finish_sampling(self) -> bool:
+        if (self.upstream_done and not self.input_queue
+                and not any(k[0] == "sample" for k in self._internal.values())
+                and not self._boundaries_ready):
+            # All samples in: compute range boundaries on the driver.
+            vals = np.concatenate(self._samples) if self._samples else np.array([0.0])
+            qs = [(i + 1) / self._num_out for i in range(self._num_out - 1)]
+            self._spec["boundaries"] = [float(v) for v in np.quantile(vals, qs)]
+            self._boundaries_ready = True
+            # blocks return to the map queue
+            self.input_queue = self._pending_sample + self.input_queue
+            self._pending_sample = []
+            return bool(self.input_queue)
+        return False
+
+    def _can_reduce(self) -> bool:
+        return (self.upstream_done and not self.input_queue and not self._right_refs
+                and self._boundaries_ready and self._maps_in_flight == 0
+                and self._reduces_launched < self._num_out
+                and bool(self._map_outputs or self._right_outputs))
 
     def launch_one(self):
-        blocks = list(self.input_queue)
-        self.input_queue.clear()
-        self._launched = True
-        num_out = self._num_out or len(blocks) or 1
-        remote = ray.remote(_consolidate_task).options(num_returns=num_out)
-        refs = remote.remote(
-            self._kind, num_out, self._seed, self._sort_key, self._descending, *blocks
-        )
-        if num_out == 1:
-            refs = [refs]
-        return self._track(list(refs))
+        if self._kind == "sort" and not self._boundaries_ready:
+            if not self.input_queue:
+                return []  # _maybe_finish_sampling flipped the phase
+            block_ref = self.input_queue.pop(0)
+            self._pending_sample.append(block_ref)
+            ref = self._sample_remote.remote(self._spec["sort_key"], block_ref)
+            self._internal[ref] = ("sample",)
+            self.in_flight[ref] = None
+            return [ref]
+        if self.input_queue or self._right_refs:
+            side = "left" if self.input_queue else "right"
+            block_ref = (self.input_queue.pop(0) if side == "left"
+                         else self._right_refs.pop(0))
+            refs = self._map_remote.remote(
+                self._kind, self._num_out, self._spec, self._map_index, block_ref)
+            self._map_index += 1
+            if self._num_out == 1:
+                refs = [refs]
+            refs = list(refs)
+            out_list = self._map_outputs if side == "left" else self._right_outputs
+            out_list.append(refs)
+            self._maps_in_flight += 1
+            # Track ONE ref per map for completion accounting (siblings of
+            # a multi-return task complete together).
+            self._internal[refs[0]] = ("map",)
+            self.in_flight[refs[0]] = None
+            return [refs[0]]
+        if self._can_reduce():
+            i = self._reduces_launched
+            self._reduces_launched += 1
+            # Descending sort: partition 0 holds the SMALLEST range — emit
+            # partitions in reverse so the global stream is ordered.
+            if self._kind == "sort" and self._spec.get("descending"):
+                i = self._num_out - 1 - i
+            left = [m[i] for m in self._map_outputs]
+            right = [m[i] for m in self._right_outputs]
+            spec = {k: v for k, v in self._spec.items() if k != "map_groups_fn"}
+            spec["map_groups_fn"] = self._spec["map_groups_fn"]
+            ref = self._reduce_remote.remote(
+                self._kind, spec, i, len(left), *(left + right))
+            return self._track([ref])
+        return []
+
+    def on_complete(self, ref) -> None:
+        tag = self._internal.pop(ref, None)
+        if tag is None:
+            super().on_complete(ref)  # a reduce: ordered output emission
+            return
+        self.in_flight.pop(ref, None)
+        if tag[0] == "sample":
+            self._samples.append(ray.get(ref))
+        else:  # map
+            self._maps_in_flight -= 1
 
     def done(self) -> bool:
-        # also covers an empty upstream (nothing to consolidate)
-        return self.upstream_done and not self.in_flight and not self.input_queue
+        if not (self.upstream_done and not self.input_queue and not self.in_flight
+                and not self._right_refs):
+            return False
+        if not self._map_outputs and not self._right_outputs:
+            return True  # empty upstream: nothing to exchange
+        return self._reduces_launched >= self._num_out
 
 
 class LimitPhysicalOp(PhysicalOp):
@@ -334,13 +587,23 @@ def plan(last_op: L.LogicalOp) -> list[PhysicalOp]:
             pending_stages.append(MapStage("filter", lop.fn))
         elif isinstance(lop, L.Repartition):
             flush_maps()
-            ops.append(AllToAllPhysicalOp("repartition", num_out=lop.num_blocks))
+            ops.append(ExchangePhysicalOp("repartition", num_out=lop.num_blocks))
         elif isinstance(lop, L.RandomShuffle):
             flush_maps()
-            ops.append(AllToAllPhysicalOp("shuffle", seed=lop.seed))
+            ops.append(ExchangePhysicalOp("shuffle", seed=lop.seed))
         elif isinstance(lop, L.Sort):
             flush_maps()
-            ops.append(AllToAllPhysicalOp("sort", sort_key=lop.key, descending=lop.descending))
+            ops.append(ExchangePhysicalOp("sort", sort_key=lop.key, descending=lop.descending))
+        elif isinstance(lop, L.GroupByAggregate):
+            flush_maps()
+            ops.append(ExchangePhysicalOp(
+                "groupby", num_out=lop.num_out, key=lop.key, aggs=lop.aggs,
+                map_groups_fn=lop.map_groups_fn))
+        elif isinstance(lop, L.Join):
+            flush_maps()
+            ops.append(ExchangePhysicalOp(
+                "join", num_out=lop.num_out, key=lop.key,
+                right_refs=lop.right_refs, join_type=lop.join_type))
         elif isinstance(lop, L.Limit):
             flush_maps()
             ops.append(LimitPhysicalOp(lop.limit))

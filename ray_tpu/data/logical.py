@@ -82,3 +82,25 @@ class Limit(LogicalOp):
 @dataclasses.dataclass
 class Union(LogicalOp):
     others: list[LogicalOp] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class GroupByAggregate(LogicalOp):
+    """groupby(key) + aggregations or map_groups (reference
+    ``grouped_data.py:21``)."""
+
+    key: str = ""
+    aggs: list = None  # [(col, "count"|"sum"|"min"|"max"|"mean")]
+    map_groups_fn: Any = None
+    num_out: int | None = None
+
+
+@dataclasses.dataclass
+class Join(LogicalOp):
+    """Hash join against a pre-materialized right side (reference
+    ``Dataset.join``)."""
+
+    key: str = ""
+    join_type: str = "inner"
+    right_refs: list = dataclasses.field(default_factory=list)
+    num_out: int | None = None

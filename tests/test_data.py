@@ -151,3 +151,90 @@ def test_union_and_write_json(ray_cluster, tmp_path):
     for f in sorted((tmp_path / "out").iterdir()):
         rows += [json.loads(line) for line in f.read_text().splitlines()]
     assert sorted(r["id"] for r in rows) == [0, 1, 2, 3, 4, 10, 11, 12, 13, 14]
+
+
+def test_groupby_aggregations(ray_cluster):
+    """groupby().count/sum/min/max/mean through the hash exchange with
+    map-side partial aggregation (reference grouped_data.py:21)."""
+    rows = [{"k": i % 3, "v": float(i)} for i in range(30)]
+    ds = rd.from_items(rows, parallelism=4)
+
+    counts = {r["k"]: r["count()"] for r in ds.groupby("k").count().take_all()}
+    assert counts == {0: 10, 1: 10, 2: 10}
+
+    sums = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    assert sums == {k: sum(float(i) for i in range(30) if i % 3 == k) for k in range(3)}
+
+    mins = {r["k"]: r["min(v)"] for r in ds.groupby("k").min("v").take_all()}
+    assert mins == {0: 0.0, 1: 1.0, 2: 2.0}
+
+    maxs = {r["k"]: r["max(v)"] for r in ds.groupby("k").max("v").take_all()}
+    assert maxs == {0: 27.0, 1: 28.0, 2: 29.0}
+
+    means = {r["k"]: r["mean(v)"] for r in ds.groupby("k").mean("v").take_all()}
+    assert means == {k: sums[k] / 10 for k in range(3)}
+
+    multi = ds.groupby("k").aggregate(("v", "sum"), ("v", "max")).take_all()
+    assert {r["k"]: (r["sum(v)"], r["max(v)"]) for r in multi} == {
+        k: (sums[k], maxs[k]) for k in range(3)}
+
+
+def test_groupby_map_groups(ray_cluster):
+    ds = rd.from_items([{"k": i % 2, "v": i} for i in range(10)], parallelism=3)
+
+    def normalize(batch):
+        v = batch["v"]
+        return {"k": batch["k"][:1], "spread": [int(v.max() - v.min())]}
+
+    out = ds.groupby("k").map_groups(normalize).take_all()
+    assert sorted((r["k"], r["spread"]) for r in out) == [(0, 8), (1, 8)]
+
+
+def test_join_inner_and_left(ray_cluster):
+    left = rd.from_items([{"id": i, "a": i * 10} for i in range(8)], parallelism=3)
+    right = rd.from_items([{"id": i, "b": i * 100} for i in range(0, 8, 2)], parallelism=2)
+
+    inner = left.join(right, on="id").take_all()
+    assert sorted((r["id"], r["a"], r["b"]) for r in inner) == [
+        (i, i * 10, i * 100) for i in range(0, 8, 2)]
+
+    outer = left.join(right, on="id", how="left outer").take_all()
+    assert len(outer) == 8
+    matched = {r["id"]: r["b"] for r in outer if r["b"] is not None}
+    assert matched == {i: i * 100 for i in range(0, 8, 2)}
+
+
+def test_zip(ray_cluster):
+    a = rd.from_items([{"x": i} for i in range(12)], parallelism=3)
+    b = rd.from_items([{"y": i * 2} for i in range(12)], parallelism=4)  # misaligned blocks
+    out = a.zip(b).take_all()
+    assert sorted((r["x"], r["y"]) for r in out) == [(i, i * 2) for i in range(12)]
+
+    with pytest.raises(ValueError, match="equal row counts"):
+        a.zip(rd.from_items([{"y": 1}], parallelism=1)).take_all()
+
+
+def test_shuffle_exchange_is_partitioned(ray_cluster):
+    """random_shuffle runs as a map-reduce exchange: output arrives as
+    multiple partition blocks (not one consolidation block), preserves the
+    multiset, and actually permutes."""
+    ds = rd.range(2000, parallelism=8).random_shuffle(seed=7)
+    refs = list(ds.iter_internal_ref_bundles())
+    assert len(refs) > 1, "shuffle must emit one block per partition"
+    rows = [r["id"] for r in ds.iter_rows()]
+    assert sorted(rows) == list(builtins.range(2000))
+    assert rows != sorted(rows)
+
+
+def test_sort_exchange_range_partitioned(ray_cluster):
+    """sort samples boundaries and range-partitions; the global stream is
+    ordered across partition blocks."""
+    import random
+
+    vals = list(builtins.range(500))
+    random.Random(3).shuffle(vals)
+    ds = rd.from_items([{"v": v} for v in vals], parallelism=6).sort("v")
+    refs = list(ds.iter_internal_ref_bundles())
+    assert len(refs) > 1
+    out = [r["v"] for r in ds.iter_rows()]
+    assert out == sorted(vals)
