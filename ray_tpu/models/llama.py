@@ -65,6 +65,10 @@ PRESETS: dict[str, LlamaConfig] = {
     "llama3-8b": LlamaConfig(),
     "llama3-1b": LlamaConfig(hidden=2048, n_layers=16, n_heads=32, n_kv_heads=8,
                              intermediate=8192, head_dim=64),
+    # Exact 8B layer dims (hidden 4096, 32 q-heads, head_dim 128) at 8
+    # layers so params+optimizer fit one 16 GB chip: the honest per-layer
+    # perf point for the 8B north star (MFU is computed from THIS config).
+    "llama3-8b-proxy": LlamaConfig(n_layers=8),
     # tiny configs for tests / dryruns
     "debug": LlamaConfig(vocab_size=256, hidden=64, n_layers=2, n_heads=4,
                          n_kv_heads=2, intermediate=128, head_dim=16),

@@ -46,7 +46,8 @@ def mha_reference(q, k, v, *, causal: bool = True, sm_scale: float | None = None
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, sm_scale, causal, block_q, block_k, n_k
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, sm_scale, causal, block_q, block_k, n_k
 ):
     ki = pl.program_id(3)
     qi = pl.program_id(2)
@@ -95,6 +96,10 @@ def _flash_kernel(
     @pl.when(ki == n_k - 1)
     def _final():
         o_ref[0, 0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # logsumexp residual for the backward kernels, replicated
+            # along lanes (the jax TPU flash layout: [B,H,S,128]).
+            lse_ref[0, 0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
 
 def _flash_forward(
@@ -107,6 +112,7 @@ def _flash_forward(
     block_q: int,
     block_k: int,
     interpret: bool,
+    save_residuals: bool = False,
 ):
     b, hq, sq, d = q.shape
     hkv = k.shape[1]
@@ -118,7 +124,8 @@ def _flash_forward(
     # fallback for shapes the TPU tiling can't take: ragged blocks or blocks
     # not multiple of the bf16 sublane tile (16)
     if sq % block_q or sk % block_k or block_q % 16 or block_k % 16:
-        return mha_reference(q, k, v, causal=causal, sm_scale=scale)
+        o = mha_reference(q, k, v, causal=causal, sm_scale=scale)
+        return (o, None) if save_residuals else o
     n_q, n_k = sq // block_q, sk // block_k
 
     grid = (b, hq, n_q, n_k)
@@ -130,7 +137,18 @@ def _flash_forward(
         block_k=block_k,
         n_k=n_k,
     )
-    return pl.pallas_call(
+    if not save_residuals:
+        def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+                   _inner=kernel):
+            _inner(q_ref, k_ref, v_ref, o_ref, None, acc_ref, m_ref, l_ref)
+
+    out_specs = [pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0))]
+    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    if save_residuals:
+        out_specs.append(
+            pl.BlockSpec((1, 1, block_q, 128), lambda bi, hi, qi, ki: (bi, hi, qi, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((b, hq, sq, 128), jnp.float32))
+    result = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -140,8 +158,8 @@ def _flash_forward(
             pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi // rep, ki, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi // rep, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=out_specs if save_residuals else out_specs[0],
+        out_shape=out_shape if save_residuals else out_shape[0],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -149,6 +167,166 @@ def _flash_forward(
         ],
         interpret=interpret,
     )(q, k, v)
+    return result
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
+                   acc_ref, *, sm_scale, causal, block_q, block_k, n_k):
+    """dQ: for one q block, accumulate ds @ K over all k blocks (k axis
+    innermost → sequential on-core, acc lives in VMEM)."""
+    ki = pl.program_id(3)
+    qi = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    needed = jnp.logical_or(jnp.logical_not(causal), k_start <= q_start + block_q - 1)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        g = g_ref[0, 0]
+        lse = lse_ref[0, 0]      # [bq, 128] lanes-replicated
+        delta = delta_ref[0, 0]  # [bq, 128]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        if causal:
+            q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        p = jnp.exp(s - lse[:, :1])  # masked entries underflow to 0
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta[:, :1]) * sm_scale).astype(q.dtype)
+        acc_ref[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _final():
+        dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, dk_acc, dv_acc,
+                     *, sm_scale, causal, block_q, block_k, n_q):
+    """dK/dV: for one k block, accumulate over all q blocks (q axis
+    innermost). p/ds are computed q-major and contracted over the q dim
+    (dot_general) — no transposes materialize."""
+    qi = pl.program_id(3)
+    ki = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    needed = jnp.logical_or(jnp.logical_not(causal), q_start + block_q - 1 >= k_start)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        g = g_ref[0, 0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        if causal:
+            q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        p = jnp.exp(s - lse[:, :1])                       # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                  # [bk, d]
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta[:, :1]) * sm_scale).astype(q.dtype)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )                                                  # [bk, d]
+
+    @pl.when(qi == n_q - 1)
+    def _final():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, *, causal, sm_scale, block_q, block_k,
+                    interpret):
+    """Pallas dq/dk/dv. K/V stay at kv-head count (GQA via index maps);
+    dk/dv come out at q-head count and are reduced by the caller."""
+    b, hq, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    rep = hq // hkv
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    n_q, n_k = sq // block_q, sk // block_k
+    # delta = rowsum(dO * O), lanes-replicated like lse.
+    delta = jnp.broadcast_to(
+        jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                keepdims=True),
+        (b, hq, sq, 128),
+    )
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi // rep, ki, 0))
+    lm_spec = pl.BlockSpec((1, 1, block_q, 128), lambda bi, hi, ki, qi: (bi, hi, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_k=n_k),
+        grid=(b, hq, n_q, n_k),  # k innermost
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi // rep, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi // rep, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, sm_scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_q=n_q),
+        grid=(b, hq, n_k, n_q),  # q innermost
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, lm_spec, lm_spec],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, hq, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    if rep > 1:
+        dk = dk.reshape(b, hkv, rep, sk, d).sum(axis=2).astype(k.dtype)
+        dv = dv.reshape(b, hkv, rep, sk, d).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
 
 
 def _mha_backward_blocked(q, k, v, g, *, causal, sm_scale, block_q):
@@ -203,9 +381,17 @@ def _mha_backward_blocked(q, k, v, g, *, causal, sm_scale, block_q):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _blocks_fit(sq, sk, block_q, block_k) -> bool:
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    return not (sq % block_q or sk % block_k or block_q % 16 or block_k % 16)
+
+
 @functools.lru_cache(maxsize=None)
 def _make_flash(causal, sm_scale, block_q, block_k, interpret):
-    """custom_vjp wrapper: Pallas kernel forward, blocked-recompute backward."""
+    """custom_vjp wrapper: Pallas kernels for BOTH directions (forward
+    saves the logsumexp residual; dq and dk/dv are dedicated kernels).
+    Ragged shapes fall back to the jnp blocked paths."""
 
     @jax.custom_vjp
     def f(q, k, v):
@@ -215,10 +401,23 @@ def _make_flash(causal, sm_scale, block_q, block_k, interpret):
         )
 
     def fwd(q, k, v):
-        return f(q, k, v), (q, k, v)
+        if not _blocks_fit(q.shape[2], k.shape[2], block_q, block_k):
+            return f(q, k, v), (q, k, v, None, None)
+        o, lse = _flash_forward(
+            q, k, v, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+            save_residuals=True,
+        )
+        return o, (q, k, v, o, lse)
 
     def bwd(res, g):
-        q, k, v = res
+        q, k, v, o, lse = res
+        if lse is not None:
+            return _flash_backward(
+                q, k, v, o, lse, g, causal=causal, sm_scale=sm_scale,
+                block_q=block_q, block_k=block_k, interpret=interpret,
+            )
+        # Ragged fallback: blocked-recompute backward in plain JAX.
         hq, hkv = q.shape[1], k.shape[1]
         if hq != hkv:
             rep = hq // hkv
