@@ -1,0 +1,150 @@
+"""Core scalability microbenchmarks.
+
+Equivalent of the reference's ``python/ray/_private/ray_perf.py:93``: a
+fixed suite of control-plane microbenchmarks (task submission, actor
+calls, put/get by size, many-task / many-actor / many-PG stress) whose
+numbers are tracked in ``PERF.md`` against the reference's published
+envelope (BASELINE.md). Run: ``python -m ray_tpu._perf [--quick]``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def timeit(name: str, fn, n: int, results: list, *, unit: str = "ops/s") -> float:
+    t0 = time.perf_counter()
+    fn()
+    dt = time.perf_counter() - t0
+    rate = n / dt
+    results.append({"name": name, "rate": round(rate, 1), "n": n,
+                    "seconds": round(dt, 3), "unit": unit})
+    print(f"{name:<44} {rate:>12,.1f} {unit}  ({n} in {dt:.2f}s)", flush=True)
+    return rate
+
+
+def main(quick: bool = False) -> list[dict]:
+    import ray_tpu
+
+    scale = 0.2 if quick else 1.0
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    results: list[dict] = []
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    @ray_tpu.remote
+    def noop_arg(x):
+        return x
+
+    # Warmup: start workers, prime lease pipelines.
+    ray_tpu.get([noop.remote() for _ in range(20)], timeout=120)
+
+    n = int(500 * scale)
+    timeit("tasks: submit+get sync (1 client)",
+           lambda: [ray_tpu.get(noop.remote(), timeout=60) for _ in range(n)],
+           n, results)
+
+    n = int(2000 * scale)
+    timeit("tasks: batch submit then get",
+           lambda: ray_tpu.get([noop.remote() for _ in range(n)], timeout=300),
+           n, results)
+
+    n = int(1000 * scale)
+    timeit("tasks: 1KB arg roundtrip",
+           lambda: ray_tpu.get([noop_arg.remote(b"x" * 1024) for _ in range(n)],
+                               timeout=300),
+           n, results)
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+        async def ainc(self):
+            self.n += 1
+            return self.n
+
+    actor = Counter.remote()
+    ray_tpu.get(actor.inc.remote(), timeout=60)
+
+    n = int(500 * scale)
+    timeit("actor: calls sync (1 actor, 1 client)",
+           lambda: [ray_tpu.get(actor.inc.remote(), timeout=60) for _ in range(n)],
+           n, results)
+
+    n = int(2000 * scale)
+    timeit("actor: batch calls then get",
+           lambda: ray_tpu.get([actor.inc.remote() for _ in range(n)], timeout=300),
+           n, results)
+
+    async_actor = Counter.options(max_concurrency=16).remote()
+    ray_tpu.get(async_actor.ainc.remote(), timeout=60)
+    n = int(2000 * scale)
+    timeit("actor: async-method batch calls (conc=16)",
+           lambda: ray_tpu.get([async_actor.ainc.remote() for _ in range(n)],
+                               timeout=300),
+           n, results)
+
+    # put/get by size
+    for size, label, count in [(1024, "1KB", 1000), (1 << 20, "1MB", 200),
+                               (10 << 20, "10MB", 40)]:
+        count = max(5, int(count * scale))
+        payload = b"x" * size
+        refs: list = []
+
+        def do_puts():
+            refs.extend(ray_tpu.put(payload) for _ in range(count))
+
+        timeit(f"object: put {label}", do_puts, count, results)
+        timeit(f"object: get {label}",
+               lambda: [ray_tpu.get(r, timeout=60) for r in refs], count, results)
+        del refs
+
+    # many-task stress: wide fan-out through the scheduler
+    n = int(5000 * scale)
+    timeit(f"stress: {n} tiny tasks end-to-end",
+           lambda: ray_tpu.get([noop.remote() for _ in range(n)], timeout=600),
+           n, results)
+
+    # many-actor stress: creation + one call each
+    n = int(40 * scale) or 8
+
+    def many_actors():
+        # fractional CPUs: this measures the scheduler, not core count
+        actors = [Counter.options(num_cpus=0.05).remote() for _ in range(n)]
+        ray_tpu.get([a.inc.remote() for a in actors], timeout=300)
+        for a in actors:
+            ray_tpu.kill(a)
+
+    timeit(f"stress: create+call+kill {n} actors", many_actors, n, results,
+           unit="actors/s")
+
+    # placement-group churn
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    n = max(3, int(20 * scale))
+
+    def pg_churn():
+        for _ in range(n):
+            pg = placement_group([{"CPU": 1}], strategy="PACK")
+            assert pg.wait(timeout_seconds=30)
+            remove_placement_group(pg)
+
+    timeit(f"stress: {n} PG create/ready/remove cycles", pg_churn, n, results,
+           unit="pgs/s")
+
+    return results
+
+
+if __name__ == "__main__":
+    quick = "--quick" in sys.argv
+    out = main(quick=quick)
+    print(json.dumps({"perf": out}))

@@ -51,6 +51,10 @@ class RayTpuConfig:
     # Max tasks dispatched to one worker lease before returning it.
     worker_lease_timeout_ms: int = 500
     max_pending_lease_requests_per_scheduling_category: int = 10
+    # Keep a drained lease warm briefly before returning the worker: a
+    # sync submit->get->submit loop re-pushes on the SAME lease instead of
+    # paying acquire+return RPCs per task (reference: worker lease reuse).
+    lease_idle_grace_ms: int = 20
 
     # --- worker pool ---------------------------------------------------------
     num_prestart_workers: int = 2

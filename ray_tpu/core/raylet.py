@@ -243,8 +243,28 @@ class Raylet:
         a GCS outage must still reach the restarted GCS, or the restored
         record stays ALIVE forever."""
         pending_deaths: list[dict] = []
+        cfg = get_config()
         while True:
             await asyncio.sleep(0.2)
+            # Prestart-pool maintenance (reference worker_pool prestart):
+            # keep `num_prestart_workers` DEFAULT-env workers idle at all
+            # times so actor creation and task bursts claim a ready worker
+            # instead of paying the ~2s spawn+import+register cold start.
+            idle_default = sum(
+                1 for wid in self._idle
+                if (w := self._workers.get(wid)) and w.env_hash == ""
+            )
+            starting = sum(
+                1 for w in self._workers.values()
+                if w.state == "starting" and w.env_hash == ""
+            )
+            if (not self._shutdown
+                    and idle_default + starting < cfg.num_prestart_workers
+                    and starting < cfg.maximum_startup_concurrency):
+                try:
+                    self._start_worker()
+                except Exception:
+                    pass
             for w in list(self._workers.values()):
                 # Drivers register without a proc handle but always live on
                 # this host: poll their pid so a driver that exits with
@@ -325,14 +345,22 @@ class Raylet:
         # it would be 8KB block-buffered and prints from long-lived workers
         # would never reach the driver.
         env["PYTHONUNBUFFERED"] = "1"
-        env.setdefault("JAX_PLATFORMS", "cpu")  # workers don't grab the TPU by default
         from .runtime_env import apply_runtime_env
 
+        explicit_vars = (runtime_env or {}).get("env_vars") or {}
+        if "JAX_PLATFORMS" not in explicit_vars:
+            # Workers don't grab the TPU by default. FORCE cpu (don't
+            # setdefault): drivers often run with JAX_PLATFORMS=axon/tpu
+            # inherited from their own env, and passing that through made
+            # every worker pay the multi-second accelerator-plugin boot
+            # in sitecustomize (~9s/worker — the actor-creation
+            # throughput collapse the perf suite exposed). A TPU worker
+            # opts in by unsetting it via runtime_env env_vars.
+            env["JAX_PLATFORMS"] = "cpu"
         # working_dir: tasks run with this cwd and import modules from it
         # (reference runtime_env working_dir, minus the remote upload —
         # single-host path semantics).
         working_dir = apply_runtime_env(env, runtime_env)
-        explicit_vars = (runtime_env or {}).get("env_vars") or {}
         if env.get("JAX_PLATFORMS") == "cpu" and "PALLAS_AXON_POOL_IPS" not in explicit_vars:
             # Some images hook accelerator-plugin registration (a multi-
             # second jax import) into sitecustomize, gated on this var.

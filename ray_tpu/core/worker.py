@@ -650,6 +650,21 @@ class CoreWorker:
             self._task_counter += 1
             return TaskID.for_normal_task(self.job_id, self.current_task_id, self._task_counter)
 
+    @staticmethod
+    def _accelerator_runtime_env(resources: dict | None, runtime_env: dict | None) -> dict:
+        """Workers are pinned to JAX_PLATFORMS=cpu by the raylet unless the
+        runtime_env explicitly overrides it. A task/actor that REQUESTS the
+        TPU obviously wants the accelerator: inject the opt-out so users
+        don't silently train/infer on CPU while holding a TPU lease."""
+        if not resources or not resources.get("TPU"):
+            return runtime_env or {}
+        renv = dict(runtime_env or {})
+        env_vars = dict(renv.get("env_vars") or {})
+        if "JAX_PLATFORMS" not in env_vars:
+            env_vars["JAX_PLATFORMS"] = None  # unset -> platform autodetect
+            renv["env_vars"] = env_vars
+        return renv
+
     def submit_task(
         self,
         fn: Callable,
@@ -687,7 +702,7 @@ class CoreWorker:
             scheduling_strategy=scheduling_strategy or {},
             placement_group_id=placement_group_id,
             placement_group_bundle_index=placement_group_bundle_index,
-            runtime_env=runtime_env or {},
+            runtime_env=self._accelerator_runtime_env(resources, runtime_env),
         )
         if streaming:
             return self._submit_streaming(spec)
@@ -797,12 +812,28 @@ class CoreWorker:
                     return
                 worker_addr, worker_id, raylet_client = lease
                 worker = RpcClient(worker_addr)
+                # Spread tasks salt the key per task (key[-1] != 0): their
+                # queue can never refill, so skip the grace.
+                grace_s = 0.0 if key[-1] else get_config().lease_idle_grace_ms / 1000.0
                 try:
                     while True:
                         with self._queue_lock:
-                            if not self._task_queues.get(key):
+                            queue = self._task_queues.get(key)
+                            spec = queue.pop(0) if queue else None
+                        if spec is None:
+                            # Drained: hold the lease for a short grace so
+                            # an immediate next submit reuses it (sync
+                            # loops would otherwise pay a full lease
+                            # acquire+return round trip per task).
+                            if grace_s > 0:
+                                deadline = time.monotonic() + grace_s
+                                while spec is None and time.monotonic() < deadline:
+                                    await asyncio_sleep(0.002)
+                                    with self._queue_lock:
+                                        queue = self._task_queues.get(key)
+                                        spec = queue.pop(0) if queue else None
+                            if spec is None:
                                 break
-                            spec = self._task_queues[key].pop(0)
                         try:
                             worker_alive = await self._push_and_complete(spec, worker, worker_id)
                         except BaseException as e:
@@ -1006,7 +1037,7 @@ class CoreWorker:
             scheduling_strategy=scheduling_strategy or {},
             placement_group_id=placement_group_id,
             placement_group_bundle_index=placement_group_bundle_index,
-            runtime_env=runtime_env or {},
+            runtime_env=self._accelerator_runtime_env(res, runtime_env),
         )
         reply = self._gcs_call(
             "RegisterActor",
