@@ -469,7 +469,20 @@ class GcsServer:
         for attempt in range(60):
             if record["state"] == DEAD:  # killed while pending
                 return
-            node_id = self._select_node(resources, strategy)
+            pg_id = spec.get("placement_group_id") or b""
+            if pg_id:
+                # PG-bundled actor: the bundle RESERVED its resources, so
+                # availability-based selection would see a full cluster and
+                # never place it — go straight to the bundle's node (the
+                # raylet grants the lease from the bundle reservation).
+                pg_hex = pg_id.hex() if isinstance(pg_id, bytes) else pg_id
+                pg_rec = self._placement_groups.get(pg_hex)
+                locs = (pg_rec or {}).get("bundle_locations") or []
+                idx = spec.get("placement_group_bundle_index", -1)
+                node_id = (locs[idx] if 0 <= idx < len(locs)
+                           else (locs[0] if locs else None))
+            else:
+                node_id = self._select_node(resources, strategy)
             if node_id is None:
                 await asyncio.sleep(0.5)
                 continue

@@ -14,6 +14,7 @@ from .config import (
     RunConfig,
     ScalingConfig,
 )
+from .controller import ElasticScalingPolicy, FixedScalingPolicy
 from .session import get_checkpoint, get_context, get_dataset_shard, report
 from .trainer import DataParallelTrainer, JaxTrainer
 
@@ -21,6 +22,8 @@ __all__ = [
     "Checkpoint",
     "CheckpointConfig",
     "DataParallelTrainer",
+    "ElasticScalingPolicy",
+    "FixedScalingPolicy",
     "FailureConfig",
     "JaxTrainer",
     "Result",

@@ -29,6 +29,11 @@ class ScalingConfig:
     resources_per_worker: dict | None = None
     topology: str | None = None
     placement_strategy: str = "PACK"
+    # Elastic training (reference v2 scaling_policy/scaling_policy.py:29):
+    # when set, `num_workers` becomes the MAX and the controller sizes the
+    # group to observed cluster capacity in [min_workers, num_workers],
+    # restarting slice-atomically from the latest checkpoint on resize.
+    min_workers: int | None = None
     # Per-worker runtime env ({"env_vars": {...}}). TPU idiom: the driver
     # stays off the chip (JAX_PLATFORMS=cpu) and the train workers claim it
     # by clearing that override.

@@ -26,8 +26,75 @@ class FixedScalingPolicy:
     def __init__(self, scaling_config: ScalingConfig):
         self._config = scaling_config
 
-    def group_size(self, attempt: int) -> int:
+    def group_size(self, current: int | None = None) -> int:
         return self._config.num_workers
+
+    def monitor(self, current: int) -> int | None:
+        return None  # never resizes
+
+
+class ElasticScalingPolicy:
+    """Size the group to observed cluster capacity within
+    ``[min_workers, num_workers]`` (reference v2
+    ``execution/scaling_policy/scaling_policy.py:29`` ResizeDecision).
+
+    TPU discipline: the worker group is slice-atomic, so a resize is a
+    whole-group restart from the latest checkpoint — never an in-place
+    membership change (SPMD collectives can't survive one)."""
+
+    def __init__(self, scaling_config: ScalingConfig, *, check_interval_s: float = 2.0):
+        self._config = scaling_config
+        self.min = max(1, scaling_config.min_workers or 1)
+        self.max = scaling_config.num_workers
+        self._check_interval = check_interval_s
+        self._next_check = 0.0
+        self._pending_target: int | None = None
+
+    def _feasible_workers(self, holding: int = 0) -> int:
+        """Workers the cluster can host NOW: floor over each required
+        resource of available/required, plus what the current group holds."""
+        from ..core import api as ray
+
+        need = self._config.worker_resources()
+        try:
+            avail = ray.available_resources()
+        except Exception:
+            return holding or self.min
+        fits = min(
+            int(avail.get(res, 0.0) / amount) for res, amount in need.items()
+        ) if need else self.max
+        return max(0, fits) + holding
+
+    def group_size(self, current: int | None = None) -> int:
+        feasible = self._feasible_workers(holding=current or 0)
+        size = max(self.min, min(self.max, feasible))
+        return size
+
+    def monitor(self, current: int) -> int | None:
+        """While the group runs: return a new size when capacity changed
+        enough to justify a slice-atomic restart, else None. Debounced:
+        the target must hold for two consecutive checks — node-death
+        detection lags heartbeats, and a dying node's resources would
+        otherwise read as phantom upscale capacity."""
+        now = time.monotonic()
+        if now < self._next_check:
+            return None
+        self._next_check = now + self._check_interval
+        target = max(self.min, min(self.max, self._feasible_workers(holding=current)))
+        if target == current:
+            self._pending_target = None
+            return None
+        if target == self._pending_target:
+            self._pending_target = None
+            return target
+        self._pending_target = target
+        return None
+
+
+class _ResizeSignal(Exception):
+    def __init__(self, new_size: int):
+        super().__init__(f"resize to {new_size}")
+        self.new_size = new_size
 
 
 class MaxFailurePolicy:
@@ -63,7 +130,11 @@ class TrainController:
         self._datasets = datasets or {}
         self._scaling = scaling_config
         self._run_config = run_config
-        self._scaling_policy = FixedScalingPolicy(scaling_config)
+        self._scaling_policy = (
+            ElasticScalingPolicy(scaling_config)
+            if scaling_config.min_workers is not None
+            else FixedScalingPolicy(scaling_config)
+        )
         self._failure_policy = MaxFailurePolicy(run_config.failure_config.max_failures)
         self._ckpt_manager = CheckpointManager(run_config.checkpoint_config)
         self._resume = resume_from_checkpoint
@@ -79,14 +150,30 @@ class TrainController:
         os.makedirs(run_dir, exist_ok=True)
 
         last_error: Exception | None = None
+        size = self._scaling_policy.group_size()
         while True:
-            group = WorkerGroup.create(self._scaling, name, run_dir)
+            group = None
             try:
+                # Group creation can fail too (e.g. the placement group is
+                # unschedulable because a node died and the size is stale):
+                # route it through the same failure/re-size path.
+                try:
+                    group = WorkerGroup.create(
+                        self._scaling, name, run_dir, num_workers=size)
+                except Exception as e:
+                    raise WorkerGroupError(f"worker group creation failed: {e}") from e
                 # Fresh streaming splits per attempt: a restarted group must
                 # not consume a dead attempt's half-drained stream.
                 group.setup_datasets(self._datasets)
-                self._run_attempt(group)
+                self._run_attempt(group, size)
                 break
+            except _ResizeSignal as rs:
+                # Not a failure: slice-atomic restart at the new size from
+                # the latest checkpoint (reference ResizeDecision handling).
+                logger.info("Elastic resize: %d -> %d workers (restarting from "
+                            "latest checkpoint)", size, rs.new_size)
+                size = rs.new_size
+                continue
             except WorkerGroupError as e:
                 last_error = e
                 if self._failure_policy.should_restart():
@@ -96,6 +183,9 @@ class TrainController:
                         "group from %s: %s",
                         self._failure_policy.failures, resume, e,
                     )
+                    # Re-size on restart: a lost node may have shrunk the
+                    # feasible group (elastic policies adapt, fixed repeats).
+                    size = self._scaling_policy.group_size(current=0)
                     continue
                 return Result(
                     metrics=self._metrics_history[-1] if self._metrics_history else None,
@@ -105,7 +195,8 @@ class TrainController:
                     metrics_history=self._metrics_history,
                 )
             finally:
-                group.shutdown()
+                if group is not None:
+                    group.shutdown()
 
         return Result(
             metrics=self._metrics_history[-1] if self._metrics_history else None,
@@ -116,7 +207,7 @@ class TrainController:
         )
 
     # ------------------------------------------------------------------
-    def _run_attempt(self, group: WorkerGroup) -> None:
+    def _run_attempt(self, group: WorkerGroup, size: int) -> None:
         resume = self._ckpt_manager.latest or self._resume
         resume_path = resume.path if resume else None
         try:
@@ -135,6 +226,9 @@ class TrainController:
                     raise WorkerGroupError(f"worker {i} failed:\n{p['error']}")
             if all(p.get("done") for p in polls):
                 return
+            new_size = self._scaling_policy.monitor(size)
+            if new_size is not None:
+                raise _ResizeSignal(new_size)
             time.sleep(self._poll_interval)
 
     def _ingest(self, polls: list[dict]) -> None:

@@ -121,8 +121,9 @@ class WorkerGroup:
         self._splits: dict = {}
 
     @classmethod
-    def create(cls, scaling_config, experiment_name: str, storage_path: str) -> "WorkerGroup":
-        n = scaling_config.num_workers
+    def create(cls, scaling_config, experiment_name: str, storage_path: str,
+               num_workers: int | None = None) -> "WorkerGroup":
+        n = num_workers if num_workers is not None else scaling_config.num_workers
         res = scaling_config.worker_resources()
         bundles = [dict(res) for _ in range(n)]
         if scaling_config.topology:
