@@ -16,7 +16,9 @@ from .env import CartPole, GridWorld
 from .env_runner import EnvRunner, EnvRunnerGroup
 from .learner import Learner
 from .learner_group import LearnerGroup
+from .dqn import DQN, DQNConfig
 from .ppo import PPO, PPOConfig
+from .replay import ReplayBuffer
 
 __all__ = [
     "Algorithm",
@@ -29,4 +31,7 @@ __all__ = [
     "LearnerGroup",
     "PPO",
     "PPOConfig",
+    "DQN",
+    "DQNConfig",
+    "ReplayBuffer",
 ]

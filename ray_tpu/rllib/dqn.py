@@ -1,0 +1,217 @@
+"""DQN (double Q-learning + target network) on the Learner/EnvRunner stack.
+
+Equivalent of ``rllib/algorithms/dqn/dqn.py`` + ``dqn_rainbow_learner.py``
+(minus the rainbow extras): epsilon-greedy transition collection through
+the shared EnvRunnerGroup, a uniform ReplayBuffer, and a jitted double-DQN
+Huber loss on the shared Learner — the algorithm proves the
+Learner/EnvRunner abstractions generalize beyond on-policy PPO.
+
+The Q-network reuses the actor-critic MLP (``models.forward``): the ``pi``
+head's logits ARE the Q-values; the ``vf`` head is simply unused. The
+target network rides into the jitted loss as part of the batch pytree.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import models
+from .algorithm import Algorithm, AlgorithmConfig
+from .env_runner import EnvRunnerGroup, _np_forward, _softmax  # noqa: F401
+from .learner_group import LearnerGroup
+from .replay import ReplayBuffer
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.gamma = 0.99
+        self.lr = 5e-4
+        self.hidden = 64
+        self.buffer_size = 50_000
+        self.batch_size = 64
+        self.learning_starts = 1_000
+        self.updates_per_iteration = 32
+        self.target_update_freq = 200   # learner updates between target syncs
+        self.double_q = True
+        self.eps_start = 1.0
+        self.eps_end = 0.05
+        self.eps_decay_steps = 10_000
+        self.rollout_len = 32
+
+    def training(self, *, gamma=None, buffer_size=None, batch_size=None,
+                 learning_starts=None, updates_per_iteration=None,
+                 target_update_freq=None, double_q=None, eps_start=None,
+                 eps_end=None, eps_decay_steps=None, hidden=None, **kwargs):
+        for name, val in (("gamma", gamma), ("buffer_size", buffer_size),
+                          ("batch_size", batch_size), ("learning_starts", learning_starts),
+                          ("updates_per_iteration", updates_per_iteration),
+                          ("target_update_freq", target_update_freq),
+                          ("double_q", double_q), ("eps_start", eps_start),
+                          ("eps_end", eps_end), ("eps_decay_steps", eps_decay_steps),
+                          ("hidden", hidden)):
+            if val is not None:
+                setattr(self, name, val)
+        return super().training(**kwargs)
+
+
+def make_dqn_loss(gamma: float, double_q: bool):
+    """batch: obs, actions, rewards, next_obs, terminated, target_params."""
+
+    def loss_fn(params, batch):
+        q_all, _ = models.forward(params, batch["obs"])          # [B, A]
+        q_sa = jnp.take_along_axis(q_all, batch["actions"][:, None], axis=1)[:, 0]
+        q_next_target, _ = models.forward(batch["target_params"], batch["next_obs"])
+        if double_q:
+            # Double DQN: online net selects, target net evaluates.
+            q_next_online, _ = models.forward(params, batch["next_obs"])
+            a_sel = jnp.argmax(q_next_online, axis=1)
+        else:
+            a_sel = jnp.argmax(q_next_target, axis=1)
+        q_next = jnp.take_along_axis(q_next_target, a_sel[:, None], axis=1)[:, 0]
+        target = batch["rewards"] + gamma * (1.0 - batch["terminated"]) * q_next
+        td = q_sa - jax.lax.stop_gradient(target)
+        loss = jnp.mean(jnp.where(jnp.abs(td) < 1.0, 0.5 * td**2, jnp.abs(td) - 0.5))
+        metrics = {
+            "td_error_mean": jnp.mean(jnp.abs(td)),
+            "q_mean": jnp.mean(q_sa),
+        }
+        return loss, metrics
+
+    return loss_fn
+
+
+class QEnvRunner:
+    """Epsilon-greedy transition collector over the shared vectorized-env
+    protocol: emits flat (s, a, r, s', terminated) fragments plus episode
+    returns. Auto-reset envs: s' at a done step is the TERMINAL obs from
+    ``info``, not the freshly reset state."""
+
+    def __init__(self, env_cls, num_envs: int = 8, rollout_len: int = 32, seed: int = 0):
+        self.env = env_cls(num_envs=num_envs, seed=seed)
+        self.num_envs = num_envs
+        self.rollout_len = rollout_len
+        self.rng = np.random.default_rng(seed ^ 0xD0)
+        self.obs = self.env.reset()
+        self._ep_return = np.zeros(num_envs, np.float32)
+        self._completed: list[float] = []
+
+    def sample(self, weights, epsilon: float = 0.05) -> dict:
+        T, N = self.rollout_len, self.num_envs
+        obs_b = np.zeros((T, N, self.env.obs_dim), np.float32)
+        act_b = np.zeros((T, N), np.int64)
+        rew_b = np.zeros((T, N), np.float32)
+        next_b = np.zeros((T, N, self.env.obs_dim), np.float32)
+        term_b = np.zeros((T, N), np.float32)
+        for t in range(T):
+            q, _ = _np_forward(weights, self.obs)
+            greedy = q.argmax(axis=1)
+            random_a = self.rng.integers(0, self.env.n_actions, N)
+            explore = self.rng.random(N) < epsilon
+            actions = np.where(explore, random_a, greedy)
+            obs_b[t], act_b[t] = self.obs, actions
+            self.obs, rewards, dones, info = self.env.step(actions)
+            rew_b[t] = rewards
+            # next state: terminal obs where the episode just ended
+            next_b[t] = np.where(dones[:, None], info["terminal_obs"], self.obs)
+            term_b[t] = info["terminated"].astype(np.float32)  # truncation bootstraps
+            self._ep_return += rewards
+            for i in np.nonzero(dones)[0]:
+                self._completed.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+        completed, self._completed = self._completed, []
+        return {
+            "obs": obs_b.reshape(T * N, -1),
+            "actions": act_b.reshape(-1),
+            "rewards": rew_b.reshape(-1),
+            "next_obs": next_b.reshape(T * N, -1),
+            "terminated": term_b.reshape(-1),
+            "episode_returns": np.asarray(completed, np.float32),
+        }
+
+
+class DQN(Algorithm):
+    def _setup(self) -> None:
+        c: DQNConfig = self.config  # type: ignore[assignment]
+        env_probe = c.env_cls(num_envs=1)
+        obs_dim, n_actions = env_probe.obs_dim, env_probe.n_actions
+
+        def init_params_fn(key):
+            return models.init_policy(key, obs_dim, n_actions, c.hidden)
+
+        self.learner_group = LearnerGroup(
+            make_dqn_loss(c.gamma, c.double_q),
+            init_params_fn,
+            num_learners=c.num_learners,
+            lr=c.lr,
+            max_grad_norm=c.max_grad_norm,
+            seed=c.seed,
+        )
+        self.env_runner_group = EnvRunnerGroup(
+            c.env_cls,
+            num_env_runners=c.num_env_runners,
+            num_envs_per_runner=c.num_envs_per_runner,
+            rollout_len=c.rollout_len,
+            seed=c.seed,
+            runner_cls=QEnvRunner,
+        )
+        self.buffer = ReplayBuffer(c.buffer_size, obs_dim, seed=c.seed)
+        self.target_params = self.learner_group.get_weights()
+        self._env_steps = 0
+        self._updates = 0
+        self._recent_returns: list[float] = []
+
+    def _epsilon(self) -> float:
+        c: DQNConfig = self.config  # type: ignore[assignment]
+        frac = min(1.0, self._env_steps / max(1, c.eps_decay_steps))
+        return c.eps_start + frac * (c.eps_end - c.eps_start)
+
+    def training_step(self) -> dict:
+        c: DQNConfig = self.config  # type: ignore[assignment]
+        weights = self.learner_group.get_weights()
+        samples = self.env_runner_group.sample(weights, epsilon=self._epsilon())
+        for s in samples:
+            self.buffer.add_batch(s["obs"], s["actions"], s["rewards"],
+                                  s["next_obs"], s["terminated"])
+            self._env_steps += len(s["actions"])
+            self._recent_returns.extend(s["episode_returns"].tolist())
+
+        metrics: dict = {}
+        if len(self.buffer) >= c.learning_starts:
+            for _ in range(c.updates_per_iteration):
+                batch = self.buffer.sample(c.batch_size)
+                batch["target_params"] = self.target_params
+                metrics = self.learner_group.update(batch)
+                self._updates += 1
+                if self._updates % c.target_update_freq == 0:
+                    self.target_params = self.learner_group.get_weights()
+
+        self._recent_returns = self._recent_returns[-100:]
+        metrics["episode_return_mean"] = (
+            float(np.mean(self._recent_returns)) if self._recent_returns else 0.0
+        )
+        metrics["num_env_steps_sampled"] = self._env_steps
+        metrics["epsilon"] = self._epsilon()
+        metrics["buffer_size"] = len(self.buffer)
+        return metrics
+
+    def get_state(self) -> dict:
+        return {
+            "iteration": self.iteration,
+            "learner": self.learner_group.get_state(),
+            "target_params": self.target_params,
+            "env_steps": self._env_steps,
+            "updates": self._updates,
+        }
+
+    def set_state(self, state: dict) -> None:
+        self.iteration = state["iteration"]
+        self.learner_group.set_state(state["learner"])
+        self.target_params = state["target_params"]
+        self._env_steps = state["env_steps"]
+        self._updates = state["updates"]
+
+
+DQNConfig.algo_cls = DQN

@@ -89,30 +89,35 @@ class EnvRunner:
 
 
 class EnvRunnerGroup:
-    """N EnvRunner actors sampling in parallel (``num_env_runners=0`` runs
-    one local runner in-process)."""
+    """N runner actors sampling in parallel (``num_env_runners=0`` runs
+    one local runner in-process). ``runner_cls`` lets algorithms swap the
+    action-selection/recording policy (PPO's distribution sampler, DQN's
+    epsilon-greedy transition collector) while reusing the group
+    machinery — the reference's EnvRunner polymorphism."""
 
     def __init__(self, env_cls, *, num_env_runners: int = 0, num_envs_per_runner: int = 8,
-                 rollout_len: int = 64, seed: int = 0):
+                 rollout_len: int = 64, seed: int = 0, runner_cls: type | None = None):
+        runner_cls = runner_cls or EnvRunner
         if num_env_runners == 0:
-            self._local = EnvRunner(env_cls, num_envs_per_runner, rollout_len, seed)
+            self._local = runner_cls(env_cls, num_envs_per_runner, rollout_len, seed)
             self._actors = []
         else:
             from ..core import api as ray
 
             self._local = None
-            cls = ray.remote(EnvRunner)
+            cls = ray.remote(runner_cls)
             self._actors = [
                 cls.remote(env_cls, num_envs_per_runner, rollout_len, seed + 1000 * i)
                 for i in range(num_env_runners)
             ]
 
-    def sample(self, weights) -> list[dict]:
+    def sample(self, weights, **kwargs) -> list[dict]:
         if self._local is not None:
-            return [self._local.sample(weights)]
+            return [self._local.sample(weights, **kwargs)]
         from ..core import api as ray
 
-        return ray.get([a.sample.remote(weights) for a in self._actors], timeout=300)
+        return ray.get([a.sample.remote(weights, **kwargs) for a in self._actors],
+                       timeout=300)
 
     def shutdown(self) -> None:
         from ..core import api as ray

@@ -1,0 +1,50 @@
+"""Replay buffer for off-policy algorithms.
+
+Equivalent of the reference's
+``rllib/utils/replay_buffers/replay_buffer.py`` (uniform
+EpisodeReplayBuffer storage): a fixed-capacity ring of transitions with
+uniform sampling. Stored as preallocated numpy columns — adds are
+vectorized fragment appends, samples are one fancy-index per column.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int, obs_dim: int, *, seed: int = 0):
+        self.capacity = capacity
+        self._obs = np.zeros((capacity, obs_dim), np.float32)
+        self._next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self._actions = np.zeros(capacity, np.int64)
+        self._rewards = np.zeros(capacity, np.float32)
+        # 1.0 only for TRUE terminations: time-limit truncations bootstrap.
+        self._terminated = np.zeros(capacity, np.float32)
+        self._size = 0
+        self._pos = 0
+        self._rng = np.random.default_rng(seed ^ 0xB0FF)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_batch(self, obs, actions, rewards, next_obs, terminated) -> None:
+        n = len(actions)
+        idx = (self._pos + np.arange(n)) % self.capacity
+        self._obs[idx] = obs
+        self._actions[idx] = actions
+        self._rewards[idx] = rewards
+        self._next_obs[idx] = next_obs
+        self._terminated[idx] = terminated
+        self._pos = int((self._pos + n) % self.capacity)
+        self._size = int(min(self._size + n, self.capacity))
+
+    def sample(self, batch_size: int) -> dict:
+        idx = self._rng.integers(0, self._size, batch_size)
+        return {
+            "obs": self._obs[idx],
+            "actions": self._actions[idx],
+            "rewards": self._rewards[idx],
+            "next_obs": self._next_obs[idx],
+            "terminated": self._terminated[idx],
+        }

@@ -204,3 +204,93 @@ def test_ppo_distributed_smoke(ray_cluster):
         assert m2["training_iteration"] == 2
     finally:
         algo.stop()
+
+
+def test_replay_buffer_ring_and_sampling():
+    from ray_tpu.rllib import ReplayBuffer
+
+    buf = ReplayBuffer(capacity=100, obs_dim=2, seed=0)
+    obs = np.arange(240, dtype=np.float32).reshape(120, 2)
+    buf.add_batch(obs, np.arange(120) % 4, np.ones(120, np.float32), obs + 1,
+                  np.zeros(120, np.float32))
+    assert len(buf) == 100  # ring wrapped
+    batch = buf.sample(32)
+    assert batch["obs"].shape == (32, 2) and batch["actions"].shape == (32,)
+    # wrapped entries are the most recent 100 (rows 20..119)
+    assert batch["obs"].min() >= 40.0
+
+
+def test_dqn_loss_targets():
+    """Double-DQN target: r + gamma * Q_target(s', argmax_a Q_online(s', a)),
+    zeroed on termination."""
+    import jax
+    from ray_tpu.rllib import models
+    from ray_tpu.rllib.dqn import make_dqn_loss
+
+    params = models.init_policy(jax.random.PRNGKey(0), 2, 3, hidden=8)
+    target = models.init_policy(jax.random.PRNGKey(1), 2, 3, hidden=8)
+    batch = {
+        "obs": np.zeros((4, 2), np.float32),
+        "actions": np.array([0, 1, 2, 0]),
+        "rewards": np.ones(4, np.float32),
+        "next_obs": np.ones((4, 2), np.float32),
+        "terminated": np.array([0, 0, 1, 1], np.float32),
+        "target_params": target,
+    }
+    loss, metrics = make_dqn_loss(0.9, double_q=True)(params, batch)
+    assert np.isfinite(float(loss)) and "td_error_mean" in metrics
+    # terminated rows must not bootstrap: recompute by hand
+    q_all, _ = models.forward(params, batch["obs"])
+    q_sa = np.take_along_axis(np.asarray(q_all), batch["actions"][:, None], 1)[:, 0]
+    qn_on, _ = models.forward(params, batch["next_obs"])
+    qn_tg, _ = models.forward(target, batch["next_obs"])
+    a_sel = np.asarray(qn_on).argmax(1)
+    qn = np.take_along_axis(np.asarray(qn_tg), a_sel[:, None], 1)[:, 0]
+    tgt = batch["rewards"] + 0.9 * (1 - batch["terminated"]) * qn
+    td = q_sa - tgt
+    expected = np.mean(np.where(np.abs(td) < 1, 0.5 * td**2, np.abs(td) - 0.5))
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
+
+
+def test_dqn_learns_cartpole():
+    """Off-policy DQN learns CartPole in-process — the Learner/EnvRunner
+    stack generalizes beyond PPO (reference rllib/algorithms/dqn)."""
+    from ray_tpu.rllib import DQNConfig
+    from ray_tpu.rllib.env import CartPole
+
+    algo = (DQNConfig()
+            .environment(CartPole)
+            .env_runners(num_env_runners=0, num_envs_per_runner=16, rollout_len=32)
+            .training(lr=1e-3, learning_starts=500, updates_per_iteration=48,
+                      target_update_freq=100, eps_decay_steps=6000, batch_size=128)
+            .seeding(0)
+            .build())
+    best = 0.0
+    for _ in range(70):
+        m = algo.train()
+        best = max(best, m["episode_return_mean"])
+        if best > 150:
+            break
+    assert best > 150, f"DQN did not learn: best={best}"
+
+
+def test_dqn_distributed_runners(ray_cluster):
+    """DQN with remote EnvRunner actors: transitions flow through the
+    object store, learning still progresses."""
+    from ray_tpu.rllib import DQNConfig
+    from ray_tpu.rllib.env import GridWorld
+
+    algo = (DQNConfig()
+            .environment(GridWorld)
+            .env_runners(num_env_runners=2, num_envs_per_runner=8, rollout_len=25)
+            .training(lr=2e-3, learning_starts=300, updates_per_iteration=24,
+                      eps_decay_steps=2500, batch_size=64)
+            .seeding(1)
+            .build())
+    best = -1e9
+    for _ in range(40):
+        m = algo.train()
+        best = max(best, m["episode_return_mean"])
+    algo.stop()
+    # optimal GridWorld return ~ +1 - 8*0.01; random wandering is deeply negative
+    assert best > 0.5, f"distributed DQN did not learn GridWorld: best={best}"
