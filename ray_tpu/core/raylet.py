@@ -328,13 +328,24 @@ class Raylet:
         renv = runtime_env or {}
         env_vars = renv.get("env_vars") or {}
         working_dir = renv.get("working_dir") or ""
-        if not env_vars and not working_dir:
+        py_modules = renv.get("py_modules") or []
+        pip = renv.get("pip") or renv.get("uv") or []
+        if not env_vars and not working_dir and not py_modules and not pip:
             return ""
         import hashlib
         import json
 
-        blob = json.dumps({"env_vars": env_vars, "working_dir": working_dir},
-                          sort_keys=True)
+        modules_digest = ""
+        if py_modules:
+            # Content-addressed, like the reference's uploaded py_modules
+            # URIs: editing a module must produce a DIFFERENT env so stale
+            # idle workers (old sys.path, old imports) never match.
+            from .runtime_env import _hash_paths
+
+            modules_digest = _hash_paths(list(py_modules))
+        blob = json.dumps({"env_vars": env_vars, "working_dir": working_dir,
+                           "py_modules": modules_digest, "pip": pip},
+                          sort_keys=True, default=str)
         return hashlib.sha1(blob.encode()).hexdigest()[:16]
 
     def _start_worker(self, runtime_env: dict | None = None) -> WorkerHandle:

@@ -1,17 +1,160 @@
 """Runtime-env application shared by the raylet worker pool and the job
 manager (reference ``python/ray/_private/runtime_env/``): env_vars merge
-(``None`` unsets) and working_dir with PYTHONPATH threading so spawned
-processes can still import ray_tpu from its source tree.
+(``None`` unsets), working_dir with PYTHONPATH threading, and the
+dependency plugins — ``py_modules`` (staged local packages) and ``pip``
+(requirements installed into a content-addressed target dir) — backed by
+a URI cache (reference ``uri_cache.py``): each unique spec is prepared
+ONCE under ``/tmp/ray_tpu/runtime_env/<plugin>/<hash>`` with a sentinel
+lock, reused by every worker, and LRU-evicted over a size cap.
 """
 
 from __future__ import annotations
 
+import hashlib
+import logging
 import os
+import shutil
+import subprocess
+import sys
+import time
+
+logger = logging.getLogger(__name__)
+
+URI_CACHE_ROOT = os.environ.get("RAY_TPU_RUNTIME_ENV_CACHE",
+                                "/tmp/ray_tpu/runtime_env")
+URI_CACHE_MAX_BYTES = int(os.environ.get("RAY_TPU_RUNTIME_ENV_CACHE_BYTES",
+                                         str(2 << 30)))
 
 
 def package_root() -> str:
     """Directory containing the ``ray_tpu`` package (the repo root)."""
     return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ------------------------------------------------------------- URI cache
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+def _evict_lru(plugin_root: str, incoming_hint: int = 0) -> None:
+    """Drop least-recently-used cache entries once the plugin's cache
+    exceeds the cap (reference uri_cache.py eviction)."""
+    try:
+        entries = [os.path.join(plugin_root, d) for d in os.listdir(plugin_root)]
+    except OSError:
+        return
+    sized = [(p, _dir_bytes(p), os.path.getmtime(p)) for p in entries if os.path.isdir(p)]
+    total = sum(s for _, s, _ in sized) + incoming_hint
+    if total <= URI_CACHE_MAX_BYTES:
+        return
+    for path, size, _mtime in sorted(sized, key=lambda e: e[2]):
+        if total <= URI_CACHE_MAX_BYTES:
+            break
+        shutil.rmtree(path, ignore_errors=True)
+        total -= size
+        logger.info("runtime_env cache evicted %s (%.1f MB)", path, size / 1e6)
+
+
+def _prepare_cached(plugin: str, uri_hash: str, build) -> str:
+    """Create-once semantics: the first caller builds into a tmp dir and
+    renames it in; concurrent callers wait on the ready marker."""
+    plugin_root = os.path.join(URI_CACHE_ROOT, plugin)
+    os.makedirs(plugin_root, exist_ok=True)
+    target = os.path.join(plugin_root, uri_hash)
+    if os.path.isdir(target):
+        os.utime(target)  # LRU touch
+        return target
+    tmp = f"{target}.building.{os.getpid()}"
+    try:
+        os.makedirs(tmp)
+    except FileExistsError:
+        pass
+    else:
+        try:
+            _evict_lru(plugin_root)
+            build(tmp)
+            try:
+                os.rename(tmp, target)
+            except OSError:
+                shutil.rmtree(tmp, ignore_errors=True)  # lost the race
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+    deadline = time.monotonic() + 300.0
+    while not os.path.isdir(target):
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"runtime_env {plugin}:{uri_hash} never became ready")
+        time.sleep(0.1)
+    return target
+
+
+def _hash_paths(paths: list[str]) -> str:
+    """Content hash over module trees so edits produce a fresh URI."""
+    h = hashlib.sha1()
+    for p in sorted(paths):
+        p = os.path.abspath(p)
+        h.update(p.encode())
+        if os.path.isfile(p):
+            h.update(open(p, "rb").read())
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs.sort()
+            for f in sorted(files):
+                if f.endswith(".pyc"):
+                    continue
+                fp = os.path.join(root, f)
+                h.update(os.path.relpath(fp, p).encode())
+                try:
+                    h.update(open(fp, "rb").read())
+                except OSError:
+                    pass
+    return h.hexdigest()[:16]
+
+
+def ensure_py_modules(modules: list[str]) -> str:
+    """Stage local module dirs/files into one cached PYTHONPATH entry
+    (reference py_modules.py, minus the remote-URI download — single-host
+    path semantics, matching working_dir)."""
+
+    def build(tmp: str) -> None:
+        for m in modules:
+            m = os.path.abspath(m)
+            dest = os.path.join(tmp, os.path.basename(m.rstrip("/")))
+            if os.path.isdir(m):
+                shutil.copytree(m, dest, ignore=shutil.ignore_patterns("__pycache__"))
+            else:
+                shutil.copy2(m, dest)
+
+    return _prepare_cached("py_modules", _hash_paths(modules), build)
+
+
+def ensure_pip(requirements: list[str] | dict) -> str:
+    """Install requirements ONCE into a cached ``--target`` dir
+    (reference pip.py + uri_cache.py). ``--no-build-isolation`` so local
+    source packages build offline with the baked setuptools (this
+    environment has zero egress; remote packages need a reachable index)."""
+    if isinstance(requirements, dict):
+        requirements = requirements.get("packages", [])
+    reqs = [str(r) for r in requirements]
+    uri = hashlib.sha1("\n".join(sorted(reqs)).encode()).hexdigest()[:16]
+
+    def build(tmp: str) -> None:
+        cmd = [sys.executable, "-m", "pip", "install", "--quiet",
+               "--no-build-isolation", "--target", tmp, *reqs]
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"pip runtime_env install failed ({' '.join(reqs)}):\n"
+                f"{proc.stderr[-2000:]}")
+
+    return _prepare_cached("pip", uri, build)
 
 
 def apply_runtime_env(env: dict, runtime_env: dict | None) -> str | None:
@@ -24,9 +167,17 @@ def apply_runtime_env(env: dict, runtime_env: dict | None) -> str | None:
             env.pop(key, None)
         else:
             env[key] = str(value)
+    extra_paths: list[str] = []
     working_dir = renv.get("working_dir") or None
     if working_dir is not None:
-        paths = [working_dir, package_root()]
+        extra_paths.append(working_dir)
+    if renv.get("py_modules"):
+        extra_paths.append(ensure_py_modules(list(renv["py_modules"])))
+    pip_spec = renv.get("pip") or renv.get("uv")  # uv: same offline semantics
+    if pip_spec:
+        extra_paths.append(ensure_pip(pip_spec))
+    if extra_paths:
+        paths = [*extra_paths, package_root()]
         if env.get("PYTHONPATH"):
             paths.append(env["PYTHONPATH"])
         env["PYTHONPATH"] = os.pathsep.join(paths)

@@ -764,13 +764,28 @@ class CoreWorker:
             with self._counter_lock:
                 self._spread_salt += 1
                 salt = self._spread_salt
-        env_vars = (spec.runtime_env or {}).get("env_vars") or {}
+        # The FULL runtime env keys the pipeline: leases hold workers built
+        # for one env, and a task with different py_modules/pip/working_dir
+        # pushed onto a reused lease would import the wrong world.
+        renv = spec.runtime_env or {}
+        renv_key = ""
+        if renv:
+            import json
+
+            renv_key = json.dumps(renv, sort_keys=True, default=str)
+            if renv.get("py_modules"):
+                # Content digest, not just paths: an edited module must key
+                # a fresh pipeline, or a warm lease (idle-grace reuse)
+                # would push the task onto a worker with the stale code.
+                from .runtime_env import _hash_paths
+
+                renv_key += ":" + _hash_paths(list(renv["py_modules"]))
         return (
             tuple(sorted(spec.required_resources().items())),
             spec.placement_group_id,
             spec.placement_group_bundle_index,
             tuple(sorted(strategy.items())) if strategy else (),
-            tuple(sorted(env_vars.items())),
+            renv_key,
             # Retriable and non-retriable tasks never share a lease: the
             # raylet's OOM policy kills leases whose probe spec was
             # retriable, which must hold for every task pushed on them.

@@ -1,0 +1,117 @@
+"""Runtime-env dependency plugins: py_modules + pip with URI caching
+(reference ``python/ray/_private/runtime_env/{py_modules.py,pip.py,
+uri_cache.py}``)."""
+
+import glob
+import os
+import tempfile
+import textwrap
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(autouse=True)
+def _cluster(ray_cluster):
+    yield
+
+
+def _make_py_module(tmp_path, name: str, body: str) -> str:
+    pkg = os.path.join(str(tmp_path), name)
+    os.makedirs(pkg, exist_ok=True)
+    with open(os.path.join(pkg, "__init__.py"), "w") as f:
+        f.write(body)
+    return pkg
+
+
+def test_py_modules_staged_on_worker_path(tmp_path):
+    pkg = _make_py_module(tmp_path, "renv_mod_a", "MAGIC = 41\n")
+
+    @ray_tpu.remote
+    def use_module():
+        import renv_mod_a
+
+        return renv_mod_a.MAGIC + 1
+
+    assert ray_tpu.get(
+        use_module.options(runtime_env={"py_modules": [pkg]}).remote(),
+        timeout=120) == 42
+
+
+def test_py_modules_content_hash_invalidates(tmp_path):
+    """Editing the module produces a fresh URI: workers see the new code,
+    not a stale cache entry."""
+    pkg = _make_py_module(tmp_path, "renv_mod_b", "VALUE = 1\n")
+
+    @ray_tpu.remote
+    def read_value():
+        import renv_mod_b
+
+        return renv_mod_b.VALUE
+
+    assert ray_tpu.get(
+        read_value.options(runtime_env={"py_modules": [pkg]}).remote(),
+        timeout=120) == 1
+    with open(os.path.join(pkg, "__init__.py"), "w") as f:
+        f.write("VALUE = 2\n")
+    assert ray_tpu.get(
+        read_value.options(runtime_env={"py_modules": [pkg]}).remote(),
+        timeout=120) == 2
+
+
+def test_pip_local_package_installed_once(tmp_path):
+    """pip requirements install into a cached --target dir exactly once;
+    a second task with the same spec reuses the URI (reference
+    uri_cache.py create-once semantics)."""
+    pip_pkg = str(tmp_path / "pipsrc")
+    os.makedirs(os.path.join(pip_pkg, "renv_pipmod"))
+    with open(os.path.join(pip_pkg, "renv_pipmod", "__init__.py"), "w") as f:
+        f.write("VALUE = 'installed'\n")
+    with open(os.path.join(pip_pkg, "pyproject.toml"), "w") as f:
+        f.write(textwrap.dedent("""
+            [build-system]
+            requires = ["setuptools"]
+            build-backend = "setuptools.build_meta"
+            [project]
+            name = "renv-pipmod"
+            version = "0.1"
+            [tool.setuptools]
+            packages = ["renv_pipmod"]
+        """))
+
+    @ray_tpu.remote
+    def use_pip():
+        import renv_pipmod
+
+        return renv_pipmod.VALUE
+
+    renv = {"pip": [pip_pkg]}
+    assert ray_tpu.get(use_pip.options(runtime_env=renv).remote(), timeout=300) == "installed"
+    before = set(glob.glob("/tmp/ray_tpu/runtime_env/pip/*"))
+    assert ray_tpu.get(use_pip.options(runtime_env=renv).remote(), timeout=300) == "installed"
+    after = set(glob.glob("/tmp/ray_tpu/runtime_env/pip/*"))
+    assert before == after  # cached URI reused, no reinstall
+
+
+def test_mismatched_envs_never_share_a_worker(tmp_path):
+    """Two tasks with identical resources but different py_modules must
+    run on different workers (the lease pipeline keys on the FULL runtime
+    env; a reused lease would import the wrong world)."""
+    pkg_a = _make_py_module(tmp_path, "renv_only_a", "X = 'a'\n")
+
+    @ray_tpu.remote
+    def has_module(name):
+        import importlib
+
+        try:
+            importlib.import_module(name)
+            return True
+        except ImportError:
+            return False
+
+    assert ray_tpu.get(
+        has_module.options(runtime_env={"py_modules": [pkg_a]}).remote("renv_only_a"),
+        timeout=120) is True
+    # plain-env task right after: must NOT land on the py_modules worker
+    assert ray_tpu.get(has_module.remote("renv_only_a"), timeout=120) is False
