@@ -261,7 +261,11 @@ class GcsServer:
         if "resources" in p and p["resources"]:
             node["resources"] = p["resources"]
         node["pending_demand"] = p.get("pending_demand", [])
-        return {}
+        # Bundle reconciliation (reference: GCS-restart bundle cleanup):
+        # the raylet cancels reservations whose group no longer exists —
+        # half-committed 2PC bundles from before a GCS crash would
+        # otherwise pin their resources forever.
+        return {"live_pgs": list(self._placement_groups.keys())}
 
     async def handle_GetAllNodes(self, p: dict) -> dict:
         return {"nodes": list(self._nodes.values())}

@@ -216,6 +216,18 @@ class Raylet:
                     },
                     timeout=5.0,
                 )
+                live_pgs = reply.get("live_pgs")
+                if live_pgs is not None:
+                    live = set(live_pgs)
+                    now = time.monotonic()
+                    for key, b in list(self._pg_bundles.items()):
+                        # Age guard: a bundle reserved AFTER the GCS
+                        # composed its live list would look orphaned for
+                        # one beat — never reclaim fresh reservations.
+                        if key[0] in live or now - b.get("reserved_at", 0.0) < 10.0:
+                            continue
+                        logger.info("reclaiming orphaned bundle %s", key)
+                        self._drop_bundle(key)
                 if reply.get("unknown"):
                     # The GCS restarted and lost the node table: re-register
                     # (gcs_client reconnection path in the reference).
@@ -1200,14 +1212,20 @@ class Raylet:
 
     # --------------------------------------------------- placement-group 2PC
     async def handle_ReserveBundle(self, p: dict) -> dict:
+        key = (p["pg_id"], p["bundle_index"])
+        if key in self._pg_bundles:
+            # Idempotent: a restarted GCS re-drives 2PC for PENDING groups;
+            # double-acquiring here would leak the bundle's resources.
+            return {"ok": True}
         request = ResourceSet(p["resources"])
         if not self.resources.can_fit(request):
             return {"ok": False}
         self.resources.acquire(request)
-        self._pg_bundles[(p["pg_id"], p["bundle_index"])] = {
+        self._pg_bundles[key] = {
             "resources": request,
             "used": ResourceSet(),
             "committed": False,
+            "reserved_at": time.monotonic(),
         }
         return {"ok": True}
 
@@ -1217,11 +1235,16 @@ class Raylet:
             b["committed"] = True
         return {"ok": b is not None}
 
-    async def handle_CancelBundle(self, p: dict) -> dict:
-        b = self._pg_bundles.pop((p["pg_id"], p["bundle_index"]), None)
+    def _drop_bundle(self, key: tuple) -> None:
+        """Release one bundle reservation back to the node pool and admit
+        parked leases (shared by 2PC cancel and heartbeat reconciliation)."""
+        b = self._pg_bundles.pop(key, None)
         if b is not None:
             self.resources.release(b["resources"])
-            self._wake_lease_waiters()  # freed capacity: admit parked leases
+            self._wake_lease_waiters()
+
+    async def handle_CancelBundle(self, p: dict) -> dict:
+        self._drop_bundle((p["pg_id"], p["bundle_index"]))
         return {}
 
     async def handle_ReturnBundle(self, p: dict) -> dict:

@@ -103,6 +103,17 @@ class TaskManager:
         self._pending: dict[bytes, dict] = {}
         self._lineage: dict[bytes, TaskSpec] = {}  # return object id -> spec
         self._lineage_bytes = 0
+        self._lineage_cost: dict[bytes, int] = {}  # oid -> charged bytes
+
+    @staticmethod
+    def _spec_bytes(spec: TaskSpec) -> int:
+        """Real lineage footprint of a pinned spec (reference
+        task_manager.h:219 caps actual bytes): inline arg payloads
+        dominate — a large captured closure must charge what it weighs."""
+        total = 256  # fixed fields
+        for arg in spec.args:
+            total += len(arg.get("blob") or b"") + len(arg.get("meta") or b"") + 64
+        return total
 
     def add_pending(self, spec: TaskSpec, return_ids: list[ObjectID]) -> None:
         with self._lock:
@@ -121,12 +132,17 @@ class TaskManager:
             entry = self._pending.pop(task_id, None)
             if entry is not None:
                 # Pin lineage so lost objects can be reconstructed
-                # (task_manager.h:219 lineage pinning, capped).
+                # (task_manager.h:219 lineage pinning, capped by REAL bytes).
                 spec = entry["spec"]
                 if spec.max_retries != 0 and self._lineage_bytes < get_config().lineage_max_bytes:
+                    cost = self._spec_bytes(spec)
                     for oid in entry["return_ids"]:
-                        self._lineage[oid.binary()] = spec
-                    self._lineage_bytes += 256
+                        key = oid.binary()
+                        if key in self._lineage:
+                            continue  # reconstruction re-completes: no re-charge
+                        self._lineage[key] = spec
+                        self._lineage_cost[key] = cost
+                        self._lineage_bytes += cost
 
     def consume_retry(self, task_id: bytes) -> bool:
         """Returns True if the task may be retried (decrements budget)."""
@@ -150,7 +166,9 @@ class TaskManager:
 
     def evict_lineage(self, object_id: ObjectID) -> None:
         with self._lock:
-            self._lineage.pop(object_id.binary(), None)
+            key = object_id.binary()
+            if self._lineage.pop(key, None) is not None:
+                self._lineage_bytes -= self._lineage_cost.pop(key, 0)
 
     def num_pending(self) -> int:
         with self._lock:

@@ -47,14 +47,7 @@ def detect_num_tpu_chips() -> int:
         override = os.environ.get("RAY_TPU_CHIPS_PER_HOST")
         if override:
             return int(override)
-        # best-effort: single-host slices expose all chips, pod slices 4
-        # per host; override via RAY_TPU_CHIPS_PER_HOST when this guesses
-        # wrong
-        try:
-            n = int(acc.rsplit("-", 1)[1])
-        except (IndexError, ValueError):
-            n = 4
-        return n if n <= 8 else 4
+        return _chips_per_host_for_type(acc)
     # Fall back to asking JAX (only reached when no TPU env markers exist,
     # so this cannot initialize a TPU backend by surprise).
     try:
@@ -63,6 +56,25 @@ def detect_num_tpu_chips() -> int:
         return sum(1 for d in jax.devices() if "tpu" in d.platform.lower() or "axon" in str(getattr(d, "client", "")).lower() or d.platform == "axon")
     except Exception:
         return 0
+
+
+def _chips_per_host_for_type(acc: str) -> int:
+    """Chips THIS host contributes to the slice, derived per generation
+    (reference ``_private/accelerators/tpu.py:170-192``) — the suffix
+    counts CORES on v2/v3/v4/v5p (2 cores/chip, 4 chips/host) but CHIPS
+    on v5e/v6e (single host up to 8, pods 4/host). The old suffix-only
+    guess mis-sized e.g. v4-8 (4 chips, not 8)."""
+    gen, _, suffix = acc.rpartition("-")
+    gen = gen.lower()
+    try:
+        n = int(suffix)
+    except ValueError:
+        return 4
+    if gen in ("v2", "v3", "v4", "v5p"):
+        chips_total = max(1, n // 2)  # suffix counts cores
+        return min(4, chips_total)    # 4 chips per host
+    # v5litepod / v5e / v6e: suffix counts chips; <=8 fits one host
+    return n if n <= 8 else 4
 
 
 @functools.lru_cache(maxsize=1)

@@ -94,45 +94,94 @@ class _Storage:
             return pickle.load(f)
 
 
+_WF_REF = "__wf_dep_ref__"
+
+
+def _run_step(fn, args_spec, kwargs_spec, *dep_values):
+    """Execute one step on a worker: dependency refs arrive as resolved
+    VALUES (top-level args); placeholders in the specs splice them back
+    into the original argument tree."""
+
+    def fill(value):
+        if isinstance(value, dict):
+            if set(value) == {_WF_REF}:
+                return dep_values[value[_WF_REF]]
+            return {k: fill(v) for k, v in value.items()}
+        if isinstance(value, list):
+            return [fill(v) for v in value]
+        if isinstance(value, tuple):
+            return tuple(fill(v) for v in value)
+        return value
+
+    return fn(*[fill(a) for a in args_spec],
+              **{k: fill(v) for k, v in kwargs_spec.items()})
+
+
 def _execute(root: StepNode, storage: _Storage, step_timeout_s: float | None) -> Any:
-    """DFS-evaluate the step DAG. Step ids are assigned in deterministic
-    DFS order, so a resumed run maps steps to the same checkpoints."""
+    """Submit the whole step DAG as tasks wired by ObjectRefs: independent
+    branches run CONCURRENTLY (reference ``workflow_executor.py:32``
+    schedules every ready step), and results checkpoint as they complete.
+    Step ids are assigned in deterministic DFS order, so a resumed run
+    maps steps to the same checkpoints."""
     from ..core import api as ray
 
     counter = [0]
     memo: dict[int, Any] = {}
+    pending: dict[Any, str] = {}  # ref -> step_id awaiting checkpoint
 
-    def resolve(value):
-        """Evaluate StepNodes anywhere in the argument tree — nested nodes
-        in lists/tuples/dicts are dependencies too."""
-        if isinstance(value, StepNode):
-            return evaluate(value)
-        if isinstance(value, list):
-            return [resolve(v) for v in value]
-        if isinstance(value, tuple):
-            return tuple(resolve(v) for v in value)
-        if isinstance(value, dict):
-            return {k: resolve(v) for k, v in value.items()}
-        return value
-
-    def evaluate(node: StepNode):
+    def build(node: StepNode):
+        """Returns the node's ObjectRef (children submitted first; ids
+        follow argument order — stable across runs)."""
         if id(node) in memo:
             return memo[id(node)]
-        # Children first: ids follow argument order (stable across runs).
-        args = [resolve(a) for a in node.args]
-        kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+        dep_refs: list = []
+
+        def transform(value):
+            if isinstance(value, StepNode):
+                dep_refs.append(build(value))
+                return {_WF_REF: len(dep_refs) - 1}
+            if isinstance(value, list):
+                return [transform(v) for v in value]
+            if isinstance(value, tuple):
+                return tuple(transform(v) for v in value)
+            if isinstance(value, dict):
+                return {k: transform(v) for k, v in value.items()}
+            return value
+
+        args_spec = [transform(a) for a in node.args]
+        kwargs_spec = {k: transform(v) for k, v in node.kwargs.items()}
         step_id = f"{counter[0]:04d}-{node.name}"
         counter[0] += 1
         if storage.has_step(step_id):
-            result = storage.load_step(step_id)
+            ref = ray.put(storage.load_step(step_id))
         else:
-            remote_fn = ray.remote(node.fn) if not hasattr(node.fn, "remote") else node.fn
-            result = ray.get(remote_fn.remote(*args, **kwargs), timeout=step_timeout_s)
-            storage.save_step(step_id, result)
-        memo[id(node)] = result
-        return result
+            opts = {"name": node.name}
+            fn = node.fn
+            if isinstance(fn, ray.RemoteFunction):
+                # Preserve the step's remote options (num_tpus, resources,
+                # retries...): the wrapper task must schedule exactly as
+                # the user-configured remote function would.
+                opts = {**fn._options, **opts}
+                fn = fn._fn
+            ref = ray.remote(_run_step).options(**opts).remote(
+                fn, args_spec, kwargs_spec, *dep_refs)
+            pending[ref] = step_id
+        memo[id(node)] = ref
+        return ref
 
-    return evaluate(root)
+    root_ref = build(root)
+    # Checkpoint steps AS they complete (any order); a step failure
+    # surfaces on its get and fails the workflow — already-completed
+    # siblings keep their checkpoints for resume.
+    while pending:
+        ready, _ = ray.wait(list(pending), num_returns=1, timeout=step_timeout_s)
+        if not ready:
+            raise TimeoutError(
+                f"no workflow step completed within step_timeout_s={step_timeout_s}")
+        ref = ready[0]
+        step_id = pending.pop(ref)
+        storage.save_step(step_id, ray.get(ref, timeout=step_timeout_s))
+    return ray.get(root_ref, timeout=step_timeout_s)
 
 
 def run(dag: StepNode, *, workflow_id: str, storage: str | None = None,
