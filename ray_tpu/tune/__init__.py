@@ -10,10 +10,13 @@ from ..train.session import get_checkpoint, get_context, report
 from .schedulers import (
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
 )
 from .search import (
     BasicVariantGenerator,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -29,7 +32,10 @@ __all__ = [
     "AsyncHyperBandScheduler",
     "BasicVariantGenerator",
     "FIFOScheduler",
+    "HyperBandScheduler",
+    "MedianStoppingRule",
     "PopulationBasedTraining",
+    "TPESearcher",
     "ResultGrid",
     "TuneConfig",
     "Tuner",

@@ -67,6 +67,81 @@ class AsyncHyperBandScheduler:
         return CONTINUE
 
 
+class HyperBandScheduler:
+    """Multi-bracket HyperBand (stop-based, ASHA-promotion variant):
+    trials are dealt round-robin into ``s_max+1`` brackets with different
+    initial budgets; within a bracket, each rung keeps the top
+    1/``reduction_factor``. Brackets with small grace periods kill bad
+    configs early; the conservative bracket never early-stops — the
+    hedge that distinguishes HyperBand from single-bracket ASHA.
+    Reference: ``tune/schedulers/hyperband.py`` (bracket structure) with
+    async stop decisions (``async_hyperband.py:187`` _Bracket rungs).
+    """
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration", max_t: int = 81,
+                 reduction_factor: int = 3):
+        import math
+
+        self._sign = 1.0 if mode == "max" else -1.0
+        self._metric = metric
+        self._time_attr = time_attr
+        s_max = int(math.log(max_t, reduction_factor))
+        # bracket s: grace period rf^s (s = s_max is the no-early-stop one)
+        self._brackets = [
+            AsyncHyperBandScheduler(
+                metric=metric, mode=mode, time_attr=time_attr, max_t=max_t,
+                grace_period=reduction_factor ** s,
+                reduction_factor=reduction_factor,
+            )
+            for s in range(s_max + 1)
+        ]
+        self._assignment: dict[Any, int] = {}
+        self._next = 0
+
+    def on_result(self, trial, metrics: dict) -> str:
+        idx = self._assignment.get(trial)
+        if idx is None:
+            idx = self._assignment[trial] = self._next % len(self._brackets)
+            self._next += 1
+        return self._brackets[idx].on_result(trial, metrics)
+
+
+class MedianStoppingRule:
+    """Stop a trial whose best result so far is worse than the median of
+    the other trials' RUNNING MEANS at the same step (reference
+    ``tune/schedulers/median_stopping_rule.py``)."""
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 5, min_samples_required: int = 3):
+        self._metric = metric
+        self._sign = 1.0 if mode == "max" else -1.0
+        self._time_attr = time_attr
+        self._grace = grace_period
+        self._min_samples = min_samples_required
+        self._history: dict[Any, list[float]] = {}
+        self._best: dict[Any, float] = {}
+
+    def on_result(self, trial, metrics: dict) -> str:
+        score = self._sign * float(metrics.get(self._metric, float("-inf")))
+        self._history.setdefault(trial, []).append(score)
+        self._best[trial] = max(self._best.get(trial, float("-inf")), score)
+        t = metrics.get(self._time_attr, len(self._history[trial]))
+        if t < self._grace:
+            return CONTINUE
+        other_means = [
+            sum(h) / len(h) for tr, h in self._history.items() if tr is not trial and h
+        ]
+        if len(other_means) < self._min_samples:
+            return CONTINUE
+        other_means.sort()
+        median = other_means[len(other_means) // 2]
+        if self._best[trial] < median:
+            return STOP
+        return CONTINUE
+
+
 class PopulationBasedTraining:
     """PBT: at each perturbation interval, bottom-quantile trials exploit a
     top-quantile trial's checkpoint + config and explore by mutation.

@@ -80,6 +80,148 @@ def grid_search(values) -> GridSearch:
     return GridSearch(values)
 
 
+class TPESearcher:
+    """Sequential model-based search, Tree-structured Parzen Estimator
+    style (the role Optuna's default sampler plays behind the reference's
+    ``OptunaSearch``, ``tune/search/optuna/optuna_search.py:81`` — Optuna
+    itself is not available in this image, so the estimator is native).
+
+    ``suggest()`` proposes configs one at a time; completed trials are fed
+    back via ``on_trial_complete``. Numeric params: candidates are drawn
+    from a Parzen window over the top-``gamma`` configs and ranked by the
+    good/bad density ratio. Categoricals: weighted by goodness counts.
+    Falls back to random sampling until ``n_startup`` observations exist.
+    """
+
+    def __init__(self, metric: str, mode: str = "max", *, seed: int | None = None,
+                 gamma: float = 0.25, n_startup: int = 6, n_candidates: int = 24):
+        self.metric = metric
+        self.sign = 1.0 if mode == "max" else -1.0
+        self._rng = random.Random(seed)
+        self.gamma = gamma
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self._observations: list[tuple[dict, float]] = []
+        self._space: dict | None = None
+
+    # --------------------------------------------------- sequential protocol
+    def set_space(self, param_space: dict) -> None:
+        self._space = {
+            k: (GridSearch(v["grid_search"])
+                if isinstance(v, dict) and set(v) == {"grid_search"} else v)
+            for k, v in param_space.items()
+        }
+
+    def on_trial_complete(self, config: dict, metrics: dict) -> None:
+        if metrics and self.metric in metrics:
+            self._observations.append((config, self.sign * float(metrics[self.metric])))
+
+    def suggest(self) -> dict:
+        assert self._space is not None, "set_space() first"
+        if len(self._observations) < self.n_startup:
+            return self._random_config()
+        ranked = sorted(self._observations, key=lambda o: -o[1])
+        n_good = max(1, int(len(ranked) * self.gamma))
+        good = [c for c, _ in ranked[:n_good]]
+        bad = [c for c, _ in ranked[n_good:]] or good
+        best, best_score = None, float("-inf")
+        for _ in range(self.n_candidates):
+            cand = self._sample_near(good)
+            score = self._density(cand, good) - self._density(cand, bad)
+            if score > best_score:
+                best, best_score = cand, score
+        return best
+
+    # ------------------------------------------------------------- internals
+    def _numeric_value(self, key, value) -> float | None:
+        dom = self._space[key]
+        import math
+
+        if isinstance(dom, (Uniform, RandInt)):
+            return float(value)
+        if isinstance(dom, LogUniform):
+            return math.log(max(value, 1e-300))
+        return None
+
+    def _random_config(self) -> dict:
+        cfg = {}
+        for k, v in self._space.items():
+            if isinstance(v, GridSearch):
+                cfg[k] = self._rng.choice(v.values)
+            elif isinstance(v, Domain):
+                cfg[k] = v.sample(self._rng)
+            else:
+                cfg[k] = v
+        return cfg
+
+    def _domain_range(self, dom) -> tuple[float, float]:
+        if isinstance(dom, Uniform):
+            return dom.low, dom.high
+        if isinstance(dom, RandInt):
+            return float(dom.low), float(dom.high)
+        return dom._lo, dom._hi  # LogUniform: log domain
+
+    def _bandwidth(self, xs: list[float], dom) -> float:
+        lo, hi = self._domain_range(dom)
+        # Parzen bandwidth: shrinks as evidence accumulates (Scott-rule
+        # style n^-1/5) but with a PRIOR FLOOR so a collapsed good-set
+        # never freezes the search (TPE mixes the uniform prior in).
+        n = max(len(self._observations), 1)
+        return max((max(xs) - min(xs)) * 0.5,
+                   (hi - lo) / 8.0 * n ** -0.2)
+
+    def _sample_near(self, good: list[dict]) -> dict:
+        import math
+
+        cfg = {}
+        for k, dom in self._space.items():
+            if not isinstance(dom, Domain) and not isinstance(dom, GridSearch):
+                cfg[k] = dom
+                continue
+            cats = dom.values if isinstance(dom, GridSearch) else (
+                dom.categories if isinstance(dom, Choice) else None)
+            if cats is not None:
+                # categorical: sample weighted by goodness counts (+1 prior)
+                weights = [1 + sum(1 for g in good if g.get(k) == c) for c in cats]
+                cfg[k] = self._rng.choices(cats, weights=weights)[0]
+                continue
+            if self._rng.random() < 0.35:
+                # exploration: draw from the prior (TPE's prior mixture)
+                cfg[k] = dom.sample(self._rng)
+                continue
+            xs = [self._numeric_value(k, g[k]) for g in good if k in g]
+            # rank-weighted anchor: the BEST point (good[0]) pulls hardest
+            anchor = xs[0] if self._rng.random() < 0.5 else self._rng.choice(xs)
+            x = self._rng.gauss(anchor, self._bandwidth(xs, dom))
+            if isinstance(dom, Uniform):
+                cfg[k] = min(max(x, dom.low), dom.high)
+            elif isinstance(dom, RandInt):
+                cfg[k] = int(min(max(round(x), dom.low), dom.high - 1))
+            else:  # LogUniform
+                cfg[k] = min(max(math.exp(x), math.exp(dom._lo)), math.exp(dom._hi))
+        return cfg
+
+    def _density(self, cand: dict, configs: list[dict]) -> float:
+        import math
+
+        total = 0.0
+        for k, dom in self._space.items():
+            if isinstance(dom, GridSearch) or isinstance(dom, Choice):
+                cats = dom.values if isinstance(dom, GridSearch) else dom.categories
+                count = sum(1 for c in configs if c.get(k) == cand[k])
+                total += math.log((count + 1) / (len(configs) + len(cats)))
+            elif isinstance(dom, Domain):
+                xs = [self._numeric_value(k, c[k]) for c in configs if k in c]
+                if not xs:
+                    continue
+                x = self._numeric_value(k, cand[k])
+                bw = self._bandwidth(xs, dom)
+                total += math.log(sum(
+                    math.exp(-0.5 * ((x - xi) / bw) ** 2) for xi in xs
+                ) / len(xs) + 1e-12)
+        return total
+
+
 class BasicVariantGenerator:
     """Grid axes are expanded exhaustively; Domain axes sampled num_samples
     times. Reference: search/basic_variant.py."""

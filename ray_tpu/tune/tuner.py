@@ -165,6 +165,8 @@ class Tuner:
     def fit(self) -> ResultGrid:
         tc = self._tune_config
         ckpt_cfg = self._run_config.checkpoint_config or CheckpointConfig()
+        searcher = None  # sequential (suggest/on_trial_complete) searcher
+        to_suggest = 0
         if self._restore_dir is not None:
             exp_dir = self._restore_dir
             name = os.path.basename(exp_dir.rstrip("/"))
@@ -177,16 +179,32 @@ class Tuner:
             os.makedirs(exp_dir, exist_ok=True)
 
             search = tc.search_alg or BasicVariantGenerator(seed=tc.seed)
-            configs = search.generate(self._param_space, tc.num_samples)
             scheduler = tc.scheduler or FIFOScheduler()
-
-            trials = [
-                Trial(cfg, os.path.join(exp_dir, f"trial_{i:05d}")) for i, cfg in enumerate(configs)
-            ]
-            for t in trials:
-                os.makedirs(t.dir, exist_ok=True)
-                t.ckpt_manager = CheckpointManager(ckpt_cfg)
+            if hasattr(search, "suggest"):
+                # Sequential model-based search (TPE/BO): configs are
+                # proposed one at a time, informed by completed trials
+                # (reference: SearchGenerator over a Searcher).
+                searcher = search
+                searcher.set_space(self._param_space)
+                to_suggest = tc.num_samples
+                trials = []
+            else:
+                configs = search.generate(self._param_space, tc.num_samples)
+                trials = [
+                    Trial(cfg, os.path.join(exp_dir, f"trial_{i:05d}"))
+                    for i, cfg in enumerate(configs)
+                ]
+                for t in trials:
+                    os.makedirs(t.dir, exist_ok=True)
+                    t.ckpt_manager = CheckpointManager(ckpt_cfg)
         self._save_experiment_state(exp_dir, trials)
+
+        def new_trial(cfg: dict) -> Trial:
+            t = Trial(cfg, os.path.join(exp_dir, f"trial_{len(trials):05d}"))
+            os.makedirs(t.dir, exist_ok=True)
+            t.ckpt_manager = CheckpointManager(ckpt_cfg)
+            trials.append(t)
+            return t
 
         pending = [t for t in trials if t.state == "PENDING"]
         running: list[Trial] = []
@@ -202,7 +220,15 @@ class Tuner:
             )
             trial.state = "RUNNING"
 
-        while pending or running:
+        def finish(trial: Trial) -> None:
+            nonlocal to_suggest
+            if searcher is not None:
+                searcher.on_trial_complete(trial.config, trial.last_metrics)
+
+        while pending or running or to_suggest > 0:
+            while to_suggest > 0 and len(running) + len(pending) < tc.max_concurrent_trials:
+                pending.append(new_trial(searcher.suggest()))
+                to_suggest -= 1
             while pending and len(running) < tc.max_concurrent_trials:
                 trial = pending.pop(0)
                 start(trial)
@@ -216,6 +242,7 @@ class Tuner:
                     trial.state = "ERROR"
                     trial.error = str(e)
                     running.remove(trial)
+                    finish(trial)
                     continue
                 decision = CONTINUE
                 for entry in poll["reports"]:
@@ -246,17 +273,20 @@ class Tuner:
                     trial.state = "TERMINATED"
                     ray.kill(trial.actor)
                     running.remove(trial)
+                    finish(trial)
                     self._save_experiment_state(exp_dir, trials)
                 elif poll.get("error"):
                     trial.state = "ERROR"
                     trial.error = poll["error"]
                     ray.kill(trial.actor)
                     running.remove(trial)
+                    finish(trial)
                     self._save_experiment_state(exp_dir, trials)
                 elif poll.get("done"):
                     trial.state = "TERMINATED"
                     ray.kill(trial.actor)
                     running.remove(trial)
+                    finish(trial)
                     self._save_experiment_state(exp_dir, trials)
 
         self._save_experiment_state(exp_dir, trials)

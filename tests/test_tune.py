@@ -131,10 +131,10 @@ def test_tuner_restore_reruns_only_incomplete(ray_cluster, tmp_path):
         train.report({"score": float(config["x"] * 10)})
 
     exp_name = "restore_exp"
-    tuner = Tuner(
+    tuner = tune.Tuner(
         trainable,
         param_space={"x": {"grid_search": [1, 2, 3]}, "marker_dir": marker_dir},
-        tune_config=TuneConfig(metric="score", mode="max"),
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
         run_config=RunConfig(name=exp_name, storage_path=str(tmp_path)),
     )
     grid = tuner.fit()
@@ -149,3 +149,79 @@ def test_tuner_restore_reruns_only_incomplete(ray_cluster, tmp_path):
     assert os.path.getsize(os.path.join(marker_dir, "ran-1")) == 1
     assert os.path.getsize(os.path.join(marker_dir, "ran-3")) == 1
     assert os.path.getsize(os.path.join(marker_dir, "ran-2")) == 2
+
+
+def test_tpe_searcher_converges_on_quadratic(ray_cluster, tmp_path):
+    """Sequential TPE search concentrates samples near the optimum of a
+    known objective — later suggestions beat random's expected quality
+    (reference OptunaSearch role, optuna_search.py:81)."""
+    from ray_tpu.tune import TPESearcher
+
+    def objective(config):
+        x = config["x"]
+        tune.report({"score": -((x - 3.0) ** 2)})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.uniform(-10.0, 10.0)},
+        tune_config=tune.TuneConfig(metric="score", mode="max", num_samples=28,
+                               max_concurrent_trials=2,
+                               search_alg=TPESearcher("score", "max", seed=0)),
+        run_config=RunConfig(name="tpe", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result(metric="score", mode="max")
+    # found a decent optimum (random-only over 28 draws on [-10,10] has
+    # ~25% chance of doing this poorly; guided search concentrates)
+    assert best.metrics["score"] > -6.0, best.metrics
+    obs = [s for _, s in tuner._tune_config.search_alg._observations]
+    # guided phase concentrates: mean of later observations beats the
+    # random-startup mean (the estimator is actually steering)
+    import statistics
+    assert statistics.mean(obs[-10:]) > statistics.mean(obs[:6]), obs
+
+
+def test_hyperband_multi_bracket_stops_bad_trials(ray_cluster, tmp_path):
+    from ray_tpu.tune import HyperBandScheduler
+
+    def trainable(config):
+        for step in range(1, 10):
+            tune.report({"training_iteration": step, "acc": config["q"] * step})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([0.1, 0.2, 0.9, 1.0, 0.15, 0.85])},
+        tune_config=tune.TuneConfig(metric="acc", mode="max", num_samples=1,
+                               max_concurrent_trials=3,
+                               scheduler=HyperBandScheduler(metric="acc", mode="max",
+                                                            max_t=9,
+                                                            reduction_factor=3)),
+        run_config=RunConfig(name="hb", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result(metric="acc", mode="max")
+    assert best.metrics["acc"] >= 8.0  # a good trial ran to completion
+
+
+def test_median_stopping_rule(ray_cluster, tmp_path):
+    from ray_tpu.tune import MedianStoppingRule
+
+    def trainable(config):
+        for step in range(1, 12):
+            tune.report({"training_iteration": step, "acc": config["q"] * step})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([0.1, 1.0, 0.9, 0.95, 0.05])},
+        tune_config=tune.TuneConfig(metric="acc", mode="max", num_samples=1,
+                               max_concurrent_trials=4,
+                               scheduler=MedianStoppingRule(metric="acc", mode="max",
+                                                            grace_period=3)),
+        run_config=RunConfig(name="med", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    # bad trials (q=0.1, 0.05) stop early: fewer than 11 iterations
+    histories = [len(r.metrics_history) for r in grid._results]
+    assert min(histories) < 11, histories
+    best = grid.get_best_result(metric="acc", mode="max")
+    assert best.metrics["acc"] >= 9.0
