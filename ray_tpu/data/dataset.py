@@ -38,6 +38,7 @@ class Dataset:
                     fn_kwargs: dict | None = None, compute=None,
                     fn_constructor_args: tuple = (),
                     fn_constructor_kwargs: dict | None = None,
+                    ray_actor_options: dict | None = None,
                     **_ignored) -> "Dataset":
         """``compute=ActorPoolStrategy(size=n)`` runs the fn on a pool of
         stateful actors — pass a CLASS and it is constructed once per
@@ -49,7 +50,8 @@ class Dataset:
             "map_batches", self._last_op, fn=fn, batch_format=batch_format,
             fn_kwargs=fn_kwargs or {}, compute=compute,
             fn_constructor_args=fn_constructor_args,
-            fn_constructor_kwargs=fn_constructor_kwargs or {}))
+            fn_constructor_kwargs=fn_constructor_kwargs or {},
+            ray_actor_options=ray_actor_options))
 
     def union(self, *others: "Dataset") -> "MaterializedDataset":
         """Concatenate datasets (materializes each input's blocks)."""

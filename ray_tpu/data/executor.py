@@ -328,12 +328,14 @@ class ActorPoolMapPhysicalOp(PhysicalOp):
     def __init__(self, fn, batch_format: str, fn_kwargs: dict, *,
                  pool_size: int, constructor_args: tuple = (),
                  constructor_kwargs: dict | None = None,
+                 ray_actor_options: dict | None = None,
                  max_tasks_per_actor: int = 2):
         super().__init__(f"ActorPoolMap[{getattr(fn, '__name__', 'fn')}x{pool_size}]")
         self._fn = fn
         self._batch_format = batch_format
         self._fn_kwargs = fn_kwargs
         self._pool_size = pool_size
+        self._actor_options = ray_actor_options or {}
         self._ctor = (constructor_args, constructor_kwargs or {})
         self._max_per_actor = max_tasks_per_actor
         self._actors: list = []
@@ -344,6 +346,8 @@ class ActorPoolMapPhysicalOp(PhysicalOp):
         if self._actors:
             return
         cls = ray.remote(_MapWorker)
+        if self._actor_options:
+            cls = cls.options(**self._actor_options)
         args, kwargs = self._ctor
         self._actors = [cls.remote(self._fn, args, kwargs) for _ in range(self._pool_size)]
         self._actor_load = {i: 0 for i in range(self._pool_size)}
@@ -576,6 +580,7 @@ def plan(last_op: L.LogicalOp) -> list[PhysicalOp]:
                     pool_size=lop.compute.size,
                     constructor_args=lop.fn_constructor_args,
                     constructor_kwargs=lop.fn_constructor_kwargs,
+                    ray_actor_options=lop.ray_actor_options,
                 ))
             else:
                 pending_stages.append(MapStage("batches", lop.fn, lop.batch_format, lop.fn_kwargs))

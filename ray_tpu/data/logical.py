@@ -41,6 +41,7 @@ class MapBatches(LogicalOp):
     compute: Any = None
     fn_constructor_args: tuple = ()
     fn_constructor_kwargs: dict = dataclasses.field(default_factory=dict)
+    ray_actor_options: dict | None = None  # e.g. {"resources": {"TPU": 1}}
 
 
 @dataclasses.dataclass

@@ -12,6 +12,7 @@ without recomputation; token streaming rides the core streaming-generator
 protocol through Serve.
 """
 
+from .batch import LLMProcessorConfig, Processor, build_llm_processor
 from .engine import InferenceEngine, PageAllocator, Request
 from .model import decode_step, init_pages, prefill_chunk
 from .serving import LLMDeployment, build_llm_app
@@ -19,6 +20,9 @@ from .tokenizer import ByteTokenizer
 
 __all__ = [
     "InferenceEngine",
+    "LLMProcessorConfig",
+    "Processor",
+    "build_llm_processor",
     "PageAllocator",
     "Request",
     "init_pages",

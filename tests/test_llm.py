@@ -293,3 +293,32 @@ def test_serve_llm_app_concurrent_http(ray_cluster):
             assert r["finish_reason"] in ("length", "stop")
     finally:
         serve.shutdown()
+
+
+def test_batch_llm_processor(ray_cluster):
+    """Data batch inference through the Processor pipeline (reference
+    llm/_internal/batch/processor/base.py): rows in -> generated_text out,
+    with per-row sampling columns and pre/postprocess stages."""
+    from ray_tpu import data as rd
+    from ray_tpu.llm import LLMProcessorConfig, build_llm_processor
+
+    config = LLMProcessorConfig(preset="debug-128", concurrency=1, batch_size=8,
+                                max_slots=4, max_len=128, max_tokens=6)
+    processor = build_llm_processor(
+        config,
+        preprocess=lambda row: {"prompt": f"say {row['word']}",
+                                "max_tokens": 4 + (row["id"] % 3),
+                                "word": row["word"], "id": row["id"]},
+        postprocess=lambda row: {"word": row["word"],
+                                 "text": row["generated_text"],
+                                 "n": row["num_generated_tokens"]},
+    )
+    rows = [{"id": i, "word": w} for i, w in enumerate(["alpha", "beta", "gamma",
+                                                        "delta", "epsilon", "zeta"])]
+    out = processor(rd.from_items(rows, parallelism=2)).take_all()
+    assert len(out) == 6
+    by_word = {r["word"]: r for r in out}
+    assert set(by_word) == {w["word"] for w in rows}
+    for i, w in enumerate(["alpha", "beta", "gamma", "delta", "epsilon", "zeta"]):
+        assert by_word[w]["n"] == 4 + (i % 3)  # per-row max_tokens honored
+        assert isinstance(by_word[w]["text"], str)
