@@ -100,3 +100,138 @@ class Channel:
             os.unlink(self.path)
         except OSError:
             pass
+
+
+# ---------------------------------------------------------------- cross-node
+# TCP mutable channels with the same latest-wins/seq semantics as the shm
+# channel, for DAG edges whose endpoints live on different nodes (reference
+# ``experimental/channel/shared_memory_channel.py`` falls back to its
+# cross-node transport the same way). Frame: [u64 seq][u32 len][payload];
+# len == STOP_LEN signals writer close.
+
+import socket
+import struct as _struct
+import threading
+
+_FRAME = _struct.Struct("<QI")
+_REQ = _struct.Struct("<Q")
+STOP_LEN = 0xFFFFFFFF
+
+
+class TcpChannelServer:
+    """Writer end: holds the latest message; any number of readers long-
+    poll for sequences newer than their cursor."""
+
+    def __init__(self, host: str = "0.0.0.0", advertise: str | None = None):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(64)
+        port = self._sock.getsockname()[1]
+        self.address = f"{advertise or '127.0.0.1'}:{port}"
+        self._cond = threading.Condition()
+        self._seq = 0
+        self._payload = b""
+        self._stopped = False
+        self._closed = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    # writer interface (mirrors Channel)
+    def write(self, payload: bytes) -> None:
+        with self._cond:
+            self._seq += 1
+            self._payload = bytes(payload)
+            self._cond.notify_all()
+
+    def close_writer(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._seq += 1
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------ internals
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                req = _recv_exact(conn, _REQ.size)
+                if req is None:
+                    return
+                (last_seq,) = _REQ.unpack(req)
+                with self._cond:
+                    while self._seq <= last_seq and not self._stopped:
+                        self._cond.wait(1.0)
+                        if self._closed:
+                            return
+                    # Same semantics as the shm channel: close_writer
+                    # overrides the slot — once stopped, readers see STOP.
+                    if self._stopped:
+                        conn.sendall(_FRAME.pack(self._seq, STOP_LEN))
+                        continue
+                    seq, payload = self._seq, self._payload
+                conn.sendall(_FRAME.pack(seq, len(payload)) + payload)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class TcpChannelReader:
+    """Reader end: same interface as Channel.read (blocking, cursor-based)."""
+
+    def __init__(self, address: str, capacity: int = 0, connect_timeout: float = 30.0):
+        host, _, port = address.rpartition(":")
+        self._sock = socket.create_connection((host, int(port)), timeout=connect_timeout)
+        self._sock.settimeout(None)
+
+    def read(self, last_seq: int, timeout: float | None = None) -> tuple[bytes, int]:
+        self._sock.settimeout(timeout)
+        try:
+            self._sock.sendall(_REQ.pack(last_seq))
+            head = _recv_exact(self._sock, _FRAME.size)
+            if head is None:
+                raise ChannelClosed("tcp channel writer gone")
+            seq, length = _FRAME.unpack(head)
+            if length == STOP_LEN:
+                raise ChannelClosed("tcp channel stopped")
+            payload = _recv_exact(self._sock, length)
+            if payload is None:
+                raise ChannelClosed("tcp channel writer gone")
+            return payload, seq
+        except socket.timeout:
+            raise TimeoutError(f"tcp channel idle past {timeout}s")
+        finally:
+            self._sock.settimeout(None)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf

@@ -18,7 +18,15 @@ import uuid
 
 from ..core import serialization
 from ..core.status import RayTaskError
-from .channel import Channel, ChannelClosed
+from .channel import Channel, ChannelClosed, TcpChannelReader, TcpChannelServer
+
+
+def _open_reader(desc, capacity: int):
+    """Open the reader end of a channel descriptor: ("shm", path) or
+    ("tcp", address)."""
+    if desc[0] == "tcp":
+        return TcpChannelReader(desc[1])
+    return Channel(desc[1], capacity)
 from .nodes import ClassMethodNode, DAGNode, InputNode, MultiOutputNode
 
 # Channel payload = [u32 meta_len][meta][blob] using the core serializer,
@@ -44,20 +52,45 @@ def _unpack(payload: bytes):
     return value, meta == serialization.META_ERROR
 
 
-def _actor_loop(instance, method_name: str, in_specs: list, out_path: str,
+def _probe_node(instance) -> str:
+    """Phase-0 placement probe (runs on the actor)."""
+    from ..core.worker import global_worker
+
+    return global_worker().node_id
+
+
+def _create_out_server(instance) -> str:
+    """Phase-1 for a cross-node producer: create the TCP channel server in
+    the actor process (stashed on the instance for the phase-2 loop) and
+    return its address."""
+    from ..core.worker import global_worker
+
+    from .channel import TcpChannelServer
+
+    host = global_worker().address.rpartition(":")[0] or "127.0.0.1"
+    server = TcpChannelServer(advertise=host)
+    instance.__dict__["_dag_out_server"] = server
+    return server.address
+
+
+def _actor_loop(instance, method_name: str, in_specs: list, out_desc,
                 capacity: int) -> str:
     """Runs ON the actor (shipped via __ray_call__): spin on input
     channels, apply the bound method, write the result. ``in_specs`` is a
-    list of ("chan", path) / ("const", value) in positional order."""
+    list of ("chan", desc) / ("const", value) in positional order, where
+    desc is ("shm", path) or ("tcp", address)."""
     channels = {
-        path: Channel(path, capacity) for kind, path in in_specs if kind == "chan"
+        desc: _open_reader(desc, capacity) for kind, desc in in_specs if kind == "chan"
     }
-    # Readiness marker: compile() blocks until every loop has one, so
-    # execute() timeouts never race actor-creation latency.
-    with open(out_path + ".ready", "w") as f:
-        f.write("1")
-    out = Channel(out_path, capacity)
-    cursors = {path: 0 for path in channels}
+    if out_desc[0] == "tcp":
+        out = instance.__dict__.pop("_dag_out_server")
+    else:
+        # Readiness marker: compile() blocks until every loop has one, so
+        # execute() timeouts never race actor-creation latency.
+        with open(out_desc[1] + ".ready", "w") as f:
+            f.write("1")
+        out = Channel(out_desc[1], capacity)
+    cursors = {desc: 0 for desc in channels}
     method = getattr(instance, method_name)
     try:
         while True:
@@ -137,21 +170,58 @@ class CompiledDAG:
                 )
             seen_actors[actor_id] = node.method_name
 
-        self._dir = tempfile.mkdtemp(prefix="raytpu_dag_", dir="/dev/shm")
-        # One channel per producer (InputNode + every method node).
+        # Placement: each producer's channel is shm when every endpoint
+        # shares its node, TCP otherwise (reference: shared_memory_channel
+        # falls back to its cross-node transport per edge).
+        from ..core import api as ray
+
+        driver_node = ray.get_runtime_context().node_id
+        node_of: dict[int, str] = {id(self._input_node): driver_node}
         for node in order:
-            path = os.path.join(self._dir, f"ch_{uuid.uuid4().hex[:10]}")
-            Channel(path, self.capacity, create=True).close()
-            self._channels[id(node)] = path
-        self._input = Channel(self._channels[id(self._input_node)], self.capacity)
+            if isinstance(node, ClassMethodNode):
+                node_of[id(node)] = ray.get(
+                    node.actor.__ray_call__.remote(_probe_node), timeout=60)
+        consumers: dict[int, list[str]] = {id(n): [] for n in order}
+        for node in order:
+            if isinstance(node, ClassMethodNode):
+                for up in node.upstream():
+                    consumers[id(up)].append(node_of[id(node)])
+        for out in self._outputs:
+            consumers[id(out)].append(driver_node)  # driver reads outputs
+
+        self._dir = tempfile.mkdtemp(prefix="raytpu_dag_", dir="/dev/shm")
+        self._cross_node: set[int] = set()
+        # One channel per producer (InputNode + every method node). The
+        # descriptor is ("shm", path) or ("tcp", address).
+        for node in order:
+            local = all(c == node_of[id(node)] for c in consumers[id(node)])
+            if local:
+                path = os.path.join(self._dir, f"ch_{uuid.uuid4().hex[:10]}")
+                Channel(path, self.capacity, create=True).close()
+                self._channels[id(node)] = ("shm", path)
+                continue
+            self._cross_node.add(id(node))
+            if node is self._input_node:
+                self._input_server = TcpChannelServer()
+                self._channels[id(node)] = ("tcp", self._input_server.address)
+            else:
+                # Phase 1: the producing actor creates its server NOW so
+                # consumers know the address before their loops install.
+                addr = ray.get(
+                    node.actor.__ray_call__.remote(_create_out_server), timeout=60)
+                self._channels[id(node)] = ("tcp", addr)
+
+        in_desc = self._channels[id(self._input_node)]
+        self._input = (self._input_server if in_desc[0] == "tcp"
+                       else Channel(in_desc[1], self.capacity))
         self._out_channels = [
-            Channel(self._channels[id(node)], self.capacity) for node in self._outputs
+            _open_reader(self._channels[id(node)], self.capacity)
+            for node in self._outputs
         ]
         self._out_cursors = [0] * len(self._outputs)
 
-        # Install executor loops (upstream-last so consumers are listening
-        # before producers can emit — order doesn't actually matter since
-        # channels buffer one message, but deterministic is nicer).
+        # Phase 2: install executor loops (upstream-last so consumers are
+        # listening before producers can emit).
         for node in order:
             if not isinstance(node, ClassMethodNode):
                 continue
@@ -179,9 +249,13 @@ class CompiledDAG:
 
         from ..core import api as ray
 
+        # Cross-node producers have no driver-visible marker file; their
+        # phase-1 server creation already proved the actor alive, and the
+        # loop-ref liveness check below covers install crashes.
         markers = [
-            self._channels[id(node)] + ".ready"
+            self._channels[id(node)][1] + ".ready"
             for node in self._channels_nodes()
+            if self._channels[id(node)][0] == "shm"
         ]
         deadline = time.monotonic() + timeout
         while True:
