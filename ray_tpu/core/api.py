@@ -50,6 +50,13 @@ def init(
             # Submitted-job drivers connect to the running cluster via env
             # (reference: RAY_ADDRESS set by the job manager for entrypoints).
             address = os.environ.get("RAY_TPU_ADDRESS") or None
+        if address is not None and address.startswith("ray://"):
+            # Ray Client mode: a REMOTE driver proxied through a cluster-
+            # side ClientServer (reference util/client/__init__.py:200).
+            from ..util.client import connect
+
+            set_global_worker(connect(address))
+            return {"address": address, "node_id": "client"}
         if address is None:
             _node = Node(
                 head=True,

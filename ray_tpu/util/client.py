@@ -1,0 +1,333 @@
+"""Ray Client: remote drivers over ``ray://host:port``.
+
+Equivalent of the reference's client mode
+(``python/ray/util/client/__init__.py:200``): a thin proxy server runs
+next to the cluster; remote Python processes connect with
+``ray_tpu.init(address="ray://host:port")`` and use the NORMAL API —
+``@remote``, ``put/get/wait``, actors — while every operation executes
+in the proxy's driver on the cluster. The client worker duck-types the
+``CoreWorker`` surface the public API calls, so no separate client API
+exists (the reference generates the same illusion with a gRPC proxy).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any
+
+import cloudpickle
+
+from ..core import serialization
+from ..core.ids import JobID, ObjectID, TaskID
+from ..core.object_ref import ObjectRef, install_refcount_hooks
+from ..core.rpc import EventLoopThread, RetryableRpcClient, RpcServer
+from ..core.status import RayTpuError
+
+CLIENT_PREFIX = "ray://"
+
+
+class ClientServer:
+    """Cluster-side proxy: executes client requests as this process's
+    driver (it must run in a connected driver process — e.g. the head
+    bootstrap or any ``ray_tpu.init()``'d process)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 10001):
+        from ..core.worker import global_worker
+
+        self._worker = global_worker()
+        self._io = EventLoopThread("raytpu-client-server")
+        self._server = RpcServer(host, port)
+        self._server.register_service(self)
+        # Per-client object registries: client ref id -> real ObjectRef
+        # (dropping a client drops its refs).
+        self._refs: dict[str, dict[str, ObjectRef]] = {}
+        self._actors: dict[str, Any] = {}  # actor id hex -> handle
+        self._lock = threading.Lock()
+        self._io.run_sync(self._server.start())
+        self.address = self._server.address
+
+    def stop(self) -> None:
+        try:
+            self._io.run_sync(self._server.stop())
+        except Exception:
+            pass
+        self._io.stop()
+
+    # ------------------------------------------------------------- helpers
+    def _client(self, p: dict) -> dict:
+        with self._lock:
+            return self._refs.setdefault(p["client_id"], {})
+
+    def _resolve(self, p: dict, wire_args: list) -> tuple[tuple, dict]:
+        refs = self._client(p)
+        args, kwargs = [], {}
+
+        def fix(v):
+            if isinstance(v, dict) and v.get("__client_ref__"):
+                return refs[v["id"]]
+            return v
+
+        for entry in wire_args:
+            value = fix(cloudpickle.loads(entry["blob"]))
+            if "key" in entry:
+                kwargs[entry["key"]] = value
+            else:
+                args.append(value)
+        return tuple(args), kwargs
+
+    def _track(self, p: dict, ref: ObjectRef) -> str:
+        rid = uuid.uuid4().hex
+        self._client(p)[rid] = ref
+        return rid
+
+    # ------------------------------------------------------------ handlers
+    async def handle_ClientPut(self, p: dict) -> dict:
+        import asyncio
+
+        value = cloudpickle.loads(p["blob"])
+        ref = await asyncio.get_running_loop().run_in_executor(
+            None, self._worker.put, value)
+        return {"ref": self._track(p, ref)}
+
+    async def handle_ClientGet(self, p: dict) -> dict:
+        import asyncio
+
+        refs = self._client(p)
+        try:
+            targets = [refs[r] for r in p["refs"]]
+        except KeyError as e:
+            return {"error": cloudpickle.dumps(RayTpuError(f"unknown client ref {e}"))}
+        loop = asyncio.get_running_loop()
+        try:
+            values = await loop.run_in_executor(
+                None, lambda: self._worker.get(targets, p.get("timeout")))
+        except Exception as e:
+            # The as_instanceof_cause wrapper class is process-local: ship
+            # the inner RayTaskError; the client re-wraps.
+            inner = getattr(e, "_inner", e)
+            return {"error": cloudpickle.dumps(inner)}
+        return {"blob": cloudpickle.dumps(values)}
+
+    async def handle_ClientWait(self, p: dict) -> dict:
+        import asyncio
+
+        refs = self._client(p)
+        targets = [refs[r] for r in p["refs"]]
+        loop = asyncio.get_running_loop()
+        ready, not_ready = await loop.run_in_executor(
+            None, lambda: self._worker.wait(
+                targets, p["num_returns"], p.get("timeout")))
+        ready_ids = [p["refs"][targets.index(r)] for r in ready]
+        return {"ready": ready_ids,
+                "not_ready": [r for r in p["refs"] if r not in ready_ids]}
+
+    async def handle_ClientSubmitTask(self, p: dict) -> dict:
+        import asyncio
+
+        fn = cloudpickle.loads(p["fn"])
+        args, kwargs = self._resolve(p, p["args"])
+        opts = p.get("options") or {}
+        loop = asyncio.get_running_loop()
+        refs = await loop.run_in_executor(
+            None, lambda: self._worker.submit_task(fn, args, kwargs, **opts))
+        if not isinstance(refs, list):  # streaming unsupported over client v1
+            return {"error": cloudpickle.dumps(
+                RayTpuError("streaming tasks are not supported over ray:// yet"))}
+        return {"refs": [self._track(p, r) for r in refs]}
+
+    async def handle_ClientCreateActor(self, p: dict) -> dict:
+        import asyncio
+
+        cls = cloudpickle.loads(p["cls"])
+        args, kwargs = self._resolve(p, p["args"])
+        opts = p.get("options") or {}
+        loop = asyncio.get_running_loop()
+        try:
+            actor_id = await loop.run_in_executor(
+                None, lambda: self._worker.create_actor(cls, args, kwargs, **opts))
+        except Exception as e:
+            return {"error": cloudpickle.dumps(e)}
+        return {"actor_id": actor_id.hex()}
+
+    async def handle_ClientActorCall(self, p: dict) -> dict:
+        import asyncio
+
+        args, kwargs = self._resolve(p, p["args"])
+        loop = asyncio.get_running_loop()
+        refs = await loop.run_in_executor(
+            None, lambda: self._worker.submit_actor_task(
+                bytes.fromhex(p["actor_id"]), p["method"], args, kwargs,
+                num_returns=p.get("num_returns", 1)))
+        return {"refs": [self._track(p, r) for r in refs]}
+
+    async def handle_ClientKillActor(self, p: dict) -> dict:
+        self._worker.kill_actor(bytes.fromhex(p["actor_id"]))
+        return {}
+
+    async def handle_ClientGetActorByName(self, p: dict) -> dict:
+        found = self._worker.get_actor_by_name(p["name"])
+        if found is None:
+            return {"found": False}
+        return {"found": True, "actor_id": found[0].hex()}
+
+    async def handle_ClientGcsCall(self, p: dict) -> dict:
+        # read-only control-plane passthrough (cluster_resources, nodes...)
+        if p["method"] not in ("GetAllNodes", "Timeline"):
+            return {"error": cloudpickle.dumps(
+                RayTpuError(f"GCS method {p['method']!r} not allowed over ray://"))}
+        return self._worker._gcs_call(p["method"], p.get("payload") or {})
+
+    async def handle_ClientDisconnect(self, p: dict) -> dict:
+        with self._lock:
+            self._refs.pop(p["client_id"], None)
+        return {}
+
+
+class ClientWorker:
+    """Client-side stand-in for ``CoreWorker``: implements the method
+    surface the public API uses, forwarding everything to the proxy."""
+
+    def __init__(self, address: str):
+        host_port = address[len(CLIENT_PREFIX):]
+        self.client_id = uuid.uuid4().hex
+        self.io = EventLoopThread("raytpu-client")
+        self.rpc = RetryableRpcClient(host_port)
+        self.node_id = "client"
+        self.worker_id = f"client-{self.client_id[:12]}"
+        self.job_id = JobID.from_int(0)
+        self.actor_id = b""
+        self.mode = "client"
+        self._ref_lock = threading.Lock()
+        self._local_refs: dict[bytes, str] = {}  # ObjectID binary -> server rid
+        install_refcount_hooks(lambda r: None, lambda r: None)
+
+    # ------------------------------------------------------------ plumbing
+    def _call(self, method: str, payload: dict, timeout: float | None = 300.0) -> dict:
+        from ..core.status import RayTaskError
+
+        payload = {**payload, "client_id": self.client_id}
+        reply = self.io.run_sync(self.rpc.call(method, payload, timeout))
+        if reply.get("error"):
+            err = cloudpickle.loads(reply["error"])
+            if isinstance(err, RayTaskError):
+                raise err.as_instanceof_cause()
+            raise err
+        return reply
+
+    def _make_ref(self, rid: str) -> ObjectRef:
+        # Client-side ObjectRefs carry a synthetic id; the server rid maps
+        # back to the real ref.
+        oid = ObjectID(bytes.fromhex(rid) + b"\x00" * (28 - len(rid) // 2))
+        with self._ref_lock:
+            self._local_refs[oid.binary()] = rid
+        return ObjectRef(oid, owner_address="", _add_local_ref=False)
+
+    def _rid(self, ref: ObjectRef) -> str:
+        with self._ref_lock:
+            rid = self._local_refs.get(ref.binary())
+        if rid is None:
+            raise RayTpuError("ObjectRef does not belong to this client session")
+        return rid
+
+    def _wire_args(self, args: tuple, kwargs: dict) -> list:
+        out = []
+        for kind, item in [(None, a) for a in args] + list(kwargs.items()):
+            if isinstance(item, ObjectRef):
+                blob = cloudpickle.dumps({"__client_ref__": True, "id": self._rid(item)})
+            else:
+                blob = cloudpickle.dumps(item)
+            entry = {"blob": blob}
+            if kind is not None:
+                entry["key"] = kind
+            out.append(entry)
+        return out
+
+    # ------------------------------------------------------------- surface
+    def put(self, value: Any) -> ObjectRef:
+        reply = self._call("ClientPut", {"blob": cloudpickle.dumps(value)})
+        return self._make_ref(reply["ref"])
+
+    def get(self, refs, timeout: float | None = None):
+        reply = self._call("ClientGet", {
+            "refs": [self._rid(r) for r in refs], "timeout": timeout,
+        }, timeout=None if timeout is None else timeout + 30.0)
+        return cloudpickle.loads(reply["blob"])
+
+    def wait(self, refs, num_returns: int, timeout: float | None):
+        rids = [self._rid(r) for r in refs]
+        reply = self._call("ClientWait", {
+            "refs": rids, "num_returns": num_returns, "timeout": timeout,
+        }, timeout=None if timeout is None else timeout + 30.0)
+        by_rid = dict(zip(rids, refs))
+        return ([by_rid[r] for r in reply["ready"]],
+                [by_rid[r] for r in reply["not_ready"]])
+
+    def submit_task(self, fn, args, kwargs, **options) -> list[ObjectRef]:
+        if options.get("num_returns") == "streaming":
+            raise RayTpuError("streaming tasks are not supported over ray:// yet")
+        reply = self._call("ClientSubmitTask", {
+            "fn": cloudpickle.dumps(fn),
+            "args": self._wire_args(args, kwargs),
+            "options": options,
+        })
+        return [self._make_ref(r) for r in reply["refs"]]
+
+    def create_actor(self, cls, args, kwargs, **options) -> bytes:
+        reply = self._call("ClientCreateActor", {
+            "cls": cloudpickle.dumps(cls),
+            "args": self._wire_args(args, kwargs),
+            "options": options,
+        })
+        return bytes.fromhex(reply["actor_id"])
+
+    def submit_actor_task(self, actor_id: bytes, method: str, args, kwargs,
+                          *, num_returns=1, generator_backpressure: int = 0):
+        if num_returns == "streaming":
+            raise RayTpuError("streaming actor calls are not supported over ray:// yet")
+        reply = self._call("ClientActorCall", {
+            "actor_id": actor_id.hex(), "method": method,
+            "args": self._wire_args(args, kwargs), "num_returns": num_returns,
+        })
+        return [self._make_ref(r) for r in reply["refs"]]
+
+    def kill_actor(self, actor_id: bytes) -> None:
+        self._call("ClientKillActor", {"actor_id": actor_id.hex()})
+
+    def get_actor_by_name(self, name: str):
+        reply = self._call("ClientGetActorByName", {"name": name})
+        if not reply.get("found"):
+            return None
+        return bytes.fromhex(reply["actor_id"]), reply
+
+    def register_actor_handle(self, actor_id: bytes, owned: bool) -> None:
+        pass  # client handles never own cluster actors
+
+    def deregister_actor_handle(self, actor_id: bytes) -> None:
+        pass
+
+    def _gcs_call(self, method: str, payload: dict, timeout: float | None = 30.0) -> dict:
+        return self._call("ClientGcsCall", {"method": method, "payload": payload})
+
+    def shutdown(self) -> None:
+        try:
+            self._call("ClientDisconnect", {}, timeout=5.0)
+        except Exception:
+            pass
+        try:
+            self.io.run_sync(self.rpc.close(), timeout=5)
+        except Exception:
+            pass
+        self.io.stop()
+
+    @property
+    def current_task_id(self):
+        return TaskID.nil()
+
+
+def connect(address: str) -> ClientWorker:
+    """``ray_tpu.init(address="ray://...")`` entry point."""
+    worker = ClientWorker(address)
+    # round-trip to fail fast on a bad address
+    worker._call("ClientGetActorByName", {"name": "__probe__"}, timeout=15.0)
+    return worker

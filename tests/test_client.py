@@ -1,0 +1,93 @@
+"""Ray Client (`ray://`): remote drivers proxied through a cluster-side
+server (reference ``python/ray/util/client/__init__.py:200``)."""
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(autouse=True)
+def _cluster(ray_cluster):
+    yield
+
+
+def test_client_mode_end_to_end():
+    """A SEPARATE python process connects via ray:// and uses the normal
+    API: tasks, puts/gets, ref args, actors, named actors, wait."""
+    from ray_tpu.util.client import ClientServer
+
+    server = ClientServer(host="127.0.0.1", port=0)
+    try:
+        # a named actor created cluster-side, visible to the client
+        @ray_tpu.remote
+        class Registry:
+            def __init__(self):
+                self.items = []
+
+            def add(self, x):
+                self.items.append(x)
+                return len(self.items)
+
+        reg = Registry.options(name="client_registry", lifetime="detached").remote()
+        assert ray_tpu.get(reg.add.remote("seed"), timeout=60) == 1
+
+        code = textwrap.dedent(f"""
+            import ray_tpu
+            ray_tpu.init(address="ray://{server.address}")
+
+            @ray_tpu.remote
+            def double(x):
+                return x * 2
+
+            # tasks + ref args
+            a = double.remote(21)
+            b = double.remote(a)
+            assert ray_tpu.get(b, timeout=120) == 84
+
+            # put/get + wait
+            ref = ray_tpu.put({{"k": [1, 2, 3]}})
+            assert ray_tpu.get(ref, timeout=60) == {{"k": [1, 2, 3]}}
+            ready, not_ready = ray_tpu.wait([a, b], num_returns=2, timeout=60)
+            assert len(ready) == 2 and not not_ready
+
+            # client-created actor
+            @ray_tpu.remote
+            class Counter:
+                def __init__(self):
+                    self.n = 0
+                def inc(self, k):
+                    self.n += k
+                    return self.n
+            c = Counter.remote()
+            assert ray_tpu.get(c.inc.remote(5), timeout=120) == 5
+            assert ray_tpu.get(c.inc.remote(2), timeout=60) == 7
+
+            # named actor created by the CLUSTER driver
+            reg = ray_tpu.get_actor("client_registry")
+            assert ray_tpu.get(reg.add.remote("from-client"), timeout=60) == 2
+
+            # error propagation
+            @ray_tpu.remote(max_retries=0)
+            def boom():
+                raise ValueError("client boom")
+            try:
+                ray_tpu.get(boom.remote(), timeout=60)
+                raise SystemExit("no error raised")
+            except ValueError as e:
+                assert "client boom" in str(e)
+
+            ray_tpu.shutdown()
+            print("CLIENT_OK")
+        """)
+        proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                              text=True, timeout=300, cwd="/root/repo")
+        assert "CLIENT_OK" in proc.stdout, proc.stderr[-2000:]
+
+        # cluster-side state mutated by the client is visible here
+        assert ray_tpu.get(reg.add.remote("post"), timeout=60) == 3
+    finally:
+        server.stop()
