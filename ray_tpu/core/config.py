@@ -87,6 +87,38 @@ class RayTpuConfig:
     task_events_flush_interval_ms: int = 1000
     enable_timeline: bool = True
 
+    # --- workers / executor --------------------------------------------------
+    # Thread pool depth per worker (long-poll actor methods park threads).
+    worker_executor_threads: int = 64
+    # Owner-side temporary hold on returned nested refs until the caller
+    # registers as a borrower (reference: borrowed-ref grace).
+    borrow_hold_ttl_s: float = 600.0
+    borrow_sweep_interval_s: float = 30.0
+    # Client-side actor address resolution deadline (PENDING/RESTARTING).
+    actor_resolve_timeout_s: float = 120.0
+
+    # --- streaming generators ------------------------------------------------
+    generator_report_timeout_s: float = 30.0
+    generator_wait_consumed_poll_s: float = 10.0
+
+    # --- global GC -----------------------------------------------------------
+    # Min seconds between cluster-wide gc.collect broadcasts.
+    global_gc_interval_s: float = 5.0
+
+    # --- compiled graphs -----------------------------------------------------
+    dag_ready_timeout_s: float = 120.0
+    dag_channel_capacity: int = 1 << 20
+
+    # --- serve ---------------------------------------------------------------
+    serve_router_assign_timeout_s: float = 60.0
+    serve_stream_item_timeout_s: float = 120.0
+    serve_stream_backpressure_items: int = 256
+
+    # --- data ----------------------------------------------------------------
+    data_max_in_flight_tasks: int = 8
+    data_per_op_concurrency: int = 4
+    data_exchange_partitions: int = 8
+
     # --- TPU -----------------------------------------------------------------
     # Resource name prefix for slice-head scheduling (reference
     # ``_private/accelerators/tpu.py:70-192`` auto-creates TPU-{type}-head).

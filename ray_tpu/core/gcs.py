@@ -709,7 +709,7 @@ class GcsServer:
     async def _maybe_global_gc(self, reason: str) -> None:
         """Publish a rate-limited global-GC broadcast (at most every 5s)."""
         now = time.time()
-        if now - getattr(self, "_last_global_gc", 0.0) < 5.0:
+        if now - getattr(self, "_last_global_gc", 0.0) < get_config().global_gc_interval_s:
             return
         self._last_global_gc = now
         await self.publisher.publish("global_gc", {"reason": reason})

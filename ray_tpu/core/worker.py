@@ -265,7 +265,9 @@ class CoreWorker:
         # listen_for_change); give every worker a deep pool.
         from concurrent.futures import ThreadPoolExecutor
 
-        self.io.loop.set_default_executor(ThreadPoolExecutor(max_workers=64, thread_name_prefix="raytpu-exec"))
+        self.io.loop.set_default_executor(ThreadPoolExecutor(
+            max_workers=get_config().worker_executor_threads,
+            thread_name_prefix="raytpu-exec"))
 
         # RPC server for owner + executor duties.
         self.server = RpcServer("127.0.0.1", 0)
@@ -1179,7 +1181,7 @@ class CoreWorker:
         PENDING/RESTARTING states."""
         if state.address:
             return state.address
-        deadline = time.monotonic() + 120.0
+        deadline = time.monotonic() + get_config().actor_resolve_timeout_s
         while time.monotonic() < deadline:
             reply = await self.gcs.call("GetActorInfo", {"actor_id": state.actor_id.hex()}, timeout=10.0)
             if not reply.get("found"):
@@ -1397,7 +1399,7 @@ class CoreWorker:
         import asyncio
 
         while True:
-            await asyncio.sleep(30.0)
+            await asyncio.sleep(get_config().borrow_sweep_interval_s)
             now = time.monotonic()
             expired: list[bytes] = []
             with self._borrow_holds_lock:
@@ -1593,7 +1595,7 @@ class CoreWorker:
                 reply = self.io.run_sync(client.call(
                     "ReportGeneratorItem",
                     {"task_id": spec.task_id, "index": count, "item": entry},
-                    timeout=30.0,
+                    timeout=get_config().generator_report_timeout_s,
                 ))
                 consumed = reply.get("consumed", consumed)
                 count += 1
@@ -1607,8 +1609,9 @@ class CoreWorker:
                 while bp > 0 and count - consumed >= bp:
                     r2 = self.io.run_sync(client.call(
                         "WaitGeneratorConsumed",
-                        {"task_id": spec.task_id, "until": count - bp + 1, "timeout": 10.0},
-                        timeout=40.0,
+                        {"task_id": spec.task_id, "until": count - bp + 1,
+                         "timeout": get_config().generator_wait_consumed_poll_s},
+                        timeout=get_config().generator_wait_consumed_poll_s + 30.0,
                     ))
                     consumed = r2.get("consumed", consumed)
                     if r2.get("cancel"):
@@ -1659,7 +1662,8 @@ class CoreWorker:
                 owner = self.address
                 self.refcounter.add_borrower(oid)
                 with self._borrow_holds_lock:
-                    self._borrow_holds.setdefault(oid.binary(), []).append(now + 600.0)
+                    self._borrow_holds.setdefault(oid.binary(), []).append(
+                        now + get_config().borrow_hold_ttl_s)
             wire.append({"id": oid.binary(), "owner": owner})
         return wire
 

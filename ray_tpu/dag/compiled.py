@@ -19,6 +19,7 @@ import uuid
 from ..core import serialization
 from ..core.status import RayTaskError
 from .channel import Channel, ChannelClosed, TcpChannelReader, TcpChannelServer
+from .nodes import ClassMethodNode, DAGNode, InputNode, MultiOutputNode
 
 
 def _open_reader(desc, capacity: int):
@@ -27,7 +28,6 @@ def _open_reader(desc, capacity: int):
     if desc[0] == "tcp":
         return TcpChannelReader(desc[1])
     return Channel(desc[1], capacity)
-from .nodes import ClassMethodNode, DAGNode, InputNode, MultiOutputNode
 
 # Channel payload = [u32 meta_len][meta][blob] using the core serializer,
 # so DAG values get the same encoding (and error framing) as every other
@@ -131,8 +131,10 @@ def _actor_loop(instance, method_name: str, in_specs: list, out_desc,
 
 
 class CompiledDAG:
-    def __init__(self, output_node: DAGNode, max_buffer_size: int = 1 << 20):
-        self.capacity = max_buffer_size
+    def __init__(self, output_node: DAGNode, max_buffer_size: int | None = None):
+        from ..core.config import get_config
+
+        self.capacity = max_buffer_size or get_config().dag_channel_capacity
         self._dir: str | None = None
         self._input_node: InputNode | None = None
         self._outputs: list[ClassMethodNode] = []
@@ -236,7 +238,8 @@ class CompiledDAG:
                 self._channels[id(node)], self.capacity,
             )
             self._loop_refs.append(ref)
-        self._wait_ready(timeout=120.0)
+        from ..core.config import get_config
+        self._wait_ready(timeout=get_config().dag_ready_timeout_s)
 
     def _wait_ready(self, timeout: float) -> None:
         """Block until every executor loop has opened its channels, so

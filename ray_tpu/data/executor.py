@@ -394,15 +394,15 @@ class ExchangePhysicalOp(PhysicalOp):
     a sampling pre-pass to pick range boundaries; join hash-partitions
     the (pre-materialized) right side through the same maps."""
 
-    DEFAULT_PARTITIONS = 8
-
     def __init__(self, kind: str, *, num_out: int | None = None, seed=None,
                  sort_key: str = "", descending: bool = False, key: str = "",
                  aggs: list | None = None, map_groups_fn=None,
                  right_refs: list | None = None, join_type: str = "inner"):
+        from ..core.config import get_config
+
         super().__init__(f"Exchange[{kind}]")
         self._kind = kind
-        self._num_out = num_out or self.DEFAULT_PARTITIONS
+        self._num_out = num_out or get_config().data_exchange_partitions
         self._spec = {
             "seed": seed, "sort_key": sort_key, "descending": descending,
             "key": key, "aggs": aggs, "map_groups_fn": map_groups_fn,
@@ -630,11 +630,14 @@ class StreamingExecutor:
     ``per_op_concurrency`` per operator (reference: backpressure_policy/).
     """
 
-    def __init__(self, ops: list[PhysicalOp], *, max_in_flight: int = 8,
-                 per_op_concurrency: int = 4):
+    def __init__(self, ops: list[PhysicalOp], *, max_in_flight: int | None = None,
+                 per_op_concurrency: int | None = None):
+        from ..core.config import get_config
+
+        cfg = get_config()
         self._ops = ops
-        self._max_in_flight = max_in_flight
-        self._per_op = per_op_concurrency
+        self._max_in_flight = max_in_flight or cfg.data_max_in_flight_tasks
+        self._per_op = per_op_concurrency or cfg.data_per_op_concurrency
 
     def run(self) -> Iterator[Any]:
         try:

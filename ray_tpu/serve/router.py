@@ -82,7 +82,7 @@ class Router:
             self._inflight = {rid: self._inflight.get(rid, 0) for rid in fresh}
             self._cond.notify_all()
 
-    def assign_replica(self, timeout: float = 60.0,
+    def assign_replica(self, timeout: float | None = None,
                        model_id: str = "") -> tuple[str, Any]:
         """Power-of-two choice among replicas below their cap; blocks while
         every replica is saturated (backpressure). With a multiplexed
@@ -90,6 +90,10 @@ class Router:
         preferred (cache affinity — reference multiplex-aware routing)."""
         import time
 
+        from ..core.config import get_config
+
+        if timeout is None:
+            timeout = get_config().serve_router_assign_timeout_s
         deadline = time.monotonic() + timeout
         with self._cond:
             while True:
@@ -197,9 +201,11 @@ class DeploymentStreamingResponse:
         return self
 
     def __next__(self):
+        from ..core.config import get_config
+
         try:
             ref = next(self._gen)
-            return ray.get(ref, timeout=120)
+            return ray.get(ref, timeout=get_config().serve_stream_item_timeout_s)
         except StopIteration:
             self._settle()
             raise
@@ -301,9 +307,11 @@ class DeploymentHandle:
         if self._multiplexed_model_id:
             kwargs[MULTIPLEXED_KWARG] = self._multiplexed_model_id
         try:
+            from ..core.config import get_config
+
             gen = actor.handle_request_streaming.options(
                 num_returns="streaming",
-                _generator_backpressure_num_objects=256,
+                _generator_backpressure_num_objects=get_config().serve_stream_backpressure_items,
             ).remote(self._method_name, args, kwargs)
         except Exception:
             router.release(replica_id)
