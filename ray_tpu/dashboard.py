@@ -122,6 +122,17 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/-/healthz":
             self._send(200, b'"ok"', "application/json")
             return
+        if path == "/api/serve/applications":
+            # Serve REST status (reference dashboard serve REST API).
+            try:
+                from .serve.config_api import serve_status
+
+                self._send(200, json.dumps(serve_status(), default=str).encode(),
+                           "application/json")
+            except Exception as e:
+                self._send(500, json.dumps({"error": f"{type(e).__name__}: {e}"}).encode(),
+                           "application/json")
+            return
         if path.startswith("/api/"):
             endpoint = path[len("/api/"):]
             if endpoint not in _ENDPOINTS:
@@ -136,6 +147,39 @@ class _Handler(BaseHTTPRequestHandler):
                            "application/json")
             return
         self._send(404, b'{"error": "not found"}', "application/json")
+
+    def do_PUT(self):
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path != "/api/serve/applications":
+            self._send(404, b'{"error": "not found"}', "application/json")
+            return
+        # Declarative deploy (reference PUT /api/serve/applications/).
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            config = json.loads(self.rfile.read(length))
+            from .serve.config_api import deploy_config
+
+            deployed = deploy_config(config)
+            self._send(200, json.dumps({"deployed": deployed}).encode(),
+                       "application/json")
+        except Exception as e:
+            self._send(500, json.dumps({"error": f"{type(e).__name__}: {e}"}).encode(),
+                       "application/json")
+
+    def do_DELETE(self):
+        path = self.path.split("?", 1)[0].rstrip("/")
+        prefix = "/api/serve/applications/"
+        if not path.startswith(prefix):
+            self._send(404, b'{"error": "not found"}', "application/json")
+            return
+        try:
+            from .serve import api as serve_api
+
+            serve_api.delete(path[len(prefix):])
+            self._send(200, b'{"deleted": true}', "application/json")
+        except Exception as e:
+            self._send(500, json.dumps({"error": f"{type(e).__name__}: {e}"}).encode(),
+                       "application/json")
 
 
 class Dashboard:

@@ -17,6 +17,7 @@ from .api import (
     status,
 )
 from .batching import batch
+from .config_api import build_app_from_spec, deploy_config, serve_status
 from .deployment import Application, AutoscalingConfig, Deployment, deployment
 from .multiplex import get_multiplexed_model_id, multiplexed
 from .replica import Request
@@ -31,6 +32,9 @@ __all__ = [
     "DeploymentStreamingResponse",
     "Request",
     "batch",
+    "build_app_from_spec",
+    "deploy_config",
+    "serve_status",
     "delete",
     "deployment",
     "get_app_handle",

@@ -58,6 +58,10 @@ class _DeploymentState:
         self.last_scale_up = 0.0
         self.last_scale_down = 0.0
         self.target_replicas = config["num_replicas"]
+        # crash-loop backoff: consecutive failed starts delay the next one
+        # exponentially (a broken constructor must not spin replica churn)
+        self.consecutive_start_failures = 0
+        self.next_start_allowed = 0.0
 
     @property
     def name(self) -> str:
@@ -273,9 +277,17 @@ class ServeController:
                     if p.get("ready"):
                         r.state = RUNNING
                         r.applied_user_config = user_config
+                        state.consecutive_start_failures = 0
+                        state.next_start_allowed = 0.0
                         dirty = True
                     elif p.get("failed"):
-                        logger.warning("replica %s failed to start; replacing", r.replica_id)
+                        state.consecutive_start_failures += 1
+                        delay = min(30.0, 0.5 * 2 ** min(state.consecutive_start_failures, 6))
+                        state.next_start_allowed = time.time() + delay
+                        logger.warning(
+                            "replica %s failed to start; replacing in %.1fs "
+                            "(%d consecutive failures)",
+                            r.replica_id, delay, state.consecutive_start_failures)
                         state.replicas.remove(r)
                         to_kill.append(r)
                         dirty = True
@@ -317,6 +329,8 @@ class ServeController:
                 ray.kill(r.actor)
             except Exception:
                 pass
+        if n_to_start and time.time() < state.next_start_allowed:
+            n_to_start = 0  # crash-loop backoff window
         for _ in range(n_to_start):
             self._start_replica(state)
             dirty = True

@@ -7,6 +7,7 @@ long_poll.py:204).
 """
 
 import json
+import textwrap
 import threading
 import time
 import urllib.request
@@ -277,3 +278,75 @@ def test_serve_multiplexed_models(serve_instance):
     assert out["loads"] == ["m1", "m2", "m3"]
     out = call("m1")  # m1 was evicted: loads again
     assert out["loads"].count("m1") == 2
+
+
+def test_declarative_config_deploy(serve_instance, tmp_path):
+    """Apps described as data (YAML schema: import_path + args +
+    per-deployment overrides) deploy without touching Python, and the
+    dashboard exposes the Serve REST surface (reference serve/schema.py +
+    PUT/GET /api/serve/applications)."""
+    import sys
+    import urllib.request as _rq
+
+    mod_dir = tmp_path / "apps"
+    mod_dir.mkdir()
+    (mod_dir / "my_serve_app.py").write_text(textwrap.dedent("""
+        from ray_tpu import serve
+
+        class Echo2:
+            def __init__(self, greeting="hi"):
+                self.greeting = greeting
+
+            def __call__(self, request):
+                return {"msg": f"{self.greeting} {request.query_params.get('who', '')}"}
+
+        def build(greeting="hi"):
+            return serve.deployment(Echo2).bind(greeting)
+    """))
+    sys.path.insert(0, str(mod_dir))
+    try:
+        config = {
+            "applications": [{
+                "name": "cfg_app",
+                "route_prefix": "/cfg",
+                "import_path": "my_serve_app:build",
+                "args": {"greeting": "hello"},
+                # ship the module to replicas (reference schema runtime_env)
+                "runtime_env": {"py_modules": [str(mod_dir / "my_serve_app.py")]},
+                "deployments": [{"name": "Echo2", "num_replicas": 2,
+                                 "max_ongoing_requests": 4}],
+            }],
+        }
+        deployed = serve.deploy_config(config)
+        assert deployed == {"cfg_app": "/cfg"}
+        addr = serve.http_address()
+        body = json.loads(_rq.urlopen(addr + "/cfg?who=world", timeout=60).read())
+        assert body == {"msg": "hello world"}
+
+        status = serve.serve_status()
+        assert status["applications"]["cfg_app"]["status"] == "RUNNING"
+
+        # YAML string form works too
+        yaml_config = textwrap.dedent(f"""
+            applications:
+              - name: cfg_app2
+                route_prefix: /cfg2
+                import_path: my_serve_app:build
+                args: {{greeting: yo}}
+                runtime_env:
+                  py_modules: ["{mod_dir / 'my_serve_app.py'}"]
+        """)
+        serve.deploy_config(yaml_config)
+        body = json.loads(_rq.urlopen(addr + "/cfg2?who=x", timeout=60).read())
+        assert body == {"msg": "yo x"}
+
+        # REST surface via the dashboard
+        from ray_tpu.dashboard import start_dashboard
+
+        url = start_dashboard()
+        rest = json.loads(_rq.urlopen(url + "/api/serve/applications", timeout=30).read())
+        assert "cfg_app" in rest["applications"]
+        req = _rq.Request(url + "/api/serve/applications/cfg_app2", method="DELETE")
+        assert json.loads(_rq.urlopen(req, timeout=60).read()) == {"deleted": True}
+    finally:
+        sys.path.remove(str(mod_dir))
