@@ -18,6 +18,7 @@ from .api import (
 )
 from .batching import batch
 from .config_api import build_app_from_spec, deploy_config, serve_status
+from .grpc_proxy import start_grpc
 from .deployment import Application, AutoscalingConfig, Deployment, deployment
 from .multiplex import get_multiplexed_model_id, multiplexed
 from .replica import Request
@@ -35,6 +36,7 @@ __all__ = [
     "build_app_from_spec",
     "deploy_config",
     "serve_status",
+    "start_grpc",
     "delete",
     "deployment",
     "get_app_handle",

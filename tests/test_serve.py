@@ -350,3 +350,40 @@ def test_declarative_config_deploy(serve_instance, tmp_path):
         assert json.loads(_rq.urlopen(req, timeout=60).read()) == {"deleted": True}
     finally:
         sys.path.remove(str(mod_dir))
+
+
+def test_grpc_proxy(serve_instance):
+    """Unary gRPC calls route /<app>/<method> onto replicas through the
+    shared router (reference proxy.py:534 gRPC proxy)."""
+    import cloudpickle
+    import grpc
+
+    class MathService:
+        def __call__(self, x):
+            return x + 1
+
+        def mul(self, a, b):
+            return a * b
+
+    serve.run(serve.deployment(MathService).bind(), name="math", route_prefix="/math")
+    address = serve.start_grpc()
+
+    channel = grpc.insecure_channel(address)
+    call = channel.unary_unary("/math/__call__",
+                               request_serializer=lambda b: b,
+                               response_deserializer=lambda b: b)
+    out = cloudpickle.loads(call(cloudpickle.dumps(((41,), {})), timeout=60))
+    assert out == 42
+
+    mul = channel.unary_unary("/math/mul",
+                              request_serializer=lambda b: b,
+                              response_deserializer=lambda b: b)
+    assert cloudpickle.loads(mul(cloudpickle.dumps(((6, 7), {})), timeout=60)) == 42
+
+    # unknown app -> INTERNAL error, not a hang
+    bad = channel.unary_unary("/nope/__call__",
+                              request_serializer=lambda b: b,
+                              response_deserializer=lambda b: b)
+    with pytest.raises(grpc.RpcError):
+        bad(cloudpickle.dumps(((), {})), timeout=30)
+    channel.close()
