@@ -12,6 +12,7 @@ standing in for the reference's versioned mutable plasma objects.
 
 from .channel import Channel
 from .compiled import CompiledDAG
-from .nodes import ClassMethodNode, InputNode, MultiOutputNode
+from .nodes import AllReduceNode, ClassMethodNode, InputNode, MultiOutputNode, collective
 
-__all__ = ["Channel", "CompiledDAG", "ClassMethodNode", "InputNode", "MultiOutputNode"]
+__all__ = ["AllReduceNode", "Channel", "CompiledDAG", "ClassMethodNode", "InputNode",
+           "MultiOutputNode", "collective"]

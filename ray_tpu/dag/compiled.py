@@ -159,6 +159,14 @@ class CompiledDAG:
         # task on its actor, so a second node on the same actor could never
         # start (max_concurrency=1 sequencing) — reject early instead of
         # hanging compile.
+        # Collective nodes materialize their hidden reducer actors now
+        # (they must exist before placement probing / loop install).
+        self._owned_actors = []
+        for node in order:
+            if hasattr(node, "materialize_actor"):
+                node.materialize_actor()
+                if getattr(node, "_owned_actor", False):
+                    self._owned_actors.append(node.actor)
         seen_actors: dict[bytes, str] = {}
         for node in order:
             if not isinstance(node, ClassMethodNode):
@@ -377,6 +385,13 @@ class CompiledDAG:
             input_ch.close()
         for ch in getattr(self, "_out_channels", []):
             ch.close()
+        for actor in getattr(self, "_owned_actors", []):
+            try:
+                from ..core import api as ray
+
+                ray.kill(actor)
+            except Exception:
+                pass
         if self._dir is not None:
             import shutil
 

@@ -37,3 +37,67 @@ class ClassMethodNode(DAGNode):
 class MultiOutputNode(DAGNode):
     def __init__(self, outputs: list[DAGNode]):
         self.outputs = list(outputs)
+
+
+class _DagReducer:
+    """Hidden reducer actor backing an AllReduceNode."""
+
+    _OPS = {
+        "sum": lambda vs: _reduce_add(vs),
+        "mean": lambda vs: _reduce_add(vs) / len(vs),
+        "max": lambda vs: max(vs),
+        "min": lambda vs: min(vs),
+    }
+
+    def __init__(self, op):
+        self._fn = op if callable(op) else self._OPS[op]
+
+    def reduce(self, *values):
+        return self._fn(list(values))
+
+
+def _reduce_add(values):
+    import functools
+    import operator
+
+    return functools.reduce(operator.add, values)
+
+
+class AllReduceNode(ClassMethodNode):
+    """Collective node: reduces N upstream nodes' outputs into one value
+    (reference ``python/ray/dag/collective_node.py``). The TPU design
+    keeps TENSOR collectives inside compiled XLA programs (SURVEY §2.5);
+    this is the host-side DAG collective for cross-actor results — it
+    compiles to a hidden reducer actor wired into the channel graph like
+    any other stage."""
+
+    def __init__(self, nodes: list, op: str | Any = "sum"):
+        if len(nodes) < 2:
+            raise ValueError("allreduce needs at least two upstream nodes")
+        if not callable(op) and op not in _DagReducer._OPS:
+            raise ValueError(f"unknown allreduce op {op!r}")
+        self.actor = None  # materialized at compile time
+        self.method_name = "reduce"
+        self.args = tuple(nodes)
+        self._op = op
+
+    def materialize_actor(self) -> None:
+        if self.actor is None:
+            from ..core import api as ray
+
+            self.actor = ray.remote(_DagReducer).options(num_cpus=0.1).remote(self._op)
+            self._owned_actor = True
+
+
+class _Collective:
+    """``collective.allreduce.bind([n1, n2], op=...)`` compat surface."""
+
+    class _AllReduce:
+        @staticmethod
+        def bind(nodes: list, op: str | Any = "sum") -> AllReduceNode:
+            return AllReduceNode(nodes, op)
+
+    allreduce = _AllReduce()
+
+
+collective = _Collective()

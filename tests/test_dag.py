@@ -195,3 +195,33 @@ def test_compile_rejects_actor_reuse(ray_cluster):
         dag = a.add.bind(a.add.bind(inp))
     with pytest.raises(ValueError, match="one node per actor"):
         dag.experimental_compile()
+
+
+def test_allreduce_collective_node(ray_cluster):
+    """A collective node reduces N actors' outputs inside the compiled
+    graph (reference dag/collective_node.py): the hidden reducer actor is
+    wired into the channel graph and torn down with the DAG."""
+    import numpy as np
+
+    from ray_tpu.dag import collective
+
+    @ray_tpu.remote
+    class Shard:
+        def __init__(self, scale):
+            self.scale = scale
+
+        def grad(self, x):
+            return np.asarray(x, dtype=np.float64) * self.scale
+
+    shards = [Shard.remote(s) for s in (1.0, 2.0, 3.0)]
+    with InputNode() as inp:
+        partials = [s.grad.bind(inp) for s in shards]
+        dag = collective.allreduce.bind(partials, op="mean")
+    compiled = dag.experimental_compile()
+    try:
+        out = compiled.execute([1.0, 2.0])
+        np.testing.assert_allclose(out, [2.0, 4.0])  # mean of 1x,2x,3x
+        out = compiled.execute([3.0, 0.0])
+        np.testing.assert_allclose(out, [6.0, 0.0])
+    finally:
+        compiled.teardown()
