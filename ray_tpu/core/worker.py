@@ -1391,7 +1391,10 @@ class CoreWorker:
                 continue
             cursor = reply.get("cursor", cursor)
             if reply.get("triggered"):
-                gc.collect()
+                # NEVER collect on the io loop thread: finalizers (e.g.
+                # CompiledDAG.__del__ → teardown) may run_sync back onto
+                # this very loop — a guaranteed self-deadlock.
+                await asyncio.get_running_loop().run_in_executor(None, gc.collect)
 
     async def _borrow_hold_sweeper(self) -> None:
         """Failsafe: drop return-holds whose caller never registered (it

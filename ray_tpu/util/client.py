@@ -42,7 +42,9 @@ class ClientServer:
         # Per-client object registries: client ref id -> real ObjectRef
         # (dropping a client drops its refs).
         self._refs: dict[str, dict[str, ObjectRef]] = {}
-        self._actors: dict[str, Any] = {}  # actor id hex -> handle
+        # Actors each client session OWNS (non-detached, unnamed): killed
+        # on disconnect, like handle-GC in a local driver.
+        self._client_actors: dict[str, list[bytes]] = {}
         self._lock = threading.Lock()
         self._io.run_sync(self._server.start())
         self.address = self._server.address
@@ -148,6 +150,9 @@ class ClientServer:
                 None, lambda: self._worker.create_actor(cls, args, kwargs, **opts))
         except Exception as e:
             return {"error": cloudpickle.dumps(e)}
+        if not opts.get("detached") and not opts.get("name"):
+            with self._lock:
+                self._client_actors.setdefault(p["client_id"], []).append(actor_id)
         return {"actor_id": actor_id.hex()}
 
     async def handle_ClientActorCall(self, p: dict) -> dict:
@@ -181,6 +186,14 @@ class ClientServer:
     async def handle_ClientDisconnect(self, p: dict) -> dict:
         with self._lock:
             self._refs.pop(p["client_id"], None)
+            actors = self._client_actors.pop(p["client_id"], [])
+        for actor_id in actors:
+            # Session-owned actors die with the session (the handle-GC
+            # semantics a local driver would have given them).
+            try:
+                self._worker.kill_actor(actor_id)
+            except Exception:
+                pass
         return {}
 
 

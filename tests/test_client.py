@@ -91,3 +91,9 @@ def test_client_mode_end_to_end():
         assert ray_tpu.get(reg.add.remote("post"), timeout=60) == 3
     finally:
         server.stop()
+        # detached actors outlive handles: kill explicitly or the held CPU
+        # starves every later test in the shared cluster
+        try:
+            ray_tpu.kill(reg)
+        except Exception:
+            pass
