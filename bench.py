@@ -227,7 +227,10 @@ def run_serve_bench() -> dict:
             "runtime_env": {"env_vars": {"JAX_PLATFORMS": None}},
         },
     )
-    serve.run(app, name="llm-bench")
+    # Generous health window: the replica inits 1B params + compiles on
+    # the chip (~40s), and the chip may still be releasing from the train
+    # bench that ran moments earlier.
+    serve.run(app, name="llm-bench", timeout_s=600.0)
     addr = serve.http_address()
 
     def one_request(prompt: str, timeout: float = 600.0):

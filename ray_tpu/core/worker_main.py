@@ -49,7 +49,17 @@ def main() -> None:
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
-    stop.wait()
+    # Orphan watch: workers are direct children of their raylet. If the
+    # raylet dies without a graceful stop (driver crash, kill -9), the
+    # worker is reparented (PPID changes) — exit instead of idling forever
+    # holding memory, sockets, and possibly the TPU tunnel (reference:
+    # workers exit on raylet socket close).
+    import os as _os
+
+    parent = _os.getppid()
+    while not stop.wait(timeout=2.0):
+        if _os.getppid() != parent:
+            break
 
 
 if __name__ == "__main__":

@@ -87,9 +87,11 @@ class Application:
 
 
 def deployment(_func_or_class: Any = None, **kwargs) -> Any:
-    """@serve.deployment decorator. Reference: serve/api.py."""
+    """@serve.deployment decorator / factory. Reference: serve/api.py.
+    Both forms carry their options: ``@serve.deployment(num_replicas=2)``
+    and ``serve.deployment(Cls, num_replicas=2)``."""
     if _func_or_class is not None:
-        return Deployment(_func_or_class)
+        return Deployment(_func_or_class, **kwargs)
 
     def wrap(fc):
         return Deployment(fc, **kwargs)
