@@ -10,11 +10,13 @@ above ``min_workers`` are terminated after a timeout.
 """
 
 from .autoscaler import Autoscaler, NodeTypeConfig
+from .gce import GceTpuNodeProvider
 from .node_provider import LocalNodeProvider, NodeProvider
 from .sdk import request_resources
 
 __all__ = [
     "Autoscaler",
+    "GceTpuNodeProvider",
     "NodeTypeConfig",
     "NodeProvider",
     "LocalNodeProvider",

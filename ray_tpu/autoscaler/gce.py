@@ -1,0 +1,196 @@
+"""GCE TPU-pod NodeProvider: autoscaling real TPU VM slices.
+
+Equivalent of the reference's GCP provider
+(``python/ray/autoscaler/_private/gcp/node_provider.py``) specialized
+for TPU VMs (the reference's ``tpu.py`` accelerator path): nodes are TPU
+VM slices created through the Cloud TPU REST API
+(``tpu.googleapis.com/v2``), authenticated with the instance metadata
+server's service-account token, and bootstrapped into the cluster via a
+startup script that starts a raylet pointed at the head GCS.
+
+Design notes:
+  * Each "node" is an atomic SLICE (``accelerator_type`` like
+    ``v5litepod-16``) — the TPU scheduling unit, matching the slice-head
+    resource scheme the raylet advertises.
+  * The HTTP transport is injectable: production uses urllib against the
+    live APIs; tests drive the full provider + reconciler against a fake
+    transport (this environment has zero egress, so live calls are also
+    cleanly gated with an actionable error).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable
+
+from .node_provider import NodeProvider
+
+_METADATA_TOKEN_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/"
+    "service-accounts/default/token"
+)
+
+
+class GceTransport:
+    """Live transport: metadata-server auth + TPU REST calls."""
+
+    def __init__(self, timeout_s: float = 30.0):
+        self._timeout = timeout_s
+        self._token: str | None = None
+        self._token_expiry = 0.0
+
+    def _auth_token(self) -> str:
+        if self._token and time.time() < self._token_expiry - 60:
+            return self._token
+        req = urllib.request.Request(
+            _METADATA_TOKEN_URL, headers={"Metadata-Flavor": "Google"})
+        try:
+            with urllib.request.urlopen(req, timeout=5.0) as resp:
+                blob = json.loads(resp.read())
+        except (urllib.error.URLError, OSError) as e:
+            raise RuntimeError(
+                "GceTpuNodeProvider needs the GCE metadata server (run on a "
+                "GCE VM with a service account, or inject a transport): "
+                f"{e}") from e
+        self._token = blob["access_token"]
+        self._token_expiry = time.time() + blob.get("expires_in", 3600)
+        return self._token
+
+    def request(self, method: str, url: str, body: dict | None = None) -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method, headers={
+            "Authorization": f"Bearer {self._auth_token()}",
+            "Content-Type": "application/json",
+        })
+        with urllib.request.urlopen(req, timeout=self._timeout) as resp:
+            payload = resp.read()
+        return json.loads(payload) if payload else {}
+
+
+class GceTpuNodeProvider(NodeProvider):
+    """TPU VM slices as autoscaler nodes (reference gcp/node_provider.py
+    + _private/accelerators/tpu.py provisioning path)."""
+
+    API = "https://tpu.googleapis.com/v2"
+
+    def __init__(
+        self,
+        project: str,
+        zone: str,
+        *,
+        gcs_address: str,
+        runtime_version: str = "tpu-ubuntu2204-base",
+        node_types: dict[str, dict] | None = None,
+        cluster_name: str = "raytpu",
+        transport: Any = None,
+        startup_script: str | None = None,
+    ):
+        """``node_types``: name -> {"accelerator_type": "v5litepod-16",
+        "resources": {...}} (the shapes the reconciler may request)."""
+        self.project = project
+        self.zone = zone
+        self.gcs_address = gcs_address
+        self.runtime_version = runtime_version
+        self.node_types = node_types or {}
+        self.cluster_name = cluster_name
+        self.transport = transport or GceTransport()
+        self._startup = startup_script
+        self._lock = threading.Lock()
+        self._instances: dict[str, dict] = {}  # instance_id -> {type, state}
+        self._counter = 0
+
+    # ------------------------------------------------------------- helpers
+    def _parent(self) -> str:
+        return f"projects/{self.project}/locations/{self.zone}"
+
+    def _node_url(self, instance_id: str) -> str:
+        return f"{self.API}/{self._parent()}/nodes/{instance_id}"
+
+    def _startup_script(self) -> str:
+        if self._startup is not None:
+            return self._startup
+        # Every host of the slice starts a raylet joined to the head GCS;
+        # the TPU accelerator manager advertises chips + the slice-head
+        # resource so slice-atomic scheduling works (tpu.py).
+        return (
+            "#! /bin/bash\n"
+            f"python -m ray_tpu.cli start --address={self.gcs_address} "
+            "--num-cpus=$(nproc)\n"
+        )
+
+    # ------------------------------------------------------ NodeProvider API
+    def create_node(self, node_type: str, resources: dict) -> str:
+        spec = self.node_types.get(node_type)
+        if spec is None:
+            raise ValueError(f"unknown node_type {node_type!r} "
+                             f"(configured: {list(self.node_types)})")
+        with self._lock:
+            self._counter += 1
+            instance_id = f"{self.cluster_name}-{node_type}-{self._counter}"
+            self._instances[instance_id] = {"type": node_type, "state": "CREATING"}
+        body = {
+            "acceleratorType": spec["accelerator_type"],
+            "runtimeVersion": spec.get("runtime_version", self.runtime_version),
+            "networkConfig": {"enableExternalIps": False},
+            "metadata": {"startup-script": self._startup_script()},
+            "labels": {"raytpu-cluster": self.cluster_name,
+                       "raytpu-node-type": node_type},
+        }
+        try:
+            self.transport.request(
+                "POST",
+                f"{self.API}/{self._parent()}/nodes?nodeId={instance_id}",
+                body,
+            )
+        except Exception:
+            with self._lock:
+                self._instances.pop(instance_id, None)
+            raise
+        return instance_id
+
+    def terminate_node(self, instance_id: str) -> None:
+        with self._lock:
+            inst = self._instances.pop(instance_id, None)
+        if inst is None:
+            return
+        try:
+            self.transport.request("DELETE", self._node_url(instance_id))
+        except Exception:
+            with self._lock:  # keep tracking: the VM still exists
+                self._instances[instance_id] = inst
+            raise
+
+    def non_terminated_nodes(self) -> dict[str, str]:
+        # Reconcile against the API (nodes can die outside our control).
+        try:
+            listing = self.transport.request(
+                "GET", f"{self.API}/{self._parent()}/nodes")
+        except Exception:
+            with self._lock:  # API hiccup: serve the cached view
+                return {i: v["type"] for i, v in self._instances.items()}
+        live: dict[str, str] = {}
+        with self._lock:
+            for node in listing.get("nodes", []):
+                labels = node.get("labels") or {}
+                if labels.get("raytpu-cluster") != self.cluster_name:
+                    continue
+                if node.get("state") in ("DELETING", "TERMINATED", "PREEMPTED"):
+                    continue
+                iid = node["name"].rsplit("/", 1)[-1]
+                live[iid] = labels.get("raytpu-node-type", "unknown")
+                self._instances.setdefault(
+                    iid, {"type": live[iid], "state": node.get("state", "")})
+            for iid in list(self._instances):
+                if iid not in live:
+                    self._instances.pop(iid)
+        return live
+
+    def node_id_of(self, instance_id: str) -> str | None:
+        # The raylet started by the startup script registers itself with
+        # the GCS; mapping instance -> cluster node id happens there (the
+        # reconciler matches by pending-launch expiry, not identity).
+        return None
