@@ -137,11 +137,29 @@ class InferenceEngine:
         prefill_chunk_size: int = 128,
         decode_steps_per_dispatch: int = 8,
         enable_prefix_cache: bool = True,
+        mesh=None,
         seed: int = 0,
     ):
         self.config = PRESETS[config] if isinstance(config, str) else config
         if params is None:
             params = init_params(self.config, jax.random.PRNGKey(seed))
+        self.mesh = mesh
+        if mesh is not None:
+            # Tensor-parallel inference: params shard by the model's
+            # logical axes (heads/kv_heads/mlp -> tp) and the page pool by
+            # kv_heads; the SAME jitted programs then run SPMD — XLA
+            # inserts the collectives (the multi-chip path the reference
+            # gets from vLLM's TP workers). Requires n_kv_heads % tp == 0.
+            from ..models.llama import param_axes
+            from ..parallel.sharding import logical_sharding, shard_params
+
+            tp = mesh.shape.get("tp", 1)
+            if self.config.n_kv_heads % tp:
+                raise ValueError(
+                    f"n_kv_heads={self.config.n_kv_heads} not divisible by tp={tp}")
+            params = shard_params(params, param_axes(self.config), mesh)
+            self._pages_sharding = logical_sharding(
+                mesh, ("layers", None, "kv_heads", None, "head_dim"))
         self.params = params
         self.max_slots = max_slots
         self.page_size = page_size
@@ -161,6 +179,9 @@ class InferenceEngine:
         usable = num_pages if num_pages is not None else max_slots * self.max_pages_per_seq
         self.num_pages = max_slots + usable
         self.pages = init_pages(self.config, self.num_pages, page_size)
+        if mesh is not None:
+            self.pages = jax.device_put(
+                self.pages, {"k": self._pages_sharding, "v": self._pages_sharding})
         self.allocator = PageAllocator(self.num_pages)
         # Trash pages 0..max_slots-1 are permanently owned by their slot.
         for s in range(max_slots):

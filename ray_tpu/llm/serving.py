@@ -37,13 +37,27 @@ class LLMDeployment:
         page_size: int = 16,
         prefill_chunk_size: int = 64,
         decode_steps_per_dispatch: int = 8,
+        tensor_parallel: int = 1,
         seed: int = 0,
         request_timeout_s: float = 300.0,
     ):
+        mesh = None
+        if tensor_parallel > 1:
+            # Shard the engine across this replica's visible chips (e.g.
+            # the 4/8 chips of a TPU host); XLA runs the same programs
+            # SPMD with collectives over ICI.
+            import jax
+
+            from ..parallel import MeshConfig, create_mesh
+
+            n = len(jax.devices())
+            mesh = create_mesh(MeshConfig(
+                tp=tensor_parallel, dp=max(1, n // tensor_parallel)))
         self.engine = InferenceEngine(
             preset, max_slots=max_slots, max_len=max_len, page_size=page_size,
             prefill_chunk_size=prefill_chunk_size,
-            decode_steps_per_dispatch=decode_steps_per_dispatch, seed=seed,
+            decode_steps_per_dispatch=decode_steps_per_dispatch, mesh=mesh,
+            seed=seed,
         )
         self.model_id = model_id or (preset if isinstance(preset, str) else "custom")
         self.tokenizer = ByteTokenizer()
@@ -287,7 +301,7 @@ def _render_chat(messages: list) -> str:
 def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
                   max_slots: int = 8, max_len: int = 256,
                   page_size: int = 16, prefill_chunk_size: int = 64,
-                  decode_steps_per_dispatch: int = 8,
+                  decode_steps_per_dispatch: int = 8, tensor_parallel: int = 1,
                   max_ongoing_requests: int = 32, model_id: str | None = None,
                   ray_actor_options: dict | None = None):
     """Build a Serve Application serving ``preset`` (serve.run-able).
@@ -303,4 +317,5 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
     )
     return dep.bind(preset, model_id=model_id, max_slots=max_slots, max_len=max_len,
                     page_size=page_size, prefill_chunk_size=prefill_chunk_size,
-                    decode_steps_per_dispatch=decode_steps_per_dispatch)
+                    decode_steps_per_dispatch=decode_steps_per_dispatch,
+                    tensor_parallel=tensor_parallel)

@@ -322,3 +322,26 @@ def test_batch_llm_processor(ray_cluster):
     for i, w in enumerate(["alpha", "beta", "gamma", "delta", "epsilon", "zeta"]):
         assert by_word[w]["n"] == 4 + (i % 3)  # per-row max_tokens honored
         assert isinstance(by_word[w]["text"], str)
+
+
+def test_tensor_parallel_engine_parity(small_model):
+    """The engine sharded over a tp mesh (params by heads/kv_heads, pages
+    by kv_heads; XLA inserts the collectives) decodes token-identically
+    to the single-device engine — the multi-chip inference path the
+    reference gets from vLLM's TP workers."""
+    from ray_tpu.parallel import MeshConfig, create_mesh
+
+    cfg, params = small_model
+    prompt = list(range(1, 22))
+    ref = InferenceEngine(cfg, params, max_slots=2, max_len=64, page_size=8)
+    expected = ref.generate(list(prompt), max_new_tokens=6)
+
+    n = len(jax.devices())
+    mesh = create_mesh(MeshConfig(tp=2, dp=max(1, n // 2)))
+    tp_eng = InferenceEngine(cfg, params, max_slots=2, max_len=64, page_size=8,
+                             mesh=mesh)
+    assert tp_eng.generate(list(prompt), max_new_tokens=6) == expected
+
+    with pytest.raises(ValueError, match="not divisible"):
+        InferenceEngine(cfg, params, mesh=create_mesh(MeshConfig(tp=8, dp=max(1, n // 8))),
+                        max_slots=2, max_len=64, page_size=8)
