@@ -76,6 +76,9 @@ class GceTpuNodeProvider(NodeProvider):
     + _private/accelerators/tpu.py provisioning path)."""
 
     API = "https://tpu.googleapis.com/v2"
+    # How long a just-created node may be absent from the (eventually
+    # consistent) list API before we conclude it never materialized.
+    CREATE_GRACE_S = 300.0
 
     def __init__(
         self,
@@ -131,7 +134,8 @@ class GceTpuNodeProvider(NodeProvider):
         with self._lock:
             self._counter += 1
             instance_id = f"{self.cluster_name}-{node_type}-{self._counter}"
-            self._instances[instance_id] = {"type": node_type, "state": "CREATING"}
+            self._instances[instance_id] = {
+                "type": node_type, "state": "CREATING", "created_at": time.time()}
         body = {
             "acceleratorType": spec["accelerator_type"],
             "runtimeVersion": spec.get("runtime_version", self.runtime_version),
@@ -173,20 +177,43 @@ class GceTpuNodeProvider(NodeProvider):
             with self._lock:  # API hiccup: serve the cached view
                 return {i: v["type"] for i, v in self._instances.items()}
         live: dict[str, str] = {}
+        listed: set[str] = set()
         with self._lock:
             for node in listing.get("nodes", []):
                 labels = node.get("labels") or {}
                 if labels.get("raytpu-cluster") != self.cluster_name:
                     continue
+                iid = node["name"].rsplit("/", 1)[-1]
+                listed.add(iid)
                 if node.get("state") in ("DELETING", "TERMINATED", "PREEMPTED"):
                     continue
-                iid = node["name"].rsplit("/", 1)[-1]
                 live[iid] = labels.get("raytpu-node-type", "unknown")
-                self._instances.setdefault(
+                entry = self._instances.setdefault(
                     iid, {"type": live[iid], "state": node.get("state", "")})
+                # Track the observed state, but keep created_at until grace
+                # expiry: a still-CREATING node can flap back OUT of an
+                # eventually-consistent listing, and pruning it then would
+                # re-enable the double-create.
+                entry["state"] = node.get("state", entry.get("state", ""))
             for iid in list(self._instances):
-                if iid not in live:
-                    self._instances.pop(iid)
+                if iid in live:
+                    continue
+                # The TPU list API is eventually consistent: a node we just
+                # created (CREATING, not yet visible in the listing) must
+                # not be pruned, or the reconciler under-counts pending
+                # nodes and double-creates the slice. Keep it — and report
+                # it live — until it shows up in a listing (any state) or
+                # exceeds a creation grace period. A node LISTED in a
+                # terminal state is genuinely gone and is pruned.
+                inst = self._instances[iid]
+                created_at = inst.get("created_at")
+                if (iid not in listed
+                        and inst.get("state") == "CREATING"
+                        and created_at is not None
+                        and time.time() - created_at < self.CREATE_GRACE_S):
+                    live[iid] = inst["type"]
+                    continue
+                self._instances.pop(iid)
         return live
 
     def node_id_of(self, instance_id: str) -> str | None:

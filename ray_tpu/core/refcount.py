@@ -143,6 +143,27 @@ class ReferenceCounter:
                 child_ref.contained_in = max(0, child_ref.contained_in - 1)
                 self._maybe_free(child, child_ref, freed_ids, freed)
 
+    def drop(self, oid: ObjectID) -> None:
+        """Forget an object outright, without invoking the free callback for
+        it (the caller already disposed of the value). Used by the owner for
+        stream items the consumer never materialized a ref for — their
+        ``add_owned_object`` bookkeeping would otherwise persist forever.
+        Containment edges are still released (children may free normally)."""
+        freed_ids: list[ObjectID] = []
+        freed: list[_Ref] = []
+        with self._lock:
+            ref = self._refs.pop(oid, None)
+            if ref is None:
+                return
+            for child in ref.contains:
+                child_ref = self._refs.get(child)
+                if child_ref is not None:
+                    child_ref.contained_in = max(0, child_ref.contained_in - 1)
+                    self._maybe_free(child, child_ref, freed_ids, freed)
+        for oid_, ref_ in zip(freed_ids, freed):
+            if self._on_object_freed is not None:
+                self._on_object_freed(oid_, ref_)
+
     # -- locations -----------------------------------------------------------
     def add_location(self, oid: ObjectID, node_id: bytes) -> None:
         with self._lock:

@@ -269,8 +269,15 @@ class CoreWorker:
             max_workers=get_config().worker_executor_threads,
             thread_name_prefix="raytpu-exec"))
 
-        # RPC server for owner + executor duties.
-        self.server = RpcServer("127.0.0.1", 0)
+        # RPC server for owner + executor duties. Bind to the node's
+        # routable interface (the host our raylet registered with the GCS)
+        # so the advertised worker address — and everything derived from
+        # it, e.g. cross-node DAG channel servers — is reachable from
+        # other hosts, not just loopback.
+        node_host = self.raylet_address.rpartition(":")[0]
+        if node_host in ("", "localhost"):
+            node_host = "127.0.0.1"
+        self.server = RpcServer(node_host, 0)
         self.server.register_service(self)
         # Task-event buffer: status timestamps flushed to the GCS on an
         # interval (task_event_buffer.h:224; powers list_tasks + timeline).
@@ -561,10 +568,14 @@ class CoreWorker:
         io, raylet = self.io, self.raylet
 
         def _release():
+            coro = raylet.call("PlasmaRelease", {"id": binary, "reader": reader}, 10.0)
             try:
-                io.run_coro(raylet.call("PlasmaRelease", {"id": binary, "reader": reader}, 10.0))
+                io.run_coro(coro)
             except Exception:
-                pass  # shutdown: the raylet reaps reader refs with the worker
+                # Shutdown: the raylet reaps reader refs with the worker.
+                # Close the never-scheduled coroutine so teardown doesn't
+                # warn "coroutine was never awaited".
+                coro.close()
 
         return _release
 
@@ -1306,9 +1317,12 @@ class CoreWorker:
         tid = TaskID(task_id)
         for i in range(consumed, num_items):
             # Unconsumed items never got a consumer-side ObjectRef, so the
-            # refcounter will not free them — drop the store entries here
-            # (plasma copies fall to LRU eviction).
-            self.memory_store.delete(ObjectID.for_task_return(tid, i + 1))
+            # refcounter will not free them — drop the store entries AND the
+            # owned-object refcounter bookkeeping here (plasma copies fall
+            # to LRU eviction).
+            rid = ObjectID.for_task_return(tid, i + 1)
+            self.memory_store.delete(rid)
+            self.refcounter.drop(rid)
 
     async def handle_ReportGeneratorItem(self, p: dict) -> dict:
         """Executor reports one yielded item (or stream end/error) for a
@@ -1334,6 +1348,14 @@ class CoreWorker:
         self.refcounter.add_owned_object(rid)
         self._store_return_item(rid, p["item"])
         stream.report_item(index)
+        if self._streams.get(task_id) is not stream:
+            # Raced with release_stream(): the consumer abandoned the stream
+            # after we fetched it but before we stored this item, so the
+            # release's drop loop (bounded by its num_items snapshot) missed
+            # it. Clean up here — delete/drop are idempotent — and cancel.
+            self.memory_store.delete(rid)
+            self.refcounter.drop(rid)
+            return {"consumed": index + 1, "cancel": True}
         return {"consumed": stream.consumed}
 
     async def handle_WaitGeneratorConsumed(self, p: dict) -> dict:

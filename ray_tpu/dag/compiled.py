@@ -59,16 +59,21 @@ def _probe_node(instance) -> str:
     return global_worker().node_id
 
 
+def _routable_host() -> str:
+    """This process's routable host, derived from the worker address (which
+    tracks the raylet's registered interface, not loopback)."""
+    from ..core.worker import global_worker
+
+    return global_worker().address.rpartition(":")[0] or "127.0.0.1"
+
+
 def _create_out_server(instance) -> str:
     """Phase-1 for a cross-node producer: create the TCP channel server in
     the actor process (stashed on the instance for the phase-2 loop) and
     return its address."""
-    from ..core.worker import global_worker
-
     from .channel import TcpChannelServer
 
-    host = global_worker().address.rpartition(":")[0] or "127.0.0.1"
-    server = TcpChannelServer(advertise=host)
+    server = TcpChannelServer(advertise=_routable_host())
     instance.__dict__["_dag_out_server"] = server
     return server.address
 
@@ -212,7 +217,10 @@ class CompiledDAG:
                 continue
             self._cross_node.add(id(node))
             if node is self._input_node:
-                self._input_server = TcpChannelServer()
+                # Advertise the driver's routable node host so consumer
+                # actors on other hosts connect back to the driver rather
+                # than their own loopback.
+                self._input_server = TcpChannelServer(advertise=_routable_host())
                 self._channels[id(node)] = ("tcp", self._input_server.address)
             else:
                 # Phase 1: the producing actor creates its server NOW so

@@ -104,7 +104,11 @@ def _exchange_map_task(kind: str, num_out: int, spec: dict, map_index: int, bloc
     """Partition one upstream block into ``num_out`` parts (the map half
     of the exchange; reference ``exchange/shuffle_task_spec.py``)."""
     n = block.num_rows
-    if kind == "shuffle":
+    if n == 0:
+        # A schema-less empty block (e.g. from_items([])) has no key
+        # column to hash/range on — emit empty parts directly.
+        assign = np.zeros(0, dtype=np.int64)
+    elif kind == "shuffle":
         rng = np.random.default_rng((spec.get("seed") or 0) + map_index * 7919)
         assign = rng.integers(0, num_out, n)
     elif kind == "repartition":
@@ -119,7 +123,7 @@ def _exchange_map_task(kind: str, num_out: int, spec: dict, map_index: int, bloc
     parts = []
     for i in range(num_out):
         part = block.take(np.nonzero(assign == i)[0])
-        if kind == "groupby" and spec.get("aggs"):
+        if kind == "groupby" and spec.get("aggs") and part.schema.names:
             part = _partial_aggregate(part, spec)  # map-side combine
         parts.append(part)
     return tuple(parts) if num_out > 1 else parts[0]
@@ -157,7 +161,15 @@ def _exchange_reduce_task(kind: str, spec: dict, part_index: int, n_left: int, *
     For joins, ``parts[:n_left]`` are the left side's pieces and the rest
     the right side's (same hash partition on both)."""
     left_parts = list(parts[:n_left])
+    right_parts = list(parts[n_left:])
+    if not left_parts:
+        # Join whose left upstream produced zero blocks (n_left == 0): an
+        # empty placeholder; the join branch below synthesizes the key-only
+        # empty left table (left-only columns are unknowable and absent).
+        left_parts = [_concat_keep_schema(right_parts).slice(0, 0).select([])]
     merged = _concat_keep_schema(left_parts)
+    if merged.num_rows == 0 and not merged.schema.names and kind != "join":
+        return merged  # schema-less empty partition: nothing to sort/merge
     if kind == "shuffle":
         rng = np.random.default_rng((spec.get("seed") or 0) ^ (part_index + 1))
         return merged.take(rng.permutation(merged.num_rows))
@@ -167,8 +179,20 @@ def _exchange_reduce_task(kind: str, spec: dict, part_index: int, n_left: int, *
     if kind == "groupby":
         return _final_aggregate(merged, spec)
     if kind == "join":
-        right_parts = list(parts[n_left:]) or [merged.slice(0, 0)]
-        right = _concat_keep_schema(right_parts)
+        right = _concat_keep_schema(right_parts or [merged.slice(0, 0)])
+        # A side fed only schema-less empty blocks lacks the key columns;
+        # substitute a key-only empty table so the join stays executable.
+        keys = spec["key"] if isinstance(spec["key"], list) else [spec["key"]]
+
+        def _has_keys(t):
+            return set(keys) <= set(t.schema.names)
+
+        if merged.num_rows == 0 and not _has_keys(merged):
+            if not _has_keys(right):
+                return merged  # both sides schema-less empty
+            merged = right.select(keys).slice(0, 0)
+        if right.num_rows == 0 and not _has_keys(right):
+            right = merged.select(keys).slice(0, 0)
         return merged.join(right, keys=spec["key"], join_type=spec.get("join_type", "inner"))
     return merged  # repartition
 
@@ -438,9 +462,18 @@ class ExchangePhysicalOp(PhysicalOp):
                 and not any(k[0] == "sample" for k in self._internal.values())
                 and not self._boundaries_ready):
             # All samples in: compute range boundaries on the driver.
+            # Order statistics (sort + index at quantile positions) rather
+            # than np.quantile, so string and other non-numeric but
+            # comparable sort keys partition correctly too.
             vals = np.concatenate(self._samples) if self._samples else np.array([0.0])
-            qs = [(i + 1) / self._num_out for i in range(self._num_out - 1)]
-            self._spec["boundaries"] = [float(v) for v in np.quantile(vals, qs)]
+            if vals.size == 0:  # blocks existed but every one was empty
+                vals = np.array([0.0])
+            vals = np.sort(vals)
+            last = len(vals) - 1
+            self._spec["boundaries"] = [
+                vals[min(last, int(round((i + 1) / self._num_out * last)))]
+                for i in range(self._num_out - 1)
+            ]
             self._boundaries_ready = True
             # blocks return to the map queue
             self.input_queue = self._pending_sample + self.input_queue

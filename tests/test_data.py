@@ -238,3 +238,28 @@ def test_sort_exchange_range_partitioned(ray_cluster):
     assert len(refs) > 1
     out = [r["v"] for r in ds.iter_rows()]
     assert out == sorted(vals)
+
+
+def test_sort_string_keys(ray_cluster):
+    """Range boundaries come from order statistics, so non-numeric (string)
+    sort keys partition correctly (regression: np.quantile TypeError)."""
+    import random
+
+    words = [f"w{i:03d}" for i in builtins.range(120)]
+    shuffled = list(words)
+    random.Random(11).shuffle(shuffled)
+    ds = rd.from_items([{"s": w} for w in shuffled], parallelism=5).sort("s")
+    out = [r["s"] for r in ds.iter_rows()]
+    assert out == sorted(words)
+    out_desc = [r["s"] for r in rd.from_items(
+        [{"s": w} for w in shuffled], parallelism=5).sort("s", descending=True).iter_rows()]
+    assert out_desc == sorted(words, reverse=True)
+
+
+def test_join_empty_left_side(ray_cluster):
+    """A join whose left upstream produced zero blocks must not crash the
+    reduce tasks (regression: _concat_keep_schema IndexError)."""
+    left = rd.from_items([], parallelism=1)
+    right = rd.from_items([{"id": i, "b": i} for i in builtins.range(6)], parallelism=2)
+    out = left.join(right, on="id").take_all()
+    assert out == []

@@ -127,3 +127,38 @@ def test_reconciler_launches_tpu_slices_for_demand():
     # pending launches count as capacity: a second pass must not relaunch
     decision2 = scaler.reconcile_once()
     assert decision2.launch == []
+
+
+class LaggyTransport(FakeTransport):
+    """Create succeeds but the node does not appear in listings yet
+    (the TPU list API is eventually consistent)."""
+
+    def __init__(self):
+        super().__init__()
+        self.visible = False
+
+    def request(self, method, url, body=None):
+        if method == "GET" and not self.visible:
+            self.calls.append((method, url, body))
+            return {"nodes": []}
+        return super().request(method, url, body)
+
+
+def test_creating_node_survives_listing_lag():
+    """A just-created node missing from the eventually-consistent list API
+    stays tracked (and counts as live) until it appears or the grace
+    period expires — pruning it would double-create the slice."""
+    t = LaggyTransport()
+    p = make_provider(t)
+    iid = p.create_node("v5e-16", {})
+    # Listing lags: node must still be reported, not pruned.
+    assert p.non_terminated_nodes() == {iid: "v5e-16"}
+    assert p.non_terminated_nodes() == {iid: "v5e-16"}
+    # Node becomes visible: tracked normally from now on.
+    t.visible = True
+    assert p.non_terminated_nodes() == {iid: "v5e-16"}
+    # Grace expired + still absent => pruned.
+    t.visible = False
+    p._instances[iid]["state"] = "CREATING"
+    p._instances[iid]["created_at"] = 0.0
+    assert p.non_terminated_nodes() == {}
