@@ -61,6 +61,11 @@ class RayTpuConfig:
     worker_register_timeout_s: float = 30.0
     idle_worker_killing_time_threshold_ms: int = 1000
     maximum_startup_concurrency: int = 4
+    # Device-release fence: how long to wait for a TPU-holding worker
+    # process to exit (after SIGTERM, then SIGKILL) before re-granting the
+    # TPU resource anyway. The libtpu device lock is exclusive per process
+    # and only the kernel releases it, on process death.
+    tpu_release_fence_timeout_s: float = 30.0
 
     # --- fault tolerance -----------------------------------------------------
     task_max_retries: int = 3

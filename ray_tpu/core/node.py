@@ -73,7 +73,7 @@ class Node:
 
     def shutdown(self) -> None:
         try:
-            self.services_loop.run_sync(self.raylet.stop(), timeout=10)
+            self.services_loop.run_sync(self.raylet.stop(), timeout=30)
         except Exception:
             pass
         if self.gcs is not None:
@@ -82,3 +82,51 @@ class Node:
             except Exception:
                 pass
         self.services_loop.stop()
+        _reap_worker_children(self.raylet.node_id.hex())
+
+
+def _reap_worker_children(node_id_hex: str, deadline_s: float = 10.0) -> None:
+    """Last-ditch sweep after raylet.stop: kill any ``worker_main`` children
+    of this process THAT BELONG TO THIS NODE (matched by the ``--node-id``
+    argument every worker is spawned with) and survived stop() — e.g. stuck
+    in a device call with SIGTERM pending. A TPU worker that outlives its
+    cluster keeps the exclusive libtpu lock and crash-loops whatever claims
+    the chip next — the next ``init()`` in this same driver process (bench
+    phases, test suites) must start from a clean slate. Workers of OTHER
+    in-process raylets (the Cluster harness) are left alone."""
+    import signal
+
+    me = os.getpid()
+    victims: list[int] = []
+    try:
+        entries = os.listdir("/proc")
+    except OSError:
+        return
+    for pid_dir in entries:
+        if not pid_dir.isdigit():
+            continue
+        pid = int(pid_dir)
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().decode(errors="replace")
+            with open(f"/proc/{pid}/stat") as f:
+                ppid = int(f.read().rsplit(")", 1)[-1].split()[1])
+        except (OSError, IndexError, ValueError):
+            continue
+        if ppid == me and "worker_main" in cmd and node_id_hex in cmd:
+            victims.append(pid)
+    for pid in victims:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+    deadline = time.monotonic() + deadline_s
+    for pid in victims:
+        while time.monotonic() < deadline:
+            try:
+                done, _ = os.waitpid(pid, os.WNOHANG)
+            except (ChildProcessError, OSError):
+                break
+            if done == pid:
+                break
+            time.sleep(0.05)

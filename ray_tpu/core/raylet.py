@@ -184,12 +184,25 @@ class Raylet:
                     w.proc.kill()
         if graceful:
             await asyncio.sleep(0)
+            deadline = time.monotonic() + 5.0
             for w in self._workers.values():
                 if w.proc is not None:
                     try:
-                        w.proc.wait(timeout=3)
+                        w.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
                     except Exception:
                         w.proc.kill()
+        # Always reap after the kill escalation: a worker that survives
+        # stop() keeps its exclusive libtpu device lock and crash-loops
+        # whatever claims the chip next (serve-after-train handoff).
+        for w in self._workers.values():
+            if w.proc is None:
+                continue
+            if w.proc.poll() is None and not graceful:
+                w.proc.kill()
+            try:
+                w.proc.wait(timeout=2)
+            except Exception:
+                pass
         await self._server.stop(grace=0.5 if graceful else 0.0)
         self.store.close()
 
@@ -307,17 +320,70 @@ class Raylet:
                     still_pending.append(report)
             pending_deaths = still_pending
 
-    def _release_lease(self, w: WorkerHandle) -> None:
+    def _release_lease(self, w: WorkerHandle) -> bool:
+        """Release a worker's lease reservation. Returns True if a TPU
+        device fence was started — the worker is being killed and must NOT
+        go back to the idle pool (its process still holds the exclusive
+        libtpu device lock; the TPU portion of the lease is re-granted only
+        once the process is confirmed dead). Without the fence, the next
+        TPU lease starts a worker that crash-loops on device init while the
+        dying holder drains (the round-3 serve-after-train failure mode)."""
         if w.lease_resources.is_empty():
-            return
-        if w.bundle_key is not None:
-            b = self._pg_bundles.get(w.bundle_key)
-            if b is not None:
-                b["used"] = b["used"].subtract(w.lease_resources, allow_negative=True)
-            w.bundle_key = None
-        else:
-            self.resources.release(w.lease_resources)
+            return False
+        lease, bundle_key = w.lease_resources, w.bundle_key
         w.lease_resources = ResourceSet()
+        w.bundle_key = None
+        tpu = lease.to_dict().get("TPU", 0.0)
+        if tpu > 0 and w.proc is not None and w.proc.poll() is None and _in_loop():
+            tpu_part = ResourceSet({"TPU": tpu})
+            self._release_into(lease.subtract(tpu_part, allow_negative=True), bundle_key)
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+            spawn(self._fenced_tpu_release(w, tpu_part, bundle_key))
+            return True
+        self._release_into(lease, bundle_key)
+        return False
+
+    def _release_into(self, res: ResourceSet, bundle_key: tuple | None) -> None:
+        if res.is_empty():
+            return
+        if bundle_key is not None:
+            b = self._pg_bundles.get(bundle_key)
+            if b is not None:
+                b["used"] = b["used"].subtract(res, allow_negative=True)
+        else:
+            self.resources.release(res)
+
+    async def _fenced_tpu_release(self, w: WorkerHandle, tpu_part: ResourceSet,
+                                  bundle_key: tuple | None) -> None:
+        """Re-grant the TPU resource only after the previous holder's
+        process is gone (SIGTERM already sent; escalate to SIGKILL at half
+        the fence timeout). The kernel drops the libtpu flock on process
+        death, so death == device released."""
+        import functools
+
+        loop = asyncio.get_running_loop()
+        timeout = get_config().tpu_release_fence_timeout_s
+        # Timed Popen.wait INSIDE the executor thread — an untimed wait
+        # abandoned by wait_for would pin the shared executor thread
+        # forever on an unkillable (D-state) worker.
+        try:
+            await loop.run_in_executor(
+                None, functools.partial(w.proc.wait, timeout / 2))
+        except Exception:
+            try:
+                w.proc.kill()
+            except Exception:
+                pass
+            try:
+                await loop.run_in_executor(
+                    None, functools.partial(w.proc.wait, timeout / 2))
+            except Exception:
+                pass  # unkillable (D-state?): re-grant anyway after the fence
+        self._release_into(tpu_part, bundle_key)
+        self._wake_lease_waiters()
 
     def _on_worker_dead(self, w: WorkerHandle) -> None:
         w.state = "dead"
@@ -782,7 +848,12 @@ class Raylet:
         w = self._workers.get(p["worker_id"])
         if w is None or w.state == "dead":
             return {}
-        self._release_lease(w)
+        if self._release_lease(w):
+            # TPU device fence: the worker was killed and must not rejoin
+            # the idle pool; the TPU re-grant happens when it is dead.
+            self._on_worker_dead(w)
+            self._wake_lease_waiters()
+            return {}
         if w.proc is not None and w.proc.poll() is not None:
             self._on_worker_dead(w)
             self._wake_lease_waiters()

@@ -1,0 +1,106 @@
+"""TPU device-release fence.
+
+The libtpu device lock is per-process and exclusive; the kernel releases
+it only on process death. The raylet therefore kills a worker whose lease
+held the ``TPU`` resource and re-grants that resource only once the
+process is confirmed dead — otherwise the next TPU lease (e.g. a serve
+replica starting right after a training job) crash-loops on device init
+while the old holder drains (the round-3 serve-after-train failure).
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture()
+def tpu_cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()  # replace the shared single-node cluster
+    c = Cluster(
+        initialize_head=True,
+        head_node_args={"num_cpus": 2, "resources": {"TPU": 1.0}},
+    )
+    ray_tpu.init(address=c.address, num_cpus=0)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def test_tpu_lease_pipeline_reuses_the_holder_process(tpu_cluster):
+    """Same-shape TPU tasks share a lease pipeline and thus the SAME
+    process — the holder keeps the device; no restart tax per task."""
+
+    @ray_tpu.remote(resources={"TPU": 1.0}, num_cpus=0)
+    def f():
+        return os.getpid()
+
+    pids = {ray_tpu.get(f.remote(), timeout=120) for _ in range(3)}
+    assert len(pids) == 1, f"TPU tasks in one pipeline should share a process, got {pids}"
+
+
+def test_tpu_handoff_waits_for_holder_death(tpu_cluster):
+    """Once a TPU lease is RETURNED, the next grant (here: a different
+    resource shape, so a fresh lease) happens only after the previous
+    holder's process is dead — no crash-looping on a held device lock."""
+
+    @ray_tpu.remote(resources={"TPU": 1.0}, num_cpus=0)
+    def hold():
+        return os.getpid()
+
+    pid1 = ray_tpu.get(hold.remote(), timeout=120)
+
+    @ray_tpu.remote(resources={"TPU": 1.0}, num_cpus=1)
+    def second(prev_pid):
+        try:
+            os.kill(prev_pid, 0)
+            prev_alive = True
+        except OSError:
+            prev_alive = False
+        return os.getpid(), prev_alive
+
+    pid2, prev_alive = ray_tpu.get(second.remote(pid1), timeout=120)
+    assert pid2 != pid1
+    assert not prev_alive, "previous TPU holder was still alive at grant time"
+
+
+def test_tpu_handoff_after_actor_kill(tpu_cluster):
+    """The serve-after-train pattern: a long-lived TPU actor is killed and
+    the next TPU actor starts first-try, after the holder died."""
+
+    @ray_tpu.remote(resources={"TPU": 1.0}, num_cpus=0)
+    class Holder:
+        def pid(self):
+            return os.getpid()
+
+    a = Holder.remote()
+    pid1 = ray_tpu.get(a.pid.remote(), timeout=120)
+    ray_tpu.kill(a)
+
+    b = Holder.remote()
+    pid2 = ray_tpu.get(b.pid.remote(), timeout=120)
+    assert pid2 != pid1
+    assert not _alive(pid1), "killed TPU actor still alive after next grant"
+    ray_tpu.kill(b)
+
+
+def test_non_tpu_workers_still_pooled(tpu_cluster):
+    """The fence is TPU-specific: plain CPU workers keep being reused."""
+
+    @ray_tpu.remote(num_cpus=1)
+    def f():
+        return os.getpid()
+
+    pids = {ray_tpu.get(f.remote(), timeout=120) for _ in range(3)}
+    assert len(pids) == 1, f"CPU workers should be pooled, got {pids}"
