@@ -145,6 +145,10 @@ class Raylet:
         # dies between PlasmaCreate and PlasmaSeal (else the creator ref
         # leaks the arena bytes forever).
         self._creating: dict[bytes, str] = {}
+        # TPU shares behind a device-release fence, per bundle key (None =
+        # node-pool lease): bundle teardown withholds these from its
+        # release; the fence re-grants them when the holder is dead.
+        self._fence_pending: dict[tuple | None, float] = {}
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> None:
@@ -337,6 +341,8 @@ class Raylet:
         if tpu > 0 and w.proc is not None and w.proc.poll() is None and _in_loop():
             tpu_part = ResourceSet({"TPU": tpu})
             self._release_into(lease.subtract(tpu_part, allow_negative=True), bundle_key)
+            self._fence_pending[bundle_key] = (
+                self._fence_pending.get(bundle_key, 0.0) + tpu)
             try:
                 w.proc.terminate()
             except Exception:
@@ -382,7 +388,17 @@ class Raylet:
                     None, functools.partial(w.proc.wait, timeout / 2))
             except Exception:
                 pass  # unkillable (D-state?): re-grant anyway after the fence
-        self._release_into(tpu_part, bundle_key)
+        left = self._fence_pending.get(bundle_key, 0.0) - tpu_part.get("TPU")
+        if left > 0:
+            self._fence_pending[bundle_key] = left
+        else:
+            self._fence_pending.pop(bundle_key, None)
+        if bundle_key is not None and bundle_key not in self._pg_bundles:
+            # The bundle was dropped mid-fence; _drop_bundle withheld our
+            # share from its release, so hand it to the node pool directly.
+            self.resources.release(tpu_part)
+        else:
+            self._release_into(tpu_part, bundle_key)
         self._wake_lease_waiters()
 
     def _on_worker_dead(self, w: WorkerHandle) -> None:
@@ -1308,10 +1324,19 @@ class Raylet:
 
     def _drop_bundle(self, key: tuple) -> None:
         """Release one bundle reservation back to the node pool and admit
-        parked leases (shared by 2PC cancel and heartbeat reconciliation)."""
+        parked leases (shared by 2PC cancel and heartbeat reconciliation).
+        TPU shares still behind a device-release fence (a bundle-leased
+        worker being killed, its process not yet confirmed dead) are
+        WITHHELD here — the fence releases them straight to the node pool
+        when the holder dies, so PG teardown can't re-grant a held chip."""
         b = self._pg_bundles.pop(key, None)
         if b is not None:
-            self.resources.release(b["resources"])
+            res = b["resources"]
+            fenced = self._fence_pending.get(key, 0.0)
+            if fenced > 0:
+                res = res.subtract(ResourceSet({"TPU": min(
+                    fenced, res.get("TPU"))}), allow_negative=True)
+            self.resources.release(res)
             self._wake_lease_waiters()
 
     async def handle_CancelBundle(self, p: dict) -> dict:

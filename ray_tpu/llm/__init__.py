@@ -14,12 +14,18 @@ protocol through Serve.
 
 from .batch import LLMProcessorConfig, Processor, build_llm_processor
 from .engine import InferenceEngine, PageAllocator, Request
+from .executor import LocalEngineExecutor
 from .model import decode_step, init_pages, prefill_chunk
+from .multihost import EngineShardWorker, ShardedEngineExecutor, create_sharded_executor
 from .serving import LLMDeployment, build_llm_app
 from .tokenizer import ByteTokenizer
 
 __all__ = [
     "InferenceEngine",
+    "LocalEngineExecutor",
+    "EngineShardWorker",
+    "ShardedEngineExecutor",
+    "create_sharded_executor",
     "LLMProcessorConfig",
     "Processor",
     "build_llm_processor",

@@ -24,12 +24,10 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
-from ..models.llama import LlamaConfig, PRESETS, init_params
-from .model import decode_loop, init_pages, prefill_chunk, sample_first_batch
+from ..models.llama import LlamaConfig, PRESETS
+from .executor import LocalEngineExecutor
 
 
 @dataclass
@@ -121,9 +119,12 @@ class PageAllocator:
 
 
 class InferenceEngine:
-    """Single-host paged-KV engine; the page pool lives on the default
-    device. ``add_request``/``cancel`` are thread-safe; ``step`` must be
-    called from one driver thread (the serving replica's engine loop)."""
+    """Paged-KV engine: this class is the host-side SCHEDULER (slots,
+    pages, prefix cache, admission); every device interaction goes through
+    an executor — ``LocalEngineExecutor`` for this process's devices
+    (optionally a tp mesh), or a multi-host fan-out (``multihost.py``).
+    ``add_request``/``cancel`` are thread-safe; ``step`` must be called
+    from one driver thread (the serving replica's engine loop)."""
 
     def __init__(
         self,
@@ -138,29 +139,11 @@ class InferenceEngine:
         decode_steps_per_dispatch: int = 8,
         enable_prefix_cache: bool = True,
         mesh=None,
+        executor=None,
         seed: int = 0,
     ):
         self.config = PRESETS[config] if isinstance(config, str) else config
-        if params is None:
-            params = init_params(self.config, jax.random.PRNGKey(seed))
         self.mesh = mesh
-        if mesh is not None:
-            # Tensor-parallel inference: params shard by the model's
-            # logical axes (heads/kv_heads/mlp -> tp) and the page pool by
-            # kv_heads; the SAME jitted programs then run SPMD — XLA
-            # inserts the collectives (the multi-chip path the reference
-            # gets from vLLM's TP workers). Requires n_kv_heads % tp == 0.
-            from ..models.llama import param_axes
-            from ..parallel.sharding import logical_sharding, shard_params
-
-            tp = mesh.shape.get("tp", 1)
-            if self.config.n_kv_heads % tp:
-                raise ValueError(
-                    f"n_kv_heads={self.config.n_kv_heads} not divisible by tp={tp}")
-            params = shard_params(params, param_axes(self.config), mesh)
-            self._pages_sharding = logical_sharding(
-                mesh, ("layers", None, "kv_heads", None, "head_dim"))
-        self.params = params
         self.max_slots = max_slots
         self.page_size = page_size
         assert max_len % page_size == 0, "max_len must be a multiple of page_size"
@@ -174,14 +157,14 @@ class InferenceEngine:
         # tunnel), so syncing once per K tokens is the difference between
         # 7 tok/s/slot and wire-speed decode.
         self.decode_steps_per_dispatch = max(1, decode_steps_per_dispatch)
-        # Pool: per-slot trash pages + usable pages (default: enough for
-        # every slot to hold a full-length sequence — shrink for memory).
-        usable = num_pages if num_pages is not None else max_slots * self.max_pages_per_seq
-        self.num_pages = max_slots + usable
-        self.pages = init_pages(self.config, self.num_pages, page_size)
-        if mesh is not None:
-            self.pages = jax.device_put(
-                self.pages, {"k": self._pages_sharding, "v": self._pages_sharding})
+        self.num_pages = self.total_pages(max_slots, max_len, page_size, num_pages)
+        if executor is None:
+            executor = LocalEngineExecutor(
+                self.config, params, max_slots=max_slots,
+                num_pages=self.num_pages, page_size=page_size, mesh=mesh,
+                seed=seed,
+            )
+        self.executor = executor
         self.allocator = PageAllocator(self.num_pages)
         # Trash pages 0..max_slots-1 are permanently owned by their slot.
         for s in range(max_slots):
@@ -194,8 +177,8 @@ class InferenceEngine:
         self._pending_first: list[tuple[Request, Any]] = []
         self._waiting: deque[Request] = deque()
         self._lock = threading.Lock()
-        self._key = jax.random.PRNGKey(seed ^ 0x5EED)
         self._counter = itertools.count()
+        self._handle_counter = itertools.count(1)
         # Host-side mirrors of decode-step inputs. Block tables default to
         # the slot's trash page so inactive slots never corrupt live pages.
         self._tokens = np.zeros(max_slots, np.int32)
@@ -204,6 +187,16 @@ class InferenceEngine:
             np.arange(max_slots, dtype=np.int32)[:, None], (1, self.max_pages_per_seq)
         )
         self.metrics = {"prefix_hit_pages": 0, "prefill_chunks": 0, "decode_steps": 0}
+
+    @staticmethod
+    def total_pages(max_slots: int, max_len: int, page_size: int,
+                    num_pages: int | None = None) -> int:
+        """Pool size: per-slot trash pages + usable pages (default: enough
+        for every slot to hold a full-length sequence). Exposed so a
+        remote executor (multi-host shards) can be pre-built with the same
+        geometry the engine will assume."""
+        usable = num_pages if num_pages is not None else max_slots * (max_len // page_size)
+        return max_slots + usable
 
     # ------------------------------------------------------------- admission
     def add_request(self, request: Request) -> None:
@@ -370,46 +363,42 @@ class InferenceEngine:
         tokens[:take] = r.prompt[r.prefill_pos:r.prefill_pos + take]
         bt = np.full(self.max_pages_per_seq, r.slot, np.int32)  # trash-pad
         bt[:len(r.block_table)] = r.block_table
-        self.pages, hidden = prefill_chunk(
-            self.params, self.pages, jnp.asarray(bt), jnp.asarray(tokens),
-            jnp.int32(r.prefill_pos), self.config, self.page_size,
-        )
+        final = r.prefill_pos + take >= len(r.prompt)
+        handle = next(self._handle_counter) if final else None
+        self.executor.prefill(bt, tokens, r.prefill_pos, handle, take)
         self.metrics["prefill_chunks"] += 1
         r.prefill_pos += take
-        if r.prefill_pos < len(r.prompt):
+        if not final:
             return []  # more chunks to go
-        # Prompt complete: queue the last real position's hidden state for
-        # BATCHED first-token sampling (device array stays on device — no
-        # sync here; a burst of prefills costs one sampling sync total).
+        # Prompt complete: queue the last real position's hidden state
+        # (stashed device-side under `handle`) for BATCHED first-token
+        # sampling — a burst of prefills costs one sampling sync total.
         with self._lock:
             if r.done:  # cancelled mid-prefill
+                self.executor.drop_handle(handle)
                 if self._prefilling and self._prefilling[0] is r:
                     self._prefilling.popleft()
                 return []
             self._prefilling.popleft()
-        self._pending_first.append((r, hidden[take - 1]))
+        self._pending_first.append((r, handle))
         return []
 
     def _flush_first_samples(self) -> list[dict]:
         """One dispatch + one sync samples the first token for every
         pending just-prefilled request."""
         pending, self._pending_first = self._pending_first, []
-        pending = [(r, h) for r, h in pending if not r.done]
-        if not pending:
+        live = [(r, h) for r, h in pending if not r.done]
+        for r, h in pending:
+            if r.done:  # cancelled mid-prefill: free the stashed hidden
+                self.executor.drop_handle(h)
+        if not live:
             return []
-        # Pad to max_slots so sample_first_batch compiles ONCE, not per
-        # distinct batch size.
-        m = len(pending)
-        hiddens = jnp.stack([h for _, h in pending]
-                            + [pending[0][1]] * (self.max_slots - m))
-        temps = np.zeros(self.max_slots, np.float32)
-        temps[:m] = [r.temperature for r, _ in pending]
-        toks, self._key = sample_first_batch(
-            hiddens, self.params["lm_head"], jnp.asarray(temps), self._key)
-        tokens = np.asarray(toks)  # the one sync
+        m = len(live)
+        temps = np.asarray([r.temperature for r, _ in live], np.float32)
+        tokens = self.executor.sample_first([h for _, h in live], temps)
         events = []
         now = time.monotonic()
-        for i, (r, _) in enumerate(pending):
+        for i, (r, _) in enumerate(live):
             with self._lock:
                 if r.done:  # cancelled while sampling
                     continue
@@ -440,14 +429,11 @@ class InferenceEngine:
         # (on-device lax.scan). Finished slots redirect writes to trash;
         # their surplus tokens are discarded below.
         K = self.decode_steps_per_dispatch
-        toks, self._key, self.pages = decode_loop(
-            self.params, self.pages, jnp.asarray(self._block_tables),
-            jnp.asarray(self._tokens), jnp.asarray(self._pos),
-            jnp.asarray(temps), jnp.asarray(eos_ids), jnp.asarray(remaining),
-            self._key, self.config, self.page_size, K,
-        )
+        tokens = self.executor.decode(
+            self._block_tables, self._tokens, self._pos, temps, eos_ids,
+            remaining, K,
+        )  # [K, slots]
         self.metrics["decode_steps"] += K
-        tokens = np.asarray(toks)  # [K, slots] — the one sync
         events = []
         for k in range(K):
             for slot, r in active.items():

@@ -1,0 +1,166 @@
+"""Engine executors: the device half of the inference engine.
+
+The ``InferenceEngine`` (engine.py) is a host-side scheduler — slots,
+pages, prefix cache, admission. Every device interaction goes through an
+executor with three operations:
+
+  * ``prefill(block_table, tokens, start_pos, handle, take)`` — run one
+    page-aligned prompt chunk; stash the last real position's hidden
+    state under ``handle`` (device-resident; no host sync).
+  * ``sample_first(handles, temps)`` — batched first-token sampling for
+    the stashed hiddens (ONE host sync for a burst of prefills).
+  * ``decode(block_tables, tokens, pos, temps, eos_ids, remaining, K)``
+    — K fused decode+sample steps, one dispatch, one sync.
+
+``LocalEngineExecutor`` runs on this process's devices (optionally a
+mesh: tensor-parallel over local chips, or a global multi-process mesh
+after ``jax.distributed.initialize`` — the params/pages are sharded, the
+SAME jitted programs run SPMD, XLA inserts the collectives). The
+multi-host fan-out lives in ``multihost.py``; the reference gets this
+split from vLLM's worker/executor architecture
+(``python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.llama import LlamaConfig, PRESETS, init_params
+from .model import decode_loop, init_pages, prefill_chunk, sample_first_batch
+
+
+class LocalEngineExecutor:
+    """Params, page pool, PRNG key and jitted programs on this process's
+    devices. With ``mesh``, params/pages shard over it (tp axis) and — for
+    a multi-process mesh — sampled-token outputs are pinned to a
+    replicated sharding so every process can read them without a gather."""
+
+    def __init__(
+        self,
+        config: LlamaConfig | str,
+        params=None,
+        *,
+        max_slots: int,
+        num_pages: int,
+        page_size: int,
+        mesh=None,
+        seed: int = 0,
+    ):
+        self.config = PRESETS[config] if isinstance(config, str) else config
+        if params is None:
+            params = init_params(self.config, jax.random.PRNGKey(seed))
+        self.mesh = mesh
+        self.max_slots = max_slots
+        self.page_size = page_size
+        pages = init_pages(self.config, num_pages, page_size)
+        self._replicated = None
+        if mesh is not None:
+            # Tensor-parallel: params shard by the model's logical axes
+            # (heads/kv_heads/mlp -> tp), the page pool by kv_heads; the
+            # same jitted programs then run SPMD with XLA collectives
+            # (the multi-chip path the reference gets from vLLM TP).
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ..models.llama import param_axes
+            from ..parallel.sharding import logical_sharding, shard_params
+
+            tp = mesh.shape.get("tp", 1)
+            if self.config.n_kv_heads % tp:
+                raise ValueError(
+                    f"n_kv_heads={self.config.n_kv_heads} not divisible by tp={tp}")
+            params = shard_params(params, param_axes(self.config), mesh)
+            self._pages_sharding = logical_sharding(
+                mesh, ("layers", None, "kv_heads", None, "head_dim"))
+            pages = jax.device_put(
+                pages, {"k": self._pages_sharding, "v": self._pages_sharding})
+            self._replicated = NamedSharding(mesh, PartitionSpec())
+        self.params = params
+        self.pages = pages
+        self._key = jax.random.PRNGKey(seed ^ 0x5EED)
+        # handle -> device hidden state [E] awaiting first-token sampling
+        self._hidden: dict[int, Any] = {}
+
+        if self._replicated is not None:
+            # Re-jit the model programs with EXPLICIT output shardings:
+            # token/key/hidden outputs pinned replicated — on a
+            # multi-process mesh an output with an arbitrary XLA-chosen
+            # sharding cannot be np.asarray'd (or indexed) by every
+            # process; replicated outputs can. Pages keep their kv_heads
+            # sharding and stay donated.
+            rep = self._replicated
+            pg = {"k": self._pages_sharding, "v": self._pages_sharding}
+            self._decode_loop = jax.jit(
+                decode_loop.__wrapped__,
+                static_argnames=("config", "page_size", "n_steps"),
+                donate_argnames=("pages",),
+                out_shardings=(rep, rep, pg),
+            )
+            self._sample_first = jax.jit(
+                sample_first_batch.__wrapped__, out_shardings=(rep, rep))
+            self._prefill = jax.jit(
+                prefill_chunk.__wrapped__,
+                static_argnames=("config", "page_size"),
+                donate_argnames=("pages",),
+                out_shardings=(pg, rep),
+            )
+        else:
+            self._decode_loop = decode_loop
+            self._sample_first = sample_first_batch
+            self._prefill = prefill_chunk
+
+    def _put(self, x: np.ndarray):
+        """Host input -> device, replicated over the mesh when present (a
+        multi-process jit requires global inputs, not bare numpy)."""
+        if self._replicated is not None:
+            return jax.device_put(x, self._replicated)
+        return jnp.asarray(x)
+
+    # ------------------------------------------------------------- operations
+    def prefill(self, block_table: np.ndarray, tokens: np.ndarray,
+                start_pos: int, handle: int | None, take: int) -> None:
+        self.pages, hidden = self._prefill(
+            self.params, self.pages, self._put(block_table.astype(np.int32)),
+            self._put(tokens.astype(np.int32)),
+            self._put(np.int32(start_pos)),
+            config=self.config, page_size=self.page_size,
+        )
+        if handle is not None:  # final chunk: stash for first-token sampling
+            self._hidden[handle] = hidden[take - 1]
+
+    def drop_handle(self, handle: int) -> None:
+        self._hidden.pop(handle, None)
+
+    def sample_first(self, handles: list[int], temps: np.ndarray) -> np.ndarray:
+        """One dispatch + one sync for every pending first token. Pads to
+        ``max_slots`` so the program compiles once, not per batch size."""
+        m = len(handles)
+        stack = [self._hidden.pop(h) for h in handles]
+        hiddens = jnp.stack(stack + [stack[0]] * (self.max_slots - m))
+        padded = np.zeros(self.max_slots, np.float32)
+        padded[:m] = temps[:m]
+        toks, self._key = self._sample_first(
+            hiddens, self.params["lm_head"], self._put(padded), self._key)
+        return np.asarray(toks)[:m]
+
+    def decode(self, block_tables: np.ndarray, tokens: np.ndarray,
+               pos: np.ndarray, temps: np.ndarray, eos_ids: np.ndarray,
+               remaining: np.ndarray, n_steps: int) -> np.ndarray:
+        toks, self._key, self.pages = self._decode_loop(
+            self.params, self.pages, self._put(block_tables.astype(np.int32)),
+            self._put(tokens.astype(np.int32)), self._put(pos.astype(np.int32)),
+            self._put(temps.astype(np.float32)),
+            self._put(eos_ids.astype(np.int32)),
+            self._put(remaining.astype(np.int32)),
+            self._key, config=self.config, page_size=self.page_size,
+            n_steps=n_steps,
+        )
+        return np.asarray(toks)  # [n_steps, slots] — the one sync
+
+    @property
+    def lm_head(self):
+        return self.params["lm_head"]

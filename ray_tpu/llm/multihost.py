@@ -1,0 +1,199 @@
+"""Multi-host LLM engine: one inference engine spanning hosts.
+
+Reference: ``python/ray/llm/_internal/serve/deployments/llm/vllm/
+vllm_models.py:117-168`` — the reference places TP×PP vLLM engines across
+nodes via placement-group bundles (STRICT_PACK when the engine fits one
+node, PACK otherwise). TPU redesign (SURVEY.md §7.1): one
+``EngineShardWorker`` actor per host, bootstrapped with
+``jax.distributed.initialize`` (the same SPMD↔actor bridge Train uses,
+``train/worker_group.py``), each holding a ``LocalEngineExecutor`` built
+over the GLOBAL mesh. The engine scheduler stays wherever the Serve
+replica lives and fans each step plan out to every shard; every shard
+executes the SAME jitted program in the same order, and XLA inserts the
+tensor-parallel collectives over ICI/DCN. Only small host arrays (block
+tables, token ids) cross the actor boundary — the params and KV pages
+never leave the shards.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ..core import api as ray
+
+
+class EngineShardWorker:
+    """Actor hosting one process (one host's chips) of the sharded engine."""
+
+    def __init__(self, rank: int, world: int):
+        self.rank = rank
+        self.world = world
+        self.executor = None
+
+    def coordinator_address(self) -> str:
+        from ..parallel.distributed import pick_coordinator_address
+
+        return pick_coordinator_address()
+
+    def init_distributed(self, coordinator: str) -> int:
+        from ..parallel.distributed import initialize_process
+
+        return initialize_process(coordinator, self.world, self.rank)
+
+    def build(self, config, *, max_slots: int, num_pages: int, page_size: int,
+              tp: int | None = None, seed: int = 0) -> int:
+        """Create the executor over the global mesh (all hosts' devices).
+        Default tp = every device in the group."""
+        import jax
+
+        from ..parallel import MeshConfig, create_mesh
+        from .executor import LocalEngineExecutor
+
+        n = len(jax.devices())
+        tp = tp or n
+        mesh = create_mesh(MeshConfig(tp=tp, dp=max(1, n // tp)))
+        self.executor = LocalEngineExecutor(
+            config, max_slots=max_slots, num_pages=num_pages,
+            page_size=page_size, mesh=mesh, seed=seed,
+        )
+        return n
+
+    # ------------------------------------------------ executor operations
+    def prefill(self, block_table, tokens, start_pos, handle, take) -> bool:
+        self.executor.prefill(block_table, tokens, start_pos, handle, take)
+        return True
+
+    def drop_handle(self, handle) -> bool:
+        self.executor.drop_handle(handle)
+        return True
+
+    def sample_first(self, handles, temps):
+        return self.executor.sample_first(handles, temps)
+
+    def decode(self, block_tables, tokens, pos, temps, eos_ids, remaining,
+               n_steps):
+        return self.executor.decode(
+            block_tables, tokens, pos, temps, eos_ids, remaining, n_steps)
+
+
+class ShardedEngineExecutor:
+    """Driver-side executor fanning every operation out to the shard
+    actors (duck-types ``LocalEngineExecutor``). Actor-call ordering per
+    caller guarantees every shard sees the identical program sequence —
+    the SPMD invariant."""
+
+    def __init__(self, shards: list, pg=None):
+        self.shards = shards
+        self._pg = pg
+        self._pending: list = []  # in-flight async dispatches (prefill/drop)
+
+    def _dispatch(self, method: str, *args) -> None:
+        """Fire-and-forget to every shard: per-caller actor ordering keeps
+        the program sequence identical on all shards, so prefill chunks
+        need no host sync (mirroring LocalEngineExecutor's pure-dispatch
+        prefill — one blocking round trip per CHUNK would wreck TTFT).
+        Errors surface at the next sync point."""
+        self._pending.extend(
+            getattr(s, method).remote(*args) for s in self.shards)
+
+    def _sync(self, timeout: float = 300.0) -> None:
+        if self._pending:
+            pending, self._pending = self._pending, []
+            ray.get(pending, timeout=timeout)
+
+    def _all(self, method: str, *args, timeout: float = 300.0):
+        self._sync(timeout)
+        refs = [getattr(s, method).remote(*args) for s in self.shards]
+        return ray.get(refs, timeout=timeout)
+
+    def prefill(self, block_table, tokens, start_pos, handle, take) -> None:
+        self._dispatch("prefill", block_table, tokens, start_pos, handle, take)
+
+    def drop_handle(self, handle) -> None:
+        self._dispatch("drop_handle", handle)
+
+    def sample_first(self, handles, temps) -> np.ndarray:
+        return self._all("sample_first", list(handles), temps)[0]
+
+    def decode(self, block_tables, tokens, pos, temps, eos_ids, remaining,
+               n_steps) -> np.ndarray:
+        return self._all(
+            "decode", block_tables, tokens, pos, temps, eos_ids, remaining,
+            n_steps)[0]
+
+    def shutdown(self) -> None:
+        for s in self.shards:
+            try:
+                ray.kill(s)
+            except Exception:
+                pass
+        if self._pg is not None:
+            from ..util import remove_placement_group
+
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+
+
+def create_sharded_executor(
+    config,
+    num_hosts: int,
+    *,
+    max_slots: int,
+    num_pages: int,
+    page_size: int,
+    tp: int | None = None,
+    seed: int = 0,
+    bundle_resources: dict | None = None,
+    topology: str | None = None,
+    strategy: str | None = None,
+    runtime_env: dict | None = None,
+) -> ShardedEngineExecutor:
+    """Place one shard actor per host and bootstrap the group.
+
+    ``bundle_resources``: per-host bundle (e.g. ``{"TPU": 4, "CPU": 1}``).
+    ``topology``: TPU slice type (e.g. ``v5litepod-16``) — claims the
+    slice-head resource on bundle 0 so the whole slice is ours atomically.
+    ``strategy``: placement strategy; defaults to the reference's choice —
+    STRICT_PACK for a single-host engine, PACK across hosts
+    (``vllm_models.py:131-168``).
+    """
+    from ..util import PlacementGroupSchedulingStrategy, placement_group, remove_placement_group
+
+    res = dict(bundle_resources or {"CPU": 1.0})
+    bundles = [dict(res) for _ in range(num_hosts)]
+    if topology:
+        bundles[0][f"TPU-{topology}-head"] = 1.0
+    strategy = strategy or ("STRICT_PACK" if num_hosts == 1 else "PACK")
+    pg = placement_group(bundles, strategy=strategy)
+    if not pg.wait(timeout_seconds=120.0):
+        remove_placement_group(pg)
+        raise TimeoutError(
+            f"placement group for {num_hosts} engine shards not ready in 120s")
+    actor_cls = ray.remote(EngineShardWorker)
+    shards = [
+        actor_cls.options(
+            resources=dict(bundles[i]),
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=i),
+            runtime_env=runtime_env,
+        ).remote(i, num_hosts)
+        for i in range(num_hosts)
+    ]
+    executor = ShardedEngineExecutor(shards, pg)
+    try:
+        coordinator = ray.get(shards[0].coordinator_address.remote(), timeout=120)
+        ray.get([s.init_distributed.remote(coordinator) for s in shards],
+                timeout=300)
+        ray.get([
+            s.build.remote(config, max_slots=max_slots, num_pages=num_pages,
+                           page_size=page_size, tp=tp, seed=seed)
+            for s in shards
+        ], timeout=600)
+    except Exception:
+        executor.shutdown()
+        raise
+    return executor

@@ -38,11 +38,35 @@ class LLMDeployment:
         prefill_chunk_size: int = 64,
         decode_steps_per_dispatch: int = 8,
         tensor_parallel: int = 1,
+        num_hosts: int = 1,
+        shard_resources: dict | None = None,
+        shard_runtime_env: dict | None = None,
+        topology: str | None = None,
         seed: int = 0,
         request_timeout_s: float = 300.0,
     ):
         mesh = None
-        if tensor_parallel > 1:
+        executor = None
+        self._sharded = None
+        if num_hosts > 1 or shard_resources is not None:
+            # Replica-spans-hosts: one engine-shard actor per host placed
+            # by a placement group, jax.distributed across them, the
+            # scheduler here fanning step plans out (reference:
+            # vllm_models.py:117-168 TP×PP placement; SURVEY §7.1 bridge).
+            from .multihost import create_sharded_executor
+
+            executor = self._sharded = create_sharded_executor(
+                preset, num_hosts,
+                max_slots=max_slots,
+                num_pages=InferenceEngine.total_pages(max_slots, max_len, page_size),
+                page_size=page_size,
+                tp=tensor_parallel if tensor_parallel > 1 else None,
+                seed=seed,
+                bundle_resources=shard_resources,
+                topology=topology,
+                runtime_env=shard_runtime_env,
+            )
+        elif tensor_parallel > 1:
             # Shard the engine across this replica's visible chips (e.g.
             # the 4/8 chips of a TPU host); XLA runs the same programs
             # SPMD with collectives over ICI.
@@ -57,7 +81,7 @@ class LLMDeployment:
             preset, max_slots=max_slots, max_len=max_len, page_size=page_size,
             prefill_chunk_size=prefill_chunk_size,
             decode_steps_per_dispatch=decode_steps_per_dispatch, mesh=mesh,
-            seed=seed,
+            executor=executor, seed=seed,
         )
         self.model_id = model_id or (preset if isinstance(preset, str) else "custom")
         self.tokenizer = ByteTokenizer()
@@ -97,6 +121,15 @@ class LLMDeployment:
         self._running = False
         if self._loop_thread.is_alive():
             self._loop_thread.join(timeout=5)
+        if self._sharded is not None:
+            self._sharded.shutdown()
+
+    def __del__(self):
+        if getattr(self, "_sharded", None) is not None:
+            try:
+                self._sharded.shutdown()
+            except Exception:
+                pass
 
     def _next_rid(self) -> str:
         with self._lock:
@@ -302,11 +335,19 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
                   max_slots: int = 8, max_len: int = 256,
                   page_size: int = 16, prefill_chunk_size: int = 64,
                   decode_steps_per_dispatch: int = 8, tensor_parallel: int = 1,
+                  num_hosts: int = 1, shard_resources: dict | None = None,
+                  shard_runtime_env: dict | None = None,
+                  topology: str | None = None,
                   max_ongoing_requests: int = 32, model_id: str | None = None,
                   ray_actor_options: dict | None = None):
     """Build a Serve Application serving ``preset`` (serve.run-able).
     Pass ``ray_actor_options={"resources": {"TPU": 1}, ...}`` to pin each
-    replica (engine) to a TPU chip."""
+    replica (engine) to a TPU chip. For an engine that SPANS hosts, set
+    ``num_hosts`` > 1 with per-host ``shard_resources`` (e.g.
+    ``{"TPU": 4, "CPU": 1}``) and optionally ``topology`` (slice type,
+    claims the slice-head resource) — the replica then schedules requests
+    while per-host shard actors execute the model SPMD over the joint
+    mesh (reference vllm_models.py:117-168)."""
     from ..serve import deployment
 
     dep = deployment(
@@ -318,4 +359,6 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
     return dep.bind(preset, model_id=model_id, max_slots=max_slots, max_len=max_len,
                     page_size=page_size, prefill_chunk_size=prefill_chunk_size,
                     decode_steps_per_dispatch=decode_steps_per_dispatch,
-                    tensor_parallel=tensor_parallel)
+                    tensor_parallel=tensor_parallel, num_hosts=num_hosts,
+                    shard_resources=shard_resources,
+                    shard_runtime_env=shard_runtime_env, topology=topology)

@@ -47,35 +47,17 @@ class TrainWorker:
         """Rank 0 picks the jax.distributed coordinator endpoint: its own IP
         plus a free port (``jax.distributed.initialize`` on process 0 binds
         and serves it)."""
-        import socket
+        from ..parallel.distributed import pick_coordinator_address
 
-        # Routable address: a UDP "connect" picks the outbound interface
-        # without sending traffic — gethostbyname(gethostname()) resolves to
-        # loopback on common /etc/hosts setups, which would break every
-        # cross-host join.
-        try:
-            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-            probe.connect(("8.8.8.8", 80))
-            host = probe.getsockname()[0]
-            probe.close()
-        except OSError:
-            host = socket.gethostbyname(socket.gethostname())
-        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        s.bind(("", 0))
-        port = s.getsockname()[1]
-        s.close()
-        return f"{host}:{port}"
+        return pick_coordinator_address()
 
     def init_distributed(self, coordinator: str) -> bool:
         """``jax.distributed.initialize`` across the group — multi-host
         slices only (single-host groups share one process's devices)."""
-        import jax
+        from ..parallel.distributed import initialize_process
 
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=self._context.world_size,
-            process_id=self._context.world_rank,
-        )
+        initialize_process(
+            coordinator, self._context.world_size, self._context.world_rank)
         return True
 
     def set_dataset_shards(self, shards: dict) -> bool:

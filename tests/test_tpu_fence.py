@@ -104,3 +104,43 @@ def test_non_tpu_workers_still_pooled(tpu_cluster):
 
     pids = {ray_tpu.get(f.remote(), timeout=120) for _ in range(3)}
     assert len(pids) == 1, f"CPU workers should be pooled, got {pids}"
+
+
+def test_tpu_fence_survives_pg_teardown(tpu_cluster):
+    """Killing a bundle-leased TPU actor and removing its placement group
+    immediately (the ShardedEngineExecutor.shutdown pattern) must NOT
+    re-grant the chip before the holder process is dead — _drop_bundle
+    withholds fenced TPU shares from its release."""
+    from ray_tpu.util import (
+        PlacementGroupSchedulingStrategy,
+        placement_group,
+        remove_placement_group,
+    )
+
+    pg = placement_group([{"TPU": 1.0, "CPU": 1.0}])
+    assert pg.wait(timeout_seconds=60)
+
+    @ray_tpu.remote(resources={"TPU": 1.0}, num_cpus=0)
+    class Holder:
+        def pid(self):
+            return os.getpid()
+
+    a = Holder.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0),
+    ).remote()
+    pid1 = ray_tpu.get(a.pid.remote(), timeout=120)
+    ray_tpu.kill(a)
+    remove_placement_group(pg)  # immediately, as multi-host teardown does
+
+    @ray_tpu.remote(resources={"TPU": 1.0}, num_cpus=0)
+    def next_lease(prev):
+        try:
+            os.kill(prev, 0)
+            return os.getpid(), True
+        except OSError:
+            return os.getpid(), False
+
+    pid2, prev_alive = ray_tpu.get(next_lease.remote(pid1), timeout=120)
+    assert pid2 != pid1
+    assert not prev_alive, "PG teardown re-granted the chip before holder death"
