@@ -261,6 +261,8 @@ class GcsServer:
         if "resources" in p and p["resources"]:
             node["resources"] = p["resources"]
         node["pending_demand"] = p.get("pending_demand", [])
+        if "store" in p:
+            node["store"] = p["store"]
         # Bundle reconciliation (reference: GCS-restart bundle cleanup):
         # the raylet cancels reservations whose group no longer exists —
         # half-committed 2PC bundles from before a GCS crash would
@@ -421,7 +423,73 @@ class GcsServer:
                         cur.pop("buckets", None)
                 else:
                     cur["value"] = cur.get("value", 0.0) + m.get("value", 0.0)
-        return {"metrics": list(merged.values())}
+        return {"metrics": list(merged.values()) + self._framework_metrics()}
+
+    def _framework_metrics(self) -> list[dict]:
+        """Cluster-state gauges (``ray_tpu_*``) synthesized from GCS tables
+        on every scrape — nodes/actors/tasks/PGs by state, per-resource
+        totals and usage, pending demand. These back the generated Grafana
+        dashboard (``ray_tpu/grafana.py``; reference
+        ``dashboard/modules/metrics/grafana_dashboard_factory.py``)."""
+        out: list[dict] = []
+
+        def gauge(name: str, value: float, **tags) -> None:
+            out.append({"name": name, "type": "gauge", "value": value, "tags": tags})
+
+        by_state: dict[str, int] = {}
+        for n in self._nodes.values():
+            by_state[n.get("state", "?")] = by_state.get(n.get("state", "?"), 0) + 1
+        for state, count in by_state.items():
+            gauge("ray_tpu_nodes", count, state=state)
+
+        totals: dict[str, float] = {}
+        avail: dict[str, float] = {}
+        demand: dict[str, int] = {}
+        for n in self._nodes.values():
+            if n.get("state") != "ALIVE":
+                continue
+            res = n.get("resources") or {}
+            for k, v in (res.get("total") or {}).items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+            for k, v in (res.get("available") or {}).items():
+                avail[k] = avail.get(k, 0.0) + float(v)
+            for d in n.get("pending_demand") or []:
+                shape = ",".join(
+                    f"{k}:{v:g}" for k, v in sorted((d.get("shape") or {}).items()))
+                demand[shape] = demand.get(shape, 0) + d.get("count", 0)
+        for k, v in totals.items():
+            gauge("ray_tpu_resource_total", v, resource=k)
+            gauge("ray_tpu_resource_used", v - avail.get(k, 0.0), resource=k)
+        if not demand:
+            demand[""] = 0  # always expose the series, even when idle
+        for shape, count in demand.items():
+            gauge("ray_tpu_pending_demand", count, shape=shape)
+
+        for node_id, n in self._nodes.items():
+            store = n.get("store")
+            if n.get("state") != "ALIVE" or not store:
+                continue
+            nid = node_id[:12]
+            gauge("ray_tpu_object_store_used_bytes", store.get("used", 0), node_id=nid)
+            gauge("ray_tpu_object_store_capacity_bytes", store.get("capacity", 0), node_id=nid)
+            gauge("ray_tpu_spilled_bytes_total", store.get("spilled_bytes_total", 0), node_id=nid)
+            gauge("ray_tpu_restored_bytes_total", store.get("restored_bytes_total", 0), node_id=nid)
+
+        by_state = {}
+        for a in self._actors.values():
+            by_state[a.get("state", "?")] = by_state.get(a.get("state", "?"), 0) + 1
+        for state, count in by_state.items():
+            gauge("ray_tpu_actors", count, state=state)
+
+        for state, count in self.task_events.count_by_state().items():
+            gauge("ray_tpu_tasks", count, state=state)
+
+        by_state = {}
+        for r in self._placement_groups.values():
+            by_state[r.get("state", "?")] = by_state.get(r.get("state", "?"), 0) + 1
+        for state, count in by_state.items():
+            gauge("ray_tpu_placement_groups", count, state=state)
+        return out
 
     # --------------------------------------------------------------- pub/sub
     async def handle_Publish(self, p: dict) -> dict:

@@ -230,6 +230,14 @@ class Raylet:
                             {"shape": dict(shape), "count": count}
                             for shape, count in self._pending_lease_demand.items()
                         ],
+                        # Store/spill gauges for the metrics pipeline
+                        # (ray_tpu_object_store_used_bytes etc.).
+                        "store": {
+                            "used": self.store.used(),
+                            "capacity": self.object_store_capacity,
+                            "spilled_bytes_total": self._spilled_bytes_total,
+                            "restored_bytes_total": self._restored_bytes_total,
+                        },
                     },
                     timeout=5.0,
                 )

@@ -118,6 +118,24 @@ class GcsTaskEventStore:
                 })
             return out
 
+    def count_by_state(self) -> dict[str, int]:
+        """State tallies without materializing record copies (metrics
+        scrapes poll this every few seconds)."""
+        out: dict[str, int] = {}
+        with self._lock:
+            for rec in self._tasks.values():
+                events = rec["events"]
+                if FAILED in events:
+                    state = FAILED
+                elif FINISHED in events:
+                    state = FINISHED
+                elif RUNNING in events:
+                    state = RUNNING
+                else:
+                    state = SUBMITTED
+                out[state] = out.get(state, 0) + 1
+        return out
+
     def chrome_trace(self) -> list[dict]:
         """Events in the chrome://tracing (Perfetto) JSON array format
         (reference ``state.py chrome_tracing_dump:442``)."""

@@ -14,36 +14,22 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-_INDEX = """<!doctype html>
-<html><head><title>ray_tpu dashboard</title>
-<style>
- body {{ font-family: monospace; margin: 2em; }}
- h1 {{ font-size: 1.2em; }}
- a {{ display: inline-block; margin-right: 1em; }}
- pre {{ background: #f5f5f5; padding: 1em; overflow-x: auto; }}
-</style></head>
-<body>
-<h1>ray_tpu dashboard</h1>
-<div>
- {links}
-</div>
-<pre id="out">loading /api/nodes ...</pre>
-<script>
- async function load(path) {{
-   const r = await fetch(path);
-   document.getElementById('out').textContent =
-     JSON.stringify(await r.json(), null, 2);
- }}
- document.querySelectorAll('a[data-api]').forEach(a =>
-   a.addEventListener('click', e => {{ e.preventDefault(); load(a.dataset.api); }}));
- load('/api/nodes');
-</script>
-</body></html>
-"""
+def _ui_html() -> bytes:
+    """The single-file SPA (``dashboard_ui.html`` next to this module —
+    the reference ships a React build in ``dashboard/client/``; here one
+    no-build HTML file renders the same overview pages from the JSON
+    API)."""
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "dashboard_ui.html")
+    with open(path, "rb") as f:
+        return f.read()
+
 
 _ENDPOINTS = [
     "nodes", "actors", "tasks", "objects", "workers",
-    "placement_groups", "jobs", "metrics", "cluster_resources", "timeline",
+    "placement_groups", "jobs", "metrics", "cluster_resources",
+    "available_resources", "timeline", "grafana_dashboard",
 ]
 
 
@@ -77,6 +63,12 @@ def _collect(endpoint: str):
         return get_metrics()
     if endpoint == "cluster_resources":
         return core_api.cluster_resources()
+    if endpoint == "available_resources":
+        return core_api.available_resources()
+    if endpoint == "grafana_dashboard":
+        from .grafana import generate_dashboard
+
+        return generate_dashboard()
     if endpoint == "timeline":
         # Chrome-trace JSON, loadable in Perfetto (reference ray.timeline).
         # Unique temp file per request: ThreadingHTTPServer handles
@@ -114,10 +106,17 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         path = self.path.split("?", 1)[0].rstrip("/")
         if path in ("", "/index.html"):
-            links = "".join(
-                f'<a href="#" data-api="/api/{e}">{e}</a>' for e in _ENDPOINTS
-            )
-            self._send(200, _INDEX.format(links=links).encode(), "text/html")
+            self._send(200, _ui_html(), "text/html; charset=utf-8")
+            return
+        if path == "/metrics":
+            # Prometheus scrape endpoint (reference: per-node metrics
+            # agent re-export; one process here).
+            try:
+                from .util.metrics import prometheus_text
+
+                self._send(200, prometheus_text().encode(), "text/plain; version=0.0.4")
+            except Exception as e:
+                self._send(500, f"# error: {e}\n".encode(), "text/plain")
             return
         if path == "/-/healthz":
             self._send(200, b'"ok"', "application/json")

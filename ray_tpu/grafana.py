@@ -1,0 +1,162 @@
+"""Grafana dashboard factory.
+
+Equivalent of the reference's generated Grafana dashboards
+(``python/ray/dashboard/modules/metrics/grafana_dashboard_factory.py`` /
+``dashboards/default_dashboard_panels.py``): emits a provisioning-ready
+dashboard JSON over the Prometheus metrics this framework exports
+(``ray_tpu.util.metrics.prometheus_text`` — framework gauges prefixed
+``ray_tpu_`` plus user Counters/Gauges/Histograms).
+
+Usage::
+
+    python -m ray_tpu.grafana > ray_tpu_dashboard.json
+    # then import in Grafana, or drop into provisioning/dashboards/
+
+The datasource is templated (``${datasource}``) so the same JSON works
+against any Prometheus instance.
+"""
+
+from __future__ import annotations
+
+import json
+
+_DS = {"type": "prometheus", "uid": "${datasource}"}
+
+# Chart colors follow the validated default palette (one hue per series
+# slot, fixed order — see the data-viz method): blue, orange, aqua, yellow.
+_SLOT_COLORS = ["#2a78d6", "#eb6834", "#1baf7a", "#eda100"]
+
+
+def _panel(panel_id: int, title: str, targets: list[dict], *, grid: dict,
+           unit: str = "short", kind: str = "timeseries") -> dict:
+    return {
+        "id": panel_id,
+        "title": title,
+        "type": kind,
+        "datasource": _DS,
+        "gridPos": grid,
+        "fieldConfig": {
+            "defaults": {
+                "unit": unit,
+                "custom": {
+                    "lineWidth": 2,
+                    "fillOpacity": 0,
+                    "showPoints": "never",
+                    "drawStyle": "line",
+                },
+                "color": {"mode": "palette-classic"},
+            },
+            "overrides": [
+                {
+                    "matcher": {"id": "byFrameRefID", "options": chr(ord("A") + i)},
+                    "properties": [{
+                        "id": "color",
+                        "value": {"mode": "fixed", "fixedColor": _SLOT_COLORS[i % len(_SLOT_COLORS)]},
+                    }],
+                }
+                for i in range(len(targets))
+            ],
+        },
+        "targets": [
+            {"refId": chr(ord("A") + i), "expr": t["expr"],
+             "legendFormat": t.get("legend", "__auto"), "datasource": _DS}
+            for i, t in enumerate(targets)
+        ],
+        "options": {
+            "legend": {"displayMode": "list", "placement": "bottom",
+                       # A templated legend ({{label}}) fans one target out
+                       # into many series — those need the legend too.
+                       "showLegend": len(targets) > 1
+                       or "{{" in targets[0].get("legend", "")},
+            "tooltip": {"mode": "multi", "sort": "desc"},
+        },
+    }
+
+
+def _stat(panel_id: int, title: str, expr: str, *, grid: dict,
+          unit: str = "short") -> dict:
+    p = _panel(panel_id, title, [{"expr": expr}], grid=grid, unit=unit, kind="stat")
+    p["options"] = {"reduceOptions": {"calcs": ["lastNotNull"]},
+                    "colorMode": "none", "graphMode": "area"}
+    return p
+
+
+def generate_dashboard(title: str = "ray_tpu cluster") -> dict:
+    """The default cluster dashboard: nodes / resources / tasks / actors /
+    object store / serve, one row each (reference
+    ``default_dashboard_panels.py`` panel inventory, TPU-scoped)."""
+    W, H = 8, 7  # grid units per panel
+    panels = [
+        # Row 1: headline stats
+        _stat(1, "Nodes alive", 'ray_tpu_nodes{state="ALIVE"}',
+              grid={"x": 0, "y": 0, "w": 4, "h": 4}),
+        _stat(2, "Actors alive", 'ray_tpu_actors{state="ALIVE"}',
+              grid={"x": 4, "y": 0, "w": 4, "h": 4}),
+        _stat(3, "Tasks running", 'ray_tpu_tasks{state="RUNNING"}',
+              grid={"x": 8, "y": 0, "w": 4, "h": 4}),
+        _stat(4, "TPU chips in use",
+              "ray_tpu_resource_used{resource=\"TPU\"}",
+              grid={"x": 12, "y": 0, "w": 4, "h": 4}),
+        _stat(5, "Object store used",
+              "sum(ray_tpu_object_store_used_bytes)",
+              grid={"x": 16, "y": 0, "w": 4, "h": 4}, unit="bytes"),
+        _stat(6, "Placement groups", 'ray_tpu_placement_groups{state="CREATED"}',
+              grid={"x": 20, "y": 0, "w": 4, "h": 4}),
+        # Row 2: utilization over time
+        _panel(10, "CPU utilization", [
+            {"expr": 'ray_tpu_resource_used{resource="CPU"}', "legend": "used"},
+            {"expr": 'ray_tpu_resource_total{resource="CPU"}', "legend": "total"},
+        ], grid={"x": 0, "y": 4, "w": W, "h": H}),
+        _panel(11, "TPU utilization", [
+            {"expr": 'ray_tpu_resource_used{resource="TPU"}', "legend": "used"},
+            {"expr": 'ray_tpu_resource_total{resource="TPU"}', "legend": "total"},
+        ], grid={"x": W, "y": 4, "w": W, "h": H}),
+        _panel(12, "Object store bytes by node", [
+            {"expr": "ray_tpu_object_store_used_bytes", "legend": "{{node_id}} used"},
+        ], grid={"x": 2 * W, "y": 4, "w": W, "h": H}, unit="bytes"),
+        # Row 3: scheduler / control plane
+        _panel(20, "Tasks by state", [
+            {"expr": "ray_tpu_tasks", "legend": "{{state}}"},
+        ], grid={"x": 0, "y": 4 + H, "w": W, "h": H}),
+        _panel(21, "Actors by state", [
+            {"expr": "ray_tpu_actors", "legend": "{{state}}"},
+        ], grid={"x": W, "y": 4 + H, "w": W, "h": H}),
+        _panel(22, "Pending resource demand", [
+            {"expr": "ray_tpu_pending_demand", "legend": "{{shape}}"},
+        ], grid={"x": 2 * W, "y": 4 + H, "w": W, "h": H}),
+        # Row 4: spill + serve
+        _panel(30, "Spill / restore throughput", [
+            {"expr": "rate(ray_tpu_spilled_bytes_total[5m])", "legend": "spilled"},
+            {"expr": "rate(ray_tpu_restored_bytes_total[5m])", "legend": "restored"},
+        ], grid={"x": 0, "y": 4 + 2 * H, "w": W, "h": H}, unit="Bps"),
+        _panel(31, "Serve requests", [
+            {"expr": "rate(serve_num_requests_total[1m])", "legend": "{{deployment}}"},
+        ], grid={"x": W, "y": 4 + 2 * H, "w": W, "h": H}, unit="reqps"),
+        _panel(32, "Serve latency p50", [
+            {"expr": "histogram_quantile(0.5, rate(serve_request_latency_ms_bucket[5m]))",
+             "legend": "{{deployment}}"},
+        ], grid={"x": 2 * W, "y": 4 + 2 * H, "w": W, "h": H}, unit="ms"),
+    ]
+    return {
+        "title": title,
+        "uid": "ray-tpu-default",
+        "tags": ["ray_tpu", "generated"],
+        "timezone": "browser",
+        "editable": True,
+        "schemaVersion": 39,
+        "refresh": "10s",
+        "time": {"from": "now-1h", "to": "now"},
+        "templating": {"list": [{
+            "name": "datasource", "type": "datasource", "query": "prometheus",
+            "label": "Data source",
+        }]},
+        "panels": panels,
+    }
+
+
+def main() -> None:
+    print(json.dumps(generate_dashboard(), indent=2))
+
+
+if __name__ == "__main__":
+    main()

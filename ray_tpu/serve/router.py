@@ -23,6 +23,31 @@ HANDLE_MARKER = "__serve_handle_marker__"
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
+# Request metrics (reference: serve_num_router_requests /
+# serve_deployment_processing_latency_ms in serve/_private/router.py) —
+# lazily created so importing serve doesn't start the metrics flusher.
+_metrics_lock = threading.Lock()
+_metrics: dict = {}
+
+
+def _serve_metrics():
+    with _metrics_lock:
+        if not _metrics:
+            from ..util.metrics import Counter, Histogram
+
+            _metrics["requests"] = Counter(
+                "serve_num_requests_total",
+                "Requests routed to replicas", tag_keys=("deployment",))
+            _metrics["errors"] = Counter(
+                "serve_num_errors_total",
+                "Requests that raised", tag_keys=("deployment",))
+            _metrics["latency"] = Histogram(
+                "serve_request_latency_ms",
+                "End-to-end handle latency",
+                boundaries=(1, 5, 25, 100, 250, 500, 1000, 5000, 30000),
+                tag_keys=("deployment",))
+        return _metrics
+
 
 def resolve_handle_markers(obj):
     """Replace deploy-time handle markers with live DeploymentHandles
@@ -140,9 +165,10 @@ class Router:
 class DeploymentResponse:
     """Future-like result of handle.remote() (reference DeploymentResponse)."""
 
-    def __init__(self, ref, on_done):
+    def __init__(self, ref, on_done, on_error=None):
         self._ref = ref
         self._on_done = on_done
+        self._on_error = on_error
         self._settle_lock = threading.Lock()
         self._settled = False
         worker = global_worker()
@@ -154,6 +180,18 @@ class DeploymentResponse:
         if not worker.memory_store.add_callback(oid, _cb):
             self._settle()
 
+    def _resolved_to_error(self) -> bool:
+        """Did the replica call raise? (Inline error payloads carry the
+        error metadata marker in the owner's memory store.)"""
+        try:
+            from ..core import serialization
+
+            entry = global_worker().memory_store.get_if_exists(self._ref.id())
+            return bool(entry is not None and not entry.in_plasma
+                        and entry.metadata == serialization.META_ERROR)
+        except Exception:
+            return False
+
     def _settle(self) -> None:
         # atomic test-and-set: the store callback and a result() caller can
         # race here, and on_done (router slot release) must run exactly once
@@ -161,6 +199,11 @@ class DeploymentResponse:
             if self._settled:
                 return
             self._settled = True
+        try:
+            if self._on_error is not None and self._resolved_to_error():
+                self._on_error()
+        except Exception:
+            pass
         try:
             self._on_done()
         except Exception:
@@ -284,9 +327,14 @@ class DeploymentHandle:
         return self.options(method_name=item)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
+        import time as _time
+
         from .multiplex import MULTIPLEXED_KWARG
 
         router = self._get_router()
+        metrics = _serve_metrics()
+        metrics["requests"].inc(tags={"deployment": self.deployment_name})
+        t0 = _time.monotonic()
         replica_id, actor = router.assign_replica(model_id=self._multiplexed_model_id)
         if self._multiplexed_model_id:
             kwargs[MULTIPLEXED_KWARG] = self._multiplexed_model_id
@@ -294,15 +342,31 @@ class DeploymentHandle:
             ref = actor.handle_request.remote(self._method_name, args, kwargs)
         except Exception:
             router.release(replica_id)
+            metrics["errors"].inc(tags={"deployment": self.deployment_name})
             raise
-        return DeploymentResponse(ref, on_done=lambda: router.release(replica_id))
+
+        def _done():
+            router.release(replica_id)
+            metrics["latency"].observe(
+                1000 * (_time.monotonic() - t0),
+                tags={"deployment": self.deployment_name})
+
+        return DeploymentResponse(
+            ref, on_done=_done,
+            on_error=lambda: metrics["errors"].inc(
+                tags={"deployment": self.deployment_name}))
 
     def remote_streaming(self, *args, **kwargs) -> DeploymentStreamingResponse:
         """Invoke through the replica's streaming path: results arrive
         item-by-item while the handler runs (token streaming, SSE)."""
         from .multiplex import MULTIPLEXED_KWARG
 
+        import time as _time
+
         router = self._get_router()
+        metrics = _serve_metrics()
+        metrics["requests"].inc(tags={"deployment": self.deployment_name})
+        t0 = _time.monotonic()
         replica_id, actor = router.assign_replica(model_id=self._multiplexed_model_id)
         if self._multiplexed_model_id:
             kwargs[MULTIPLEXED_KWARG] = self._multiplexed_model_id
@@ -315,8 +379,17 @@ class DeploymentHandle:
             ).remote(self._method_name, args, kwargs)
         except Exception:
             router.release(replica_id)
+            metrics["errors"].inc(tags={"deployment": self.deployment_name})
             raise
-        return DeploymentStreamingResponse(gen, on_done=lambda: router.release(replica_id))
+
+        def _done():
+            # Latency of a stream = full stream duration (close/exhaust).
+            router.release(replica_id)
+            metrics["latency"].observe(
+                1000 * (_time.monotonic() - t0),
+                tags={"deployment": self.deployment_name})
+
+        return DeploymentStreamingResponse(gen, on_done=_done)
 
     def __reduce__(self):
         return (DeploymentHandle, (self.app_name, self.deployment_name,

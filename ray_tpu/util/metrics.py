@@ -151,10 +151,30 @@ def get_metrics() -> list[dict]:
 
 
 def prometheus_text(metrics: list[dict] | None = None) -> str:
-    """Render metrics in the Prometheus exposition format."""
+    """Render metrics in the Prometheus exposition format. Histograms emit
+    the full ``_bucket``/``_sum``/``_count`` family (cumulative ``le``
+    buckets) so ``histogram_quantile`` works in Grafana."""
+    def _esc(v) -> str:
+        # Label-value escaping per the exposition format: one bad user tag
+        # must not invalidate the whole scrape.
+        return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
     lines = []
     for m in metrics if metrics is not None else get_metrics():
-        tags = ",".join(f'{k}="{v}"' for k, v in sorted(m.get("tags", {}).items()))
-        label = f"{{{tags}}}" if tags else ""
+        tags = sorted((m.get("tags") or {}).items())
+        base = ",".join(f'{k}="{_esc(v)}"' for k, v in tags)
+        if m.get("type") == "histogram" and m.get("buckets"):
+            cum = 0
+            for bound, count in zip(
+                    list(m.get("boundaries", [])) + ["+Inf"], m["buckets"]):
+                cum += count
+                le = f'le="{bound}"'
+                label = "{" + (base + "," if base else "") + le + "}"
+                lines.append(f"{m['name']}_bucket{label} {cum}")
+            label = f"{{{base}}}" if base else ""
+            lines.append(f"{m['name']}_sum{label} {m['value']}")
+            lines.append(f"{m['name']}_count{label} {m.get('count', cum)}")
+            continue
+        label = f"{{{base}}}" if base else ""
         lines.append(f"{m['name']}{label} {m['value']}")
     return "\n".join(lines) + "\n"

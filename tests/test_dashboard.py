@@ -64,3 +64,54 @@ def test_dashboard_unknown_endpoint_404(dashboard):
     with pytest.raises(urllib.error.HTTPError) as e:
         _get(dashboard + "/api/nope")
     assert e.value.code == 404
+
+
+def test_dashboard_serves_spa(dashboard):
+    """`/` serves the single-file UI (reference: dashboard/client/)."""
+    with urllib.request.urlopen(dashboard + "/", timeout=30) as r:
+        body = r.read().decode()
+        ctype = r.headers.get("Content-Type", "")
+    assert "text/html" in ctype
+    assert "ray_tpu dashboard" in body
+    # the SPA drives the same JSON API the tests above cover
+    assert "/api/" in body and "overview" in body
+
+
+def test_dashboard_framework_metrics_and_prometheus(dashboard):
+    """GetMetrics synthesizes ray_tpu_* cluster gauges; /metrics renders
+    the Prometheus exposition incl. histogram bucket families."""
+    metrics = _get(dashboard + "/api/metrics")
+    names = {m["name"] for m in metrics}
+    assert "ray_tpu_nodes" in names
+    assert "ray_tpu_resource_total" in names
+    assert "ray_tpu_object_store_used_bytes" in names
+
+    with urllib.request.urlopen(dashboard + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+    assert "ray_tpu_nodes{" in text
+
+    # histogram exposition: _bucket/_sum/_count with cumulative le
+    from ray_tpu.util.metrics import prometheus_text
+
+    hist = [{
+        "name": "t_ms", "type": "histogram", "tags": {"d": "x"},
+        "value": 12.0, "count": 3, "buckets": [1, 2, 0],
+        "boundaries": [10, 100],
+    }]
+    text = prometheus_text(hist)
+    assert 't_ms_bucket{d="x",le="10"} 1' in text
+    assert 't_ms_bucket{d="x",le="100"} 3' in text
+    assert 't_ms_bucket{d="x",le="+Inf"} 3' in text
+    assert 't_ms_sum{d="x"} 12.0' in text
+    assert 't_ms_count{d="x"} 3' in text
+
+
+def test_dashboard_grafana_dashboard_json(dashboard):
+    """The generated Grafana dashboard (reference
+    grafana_dashboard_factory.py) is served and structurally sound."""
+    d = _get(dashboard + "/api/grafana_dashboard")
+    assert d["uid"] == "ray-tpu-default"
+    assert len(d["panels"]) >= 10
+    assert d["templating"]["list"][0]["name"] == "datasource"
+    for p in d["panels"]:
+        assert p["targets"], p["title"]

@@ -387,3 +387,51 @@ def test_grpc_proxy(serve_instance):
     with pytest.raises(grpc.RpcError):
         bad(cloudpickle.dumps(((), {})), timeout=30)
     channel.close()
+
+
+def test_serve_request_metrics(serve_instance):
+    """Handle traffic shows up in the serve_* metrics family (reference:
+    serve_num_router_requests / processing-latency metrics)."""
+    app = Echo.bind()
+    h = serve.run(app, name="metrics-app")
+    for _ in range(3):
+        assert "echo" in h.remote(serve.Request(query={"msg": "m"})).result(timeout=60)
+
+    from ray_tpu.util.metrics import snapshot_all
+
+    deadline = time.time() + 30
+    found = {}
+    while time.time() < deadline:
+        found = {m["name"]: m for m in snapshot_all()
+                 if m.get("tags", {}).get("deployment") == "Echo"}
+        if "serve_num_requests_total" in found and "serve_request_latency_ms" in found:
+            break
+        time.sleep(0.2)
+    assert found["serve_num_requests_total"]["value"] >= 3
+    lat = found["serve_request_latency_ms"]
+    assert lat["count"] >= 3 and sum(lat["buckets"]) >= 3
+
+
+def test_serve_error_metrics(serve_instance):
+    """Replica-side exceptions count in serve_num_errors_total."""
+
+    @serve.deployment()
+    class Boom:
+        def __call__(self, request):
+            raise RuntimeError("boom")
+
+    h = serve.run(Boom.bind(), name="boom-app")
+    with pytest.raises(Exception):
+        h.remote(serve.Request(query={})).result(timeout=60)
+
+    from ray_tpu.util.metrics import snapshot_all
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        errs = [m for m in snapshot_all()
+                if m["name"] == "serve_num_errors_total"
+                and m.get("tags", {}).get("deployment") == "Boom"]
+        if errs and errs[0]["value"] >= 1:
+            return
+        time.sleep(0.2)
+    raise AssertionError("replica error never counted in serve_num_errors_total")
