@@ -25,7 +25,7 @@ def timeit(name: str, fn, n: int, results: list, *, unit: str = "ops/s") -> floa
     return rate
 
 
-def main(quick: bool = False) -> list[dict]:
+def main(quick: bool = False, stress: bool = False) -> list[dict]:
     import ray_tpu
 
     scale = 0.2 if quick else 1.0
@@ -141,10 +141,31 @@ def main(quick: bool = False) -> list[dict]:
     timeit(f"stress: {n} PG create/ready/remove cycles", pg_churn, n, results,
            unit="pgs/s")
 
+    if stress:
+        # The release-envelope shapes (BASELINE.md rows: 1M queued tasks,
+        # 40k actors) scaled to one host: a deep queued-task drain and a
+        # wide actor wave.
+        n = 100_000
+        timeit(f"stress: {n} queued tasks drain",
+               lambda: ray_tpu.get([noop.remote() for _ in range(n)],
+                                   timeout=1800),
+               n, results)
+
+        n = 500
+
+        def actor_wave():
+            actors = [Counter.options(num_cpus=0.001).remote() for _ in range(n)]
+            ray_tpu.get([a.inc.remote() for a in actors], timeout=1200)
+            for a in actors:
+                ray_tpu.kill(a)
+
+        timeit(f"stress: create+call+kill {n} actors", actor_wave, n, results,
+               unit="actors/s")
+
     return results
 
 
 if __name__ == "__main__":
     quick = "--quick" in sys.argv
-    out = main(quick=quick)
+    out = main(quick=quick, stress="--stress" in sys.argv)
     print(json.dumps({"perf": out}))

@@ -11,12 +11,17 @@ above ``min_workers`` are terminated after a timeout.
 
 from .autoscaler import Autoscaler, NodeTypeConfig
 from .gce import GceTpuNodeProvider
+from .gke import GkeTpuNodeProvider
+from .instance_manager import Instance, InstanceManager
 from .node_provider import LocalNodeProvider, NodeProvider
 from .sdk import request_resources
 
 __all__ = [
     "Autoscaler",
     "GceTpuNodeProvider",
+    "GkeTpuNodeProvider",
+    "Instance",
+    "InstanceManager",
     "NodeTypeConfig",
     "NodeProvider",
     "LocalNodeProvider",

@@ -61,6 +61,12 @@ class RayTpuConfig:
     worker_register_timeout_s: float = 30.0
     idle_worker_killing_time_threshold_ms: int = 1000
     maximum_startup_concurrency: int = 4
+    # Max normal-task specs pushed to a leased worker in ONE RPC: the
+    # batch-submit path is RPC/handoff-bound, not execution-bound.
+    task_push_batch_size: int = 16
+    # Fork default-env workers from a warm pre-imported zygote process
+    # instead of paying interpreter boot + imports per worker.
+    enable_worker_zygote: bool = True
     # Device-release fence: how long to wait for a TPU-holding worker
     # process to exit (after SIGTERM, then SIGKILL) before re-granting the
     # TPU resource anyway. The libtpu device lock is exclusive per process

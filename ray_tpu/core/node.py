@@ -82,30 +82,37 @@ class Node:
             except Exception:
                 pass
         self.services_loop.stop()
-        _reap_worker_children(self.raylet.node_id.hex())
+        _reap_worker_children(self.raylet)
 
 
-def _reap_worker_children(node_id_hex: str, deadline_s: float = 10.0) -> None:
-    """Last-ditch sweep after raylet.stop: kill any ``worker_main`` children
-    of this process THAT BELONG TO THIS NODE (matched by the ``--node-id``
-    argument every worker is spawned with) and survived stop() — e.g. stuck
-    in a device call with SIGTERM pending. A TPU worker that outlives its
-    cluster keeps the exclusive libtpu lock and crash-loops whatever claims
-    the chip next — the next ``init()`` in this same driver process (bench
-    phases, test suites) must start from a clean slate. Workers of OTHER
-    in-process raylets (the Cluster harness) are left alone."""
+def _reap_worker_children(raylet, deadline_s: float = 10.0) -> None:
+    """Last-ditch sweep after raylet.stop: kill any worker of THIS NODE
+    that survived stop() — e.g. stuck in a device call with SIGTERM
+    pending. A TPU worker that outlives its cluster keeps the exclusive
+    libtpu lock and crash-loops whatever claims the chip next — the next
+    ``init()`` in this same driver process (bench phases, test suites)
+    must start from a clean slate. Workers of OTHER in-process raylets
+    (the Cluster harness) are left alone: victims are the raylet's own
+    tracked worker pids plus direct ``worker_main`` children spawned with
+    this node's id (zygote-forked workers are always tracked)."""
     import signal
 
+    node_id_hex = raylet.node_id.hex()
     me = os.getpid()
     victims: list[int] = []
+    for w in list(raylet._workers.values()):
+        if w.proc is not None and w.proc.poll() is None:
+            victims.append(w.proc.pid)
     try:
         entries = os.listdir("/proc")
     except OSError:
-        return
+        entries = []
     for pid_dir in entries:
         if not pid_dir.isdigit():
             continue
         pid = int(pid_dir)
+        if pid in victims:
+            continue
         try:
             with open(f"/proc/{pid}/cmdline", "rb") as f:
                 cmd = f.read().decode(errors="replace")
@@ -125,8 +132,13 @@ def _reap_worker_children(node_id_hex: str, deadline_s: float = 10.0) -> None:
         while time.monotonic() < deadline:
             try:
                 done, _ = os.waitpid(pid, os.WNOHANG)
+                if done == pid:
+                    break
             except (ChildProcessError, OSError):
-                break
-            if done == pid:
-                break
+                # Not our child (zygote-forked, auto-reaped there): poll
+                # for existence instead of waiting.
+                try:
+                    os.kill(pid, 0)
+                except OSError:
+                    break
             time.sleep(0.05)

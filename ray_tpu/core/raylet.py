@@ -40,6 +40,63 @@ from ..native.store import ShmStore, StoreFullError
 logger = logging.getLogger(__name__)
 
 
+class PidHandle:
+    """Popen-compatible handle for a worker forked by the zygote (not our
+    child, so ``waitpid`` is unavailable; the zygote auto-reaps). Exposes
+    the subset of the Popen surface the raylet uses: poll/wait/terminate/
+    kill/pid/returncode. Identity is (pid, /proc start time) so a recycled
+    pid is never mistaken for the live worker (or SIGKILLed at teardown)."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode: int | None = None
+        self._starttime = self._read_starttime(pid)
+
+    @staticmethod
+    def _read_starttime(pid: int) -> str | None:
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                return f.read().rsplit(")", 1)[-1].split()[19]  # field 22
+        except (OSError, IndexError):
+            return None
+
+    def poll(self) -> int | None:
+        if self.returncode is not None:
+            return self.returncode
+        current = self._read_starttime(self.pid)
+        if current is None or (self._starttime is not None
+                               and current != self._starttime):
+            self.returncode = -1  # gone, or the pid was recycled
+            return self.returncode
+        return None
+
+    def wait(self, timeout: float | None = None) -> int:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired(f"worker pid {self.pid}", timeout)
+            time.sleep(0.02)
+        return self.returncode
+
+    def _signal(self, sig) -> None:
+        if self.poll() is not None:
+            return  # dead or recycled pid: never signal a stranger
+        try:
+            os.kill(self.pid, sig)
+        except OSError:
+            pass
+
+    def terminate(self) -> None:
+        import signal
+
+        self._signal(signal.SIGTERM)
+
+    def kill(self) -> None:
+        import signal
+
+        self._signal(signal.SIGKILL)
+
+
 @dataclass
 class WorkerHandle:
     worker_id: str
@@ -149,6 +206,9 @@ class Raylet:
         # node-pool lease): bundle teardown withholds these from its
         # release; the fence re-grants them when the holder is dead.
         self._fence_pending: dict[tuple | None, float] = {}
+        # Forkserver for default-env workers (worker_zygote.py).
+        self._zygote_proc: subprocess.Popen | None = None
+        self._zygote_booting = False
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> None:
@@ -163,6 +223,8 @@ class Raylet:
                 "resources": self.resources.to_dict(),
             },
         )
+        if get_config().enable_worker_zygote:
+            self._kick_zygote()  # warm the forkserver off-path
         self._tasks.append(spawn(self._heartbeat_loop()))
         self._tasks.append(spawn(self._worker_monitor_loop()))
         self._tasks.append(spawn(self._memory_monitor_loop()))
@@ -207,6 +269,13 @@ class Raylet:
                 w.proc.wait(timeout=2)
             except Exception:
                 pass
+        if self._zygote_proc is not None:
+            try:
+                self._zygote_proc.kill()
+                self._zygote_proc.wait(timeout=2)
+            except Exception:
+                pass
+            self._zygote_proc = None
         await self._server.stop(grace=0.5 if graceful else 0.0)
         self.store.close()
 
@@ -450,8 +519,106 @@ class Raylet:
                           sort_keys=True, default=str)
         return hashlib.sha1(blob.encode()).hexdigest()[:16]
 
+    # ------------------------------------------------------ worker zygote
+    def _default_worker_env(self) -> dict:
+        """The environment default-env workers run with (also the zygote's
+        own env, so its pre-imported image matches its children)."""
+        env = dict(os.environ)
+        env["PYTHONUNBUFFERED"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        return env
+
+    def _boot_zygote(self) -> None:
+        """Spawn the zygote and wait for its post-import handshake.
+        BLOCKING (interpreter boot + imports) — runs in an executor
+        thread, never on the event loop; `_zygote_proc` is published only
+        once the handshake arrives, so spawns before that fall back to
+        direct Popen."""
+        import json
+
+        try:
+            z = subprocess.Popen(
+                [
+                    sys.executable, "-m", "ray_tpu.core.worker_zygote",
+                    "--raylet-address", self.address,
+                    "--gcs-address", self.gcs_address,
+                    "--node-id", self.node_id.hex(),
+                    "--store-path", self.store_path,
+                    "--store-capacity", str(self.object_store_capacity),
+                ],
+                env=self._default_worker_env(),
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=open(os.path.join(
+                    self._session_dir,
+                    f"zygote-{self.node_id.hex()[:12]}.err"), "ab"),
+            )
+            ready = json.loads(z.stdout.readline())
+            if not ready.get("ready"):
+                raise RuntimeError(f"unexpected zygote handshake {ready!r}")
+            self._zygote_proc = z
+        except Exception as e:
+            logger.warning("worker zygote unavailable (%s); using direct spawn", e)
+        finally:
+            self._zygote_booting = False
+
+    def _kick_zygote(self) -> None:
+        """(Re)boot the zygote off the event loop if it isn't running."""
+        if self._zygote_booting:
+            return
+        if self._zygote_proc is not None and self._zygote_proc.poll() is None:
+            return
+        self._zygote_proc = None
+        self._zygote_booting = True
+        if _in_loop():
+            asyncio.get_running_loop().run_in_executor(None, self._boot_zygote)
+        else:
+            self._boot_zygote()
+
+    def _spawn_via_zygote(self, worker_id: str, log_path: str) -> int | None:
+        import json
+        import select
+
+        z = self._zygote_proc
+        if z is None or z.poll() is not None:
+            self._kick_zygote()  # warms up in the background
+            return None  # this spawn goes direct
+        req = {"worker_id": worker_id, "log": log_path,
+               "env": {"RAY_TPU_WORKER_ID": worker_id}}
+        try:
+            z.stdin.write((json.dumps(req) + "\n").encode())
+            z.stdin.flush()
+            # Bounded wait: a wedged zygote must not stall the event loop
+            # (fork replies normally arrive in single-digit ms).
+            ready, _, _ = select.select([z.stdout], [], [], 5.0)
+            if not ready:
+                raise TimeoutError("zygote fork reply timed out")
+            reply = json.loads(z.stdout.readline())
+            return int(reply["pid"])
+        except Exception as e:
+            logger.warning("zygote fork failed (%s); using direct spawn", e)
+            try:
+                z.kill()
+            except Exception:
+                pass
+            self._zygote_proc = None
+            return None
+
     def _start_worker(self, runtime_env: dict | None = None) -> WorkerHandle:
         worker_id = WorkerID.from_random().hex()
+        log_path = os.path.join(self._session_dir, f"worker-{worker_id[:12]}.out")
+        if not runtime_env and get_config().enable_worker_zygote:
+            # Default-env workers fork from the warm zygote image (~ms)
+            # instead of paying interpreter boot + imports per process.
+            pid = self._spawn_via_zygote(worker_id, log_path)
+            if pid is not None:
+                handle = WorkerHandle(worker_id=worker_id, pid=pid,
+                                      proc=PidHandle(pid), env_hash="")
+                handle.registered = (
+                    asyncio.get_running_loop().create_future() if _in_loop() else None)
+                self._workers[worker_id] = handle
+                return handle
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = worker_id
         # Worker stdout goes to a file the log monitor tails; without this
@@ -508,7 +675,7 @@ class Raylet:
             ],
             env=env,
             cwd=working_dir,
-            stdout=open(os.path.join(self._session_dir, f"worker-{worker_id[:12]}.out"), "wb"),
+            stdout=open(log_path, "wb"),
             stderr=subprocess.STDOUT,
         )
         handle = WorkerHandle(worker_id=worker_id, pid=proc.pid, proc=proc,

@@ -59,6 +59,10 @@ MODE_DRIVER = "driver"
 MODE_WORKER = "worker"
 
 
+class FunctionMissingError(RayTpuError):
+    """The GCS has no record of the function (lost export)."""
+
+
 class FunctionManager:
     """Pickled functions/classes in the GCS KV, keyed by content hash
     (reference ``python/ray/_private/function_manager.py``)."""
@@ -87,11 +91,15 @@ class FunctionManager:
                 return self._cache[fid]
         reply = self._worker._gcs_call("KvGet", {"key": "fn:" + fid.hex()})
         if not reply.get("found"):
-            raise RayTpuError(f"Function {fid.hex()} not found in GCS")
+            raise FunctionMissingError(f"Function {fid.hex()} not found in GCS")
         fn = cloudpickle.loads(reply["value"])
         with self._lock:
             self._cache[fid] = fn
         return fn
+
+    def cached(self, fid: bytes):
+        with self._lock:
+            return self._cache.get(fid)
 
 
 class TaskManager:
@@ -861,31 +869,73 @@ class CoreWorker:
                 # Spread tasks salt the key per task (key[-1] != 0): their
                 # queue can never refill, so skip the grace.
                 grace_s = 0.0 if key[-1] else get_config().lease_idle_grace_ms / 1000.0
+                push_batch_cap = get_config().task_push_batch_size
+                # ADAPTIVE batch size: batching amortizes per-RPC overhead
+                # for cheap tasks but SERIALIZES execution within the batch
+                # — two 1s tasks in one batch take 2s on one worker while
+                # other leased workers idle. Start at 1 and ramp up only
+                # while observed per-task time stays well under the RPC
+                # overhead scale; any slow batch resets to 1.
+                cur_batch = 1
+
+                pipeline_cap = get_config().max_pending_lease_requests_per_scheduling_category
+
+                def _pop_batch(queue) -> list:
+                    # Batched pushes defer every reply to the end of the
+                    # batch, so a spec with an ObjectRef arg must go ALONE:
+                    # its dependency may be an earlier task of the same
+                    # batch, whose result only reaches the owner with the
+                    # reply — batching them would deadlock the chain.
+                    # A SHORT queue (fewer specs than pipelines allowed)
+                    # is parallel opportunity, not batching material: other
+                    # lease pipelines can run those specs on other workers
+                    # concurrently — only batch genuine backlog.
+                    limit = cur_batch if len(queue) > pipeline_cap else 1
+                    specs: list = []
+                    while queue and len(specs) < limit:
+                        has_ref = any(
+                            e.get("t") == "r" for e in queue[0].args)
+                        if has_ref and specs:
+                            break
+                        specs.append(queue.pop(0))
+                        if has_ref:
+                            break
+                    return specs
+
                 try:
                     while True:
                         with self._queue_lock:
                             queue = self._task_queues.get(key)
-                            spec = queue.pop(0) if queue else None
-                        if spec is None:
+                            specs = _pop_batch(queue) if queue else []
+                        if not specs:
                             # Drained: hold the lease for a short grace so
                             # an immediate next submit reuses it (sync
                             # loops would otherwise pay a full lease
                             # acquire+return round trip per task).
                             if grace_s > 0:
                                 deadline = time.monotonic() + grace_s
-                                while spec is None and time.monotonic() < deadline:
+                                while not specs and time.monotonic() < deadline:
                                     await asyncio_sleep(0.002)
                                     with self._queue_lock:
                                         queue = self._task_queues.get(key)
-                                        spec = queue.pop(0) if queue else None
-                            if spec is None:
+                                        if queue:
+                                            specs = _pop_batch(queue)
+                            if not specs:
                                 break
                         try:
-                            worker_alive = await self._push_and_complete(spec, worker, worker_id)
+                            push_t0 = time.monotonic()
+                            worker_alive = await self._push_and_complete_batch(
+                                specs, worker, worker_id)
+                            per_task = (time.monotonic() - push_t0) / len(specs)
+                            if per_task < 0.005:
+                                cur_batch = min(push_batch_cap, cur_batch * 4)
+                            else:
+                                cur_batch = 1
                         except BaseException as e:
                             # Never lose a popped spec: cancellation and
-                            # unexpected errors fail it visibly.
-                            self._fail_task(spec, RayTpuError(f"task submission aborted: {type(e).__name__}: {e}"))
+                            # unexpected errors fail them visibly.
+                            for spec in specs:
+                                self._fail_task(spec, RayTpuError(f"task submission aborted: {type(e).__name__}: {e}"))
                             raise
                         if not worker_alive:
                             # Worker died mid-push: drop this lease and loop
@@ -968,7 +1018,34 @@ class CoreWorker:
             else:
                 self._fail_task(spec, WorkerCrashedError(f"Worker died executing {spec.name}: {e}"))
             return False
-        self._handle_task_reply(spec, reply)
+        if not await self._maybe_reexport(spec, reply):
+            self._handle_task_reply(spec, reply)
+        return True
+
+    async def _push_and_complete_batch(self, specs: list, worker: RpcClient,
+                                       worker_id: str) -> bool:
+        """Push a batch of normal-task specs in ONE RPC (handle_PushTasks);
+        single specs keep the one-task path. Returns False when the worker
+        died — every spec of the batch is then retried or failed (the
+        all-or-nothing RPC can't say which ran; same semantics as the
+        single-task death path)."""
+        if len(specs) == 1:
+            return await self._push_and_complete(specs[0], worker, worker_id)
+        try:
+            reply = await worker.call(
+                "PushTasks", {"specs": [s.to_wire() for s in specs]}, timeout=None)
+        except RpcError as e:
+            for spec in specs:
+                if self.task_manager.consume_retry(spec.task_id):
+                    logger.warning("Retrying task %s after worker failure: %s", spec.name, e)
+                    self._enqueue_task(spec)
+                else:
+                    self._fail_task(spec, WorkerCrashedError(
+                        f"Worker died executing {spec.name}: {e}"))
+            return False
+        for spec, r in zip(specs, reply["replies"]):
+            if not await self._maybe_reexport(spec, r):
+                self._handle_task_reply(spec, r)
         return True
 
     def _store_return_item(self, rid: ObjectID, ret: dict) -> None:
@@ -993,6 +1070,31 @@ class CoreWorker:
             node_id = ret["node_id"]
             self.refcounter.add_location(rid, node_id)
             self.memory_store.put_plasma_marker(rid, node_id.encode() if isinstance(node_id, str) else node_id)
+
+    async def _maybe_reexport(self, spec: TaskSpec, reply: dict) -> bool:
+        """Handle a worker's "function not in GCS" reply: the GCS lost the
+        export (a crash inside the snapshot window). We still hold the
+        function — re-export and resubmit (does NOT consume a user retry;
+        nothing ran). Runs ON the io loop, so the KV write is awaited, not
+        run_sync'd (that would deadlock the loop on itself)."""
+        if not reply.get("function_missing"):
+            return False
+        fn = self.functions.cached(spec.function_id)
+        if fn is None:
+            self._fail_task(spec, RayTpuError(
+                f"Function for task {spec.name} lost from the GCS and not "
+                "cached by the owner"))
+            return True
+        logger.warning("Re-exporting function for task %s after GCS loss", spec.name)
+        payload = cloudpickle.dumps(fn)
+        await self.gcs.call(
+            "KvPut",
+            {"key": "fn:" + spec.function_id.hex(), "value": payload,
+             "overwrite": True},
+            timeout=30.0,
+        )
+        self._enqueue_task(spec)
+        return True
 
     def _handle_task_reply(self, spec: TaskSpec, reply: dict) -> None:
         task_id = TaskID(spec.task_id)
@@ -1448,6 +1550,21 @@ class CoreWorker:
             return await self._execute_actor_task(spec, loop)
         return await loop.run_in_executor(None, self._execute_task, spec)
 
+    async def handle_PushTasks(self, p: dict) -> dict:
+        """Batched PushTask for normal tasks: K specs in one RPC, executed
+        sequentially in ONE executor-thread hop, K replies in one response.
+        The per-task cost of the batch-submit path is otherwise dominated
+        by per-hop RPC + thread-handoff overhead, not execution."""
+        import asyncio
+
+        specs = [TaskSpec.from_wire(w) for w in p["specs"]]
+        loop = asyncio.get_running_loop()
+
+        def run_all():
+            return [self._execute_task(s) for s in specs]
+
+        return {"replies": await loop.run_in_executor(None, run_all)}
+
     async def _execute_actor_task(self, spec: TaskSpec, loop) -> dict:
         # Per-caller submission-order delivery with an out-of-order arrival
         # buffer (transport/actor_scheduling_queue.cc). Tasks are RELEASED
@@ -1521,7 +1638,12 @@ class CoreWorker:
                 else:
                     result = _run_to_completion(method(*args, **kwargs))
             else:
-                fn, _tag = self.functions.get(spec.function_id)
+                try:
+                    fn, _tag = self.functions.get(spec.function_id)
+                except FunctionMissingError:
+                    # GCS lost the export (crash inside the snapshot
+                    # window): ask the owner to re-export + resubmit.
+                    return {"function_missing": True}
                 result = _run_to_completion(fn(*args, **kwargs))
             if spec.num_returns == -1:
                 # Streaming generator: iterate + report items; the reply

@@ -16,7 +16,7 @@ from .ids import JobID
 from .worker import MODE_WORKER, CoreWorker, set_global_worker
 
 
-def main() -> None:
+def run(argv: list[str] | None = None) -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--raylet-address", required=True)
     parser.add_argument("--gcs-address", required=True)
@@ -25,7 +25,7 @@ def main() -> None:
     parser.add_argument("--store-path", required=True)
     parser.add_argument("--store-capacity", type=int, required=True)
     parser.add_argument("--job-id", type=int, default=1)
-    args = parser.parse_args()
+    args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, format="[worker %(process)d] %(message)s")
     # SIGUSR1 → dump all thread stacks to the worker log (debugging stuck
@@ -60,6 +60,10 @@ def main() -> None:
     while not stop.wait(timeout=2.0):
         if _os.getppid() != parent:
             break
+
+
+def main() -> None:
+    run()
 
 
 if __name__ == "__main__":

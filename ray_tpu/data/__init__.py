@@ -18,6 +18,7 @@ from .dataset import (
     from_pandas,
     range,  # noqa: A004
     read_binary_files,
+    read_images,
     read_csv,
     read_json,
     read_numpy,
@@ -39,6 +40,7 @@ __all__ = [
     "from_pandas",
     "range",
     "read_binary_files",
+    "read_images",
     "read_csv",
     "read_json",
     "read_numpy",

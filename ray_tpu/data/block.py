@@ -25,7 +25,37 @@ def build_block(rows: list) -> pa.Table:
     for r in rows:
         for k in cols:
             cols[k].append(r.get(k))
-    return pa.table({k: _to_array(v) for k, v in cols.items()})
+    arrays, fields = {}, []
+    for k, v in cols.items():
+        if v and isinstance(v[0], np.ndarray) and v[0].ndim >= 1:
+            # Same tensor machinery as batch_to_block, so multi-dim row
+            # values (e.g. images through map/filter rebuilds) keep their
+            # shape metadata instead of flattening.
+            arr, shape_meta = _tensor_array(np.stack(v))
+            arrays[k] = arr
+            meta = {TENSOR_SHAPE_META: shape_meta} if shape_meta else None
+            fields.append(pa.field(k, arr.type, metadata=meta))
+        else:
+            arrays[k] = _to_array(v)
+            fields.append(pa.field(k, arrays[k].type))
+    return pa.table(arrays, schema=pa.schema(fields))
+
+
+# Field-metadata key recording a tensor column's per-row shape, so >2-D
+# tensors (e.g. HWC images) round-trip through the FixedSizeList storage
+# (reference: ArrowTensorType extension metadata).
+TENSOR_SHAPE_META = b"ray_tpu.tensor_shape"
+
+
+def _tensor_array(v: np.ndarray) -> tuple[pa.Array, bytes | None]:
+    arr = pa.FixedSizeListArray.from_arrays(
+        pa.array(v.reshape(-1)), int(np.prod(v.shape[1:])))
+    shape = None
+    if v.ndim > 2:
+        import json
+
+        shape = json.dumps(list(v.shape[1:])).encode()
+    return arr, shape
 
 
 def _to_array(values: list) -> pa.Array:
@@ -37,6 +67,16 @@ def _to_array(values: list) -> pa.Array:
     return pa.array(values)
 
 
+def _row_shape(col_field: pa.Field):
+    """Per-row tensor shape from field metadata (None = flat width)."""
+    meta = col_field.metadata or {}
+    if TENSOR_SHAPE_META in meta:
+        import json
+
+        return tuple(json.loads(meta[TENSOR_SHAPE_META]))
+    return None
+
+
 def batch_to_block(batch: Any) -> pa.Table:
     """Normalize a user-returned batch (dict of arrays / pandas / arrow /
     list of rows) into an Arrow block."""
@@ -44,6 +84,7 @@ def batch_to_block(batch: Any) -> pa.Table:
         return batch
     if isinstance(batch, dict):
         cols = {}
+        fields = []
         for k, v in batch.items():
             if not isinstance(v, np.ndarray):
                 v = list(v)
@@ -51,15 +92,18 @@ def batch_to_block(batch: Any) -> pa.Table:
                     # Binary stays off the numpy path: fixed-width S dtype
                     # silently truncates values at NUL bytes.
                     cols[k] = pa.array(v)
+                    fields.append(pa.field(k, cols[k].type))
                     continue
                 v = np.asarray(v)  # lists-of-lists -> 2D -> FixedSizeList
             if v.ndim > 1:
-                cols[k] = pa.FixedSizeListArray.from_arrays(
-                    pa.array(v.reshape(-1)), int(np.prod(v.shape[1:]))
-                )
+                arr, shape_meta = _tensor_array(v)
+                cols[k] = arr
+                meta = {TENSOR_SHAPE_META: shape_meta} if shape_meta else None
+                fields.append(pa.field(k, arr.type, metadata=meta))
             else:
                 cols[k] = pa.array(v)
-        return pa.table(cols)
+                fields.append(pa.field(k, cols[k].type))
+        return pa.table(cols, schema=pa.schema(fields))
     if isinstance(batch, list):
         return build_block(batch)
     try:
@@ -77,6 +121,9 @@ class BlockAccessor:
 
     def __init__(self, block: pa.Table):
         self._block = block
+        # Per-column flattened tensor cache: _row would otherwise
+        # re-flatten the whole column per row (O(n^2) take_all).
+        self._flat_cache: dict[str, np.ndarray] = {}
 
     @staticmethod
     def for_block(block: pa.Table) -> "BlockAccessor":
@@ -96,12 +143,13 @@ class BlockAccessor:
 
     def to_numpy(self) -> dict[str, np.ndarray]:
         out = {}
-        for name in self._block.column_names:
+        for idx, name in enumerate(self._block.column_names):
             col = self._block.column(name)
             if pa.types.is_fixed_size_list(col.type):
                 width = col.type.list_size
                 flat = col.combine_chunks().flatten().to_numpy(zero_copy_only=False)
-                out[name] = flat.reshape(self._block.num_rows, width)
+                shape = _row_shape(self._block.schema.field(idx)) or (width,)
+                out[name] = flat.reshape((self._block.num_rows,) + tuple(shape))
             else:
                 out[name] = col.to_numpy(zero_copy_only=False)
         return out
@@ -127,12 +175,17 @@ class BlockAccessor:
 
     def _row(self, i: int) -> dict:
         out = {}
-        for name in self._block.column_names:
+        for idx, name in enumerate(self._block.column_names):
             col = self._block.column(name)
             if pa.types.is_fixed_size_list(col.type):
                 width = col.type.list_size
-                flat = col.combine_chunks().flatten().to_numpy(zero_copy_only=False)
-                out[name] = flat[i * width:(i + 1) * width]
+                flat = self._flat_cache.get(name)
+                if flat is None:
+                    flat = self._flat_cache[name] = (
+                        col.combine_chunks().flatten().to_numpy(zero_copy_only=False))
+                value = flat[i * width:(i + 1) * width]
+                shape = _row_shape(self._block.schema.field(idx))
+                out[name] = value.reshape(shape) if shape else value
             else:
                 out[name] = col[i].as_py()
         return out

@@ -203,38 +203,34 @@ class Dataset:
         return [MaterializedDataset(refs[bounds[i]:bounds[i + 1]]) for i in builtins.range(n)]
 
     # ---------------------------------------------------------------- writes
+    # All writers are pyarrow.fs-backed (reference storage.py:358): `path`
+    # may be a local dir or a filesystem URI (gs://bucket/dir, s3://…).
     def write_parquet(self, path: str) -> None:
-        import os
-
         import pyarrow.parquet as pq
 
-        os.makedirs(path, exist_ok=True)
         for i, block in enumerate(self._iter_blocks()):
-            pq.write_table(block, os.path.join(path, f"part-{i:05d}.parquet"))
+            with ds.open_output(path, f"part-{i:05d}.parquet") as f:
+                pq.write_table(block, f)
 
     def write_json(self, path: str) -> None:
         import json
-        import os
 
-        os.makedirs(path, exist_ok=True)
         for i, block in enumerate(self._iter_blocks()):
             def encode(o):
                 if hasattr(o, "tolist"):
                     return o.tolist()  # numpy arrays round-trip as JSON lists
                 return str(o)
 
-            with open(os.path.join(path, f"part-{i:05d}.json"), "w") as f:
+            with ds.open_output(path, f"part-{i:05d}.json") as f:
                 for row in BlockAccessor.for_block(block).iter_rows():
-                    f.write(json.dumps(row, default=encode) + "\n")
+                    f.write((json.dumps(row, default=encode) + "\n").encode())
 
     def write_csv(self, path: str) -> None:
-        import os
-
         import pyarrow.csv as pcsv
 
-        os.makedirs(path, exist_ok=True)
         for i, block in enumerate(self._iter_blocks()):
-            pcsv.write_csv(block, os.path.join(path, f"part-{i:05d}.csv"))
+            with ds.open_output(path, f"part-{i:05d}.csv") as f:
+                pcsv.write_csv(block, f)
 
     def __repr__(self):
         return f"Dataset(ops={[o.name for o in self._last_op.chain()]})"
@@ -331,8 +327,12 @@ def from_items(items: list, *, parallelism: int = 8) -> Dataset:
     return Dataset(L.Read("read_items", read_tasks=ds.items_tasks(items, parallelism)))
 
 
-def read_parquet(paths) -> Dataset:
-    return Dataset(L.Read("read_parquet", read_tasks=ds.parquet_tasks(paths)))
+def read_parquet(paths, *, row_groups_per_task: int | None = 4) -> Dataset:
+    """Read parquet files (local paths, globs, dirs, or gs://-style URIs).
+    Tasks split at row-group granularity so datasets larger than host RAM
+    stream through the executor as bounded blocks."""
+    return Dataset(L.Read("read_parquet", read_tasks=ds.parquet_tasks(
+        paths, row_groups_per_task=row_groups_per_task)))
 
 
 def read_csv(paths) -> Dataset:
@@ -370,3 +370,12 @@ def read_text(paths) -> Dataset:
 def read_binary_files(paths) -> Dataset:
     """One row per file: columns ``path`` and ``bytes``."""
     return Dataset(L.Read("read_binary", read_tasks=ds.binary_tasks(paths)))
+
+
+def read_images(paths, *, size: tuple[int, int] | None = None,
+                mode: str | None = None) -> Dataset:
+    """Decode images into an ``image`` tensor column + ``path`` (reference
+    ``datasource/image_datasource.py``). ``size=(h, w)`` resizes, ``mode``
+    converts (e.g. "RGB")."""
+    return Dataset(L.Read("read_images", read_tasks=ds.images_tasks(
+        paths, size=size, mode=mode)))

@@ -14,9 +14,11 @@ accelerator.
 from .algorithm import Algorithm, AlgorithmConfig
 from .env import CartPole, GridWorld
 from .env_runner import EnvRunner, EnvRunnerGroup
+from .impala import APPO, APPOConfig, IMPALA, IMPALAConfig
 from .learner import Learner
 from .learner_group import LearnerGroup
 from .dqn import DQN, DQNConfig
+from .offline import BC, BCConfig, CQL, CQLConfig, collect_offline_data
 from .ppo import PPO, PPOConfig
 from .replay import ReplayBuffer
 
@@ -33,5 +35,14 @@ __all__ = [
     "PPOConfig",
     "DQN",
     "DQNConfig",
+    "IMPALA",
+    "IMPALAConfig",
+    "APPO",
+    "APPOConfig",
+    "BC",
+    "BCConfig",
+    "CQL",
+    "CQLConfig",
+    "collect_offline_data",
     "ReplayBuffer",
 ]

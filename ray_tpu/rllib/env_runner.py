@@ -84,6 +84,10 @@ class EnvRunner:
             "dones": done_buf,
             "trunc_values": trunc_val_buf,
             "last_value": last_value,
+            # Bootstrap observation: off-policy consumers (V-trace) must
+            # evaluate V(x_T) under the TARGET params, not the behavior
+            # policy's value above.
+            "last_obs": self.obs.copy(),
             "episode_returns": np.asarray(completed, np.float32),
         }
 

@@ -1,0 +1,260 @@
+"""IMPALA / APPO: asynchronous actor-learner RL with V-trace.
+
+Equivalent of ``rllib/algorithms/impala/impala.py`` and
+``rllib/algorithms/appo/appo.py``: EnvRunner actors sample continuously
+— the learner consumes whichever rollout finishes first (``ray.wait``)
+instead of barriering on the whole fleet, so slow runners never stall
+training and fast ones never idle. Because consumed rollouts were
+collected under a LAGGED policy, the advantage estimator is V-trace
+(Espeholt et al. 2018): truncated importance weights correct the
+off-policy value targets and policy gradient. APPO layers PPO's clipped
+surrogate on top of the V-trace advantages (the reference's APPO is
+exactly IMPALA + clipping).
+
+TPU shape: V-trace's reverse recursion runs INSIDE the jitted loss as a
+``lax.scan`` over time — one fused device program per update (the
+reference splits this across torch ops); rollouts stream through the
+object store from runner actors to the learner.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import models
+from .algorithm import Algorithm, AlgorithmConfig
+from .env_runner import EnvRunnerGroup
+from .learner_group import LearnerGroup
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.gamma = 0.99
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.rho_clip = 1.0          # V-trace rho-bar (importance clip)
+        self.c_clip = 1.0            # V-trace c-bar (trace-cutting clip)
+        self.num_batches_per_iteration = 4
+        self.hidden = 64
+        self.lr = 1e-3
+        # APPO extra: clipped surrogate on V-trace advantages (None = off).
+        self.clip_eps: float | None = None
+
+    def training(self, *, gamma=None, vf_coeff=None, entropy_coeff=None,
+                 rho_clip=None, c_clip=None, num_batches_per_iteration=None,
+                 hidden=None, clip_eps=None, **kwargs):
+        for name, val in (("gamma", gamma), ("vf_coeff", vf_coeff),
+                          ("entropy_coeff", entropy_coeff), ("rho_clip", rho_clip),
+                          ("c_clip", c_clip),
+                          ("num_batches_per_iteration", num_batches_per_iteration),
+                          ("hidden", hidden), ("clip_eps", clip_eps)):
+            if val is not None:
+                setattr(self, name, val)
+        return super().training(**kwargs)
+
+
+def make_vtrace_loss(gamma: float, vf_coeff: float, entropy_coeff: float,
+                     rho_clip: float, c_clip: float,
+                     clip_eps: float | None = None):
+    """V-trace actor-critic loss over a [T, N] rollout fragment.
+
+    batch: obs [T,N,D], actions [T,N], logp_old [T,N] (behavior policy),
+    rewards [T,N], dones [T,N], trunc_values [T,N] (V(terminal) at
+    time-limit truncations under the BEHAVIOR policy — bootstrap, not a
+    true termination), last_obs [N,D].
+    With ``clip_eps`` the policy term is APPO's clipped surrogate.
+    """
+
+    def loss_fn(params, batch):
+        T, N = batch["actions"].shape
+        obs = batch["obs"]
+        logits, values = models.forward(params, obs.reshape(T * N, -1))
+        logits = logits.reshape(T, N, -1)
+        values = values.reshape(T, N)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=2)[..., 0]
+        ratio = jnp.exp(logp - batch["logp_old"])
+        rho = jax.lax.stop_gradient(jnp.minimum(rho_clip, ratio))
+        c = jax.lax.stop_gradient(jnp.minimum(c_clip, ratio))
+
+        _, last_value = models.forward(params, batch["last_obs"])  # [N]
+        not_done = 1.0 - batch["dones"].astype(jnp.float32)
+        # V(x_{t+1}) with episode boundaries: zero at terminations, the
+        # behavior-policy bootstrap at time-limit truncations.
+        v_tp1 = jnp.concatenate([values[1:], last_value[None]], axis=0)
+        v_next = v_tp1 * not_done + batch["trunc_values"]
+        v_fixed = jax.lax.stop_gradient(values)
+        v_next_fixed = jax.lax.stop_gradient(v_next)
+        deltas = rho * (batch["rewards"] + gamma * v_next_fixed - v_fixed)
+
+        # vs_t - V(x_t) by reverse scan:
+        #   a_t = delta_t + gamma * c_t * not_done_t * a_{t+1}
+        def body(acc, xs):
+            delta_t, c_t, nd_t = xs
+            acc = delta_t + gamma * c_t * nd_t * acc
+            return acc, acc
+
+        _, adv_rev = jax.lax.scan(
+            body, jnp.zeros_like(last_value),
+            (deltas[::-1], c[::-1], not_done[::-1]))
+        vs_minus_v = adv_rev[::-1]
+        vs = v_fixed + vs_minus_v
+        # vs_{t+1} for the policy-gradient target (zero past terminations).
+        vs_tp1 = jnp.concatenate(
+            [vs[1:], jax.lax.stop_gradient(last_value)[None]], axis=0)
+        vs_next = vs_tp1 * not_done + batch["trunc_values"]
+        pg_adv = rho * (batch["rewards"] + gamma * vs_next - v_fixed)
+
+        if clip_eps is not None:
+            # APPO: PPO's clipped surrogate with V-trace advantages.
+            surr = jnp.minimum(
+                ratio * pg_adv,
+                jnp.clip(ratio, 1 - clip_eps, 1 + clip_eps) * pg_adv)
+            policy_loss = -surr.mean()
+        else:
+            policy_loss = -(logp * pg_adv).mean()
+        vf_loss = 0.5 * jnp.mean((values - vs) ** 2)
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=2).mean()
+        total = policy_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+        metrics = {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_rho": rho.mean(),
+            "clipped_rho_frac": (ratio > rho_clip).mean(),
+        }
+        return total, metrics
+
+    return loss_fn
+
+
+class IMPALA(Algorithm):
+    """Async actor-learner loop. Every runner always has one sample
+    request in flight; ``training_step`` drains whichever complete first
+    (up to ``num_batches_per_iteration``), updates on each, and refreshes
+    the weights the NEXT requests will use — rollout and update overlap,
+    the V-trace correction absorbs the policy lag."""
+
+    def _setup(self) -> None:
+        c: IMPALAConfig = self.config  # type: ignore[assignment]
+        if c.num_learners > 0:
+            # The data-parallel LearnerGroup shards batches over axis 0 —
+            # that is TIME for a V-trace rollout, which would truncate the
+            # trace recursion at shard boundaries. Canonical IMPALA is one
+            # learner + many async actors anyway.
+            raise ValueError(
+                "IMPALA/APPO scale via async env runners (num_env_runners); "
+                "use num_learners=0 (single in-process learner)")
+        env_probe = c.env_cls(num_envs=1)
+        obs_dim, n_actions = env_probe.obs_dim, env_probe.n_actions
+
+        def init_params_fn(key):
+            return models.init_policy(key, obs_dim, n_actions, c.hidden)
+
+        self.learner_group = LearnerGroup(
+            make_vtrace_loss(c.gamma, c.vf_coeff, c.entropy_coeff,
+                             c.rho_clip, c.c_clip, c.clip_eps),
+            init_params_fn,
+            num_learners=c.num_learners,
+            lr=c.lr,
+            max_grad_norm=c.max_grad_norm,
+            seed=c.seed,
+        )
+        self.env_runner_group = EnvRunnerGroup(
+            c.env_cls,
+            num_env_runners=c.num_env_runners,
+            num_envs_per_runner=c.num_envs_per_runner,
+            rollout_len=c.rollout_len,
+            seed=c.seed,
+        )
+        self._inflight: dict = {}  # sample ref -> runner actor
+        self._recent_returns: list[float] = []
+        self._env_steps = 0
+
+    # ------------------------------------------------------------ async loop
+    def _refill(self, weights) -> None:
+        from ..core import api as ray
+
+        busy = set(self._inflight.values())
+        for actor in self.env_runner_group._actors:
+            if actor not in busy:
+                self._inflight[actor.sample.remote(weights)] = actor
+
+    def _await_one(self, timeout: float = 300.0):
+        """Pop ONE completed rollout (and the runner that produced it);
+        runners without an in-flight request get one first."""
+        from ..core import api as ray
+
+        if not self.env_runner_group._actors:
+            # Degenerate local mode: synchronous (still V-trace-corrected —
+            # lag is simply zero).
+            return self.env_runner_group._local.sample(
+                self.learner_group.get_weights()), None
+        self._refill(self.learner_group.get_weights())
+        ready, _ = ray.wait(list(self._inflight), num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("no rollout completed within the timeout")
+        ref = ready[0]
+        return ray.get(ref, timeout=60), self._inflight.pop(ref)
+
+    def training_step(self) -> dict:
+        from ..core import api as ray
+
+        c: IMPALAConfig = self.config  # type: ignore[assignment]
+        metrics: dict = {}
+        for _ in range(c.num_batches_per_iteration):
+            sample, actor = self._await_one()
+            batch = {
+                "obs": sample["obs"],
+                "actions": sample["actions"],
+                "logp_old": sample["logp"],
+                "rewards": sample["rewards"],
+                "dones": sample["dones"],
+                "trunc_values": sample["trunc_values"],
+                "last_obs": sample["last_obs"],
+            }
+            metrics = self.learner_group.update(batch)
+            if actor is not None:
+                # Resubmit with the JUST-updated weights: the runner never
+                # idles and its next rollout lags by at most one update.
+                self._inflight[actor.sample.remote(
+                    self.learner_group.get_weights())] = actor
+            self._recent_returns.extend(sample["episode_returns"].tolist())
+            self._env_steps += sample["rewards"].size
+
+        self._recent_returns = self._recent_returns[-100:]
+        metrics["episode_return_mean"] = (
+            float(np.mean(self._recent_returns)) if self._recent_returns else 0.0
+        )
+        metrics["num_env_steps_sampled"] = self._env_steps
+        return metrics
+
+    def get_state(self) -> dict:
+        return {"iteration": self.iteration, "learner": self.learner_group.get_state()}
+
+    def set_state(self, state: dict) -> None:
+        self.iteration = state["iteration"]
+        self.learner_group.set_state(state["learner"])
+
+
+IMPALAConfig.algo_cls = IMPALA
+
+
+class APPOConfig(IMPALAConfig):
+    """APPO = IMPALA's async architecture + PPO's clipped surrogate
+    (reference ``rllib/algorithms/appo/appo.py``)."""
+
+    def __init__(self):
+        super().__init__()
+        self.clip_eps = 0.2
+
+
+class APPO(IMPALA):
+    pass
+
+
+APPOConfig.algo_cls = APPO

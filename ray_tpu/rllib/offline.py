@@ -1,0 +1,320 @@
+"""Offline RL: datasets of recorded transitions + BC / CQL training.
+
+Equivalent of the reference's offline stack
+(``rllib/offline/offline_data.py`` — Datasets of recorded experience fed
+to offline algorithms; ``rllib/algorithms/bc/bc.py``,
+``rllib/algorithms/cql/cql.py``): experience is recorded to parquet via
+``collect_offline_data`` (the reference records through RolloutWorker
+output writers), read back as a ``ray_tpu.data.Dataset``, and consumed by
+
+  * **BC** — behavior cloning: supervised ``-log pi(a|s)``;
+  * **CQL** — conservative Q-learning (discrete): double-DQN TD loss plus
+    the CQL regularizer ``alpha * (logsumexp_a Q(s,a) - Q(s, a_data))``
+    that penalizes out-of-distribution action optimism.
+
+Both train WITHOUT an environment; evaluation rolls the learned policy
+in a live env only when one is configured.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import models
+from .algorithm import Algorithm, AlgorithmConfig
+from .learner_group import LearnerGroup
+
+
+def collect_offline_data(env_cls, n_steps: int, path: str, *,
+                         num_envs: int = 8, seed: int = 0,
+                         policy_weights=None, policy_fn=None,
+                         epsilon: float = 0.3) -> int:
+    """Roll a behavior policy — MLP ``policy_weights``, a callable
+    ``policy_fn(obs) -> actions``, or uniformly random — epsilon-greedily
+    and write ``(obs, action, reward, next_obs, terminated)`` transitions
+    to a parquet dataset at ``path``. Returns rows written."""
+    from .env_runner import _np_forward
+
+    rng = np.random.default_rng(seed)
+    env = env_cls(num_envs=num_envs, seed=seed)
+    obs = env.reset()
+    rows = {"obs": [], "action": [], "reward": [], "next_obs": [], "terminated": []}
+    steps = 0
+    while steps < n_steps:
+        if policy_fn is not None:
+            greedy = np.asarray(policy_fn(obs))
+            explore = rng.random(num_envs) < epsilon
+            actions = np.where(explore, rng.integers(0, env.n_actions, num_envs), greedy)
+        elif policy_weights is None:
+            actions = rng.integers(0, env.n_actions, num_envs)
+        else:
+            logits, _ = _np_forward(policy_weights, obs)
+            greedy = logits.argmax(axis=1)
+            explore = rng.random(num_envs) < epsilon
+            actions = np.where(explore, rng.integers(0, env.n_actions, num_envs), greedy)
+        nxt, rewards, dones, info = env.step(actions)
+        terminal_obs = info.get("terminal_obs")
+        for i in range(num_envs):
+            # At episode end `nxt` is the auto-reset obs; record the true
+            # successor state for the TD target.
+            succ = terminal_obs[i] if (dones[i] and terminal_obs is not None) else nxt[i]
+            rows["obs"].append(np.asarray(obs[i], np.float32))
+            rows["action"].append(int(actions[i]))
+            rows["reward"].append(float(rewards[i]))
+            rows["next_obs"].append(np.asarray(succ, np.float32))
+            terminated = bool(dones[i]) and not bool(info["truncated"][i])
+            rows["terminated"].append(terminated)
+        obs = nxt
+        steps += num_envs
+    from .. import data as rd
+
+    ds = rd.from_items([
+        {k: rows[k][i] for k in rows} for i in range(len(rows["action"]))
+    ], parallelism=4)
+    ds.write_parquet(path)
+    return len(rows["action"])
+
+
+class OfflineConfig(AlgorithmConfig):
+    """Shared config for env-free algorithms: the data source replaces
+    the env; obs/action space comes from the data (or an optional
+    eval env)."""
+
+    def __init__(self):
+        super().__init__()
+        self.dataset = None            # ray_tpu.data.Dataset of transitions
+        self.dataset_path: str | None = None  # or a parquet path
+        self.batch_size = 256
+        self.updates_per_iteration = 32
+        self.hidden = 64
+        self.eval_env_cls = None       # optional: rollout eval per iteration
+        self.eval_episodes = 4
+
+    def offline_data(self, *, dataset=None, dataset_path=None, batch_size=None,
+                     updates_per_iteration=None) -> "OfflineConfig":
+        if dataset is not None:
+            self.dataset = dataset
+        if dataset_path is not None:
+            self.dataset_path = dataset_path
+        if batch_size is not None:
+            self.batch_size = batch_size
+        if updates_per_iteration is not None:
+            self.updates_per_iteration = updates_per_iteration
+        return self
+
+    def evaluation(self, *, eval_env_cls=None, eval_episodes=None) -> "OfflineConfig":
+        if eval_env_cls is not None:
+            self.eval_env_cls = eval_env_cls
+        if eval_episodes is not None:
+            self.eval_episodes = eval_episodes
+        return self
+
+
+class _OfflineAlgorithm(Algorithm):
+    """Shared setup: resolve the dataset, infer dims, loop minibatches."""
+
+    def _dataset(self):
+        c: OfflineConfig = self.config  # type: ignore[assignment]
+        if c.dataset is not None:
+            return c.dataset
+        if c.dataset_path is None:
+            raise ValueError("offline algorithms need .offline_data(dataset=|dataset_path=)")
+        from .. import data as rd
+
+        return rd.read_parquet(c.dataset_path)
+
+    def _load_transitions(self) -> dict:
+        """Materialize the (bounded) dataset into flat numpy arrays once;
+        iteration then shuffles minibatches from host RAM (the reference
+        maps Dataset batches through the learner the same way)."""
+        rows = self._dataset().take_all()
+        obs = np.stack([np.asarray(r["obs"], np.float32) for r in rows])
+        out = {
+            "obs": obs,
+            "actions": np.asarray([r["action"] for r in rows], np.int64),
+        }
+        if "reward" in rows[0]:
+            out["rewards"] = np.asarray([r["reward"] for r in rows], np.float32)
+            out["next_obs"] = np.stack(
+                [np.asarray(r["next_obs"], np.float32) for r in rows])
+            out["terminated"] = np.asarray(
+                [float(r["terminated"]) for r in rows], np.float32)
+        return out
+
+    def _evaluate(self) -> float | None:
+        c: OfflineConfig = self.config  # type: ignore[assignment]
+        if c.eval_env_cls is None:
+            return None
+        from .env_runner import _np_forward
+
+        weights = self.learner_group.get_weights()
+        env = c.eval_env_cls(num_envs=c.eval_episodes, seed=c.seed + 1)
+        obs = env.reset()
+        done = np.zeros(c.eval_episodes, bool)
+        returns = np.zeros(c.eval_episodes, np.float32)
+        for _ in range(env.max_steps if hasattr(env, "max_steps") else 500):
+            logits, _ = _np_forward(weights, obs)
+            obs, rewards, dones, _ = env.step(logits.argmax(axis=1))
+            returns += rewards * ~done
+            done |= dones
+            if done.all():
+                break
+        return float(returns.mean())
+
+
+class BCConfig(OfflineConfig):
+    pass
+
+
+def make_bc_loss():
+    def loss_fn(params, batch):
+        logits, _ = models.forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, batch["actions"][:, None], axis=1)[:, 0]
+        loss = -logp.mean()
+        acc = (jnp.argmax(logits, axis=1) == batch["actions"]).mean()
+        return loss, {"bc_loss": loss, "action_accuracy": acc}
+
+    return loss_fn
+
+
+class BC(_OfflineAlgorithm):
+    def _setup(self) -> None:
+        c: BCConfig = self.config  # type: ignore[assignment]
+        self._transitions = self._load_transitions()
+        obs_dim = self._transitions["obs"].shape[1]
+        n_actions = int(self._transitions["actions"].max()) + 1
+        if c.eval_env_cls is not None:
+            n_actions = max(n_actions, c.eval_env_cls(num_envs=1).n_actions)
+        self.learner_group = LearnerGroup(
+            make_bc_loss(),
+            lambda key: models.init_policy(key, obs_dim, n_actions, c.hidden),
+            num_learners=c.num_learners, lr=c.lr,
+            max_grad_norm=c.max_grad_norm, seed=c.seed,
+        )
+        self.rng = np.random.default_rng(c.seed)
+
+    def training_step(self) -> dict:
+        c: BCConfig = self.config  # type: ignore[assignment]
+        data, metrics = self._transitions, {}
+        n = len(data["actions"])
+        for _ in range(c.updates_per_iteration):
+            idx = self.rng.integers(0, n, min(c.batch_size, n))
+            metrics = self.learner_group.update(
+                {"obs": data["obs"][idx], "actions": data["actions"][idx]})
+        ret = self._evaluate()
+        if ret is not None:
+            metrics["episode_return_mean"] = ret
+        return metrics
+
+    def get_state(self) -> dict:
+        return {"iteration": self.iteration, "learner": self.learner_group.get_state()}
+
+    def set_state(self, state: dict) -> None:
+        self.iteration = state["iteration"]
+        self.learner_group.set_state(state["learner"])
+
+
+BCConfig.algo_cls = BC
+
+
+class CQLConfig(OfflineConfig):
+    def __init__(self):
+        super().__init__()
+        self.gamma = 0.99
+        self.cql_alpha = 1.0
+        self.target_update_freq = 100
+        self.lr = 5e-4
+
+    def training(self, *, gamma=None, cql_alpha=None, target_update_freq=None,
+                 **kwargs):
+        for name, val in (("gamma", gamma), ("cql_alpha", cql_alpha),
+                          ("target_update_freq", target_update_freq)):
+            if val is not None:
+                setattr(self, name, val)
+        return super().training(**kwargs)
+
+
+def make_cql_loss(gamma: float, cql_alpha: float):
+    """Discrete CQL: double-DQN TD + conservative regularizer."""
+
+    def loss_fn(params, batch):
+        q_all, _ = models.forward(params, batch["obs"])
+        q_sa = jnp.take_along_axis(q_all, batch["actions"][:, None], axis=1)[:, 0]
+        q_next_t, _ = models.forward(batch["target_params"], batch["next_obs"])
+        q_next_o, _ = models.forward(params, batch["next_obs"])
+        a_sel = jnp.argmax(q_next_o, axis=1)
+        q_next = jnp.take_along_axis(q_next_t, a_sel[:, None], axis=1)[:, 0]
+        target = batch["rewards"] + gamma * (1.0 - batch["terminated"]) * q_next
+        td = q_sa - jax.lax.stop_gradient(target)
+        td_loss = jnp.mean(jnp.where(jnp.abs(td) < 1.0, 0.5 * td**2, jnp.abs(td) - 0.5))
+        # Conservative term: push down Q on unseen actions relative to the
+        # dataset's actions.
+        cql_term = jnp.mean(jax.scipy.special.logsumexp(q_all, axis=1) - q_sa)
+        loss = td_loss + cql_alpha * cql_term
+        return loss, {
+            "td_loss": td_loss,
+            "cql_regularizer": cql_term,
+            "q_data_mean": q_sa.mean(),
+        }
+
+    return loss_fn
+
+
+class CQL(_OfflineAlgorithm):
+    def _setup(self) -> None:
+        c: CQLConfig = self.config  # type: ignore[assignment]
+        if c.num_learners > 0:
+            # The batch carries target_params (a pytree), which the
+            # data-parallel shard-by-row path cannot split.
+            raise ValueError("CQL supports num_learners=0 (single learner)")
+        self._transitions = self._load_transitions()
+        if "rewards" not in self._transitions:
+            raise ValueError("CQL needs full transitions (reward/next_obs/terminated)")
+        obs_dim = self._transitions["obs"].shape[1]
+        n_actions = int(self._transitions["actions"].max()) + 1
+        if c.eval_env_cls is not None:
+            n_actions = max(n_actions, c.eval_env_cls(num_envs=1).n_actions)
+        self.learner_group = LearnerGroup(
+            make_cql_loss(c.gamma, c.cql_alpha),
+            lambda key: models.init_policy(key, obs_dim, n_actions, c.hidden),
+            num_learners=c.num_learners, lr=c.lr,
+            max_grad_norm=c.max_grad_norm, seed=c.seed,
+        )
+        self.rng = np.random.default_rng(c.seed)
+        self._target_params = self.learner_group.get_weights()
+        self._updates = 0
+
+    def training_step(self) -> dict:
+        c: CQLConfig = self.config  # type: ignore[assignment]
+        data, metrics = self._transitions, {}
+        n = len(data["actions"])
+        for _ in range(c.updates_per_iteration):
+            idx = self.rng.integers(0, n, min(c.batch_size, n))
+            metrics = self.learner_group.update({
+                "obs": data["obs"][idx],
+                "actions": data["actions"][idx],
+                "rewards": data["rewards"][idx],
+                "next_obs": data["next_obs"][idx],
+                "terminated": data["terminated"][idx],
+                "target_params": self._target_params,
+            })
+            self._updates += 1
+            if self._updates % c.target_update_freq == 0:
+                self._target_params = self.learner_group.get_weights()
+        ret = self._evaluate()
+        if ret is not None:
+            metrics["episode_return_mean"] = ret
+        return metrics
+
+    def get_state(self) -> dict:
+        return {"iteration": self.iteration, "learner": self.learner_group.get_state()}
+
+    def set_state(self, state: dict) -> None:
+        self.iteration = state["iteration"]
+        self.learner_group.set_state(state["learner"])
+
+
+CQLConfig.algo_cls = CQL

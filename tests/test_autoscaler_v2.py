@@ -1,0 +1,252 @@
+"""Autoscaler v2: typed instance lifecycle + GKE/KubeRay provider.
+
+References: ``python/ray/autoscaler/v2/instance_manager/`` (typed FSM,
+stuck-instance reconciliation) and
+``python/ray/autoscaler/_private/kuberay/node_provider.py`` (CR-patching
+scale semantics, precise scale-down, multi-host replicaIndex).
+"""
+
+import pytest
+
+from ray_tpu.autoscaler.gke import GkeTpuNodeProvider
+from ray_tpu.autoscaler.instance_manager import (
+    ALLOCATED,
+    ALLOCATION_FAILED,
+    RAY_RUNNING,
+    REQUESTED,
+    TERMINATED,
+    TERMINATING,
+    InstanceManager,
+    InvalidTransition,
+)
+
+
+class FakeCloud:
+    """NodeProvider test double with controllable visibility/failures."""
+
+    def __init__(self):
+        self.created = []
+        self.terminated = []
+        self.visible = set()
+        self.fail_create = False
+        self.ignore_terminate = False
+        self._n = 0
+
+    def create_node(self, node_type, resources):
+        if self.fail_create:
+            raise RuntimeError("stockout")
+        self._n += 1
+        iid = f"vm-{self._n}"
+        self.created.append(iid)
+        self.visible.add(iid)
+        return iid
+
+    def terminate_node(self, iid):
+        self.terminated.append(iid)
+        if not self.ignore_terminate:
+            self.visible.discard(iid)
+
+    def non_terminated_nodes(self):
+        return {iid: "t" for iid in self.visible}
+
+    def node_id_of(self, iid):
+        return None
+
+
+def test_instance_lifecycle_happy_path():
+    cloud = FakeCloud()
+    mgr = InstanceManager(cloud)
+    iid = mgr.create_node("t", {"CPU": 1})
+    (inst,) = mgr.instances()
+    assert inst.state == REQUESTED and inst.cloud_instance_id == iid
+
+    mgr.reconcile([])
+    assert mgr.instances()[0].state == ALLOCATED
+
+    mgr.reconcile([{"node_id": "gcs-node-1", "state": "ALIVE"}])
+    inst = mgr.instances()[0]
+    assert inst.state == RAY_RUNNING and inst.node_id == "gcs-node-1"
+
+    mgr.terminate_node(iid)
+    assert mgr.instances()[0].state == TERMINATING
+    mgr.reconcile([])
+    assert mgr.instances()[0].state == TERMINATED
+
+
+def test_allocation_failure_retries_then_gives_up():
+    cloud = FakeCloud()
+    cloud.fail_create = True
+    mgr = InstanceManager(cloud, max_allocation_retries=2)
+    mgr.create_node("t", {"CPU": 1})
+    assert mgr.instances()[0].state == ALLOCATION_FAILED
+    repairs = mgr.reconcile([])
+    assert repairs["allocation_retried"] == 1
+    assert mgr.instances()[0].state == ALLOCATION_FAILED  # retry also failed
+    mgr.reconcile([])
+    repairs = mgr.reconcile([])
+    assert repairs["allocation_failed"] == 1
+    assert mgr.instances()[0].state == TERMINATED
+
+    # ...but a recovered cloud lets a retry succeed
+    cloud2 = FakeCloud()
+    cloud2.fail_create = True
+    mgr2 = InstanceManager(cloud2, max_allocation_retries=2)
+    mgr2.create_node("t", {"CPU": 1})
+    cloud2.fail_create = False
+    mgr2.reconcile([])
+    assert mgr2.instances()[0].state == REQUESTED
+    assert mgr2.instances()[0].retries == 1
+
+
+def test_stuck_ray_boot_replaced():
+    cloud = FakeCloud()
+    mgr = InstanceManager(cloud, ray_boot_timeout_s=0.0)
+    mgr.create_node("t", {})
+    mgr.reconcile([])  # -> ALLOCATED
+    repairs = mgr.reconcile([])  # boot timeout immediately (0s)
+    assert repairs["ray_boot_timeout"] == 1
+    inst = mgr.instances()[0]
+    assert inst.state == TERMINATING
+    assert cloud.terminated == [inst.cloud_instance_id]
+
+
+def test_stuck_terminate_reissued():
+    cloud = FakeCloud()
+    cloud.ignore_terminate = True
+    mgr = InstanceManager(cloud, terminate_timeout_s=0.0)
+    iid = mgr.create_node("t", {})
+    mgr.reconcile([])
+    mgr.terminate_node(iid)
+    repairs = mgr.reconcile([])
+    assert repairs["terminate_reissued"] == 1
+    assert cloud.terminated.count(iid) == 2
+
+
+def test_preexisting_gcs_nodes_never_claimed():
+    """The head node (alive before any managed instance) must not be
+    matched to an ALLOCATED instance."""
+    cloud = FakeCloud()
+    mgr = InstanceManager(cloud)
+    mgr.reconcile([{"node_id": "head", "state": "ALIVE"}])  # snapshot
+    mgr.create_node("t", {})
+    mgr.reconcile([{"node_id": "head", "state": "ALIVE"}])
+    assert mgr.instances()[0].state == ALLOCATED  # not RAY_RUNNING via head
+    mgr.reconcile([{"node_id": "head", "state": "ALIVE"},
+                   {"node_id": "w1", "state": "ALIVE"}])
+    inst = mgr.instances()[0]
+    assert inst.state == RAY_RUNNING and inst.node_id == "w1"
+
+
+def test_invalid_transition_rejected():
+    cloud = FakeCloud()
+    mgr = InstanceManager(cloud)
+    mgr.create_node("t", {})
+    inst = mgr.instances()[0]
+    with pytest.raises(InvalidTransition):
+        mgr._transition(inst, RAY_RUNNING and TERMINATED)  # REQUESTED -> TERMINATED
+
+
+# ---------------------------------------------------------------- GKE/KubeRay
+
+
+class FakeK8s:
+    """Mimics the RayCluster CR + the operator's pod actuation: the
+    operator deletes exactly the named workers and creates fresh replicas
+    to reach the requested count (KubeRay semantics)."""
+
+    def __init__(self, groups):
+        self.cr = {"spec": {"workerGroupSpecs": [
+            {"groupName": name, "replicas": 0, "numOfHosts": hosts}
+            for name, hosts in groups.items()
+        ]}}
+        self.live: dict[str, list[str]] = {name: [] for name in groups}
+        self._next: dict[str, int] = {name: 0 for name in groups}
+        self.patches = []
+
+    def _operate(self):
+        """The operator's reconcile: actuate pods to match the CR."""
+        for g in self.cr["spec"]["workerGroupSpecs"]:
+            name = g["groupName"]
+            deleted = set((g.get("scaleStrategy") or {}).get("workersToDelete") or [])
+            self.live[name] = [r for r in self.live[name] if r not in deleted]
+            while len(self.live[name]) < int(g.get("replicas") or 0):
+                self.live[name].append(f"{name}-r{self._next[name]}")
+                self._next[name] += 1
+
+    def request(self, method, path, body=None):
+        if method == "GET" and "/rayclusters/" in path:
+            return self.cr
+        if method == "PATCH":
+            self.patches.append(body)
+            for op in body:
+                parts = op["path"].strip("/").split("/")
+                target = self.cr
+                for p in parts[:-1]:
+                    target = target[int(p)] if p.isdigit() else target[p]
+                target[parts[-1]] = op["value"]
+            self._operate()
+            return {}
+        if method == "GET" and "/pods" in path:
+            items = []
+            for g in self.cr["spec"]["workerGroupSpecs"]:
+                for rid in self.live[g["groupName"]]:
+                    for h in range(int(g.get("numOfHosts") or 1)):
+                        items.append({
+                            "metadata": {
+                                "name": f"{rid}-host{h}",
+                                "labels": {
+                                    "ray.io/node-type": "worker",
+                                    "ray.io/group": g["groupName"],
+                                    "replicaIndex": rid,
+                                },
+                            },
+                            "status": {"phase": "Running"},
+                        })
+            return {"items": items}
+        raise AssertionError((method, path))
+
+
+def make_gke(groups=None):
+    k8s = FakeK8s(groups or {"tpu-v5e-16": 4})
+    return GkeTpuNodeProvider("ns", "rc", transport=k8s), k8s
+
+
+def test_gke_scale_up_patches_replicas():
+    p, k8s = make_gke()
+    p.create_node("tpu-v5e-16", {})
+    assert k8s.cr["spec"]["workerGroupSpecs"][0]["replicas"] == 1
+    # one REPLICA (multi-host slice) == one node, though numOfHosts=4 pods
+    nodes = p.non_terminated_nodes()
+    assert nodes == {"tpu-v5e-16-r0": "tpu-v5e-16"}
+
+
+def test_gke_precise_scale_down():
+    p, k8s = make_gke()
+    p.create_node("tpu-v5e-16", {})
+    p.create_node("tpu-v5e-16", {})
+    assert len(p.non_terminated_nodes()) == 2
+    p.terminate_node("tpu-v5e-16-r0")
+    spec = k8s.cr["spec"]["workerGroupSpecs"][0]
+    assert spec["replicas"] == 1
+    assert spec["scaleStrategy"]["workersToDelete"] == ["tpu-v5e-16-r0"]
+    assert list(p.non_terminated_nodes()) == ["tpu-v5e-16-r1"]
+
+
+def test_gke_unknown_group_rejected():
+    p, _ = make_gke()
+    with pytest.raises(ValueError, match="worker group"):
+        p.create_node("nope", {})
+
+
+def test_gke_under_instance_manager():
+    """The v2 lifecycle wraps the GKE provider transparently."""
+    p, _ = make_gke()
+    mgr = InstanceManager(p)
+    mgr.create_node("tpu-v5e-16", {})
+    assert mgr.instances()[0].state == REQUESTED
+    mgr.reconcile([])
+    # the synthetic launch id is not a live replica id; the replica list
+    # has the real one — the instance stays REQUESTED until its timeout
+    # (identity-free clouds converge via the autoscaler's pending-launch
+    # expiry), while the REPLICA is visible as capacity:
+    assert p.non_terminated_nodes() == {"tpu-v5e-16-r0": "tpu-v5e-16"}

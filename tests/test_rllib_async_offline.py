@@ -1,0 +1,155 @@
+"""IMPALA/APPO (async actor-learner, V-trace) and offline RL (BC/CQL).
+
+Acceptance per VERDICT round-3 #3: IMPALA learns CartPole DISTRIBUTED
+(async env-runner actors streaming rollouts to the learner), and an
+offline algorithm trains from a parquet dataset. References:
+``rllib/algorithms/impala/impala.py``, ``rllib/algorithms/appo/appo.py``,
+``rllib/offline/offline_data.py``.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import (
+    APPOConfig,
+    BCConfig,
+    CartPole,
+    CQLConfig,
+    IMPALAConfig,
+    collect_offline_data,
+)
+from ray_tpu.rllib.impala import make_vtrace_loss
+from ray_tpu.rllib.models import init_policy
+
+import jax
+
+
+def _cartpole_heuristic(obs: np.ndarray) -> np.ndarray:
+    """A decent hand policy: push toward the pole's lean (return ~100+)."""
+    return (obs[:, 2] + 0.5 * obs[:, 3] > 0).astype(np.int64)
+
+
+def test_vtrace_loss_shapes_and_on_policy_sanity():
+    """On-policy (behavior == target) with unclipped ratios, V-trace's rho
+    is ~1 and the loss is finite with sane metrics."""
+    key = jax.random.PRNGKey(0)
+    params = init_policy(key, 4, 2, 32)
+    T, N = 8, 3
+    rng = np.random.default_rng(0)
+    obs = rng.normal(size=(T, N, 4)).astype(np.float32)
+    from ray_tpu.rllib.models import forward
+
+    logits, _ = forward(params, obs.reshape(T * N, -1))
+    logits = np.asarray(logits).reshape(T, N, -1)
+    logp_all = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    actions = rng.integers(0, 2, (T, N))
+    logp_old = np.take_along_axis(logp_all, actions[..., None], axis=2)[..., 0]
+    batch = {
+        "obs": obs,
+        "actions": actions,
+        "logp_old": logp_old.astype(np.float32),
+        "rewards": np.ones((T, N), np.float32),
+        "dones": np.zeros((T, N), np.bool_),
+        "trunc_values": np.zeros((T, N), np.float32),
+        "last_obs": rng.normal(size=(N, 4)).astype(np.float32),
+    }
+    loss_fn = make_vtrace_loss(0.99, 0.5, 0.01, 1.0, 1.0)
+    loss, metrics = loss_fn(params, batch)
+    assert np.isfinite(float(loss))
+    assert abs(float(metrics["mean_rho"]) - 1.0) < 1e-4
+    assert float(metrics["clipped_rho_frac"]) <= 0.51
+
+
+def test_impala_cartpole_learns_distributed(ray_cluster):
+    """The flagship async test: remote env runners sample continuously;
+    the learner consumes completions out of order; returns improve."""
+    algo = (
+        IMPALAConfig()
+        .environment(CartPole)
+        .env_runners(num_env_runners=2, num_envs_per_runner=8, rollout_len=64)
+        .training(lr=2e-3, num_batches_per_iteration=4)
+        .seeding(0)
+        .build()
+    )
+    try:
+        first = algo.train()["episode_return_mean"]
+        result = {}
+        for _ in range(24):
+            result = algo.train()
+    finally:
+        algo.stop()
+    assert result["episode_return_mean"] > max(60.0, 2 * max(first, 10.0)), (
+        f"no learning: {first} -> {result['episode_return_mean']}"
+    )
+
+
+def test_appo_smoke(ray_cluster):
+    """APPO (clipped surrogate on V-trace) completes async iterations."""
+    algo = (
+        APPOConfig()
+        .environment(CartPole)
+        .env_runners(num_env_runners=2, num_envs_per_runner=4, rollout_len=32)
+        .training(num_batches_per_iteration=2)
+        .build()
+    )
+    try:
+        m = algo.train()
+        assert "policy_loss" in m and np.isfinite(m["policy_loss"])
+        assert algo.train()["training_iteration"] == 2
+    finally:
+        algo.stop()
+
+
+def test_impala_rejects_learner_sharding():
+    with pytest.raises(ValueError, match="num_learners=0"):
+        IMPALAConfig().environment(CartPole).learners(num_learners=2).build()
+
+
+@pytest.fixture(scope="module")
+def offline_dataset(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("offline") / "cartpole")
+    n = collect_offline_data(
+        CartPole, 4000, path, num_envs=8, seed=0,
+        policy_fn=_cartpole_heuristic, epsilon=0.2)
+    assert n >= 4000
+    return path
+
+
+def test_bc_learns_from_parquet(ray_cluster, offline_dataset):
+    """Behavior cloning from recorded parquet transitions recovers a
+    policy clearly better than random (~20 on CartPole)."""
+    algo = (
+        BCConfig()
+        .environment(None)
+        .offline_data(dataset_path=offline_dataset, batch_size=256,
+                      updates_per_iteration=64)
+        .evaluation(eval_env_cls=CartPole, eval_episodes=4)
+        .training(lr=3e-3)
+        .build()
+    )
+    result = {}
+    for _ in range(8):
+        result = algo.train()
+    algo.stop()
+    assert result["action_accuracy"] > 0.85
+    assert result["episode_return_mean"] > 60.0, result
+
+
+def test_cql_trains_from_parquet(ray_cluster, offline_dataset):
+    """Discrete CQL: TD + conservative regularizer train to finite losses
+    and a policy above random from the same dataset."""
+    algo = (
+        CQLConfig()
+        .environment(None)
+        .offline_data(dataset_path=offline_dataset, batch_size=256,
+                      updates_per_iteration=64)
+        .evaluation(eval_env_cls=CartPole, eval_episodes=4)
+        .training(gamma=0.99, cql_alpha=1.0)
+        .build()
+    )
+    result = {}
+    for _ in range(10):
+        result = algo.train()
+    algo.stop()
+    assert np.isfinite(result["td_loss"]) and np.isfinite(result["cql_regularizer"])
+    assert result["episode_return_mean"] > 35.0, result
