@@ -67,6 +67,13 @@ class RayTpuConfig:
     # Fork default-env workers from a warm pre-imported zygote process
     # instead of paying interpreter boot + imports per worker.
     enable_worker_zygote: bool = True
+    # Object-manager push: chunks a holder keeps in flight toward one
+    # receiver (reference push_manager.h:30 sender-side flow control).
+    push_manager_chunks_in_flight: int = 8
+    # Pull admission: concurrent inbound object transfers per raylet;
+    # excess pulls queue by class get > wait > task-arg
+    # (reference pull_manager.h:51 prioritized bundles).
+    pull_manager_max_concurrent: int = 4
     # Device-release fence: how long to wait for a TPU-holding worker
     # process to exit (after SIGTERM, then SIGKILL) before re-granting the
     # TPU resource anyway. The libtpu device lock is exclusive per process

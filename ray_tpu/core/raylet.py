@@ -209,6 +209,18 @@ class Raylet:
         # Forkserver for default-env workers (worker_zygote.py).
         self._zygote_proc: subprocess.Popen | None = None
         self._zygote_booting = False
+        # --- object manager: push + prioritized pull admission ---------
+        # In-progress inbound pushes: oid -> {offset, received, total,
+        # data_size, meta_size} (receiver side of PushObject).
+        self._receiving: dict[bytes, dict] = {}
+        # Pull admission queue: heap-ordered (class, seq) waiters; classes
+        # get(0) > wait(1) > task_arg(2) (reference pull_manager.h:51).
+        self._pull_inflight = 0
+        self._pull_waiters: list[dict] = []
+        self._pull_seq = 0
+        # Transfer counters (observability + the broadcast fan-out test).
+        self.transfer_stats = {"chunks_served": 0, "pushes_served": 0,
+                               "pulls_started": 0}
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> None:
@@ -400,6 +412,21 @@ class Raylet:
                 except Exception:
                     still_pending.append(report)
             pending_deaths = still_pending
+            # GC abandoned partial pushes: an unsealed receive allocation
+            # with no progress (holder died, object never re-pulled) would
+            # otherwise pin arena bytes forever — unsealed objects are not
+            # spillable or evictable.
+            now = time.monotonic()
+            for oid, state in list(self._receiving.items()):
+                if now - state["last_progress"] > 60.0:
+                    self._receiving.pop(oid, None)
+                    try:
+                        self.store.delete(oid, force=True)
+                    except Exception:
+                        pass
+                    self._object_meta.pop(oid, None)
+                    logger.warning("reclaimed abandoned partial push of %s",
+                                   oid.hex()[:12])
 
     def _release_lease(self, w: WorkerHandle) -> bool:
         """Release a worker's lease reservation. Returns True if a TPU
@@ -1335,16 +1362,53 @@ class Raylet:
                     refs[oid] = refs.get(oid, 0) + 1
                 return {"found": True, "offset": info[0], "data_size": info[1], "meta_size": info[2]}
             if p.get("owner_address"):
-                pulled = await self._maybe_pull(oid, p["owner_address"])
+                pulled = await self._maybe_pull(
+                    oid, p["owner_address"], p.get("pull_class", "get"))
                 if pulled:
                     continue
             if timeout == 0 or time.monotonic() > deadline:
                 return {"found": False}
             await asyncio.sleep(0.02)
 
-    async def _maybe_pull(self, oid: bytes, owner_address: str) -> bool:
-        """Locate via the owner (OwnershipBasedObjectDirectory) and fetch in
-        chunks from a holder node."""
+    _PULL_CLASS = {"get": 0, "wait": 1, "task_arg": 2}
+
+    async def _admit_pull(self, pull_class: str) -> None:
+        """Pull admission control: bounded concurrent inbound transfers,
+        ordered get > wait > task-arg within the queue (reference
+        pull_manager.h:51 — a user blocked in ray.get outranks a
+        prefetching task-arg pull)."""
+        cfg = get_config()
+        if (not self._pull_waiters
+                and self._pull_inflight < cfg.pull_manager_max_concurrent):
+            self._pull_inflight += 1
+            return
+        self._pull_seq += 1
+        entry = {
+            "key": (self._PULL_CLASS.get(pull_class, 2), self._pull_seq),
+            "fut": asyncio.get_running_loop().create_future(),
+        }
+        self._pull_waiters.append(entry)
+        self._pull_waiters.sort(key=lambda e: e["key"])
+        await entry["fut"]
+
+    def _release_pull(self) -> None:
+        self._pull_inflight -= 1
+        while (self._pull_waiters
+               and self._pull_inflight < get_config().pull_manager_max_concurrent):
+            entry = self._pull_waiters.pop(0)
+            if entry["fut"].done():
+                continue
+            self._pull_inflight += 1
+            entry["fut"].set_result(True)
+
+    async def _maybe_pull(self, oid: bytes, owner_address: str,
+                          pull_class: str = "get") -> bool:
+        """Locate via the owner (OwnershipBasedObjectDirectory) and
+        transfer from a holder node: ask the holder to PUSH (holder-driven
+        pipelined chunks, push_manager.h:30), falling back to puller-driven
+        chunk fetches. A completed copy is reported back to the owner so
+        LATER pullers of the same object fan out across receivers instead
+        of all draining the primary (broadcast tree)."""
         fut = self._fetching.get(oid)
         if fut is not None:
             try:
@@ -1354,25 +1418,181 @@ class Raylet:
             return True
         fut = asyncio.get_running_loop().create_future()
         self._fetching[oid] = fut
+        await self._admit_pull(pull_class)
+        self.transfer_stats["pulls_started"] += 1
         try:
             owner = RpcClient(owner_address)
             status = await owner.call("GetObjectLocations", {"id": oid}, timeout=10.0)
-            await owner.close()
             locations = [n for n in status.get("locations", []) if n != self.node_id.hex()]
+            # Fan-out: prefer SECONDARY holders (earlier receivers) over
+            # the primary, rotating among them by a node-local stamp — a
+            # broadcast then drains receivers tree-style instead of every
+            # puller queueing on the one primary.
+            primary = status.get("primary", "")
+            secondaries = [n for n in locations if n != primary]
+            if len(secondaries) > 1:
+                k = int(self.node_id.hex()[:4], 16) % len(secondaries)
+                secondaries = secondaries[k:] + secondaries[:k]
+            locations = secondaries + ([primary] if primary in locations else [])
+            ok = False
             for node_id in locations:
                 node = self._node_table.get(node_id)
                 if node is None or node.get("state") != "ALIVE":
-                    continue
+                    await self._refresh_node_table()
+                    node = self._node_table.get(node_id)
+                    if node is None or node.get("state") != "ALIVE":
+                        continue
                 try:
-                    await self._fetch_from_node(oid, node["address"])
-                    return True
+                    await self._transfer_from_node(oid, node["address"])
+                    ok = True
+                    break
                 except Exception as e:
-                    logger.warning("Fetch of %s from %s failed: %s", oid.hex()[:12], node_id[:8], e)
-            return False
+                    logger.warning("Transfer of %s from %s failed: %s",
+                                   oid.hex()[:12], node_id[:8], e)
+            if ok:
+                try:
+                    await owner.call("AddObjectLocation", {
+                        "id": oid, "node_id": self.node_id.hex()}, timeout=10.0)
+                except Exception:
+                    pass  # directory update is best-effort
+            await owner.close()
+            return ok
         finally:
+            self._release_pull()
             done_fut = self._fetching.pop(oid, None)
             if done_fut is not None and not done_fut.done():
                 done_fut.set_result(self.store.contains(oid) == 2)
+
+    async def _transfer_from_node(self, oid: bytes, node_address: str) -> None:
+        """Preferred path: the holder pushes chunks at its own pace (one
+        request, pipelined transfers); legacy per-chunk pull as fallback."""
+        client = self._remote_store_clients.get(node_address)
+        if client is None:
+            client = RpcClient(node_address)
+            self._remote_store_clients[node_address] = client
+        try:
+            reply = await client.call(
+                "PushObject", {"id": oid, "to": self.address}, timeout=30.0)
+        except Exception:
+            reply = {}
+        if reply.get("pushing"):
+            fut = self._fetching.get(oid)
+            if fut is not None:
+                # Resolved by the seal of the last pushed chunk. Bail on a
+                # STALLED push quickly (holder died / failed silently) —
+                # parking 120s here would pin an admission slot and starve
+                # get-class pulls behind a few bad holders.
+                deadline = time.monotonic() + 120.0
+                while time.monotonic() < deadline:
+                    try:
+                        await asyncio.wait_for(asyncio.shield(fut), 2.0)
+                        break
+                    except asyncio.TimeoutError:
+                        state = self._receiving.get(oid)
+                        last = state["last_progress"] if state else None
+                        if last is None or time.monotonic() - last > 10.0:
+                            break  # never started, or no chunk for 10s
+                if self.store.contains(oid) == 2:
+                    return
+                raise KeyError(f"push of {oid.hex()} did not complete")
+        if not reply.get("found", True):
+            raise KeyError(f"{oid.hex()} not on {node_address}")
+        if self._receiving.pop(oid, None) is not None:
+            # A failed partial push left an unsealed allocation; reclaim it
+            # before the puller-driven fallback recreates the object.
+            self.store.delete(oid, force=True)
+        await self._fetch_from_node(oid, node_address)
+
+    # --------------------------------------------------- push manager (holder)
+    async def handle_PushObject(self, p: dict) -> dict:
+        """A puller asks THIS node (a holder) to push ``id`` to it. Chunks
+        go out holder-driven with a bounded in-flight window — no
+        per-chunk round-trip stall (reference push_manager.h:30)."""
+        oid = p["id"]
+        info = self.store.get_info(oid)
+        if info is None and oid in self._spilled:
+            try:
+                await self._restore_spilled(oid)
+            except StoreFullError:
+                return {"found": False}
+            info = self.store.get_info(oid)
+        if info is None:
+            return {"found": False}
+        self.transfer_stats["pushes_served"] += 1
+        # Pin BEFORE the spawned task runs: between this handler returning
+        # and _push_to starting, a spill triggered by another handler could
+        # evict the object and leave _push_to reading a stale offset.
+        self.store.add_ref(oid)
+        spawn(self._push_to(oid, info, p["to"]))
+        return {"found": True, "pushing": True}
+
+    async def _push_to(self, oid: bytes, info: tuple, dest_address: str) -> None:
+        """Stream chunks to ``dest``; the caller already holds a store ref
+        (released here) so the pages can't move mid-push."""
+        cfg = get_config()
+        store_offset, data_size, meta_size = info
+        total = data_size + meta_size
+        try:
+            client = self._remote_store_clients.get(dest_address)
+            if client is None:
+                client = RpcClient(dest_address)
+                self._remote_store_clients[dest_address] = client
+            window: list = []
+            pos = 0
+            while pos < total:
+                size = min(cfg.object_manager_chunk_size, total - pos)
+                data = bytes(self.store.read(store_offset + pos, size))
+                window.append(spawn(client.call("PushObjectChunk", {
+                    "id": oid, "offset": pos, "data": data,
+                    "data_size": data_size, "meta_size": meta_size,
+                }, timeout=60.0)))
+                self.transfer_stats["chunks_served"] += 1
+                pos += size
+                if len(window) >= cfg.push_manager_chunks_in_flight:
+                    await window.pop(0)
+            for w in window:
+                await w
+        except Exception as e:
+            logger.warning("push of %s to %s failed: %s",
+                           oid.hex()[:12], dest_address, e)
+        finally:
+            self.store.release(oid)
+
+    # ------------------------------------------------ push manager (receiver)
+    async def handle_PushObjectChunk(self, p: dict) -> dict:
+        oid = p["id"]
+        if self.store.contains(oid) == 2 or oid in self._spilled:
+            return {"ok": True}  # already have it (duplicate push)
+        state = self._receiving.get(oid)
+        if state is None:
+            try:
+                offset = self._create_with_spill(
+                    oid, p["data_size"], p["meta_size"])
+            except StoreFullError:
+                return {"ok": False, "error": "store_full"}
+            except Exception:
+                return {"ok": False, "error": "create_failed"}
+            state = self._receiving[oid] = {
+                "offset": offset,
+                "total": p["data_size"] + p["meta_size"],
+                # Completion = UNIQUE offsets covering total: a retry push
+                # (new holder after a dead one) re-sends offsets already
+                # written — counting raw bytes would seal with holes.
+                "chunks": {},
+                "last_progress": time.monotonic(),
+            }
+            self._object_meta[oid] = {"size": state["total"]}
+        self.store.write(state["offset"] + p["offset"], p["data"])
+        state["chunks"][p["offset"]] = len(p["data"])
+        state["last_progress"] = time.monotonic()
+        if sum(state["chunks"].values()) >= state["total"]:
+            self._receiving.pop(oid, None)
+            self.store.seal(oid)
+            self.store.release(oid)
+            fut = self._fetching.get(oid)
+            if fut is not None and not fut.done():
+                fut.set_result(True)
+        return {"ok": True}
 
     async def _fetch_from_node(self, oid: bytes, node_address: str) -> None:
         cfg = get_config()

@@ -494,7 +494,8 @@ class CoreWorker:
             return None
         return max(0.0, deadline - time.monotonic())
 
-    def _get_one(self, ref: ObjectRef, deadline: float | None):
+    def _get_one(self, ref: ObjectRef, deadline: float | None,
+                 pull_class: str = "get"):
         oid = ref.id()
         owned = self.refcounter.is_owned(oid)
         while True:
@@ -502,7 +503,7 @@ class CoreWorker:
             if entry is not None and not entry.in_plasma:
                 return self._deserialize(entry.metadata, entry.blob, oid)
             if entry is not None and entry.in_plasma:
-                return self._get_from_plasma(ref, deadline)
+                return self._get_from_plasma(ref, deadline, pull_class)
             if owned:
                 remaining = self._remaining(deadline)
                 ready, _ = self.memory_store.wait_ready([oid], 1, remaining)
@@ -514,7 +515,7 @@ class CoreWorker:
             if status.get("inline"):
                 return self._deserialize(status["metadata"], status["blob"], oid)
             if status.get("in_plasma"):
-                return self._get_from_plasma(ref, deadline)
+                return self._get_from_plasma(ref, deadline, pull_class)
             raise ObjectLostError(oid, status.get("error", "owner could not locate object"))
 
     def _owner_status(self, ref: ObjectRef, deadline: float | None) -> dict:
@@ -539,7 +540,8 @@ class CoreWorker:
 
             raise OwnerDiedError(ref.id(), f"owner {ref.owner_address} unreachable: {e}")
 
-    def _get_from_plasma(self, ref: ObjectRef, deadline: float | None):
+    def _get_from_plasma(self, ref: ObjectRef, deadline: float | None,
+                         pull_class: str = "get"):
         oid = ref.id()
         remaining = self._remaining(deadline)
         reply = self._raylet_call(
@@ -552,6 +554,8 @@ class CoreWorker:
                 # object can't be spilled/evicted while views are alive.
                 "pin_read": True,
                 "reader": self.worker_id,
+                # Pull admission class (raylet orders get > wait > task_arg).
+                "pull_class": pull_class,
             },
             timeout=None if remaining is None else remaining + 10.0,
         )
@@ -559,7 +563,7 @@ class CoreWorker:
             # Lost from every node: try lineage reconstruction
             # (object_recovery_manager.h:90,106).
             if self._try_reconstruct(oid, deadline):
-                return self._get_from_plasma(ref, deadline)
+                return self._get_from_plasma(ref, deadline, pull_class)
             raise ObjectLostError(oid, "not found on any node and not reconstructable")
         data = self.shm.read(reply["offset"], reply["data_size"])
         meta = bytes(self.shm.read(reply["offset"] + reply["data_size"], reply["meta_size"]))
@@ -1373,13 +1377,27 @@ class CoreWorker:
                 self.memory_store.remove_callback(oid, _on_ready)
         return _check() or {"error": "timeout"}
 
+    async def handle_AddObjectLocation(self, p: dict) -> dict:
+        """A raylet that completed a transfer reports its new copy; later
+        pullers then fan out across receivers instead of all draining the
+        primary (the owner IS the object directory —
+        ownership_based_object_directory.h)."""
+        node_id = p["node_id"]
+        self.refcounter.add_location(
+            ObjectID(p["id"]),
+            node_id if isinstance(node_id, str) else node_id.hex())
+        return {}
+
     async def handle_GetObjectLocations(self, p: dict) -> dict:
         oid = ObjectID(p["id"])
         locations = [l if isinstance(l, str) else l.hex() for l in self.refcounter.get_locations(oid)]
         entry = self.memory_store.get_if_exists(oid)
-        if not locations and entry is not None and entry.in_plasma and entry.node_id:
-            locations = [entry.node_id.decode()]
-        return {"locations": locations}
+        primary = ""
+        if entry is not None and entry.in_plasma and entry.node_id:
+            primary = entry.node_id.decode()
+            if primary not in locations:
+                locations.append(primary)  # the primary copy always counts
+        return {"locations": locations, "primary": primary}
 
     async def handle_Ping(self, p: dict) -> dict:
         return {"worker_id": self.worker_id}
@@ -1687,7 +1705,7 @@ class CoreWorker:
                 value = serialization.deserialize(entry["meta"], entry["blob"])
             else:
                 ref = ObjectRef(ObjectID(entry["id"]), entry["owner"], _add_local_ref=False)
-                value = self._get_one(ref, deadline=None)
+                value = self._get_one(ref, deadline=None, pull_class="task_arg")
             if "key" in entry:
                 kwargs[entry["key"]] = value
             else:
