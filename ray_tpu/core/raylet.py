@@ -40,6 +40,10 @@ from ..native.store import ShmStore, StoreFullError
 logger = logging.getLogger(__name__)
 
 
+class ObjectMissingOnHolder(Exception):
+    """A node listed as holding an object reported it absent (evicted)."""
+
+
 class PidHandle:
     """Popen-compatible handle for a worker forked by the zygote (not our
     child, so ``waitpid`` is unavailable; the zygote auto-reaps). Exposes
@@ -1446,6 +1450,18 @@ class Raylet:
                     await self._transfer_from_node(oid, node["address"])
                     ok = True
                     break
+                except ObjectMissingOnHolder as e:
+                    logger.warning("Holder %s no longer has %s: %s",
+                                   node_id[:8], oid.hex()[:12], e)
+                    # ONLY on holder-reported absence (evicted secondary):
+                    # deregister so later pullers skip the stale entry.
+                    # Generic transfer failures (e.g. THIS node's store is
+                    # full) must not wipe live copies from the directory.
+                    try:
+                        await owner.call("RemoveObjectLocation", {
+                            "id": oid, "node_id": node_id}, timeout=10.0)
+                    except Exception:
+                        pass
                 except Exception as e:
                     logger.warning("Transfer of %s from %s failed: %s",
                                    oid.hex()[:12], node_id[:8], e)
@@ -1463,13 +1479,17 @@ class Raylet:
             if done_fut is not None and not done_fut.done():
                 done_fut.set_result(self.store.contains(oid) == 2)
 
-    async def _transfer_from_node(self, oid: bytes, node_address: str) -> None:
-        """Preferred path: the holder pushes chunks at its own pace (one
-        request, pipelined transfers); legacy per-chunk pull as fallback."""
+    def _store_client(self, node_address: str) -> RpcClient:
         client = self._remote_store_clients.get(node_address)
         if client is None:
             client = RpcClient(node_address)
             self._remote_store_clients[node_address] = client
+        return client
+
+    async def _transfer_from_node(self, oid: bytes, node_address: str) -> None:
+        """Preferred path: the holder pushes chunks at its own pace (one
+        request, pipelined transfers); legacy per-chunk pull as fallback."""
+        client = self._store_client(node_address)
         try:
             reply = await client.call(
                 "PushObject", {"id": oid, "to": self.address}, timeout=30.0)
@@ -1481,22 +1501,25 @@ class Raylet:
                 # Resolved by the seal of the last pushed chunk. Bail on a
                 # STALLED push quickly (holder died / failed silently) —
                 # parking 120s here would pin an admission slot and starve
-                # get-class pulls behind a few bad holders.
-                deadline = time.monotonic() + 120.0
+                # get-class pulls behind a few bad holders. The
+                # no-progress grace also covers the window BEFORE the
+                # first chunk (a busy holder may need seconds to start).
+                started = time.monotonic()
+                deadline = started + 120.0
                 while time.monotonic() < deadline:
                     try:
                         await asyncio.wait_for(asyncio.shield(fut), 2.0)
                         break
                     except asyncio.TimeoutError:
                         state = self._receiving.get(oid)
-                        last = state["last_progress"] if state else None
-                        if last is None or time.monotonic() - last > 10.0:
-                            break  # never started, or no chunk for 10s
+                        last = state["last_progress"] if state else started
+                        if time.monotonic() - last > 10.0:
+                            break  # no chunk for 10s: holder is gone
                 if self.store.contains(oid) == 2:
                     return
                 raise KeyError(f"push of {oid.hex()} did not complete")
         if not reply.get("found", True):
-            raise KeyError(f"{oid.hex()} not on {node_address}")
+            raise ObjectMissingOnHolder(f"{oid.hex()} not on {node_address}")
         if self._receiving.pop(oid, None) is not None:
             # A failed partial push left an unsealed allocation; reclaim it
             # before the puller-driven fallback recreates the object.
@@ -1533,10 +1556,16 @@ class Raylet:
         store_offset, data_size, meta_size = info
         total = data_size + meta_size
         try:
-            client = self._remote_store_clients.get(dest_address)
-            if client is None:
-                client = RpcClient(dest_address)
-                self._remote_store_clients[dest_address] = client
+            client = self._store_client(dest_address)
+
+            def _check(reply: dict) -> None:
+                if not reply.get("ok"):
+                    # Receiver is rejecting chunks (store full, create
+                    # failed): abort the stream instead of shipping the
+                    # rest of a multi-GB object into a void.
+                    raise RuntimeError(
+                        f"receiver rejected chunk: {reply.get('error')}")
+
             window: list = []
             pos = 0
             while pos < total:
@@ -1549,9 +1578,9 @@ class Raylet:
                 self.transfer_stats["chunks_served"] += 1
                 pos += size
                 if len(window) >= cfg.push_manager_chunks_in_flight:
-                    await window.pop(0)
+                    _check(await window.pop(0))
             for w in window:
-                await w
+                _check(await w)
         except Exception as e:
             logger.warning("push of %s to %s failed: %s",
                            oid.hex()[:12], dest_address, e)
@@ -1596,16 +1625,13 @@ class Raylet:
 
     async def _fetch_from_node(self, oid: bytes, node_address: str) -> None:
         cfg = get_config()
-        client = self._remote_store_clients.get(node_address)
-        if client is None:
-            client = RpcClient(node_address)
-            self._remote_store_clients[node_address] = client
+        client = self._store_client(node_address)
         first = await client.call(
             "FetchObjectChunk", {"id": oid, "offset": 0, "size": cfg.object_manager_chunk_size},
             timeout=30.0,
         )
         if not first.get("found"):
-            raise KeyError(f"{oid.hex()} not on {node_address}")
+            raise ObjectMissingOnHolder(f"{oid.hex()} not on {node_address}")
         data_size, meta_size = first["data_size"], first["meta_size"]
         total = data_size + meta_size
         offset = self._create_with_spill(oid, data_size, meta_size)

@@ -1388,6 +1388,18 @@ class CoreWorker:
             node_id if isinstance(node_id, str) else node_id.hex())
         return {}
 
+    async def handle_RemoveObjectLocation(self, p: dict) -> dict:
+        """A puller found a listed copy missing (evicted/dead holder):
+        drop the stale directory entry (locations are added as hex
+        strings by AddObjectLocation and as bytes by the return path —
+        discard both forms)."""
+        oid = ObjectID(p["id"])
+        node_id = p["node_id"]
+        hexed = node_id if isinstance(node_id, str) else node_id.hex()
+        self.refcounter.remove_location(oid, hexed)
+        self.refcounter.remove_location(oid, bytes.fromhex(hexed))
+        return {}
+
     async def handle_GetObjectLocations(self, p: dict) -> dict:
         oid = ObjectID(p["id"])
         locations = [l if isinstance(l, str) else l.hex() for l in self.refcounter.get_locations(oid)]

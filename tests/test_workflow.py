@@ -150,3 +150,121 @@ def test_independent_branches_run_concurrently(ray_cluster, tmp_path):
     elapsed = _time.monotonic() - t0
     assert out == "ab"
     assert elapsed < 2.2, f"branches serialized: {elapsed:.1f}s"
+
+
+def test_continuation_extends_workflow(ray_cluster, tmp_path):
+    """A step returning workflow.continuation(sub_dag) dynamically extends
+    the DAG; the sub-DAG's result becomes the step's result (reference
+    workflow.continuation)."""
+
+    @workflow.step
+    def double(x):
+        return x * 2
+
+    @workflow.step
+    def decide(x):
+        if x < 10:
+            return workflow.continuation(double(x + 3))
+        return x
+
+    @workflow.step
+    def plus_one(x):
+        return x + 1
+
+    dag = plus_one(decide(2))
+    out = workflow.run(dag, workflow_id="wf-cont", storage=str(tmp_path))
+    assert out == (2 + 3) * 2 + 1  # continuation ran, parent saw its result
+
+
+def test_recursive_continuations_checkpoint(ray_cluster, tmp_path):
+    """Recursion via continuations (the reference's factorial example):
+    each level checkpoints in its parent step's namespace."""
+
+    @workflow.step
+    def fact(n, acc=1):
+        if n <= 1:
+            return acc
+        return workflow.continuation(fact(n - 1, acc * n))
+
+    out = workflow.run(fact(5), workflow_id="wf-fact", storage=str(tmp_path))
+    assert out == 120
+    # rerun is fully served from checkpoints
+    assert workflow.run(fact(5), workflow_id="wf-fact", storage=str(tmp_path)) == 120
+
+
+def test_resume_inside_continuation_never_reruns_step_body(ray_cluster, tmp_path):
+    """Crash between a step finishing (returning a continuation) and the
+    sub-DAG completing: resume continues INSIDE the continuation; the
+    step's own side effect happens exactly once."""
+    body_runs = tmp_path / "body_runs"
+    flaky_flag = tmp_path / "fail_once"
+    flaky_flag.write_text("1")
+
+    @workflow.step
+    def sub(x):
+        if os.path.exists(str(flaky_flag)):
+            os.unlink(str(flaky_flag))
+            raise RuntimeError("simulated crash inside the continuation")
+        return x * 10
+
+    @workflow.step
+    def body():
+        with open(str(body_runs), "a") as f:
+            f.write("x")
+        return workflow.continuation(sub(4))
+
+    dag = body()
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wf-cont-crash", storage=str(tmp_path))
+    out = workflow.resume("wf-cont-crash", storage=str(tmp_path))
+    assert out == 40
+    assert body_runs.stat().st_size == 1, "step body re-ran on resume"
+
+
+def test_event_step_unblocks_on_trigger(ray_cluster, tmp_path):
+    """wait_for_event parks a step until trigger_event fires; the payload
+    checkpoints like any result (reference workflow/event_listener.py)."""
+    import threading
+    import time as _time
+
+    @workflow.step
+    def combine(payload, tag):
+        return f"{payload}-{tag}"
+
+    key = f"approval-{_time.time_ns()}"
+    dag = combine(workflow.wait_for_event(key), "done")
+
+    def fire():
+        _time.sleep(1.0)
+        workflow.trigger_event(key, "approved")
+
+    t = threading.Thread(target=fire)
+    t.start()
+    out = workflow.run(dag, workflow_id="wf-event", storage=str(tmp_path))
+    t.join()
+    assert out == "approved-done"
+    # resume serves the event payload from its checkpoint (no re-listen)
+    assert workflow.run(dag, workflow_id="wf-event", storage=str(tmp_path)) == "approved-done"
+
+
+def test_deep_continuation_chain_is_iterative(ray_cluster, tmp_path):
+    """A long tail-continuation chain must not exhaust the driver stack:
+    the loop grafts each level into the ONE driver loop (no nested
+    executors), and sibling branches keep checkpointing meanwhile."""
+    import sys
+
+    @workflow.step
+    def count_down(n):
+        if n <= 0:
+            return "done"
+        return workflow.continuation(count_down(n - 1))
+
+    depth = 60
+    limit = sys.getrecursionlimit()
+    try:
+        sys.setrecursionlimit(200)  # far below depth * frames-per-level
+        out = workflow.run(count_down(depth), workflow_id="wf-deep",
+                           storage=str(tmp_path), step_timeout_s=120)
+    finally:
+        sys.setrecursionlimit(limit)
+    assert out == "done"
