@@ -59,7 +59,33 @@ class LocalEngineExecutor:
         self.page_size = page_size
         pages = init_pages(self.config, num_pages, page_size)
         self._replicated = None
-        if mesh is not None:
+        self._pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+        if self._pp > 1:
+            # Pipeline-parallel: layers (params AND page pool) shard over
+            # the pp axis; shard_map programs in pp_model.py rotate
+            # activations stage->stage (ref vllm_models.py:117-168 PP).
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            if mesh.shape.get("tp", 1) > 1:
+                raise ValueError("tp must be 1 when pp > 1 (pure pipeline)")
+            if self.config.n_layers % self._pp:
+                raise ValueError(
+                    f"n_layers={self.config.n_layers} not divisible by pp={self._pp}")
+            if max_slots % self._pp:
+                raise ValueError(
+                    f"max_slots={max_slots} not divisible by pp={self._pp} "
+                    "(decode pipelines over slot groups)")
+            layer_sh = NamedSharding(mesh, PartitionSpec("pp"))
+            rep = NamedSharding(mesh, PartitionSpec())
+            params = {
+                k: (jax.tree.map(lambda a: jax.device_put(a, layer_sh), v)
+                    if k == "layers" else jax.device_put(v, rep))
+                for k, v in params.items()
+            }
+            self._pages_sharding = layer_sh
+            pages = jax.device_put(pages, {"k": layer_sh, "v": layer_sh})
+            self._replicated = rep
+        elif mesh is not None:
             # Tensor-parallel: params shard by the model's logical axes
             # (heads/kv_heads/mlp -> tp), the page pool by kv_heads; the
             # same jitted programs then run SPMD with XLA collectives
@@ -85,7 +111,18 @@ class LocalEngineExecutor:
         # handle -> device hidden state [E] awaiting first-token sampling
         self._hidden: dict[int, Any] = {}
 
-        if self._replicated is not None:
+        if self._pp > 1:
+            # pp programs define their shardings via shard_map out_specs
+            # (pages staged over pp, tokens/hidden/key replicated).
+            from .pp_model import pp_decode_loop, pp_prefill_chunk
+
+            self._key = jax.device_put(self._key, self._replicated)
+            self._prefill = functools.partial(pp_prefill_chunk, mesh=mesh)
+            self._decode_loop = functools.partial(pp_decode_loop, mesh=mesh)
+            self._sample_first = jax.jit(
+                sample_first_batch.__wrapped__,
+                out_shardings=(self._replicated, self._replicated))
+        elif self._replicated is not None:
             # Re-jit the model programs with EXPLICIT output shardings:
             # token/key/hidden outputs pinned replicated — on a
             # multi-process mesh an output with an arbitrary XLA-chosen

@@ -141,6 +141,40 @@ def prefill_chunk(params, pages: dict, block_table, tokens, start_pos,
     return {"k": new_k, "v": new_v}, hidden
 
 
+def decode_block(x, layer, kp, vp, block_tables, pos, write_idx,
+                 c: LlamaConfig, page_size: int):
+    """One decoder block for a [n, 1, E] single-token batch against a
+    page-pool slice (kp/vp: [P, KH, page, D]). Shared by the unpipelined
+    decode (``_decode_logits``) and the pp pipeline (``pp_model``) so the
+    two paths stay bitwise-identical (greedy parity depends on it)."""
+    n = x.shape[0]
+    kh, g = c.n_kv_heads, c.n_heads // c.n_kv_heads
+    max_ctx = block_tables.shape[1] * page_size
+    offset = pos % page_size
+    live = jnp.arange(max_ctx)[None] <= pos[:, None]       # [n, ctx]
+    h = rms_norm(x, layer["attn_norm"], eps=c.norm_eps)
+    q, k, v = _project_qkv(h, layer)                   # [n, H|KH, 1, D]
+    q = apply_rope(q, pos[:, None], theta=c.rope_theta)
+    k = apply_rope(k, pos[:, None], theta=c.rope_theta)
+    # Write each slot's new K/V at (its current page, offset). Distinct
+    # slots own distinct pages (trash pages for inactive slots), so
+    # the scatter has no conflicting indices.
+    kp = kp.at[write_idx, :, offset, :].set(k[:, :, 0])
+    vp = vp.at[write_idx, :, offset, :].set(v[:, :, 0])
+    ck = jax.vmap(_gather_ctx, in_axes=(None, 0))(kp, block_tables)  # [n, KH, ctx, D]
+    cv = jax.vmap(_gather_ctx, in_axes=(None, 0))(vp, block_tables)
+    qg = q[:, :, 0].reshape(n, kh, g, c.head_dim)
+    scores = jnp.einsum("nkgd,nktd->nkgt", qg, ck).astype(jnp.float32)
+    scores *= c.head_dim ** -0.5
+    scores = jnp.where(live[:, None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
+    attn = jnp.einsum("nkgt,nktd->nkgd", probs, cv).reshape(
+        n, 1, c.n_heads * c.head_dim)
+    out = jnp.einsum("bsf,fe->bse", attn,
+                     layer["wo"].reshape(c.n_heads * c.head_dim, c.hidden))
+    return _mlp(x + out, layer, c), kp, vp
+
+
 def _decode_logits(params, pages: dict, block_tables, tokens, pos,
                    config: LlamaConfig, page_size: int, write_page_idx=None):
     """One batched decode step over all slots.
@@ -155,41 +189,17 @@ def _decode_logits(params, pages: dict, block_tables, tokens, pos,
     Returns (logits [slots, vocab] f32, new pages).
     """
     c = config
-    n = tokens.shape[0]
-    max_ctx = block_tables.shape[1] * page_size
-    kh, g = c.n_kv_heads, c.n_heads // c.n_kv_heads
     x = params["embed"][tokens][:, None].astype(c.dtype)   # [slots, 1, E]
     if write_page_idx is None:
         write_page_idx = jnp.take_along_axis(
             block_tables, (pos // page_size)[:, None], axis=1)[:, 0]  # [slots]
     page_idx = write_page_idx
-    offset = pos % page_size
-    live = jnp.arange(max_ctx)[None] <= pos[:, None]       # [slots, ctx]
 
     def body(carry, xs):
         x = carry
         layer, kp, vp = xs                                 # [P, KH, page, D]
-        h = rms_norm(x, layer["attn_norm"], eps=c.norm_eps)
-        q, k, v = _project_qkv(h, layer)                   # [slots, H|KH, 1, D]
-        q = apply_rope(q, pos[:, None], theta=c.rope_theta)
-        k = apply_rope(k, pos[:, None], theta=c.rope_theta)
-        # Write each slot's new K/V at (its current page, offset). Distinct
-        # slots own distinct pages (trash pages for inactive slots), so
-        # the scatter has no conflicting indices.
-        kp = kp.at[page_idx, :, offset, :].set(k[:, :, 0])
-        vp = vp.at[page_idx, :, offset, :].set(v[:, :, 0])
-        ck = jax.vmap(_gather_ctx, in_axes=(None, 0))(kp, block_tables)  # [slots, KH, ctx, D]
-        cv = jax.vmap(_gather_ctx, in_axes=(None, 0))(vp, block_tables)
-        qg = q[:, :, 0].reshape(n, kh, g, c.head_dim)
-        scores = jnp.einsum("nkgd,nktd->nkgt", qg, ck).astype(jnp.float32)
-        scores *= c.head_dim ** -0.5
-        scores = jnp.where(live[:, None, None], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
-        attn = jnp.einsum("nkgt,nktd->nkgd", probs, cv).reshape(
-            n, 1, c.n_heads * c.head_dim)
-        out = jnp.einsum("bsf,fe->bse", attn,
-                         layer["wo"].reshape(c.n_heads * c.head_dim, c.hidden))
-        x2 = _mlp(x + out, layer, c)
+        x2, kp, vp = decode_block(
+            x, layer, kp, vp, block_tables, pos, page_idx, c, page_size)
         return x2, (kp, vp)
 
     x, (new_k, new_v) = lax.scan(body, x, (params["layers"], pages["k"], pages["v"]))

@@ -43,17 +43,21 @@ class EngineShardWorker:
         return initialize_process(coordinator, self.world, self.rank)
 
     def build(self, config, *, max_slots: int, num_pages: int, page_size: int,
-              tp: int | None = None, seed: int = 0) -> int:
+              tp: int | None = None, pp: int | None = None, seed: int = 0) -> int:
         """Create the executor over the global mesh (all hosts' devices).
-        Default tp = every device in the group."""
+        Default tp = every device in the group (pure TP); pass ``pp`` to
+        stage layers across hosts instead (pure PP this round)."""
         import jax
 
         from ..parallel import MeshConfig, create_mesh
         from .executor import LocalEngineExecutor
 
         n = len(jax.devices())
-        tp = tp or n
-        mesh = create_mesh(MeshConfig(tp=tp, dp=max(1, n // tp)))
+        pp = pp or 1
+        # pure PP requires tp=1 (executor constraint); extra devices go to
+        # dp. Pure TP (pp=1) defaults to tp over every device.
+        tp = tp or (1 if pp > 1 else n)
+        mesh = create_mesh(MeshConfig(tp=tp, pp=pp, dp=max(1, n // (tp * pp))))
         self.executor = LocalEngineExecutor(
             config, max_slots=max_slots, num_pages=num_pages,
             page_size=page_size, mesh=mesh, seed=seed,
@@ -146,6 +150,7 @@ def create_sharded_executor(
     num_pages: int,
     page_size: int,
     tp: int | None = None,
+    pp: int | None = None,
     seed: int = 0,
     bundle_resources: dict | None = None,
     topology: str | None = None,
@@ -190,7 +195,7 @@ def create_sharded_executor(
                 timeout=300)
         ray.get([
             s.build.remote(config, max_slots=max_slots, num_pages=num_pages,
-                           page_size=page_size, tp=tp, seed=seed)
+                           page_size=page_size, tp=tp, pp=pp, seed=seed)
             for s in shards
         ], timeout=600)
     except Exception:

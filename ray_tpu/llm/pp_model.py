@@ -1,0 +1,252 @@
+"""Pipeline-parallel paged-KV inference: layers staged over the ``pp`` axis.
+
+The reference places TP×PP vLLM engines across nodes via placement-group
+bundles (``python/ray/llm/_internal/serve/deployments/llm/vllm/
+vllm_models.py:117-168``) and lets vLLM move activations between PP ranks
+with NCCL send/recv. TPU redesign: the stacked layer axis of the params
+AND of the KV page pool is sharded over the mesh's ``pp`` axis; inside
+``shard_map`` each stage scans its LOCAL layers and the rotating
+activation moves stage→stage over ICI via ``lax.ppermute``. One jitted
+program runs on every stage (SPMD) — no per-rank send/recv choreography.
+
+Schedules:
+  * **Decode** fills the pipeline with SLOT GROUPS: the ``slots`` batch is
+    split into ``pp`` groups, and at tick ``t`` stage ``s`` runs group
+    ``(t - s) mod pp``. A group completes one full decode step per ``pp``
+    ticks, so once warm every stage is busy every tick — aggregate decode
+    throughput matches the unpipelined engine while params+pages memory
+    is 1/pp per device. The freshly sampled token rides the same
+    ``ppermute`` ring from the last stage back to stage 0.
+  * **Prefill** passes one chunk through the stages sequentially (tick
+    ``t`` activates stage ``t``). This wastes (pp-1)/pp of prefill
+    compute vs a sequence-pipelined schedule — acceptable because decode
+    dominates serving cost; a chunk-pipelined prefill is the natural
+    upgrade and slots into the same tick loop.
+
+Group bookkeeping (pos / done / remaining) travels WITH the rotating
+activation, so every stage sees the group's current round state without
+host synchronization, and finished slots redirect their KV writes to
+their private trash page exactly as the unpipelined ``decode_loop`` does.
+
+Constraint this round: ``tp`` must be 1 when ``pp > 1`` (pure pipeline;
+composing tp inside pp stages needs shard_map's partial-auto mode).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..models.llama import LlamaConfig
+from ..ops import apply_rope, rms_norm
+from .model import _gather_ctx, _mlp, _project_qkv, decode_block
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "page_size", "mesh"),
+                   donate_argnames=("pages",))
+def pp_prefill_chunk(params, pages, block_table, tokens, start_pos,
+                     config: LlamaConfig, page_size: int, mesh):
+    """Pipeline-staged ``prefill_chunk``: same contract as
+    ``model.prefill_chunk`` (pages updated, hidden [C, E] returned) with
+    params["layers"]/pages sharded P("pp") on the layer axis."""
+    c = config
+    pp = mesh.shape["pp"]
+    C = tokens.shape[0]
+    n_chunk_pages = C // page_size
+    max_ctx = block_table.shape[0] * page_size
+    kh, g = c.n_kv_heads, c.n_heads // c.n_kv_heads
+    causal = jnp.arange(C)[:, None] >= jnp.arange(C)[None, :]
+
+    def per_device(layers_local, kp, vp, embed, final_norm,
+                   block_table, tokens, start_pos):
+        stage = lax.axis_index("pp")
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+        positions = start_pos + jnp.arange(C, dtype=jnp.int32)
+        ctx_live = jnp.arange(max_ctx, dtype=jnp.int32) < start_pos
+        first = start_pos // page_size
+        write_ids = lax.dynamic_slice(block_table, (first,), (n_chunk_pages,))
+        x0 = embed[tokens][None].astype(c.dtype)       # [1, C, E]
+
+        def tick(carry, t):
+            act, hidden, kp, vp = carry
+            live = t == stage                          # this stage holds the chunk
+            x = jnp.where((stage == 0) & (t == 0), x0, act)
+
+            def body(xc, xs):
+                layer, kpl, vpl = xs                   # [P, KH, page, D]
+                h = rms_norm(xc, layer["attn_norm"], eps=c.norm_eps)
+                q, k, v = _project_qkv(h, layer)       # [1, H|KH, C, D]
+                q = apply_rope(q, positions, theta=c.rope_theta)
+                k = apply_rope(k, positions, theta=c.rope_theta)
+                ck = _gather_ctx(kpl, block_table)     # [KH, ctx, D]
+                cv = _gather_ctx(vpl, block_table)
+                qg = q[0].reshape(kh, g, C, c.head_dim)
+                scale = c.head_dim ** -0.5
+                s_ctx = jnp.einsum("kgcd,ktd->kgct", qg, ck).astype(jnp.float32)
+                s_self = jnp.einsum("kgcd,ktd->kgct", qg, k[0]).astype(jnp.float32)
+                s_ctx = jnp.where(ctx_live[None, None, None], s_ctx * scale, -jnp.inf)
+                s_self = jnp.where(causal[None, None], s_self * scale, -jnp.inf)
+                probs = jax.nn.softmax(
+                    jnp.concatenate([s_ctx, s_self], axis=-1), axis=-1)
+                p_ctx = probs[..., :max_ctx].astype(c.dtype)
+                p_self = probs[..., max_ctx:].astype(c.dtype)
+                attn = jnp.einsum("kgct,ktd->kgcd", p_ctx, cv) + jnp.einsum(
+                    "kgct,ktd->kgcd", p_self, v[0])
+                attn = attn.reshape(1, c.n_heads, C, c.head_dim)
+                out = jnp.einsum("bhsd,hde->bse", attn, layer["wo"])
+                x2 = _mlp(xc + out, layer, c)
+                # Guarded page write: stages without the real chunk write
+                # the OLD page values back (branchless no-op).
+                k_new = jnp.swapaxes(
+                    k[0].reshape(kh, n_chunk_pages, page_size, c.head_dim), 0, 1)
+                v_new = jnp.swapaxes(
+                    v[0].reshape(kh, n_chunk_pages, page_size, c.head_dim), 0, 1)
+                kpl = kpl.at[write_ids].set(
+                    jnp.where(live, k_new, kpl[write_ids]))
+                vpl = vpl.at[write_ids].set(
+                    jnp.where(live, v_new, vpl[write_ids]))
+                return x2, (kpl, vpl)
+
+            x, (kp, vp) = lax.scan(body, x, (layers_local, kp, vp))
+            h = rms_norm(x, final_norm, eps=c.norm_eps)[0]   # [C, E]
+            hidden = jnp.where(live & (stage == pp - 1), h, hidden)
+            act = lax.ppermute(x, "pp", perm=perm)
+            return (act, hidden, kp, vp), None
+
+        hidden0 = jnp.zeros((C, c.hidden), c.dtype)
+        act0 = jnp.zeros((1, C, c.hidden), c.dtype)
+        (_, hidden, kp, vp), _ = lax.scan(
+            tick, (act0, hidden0, kp, vp), jnp.arange(pp))
+        hidden = lax.psum(
+            jnp.where(stage == pp - 1, hidden, jnp.zeros_like(hidden)), "pp")
+        return {"k": kp, "v": vp}, hidden
+
+    layer_spec = jax.tree.map(lambda _: P("pp"), params["layers"])
+    fn = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(layer_spec, P("pp"), P("pp"), P(), P(), P(), P(), P()),
+        out_specs=({"k": P("pp"), "v": P("pp")}, P()),
+        check_vma=False,
+    )
+    return fn(params["layers"], pages["k"], pages["v"], params["embed"],
+              params["final_norm"], block_table, tokens, start_pos)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "page_size", "n_steps", "mesh"),
+                   donate_argnames=("pages",))
+def pp_decode_loop(params, pages, block_tables, tokens, pos, temps, eos_ids,
+                   remaining, key, config: LlamaConfig, page_size: int,
+                   n_steps: int, mesh):
+    """Pipelined ``decode_loop``: same contract (tokens [n_steps, slots],
+    key, pages). ``slots`` must divide into ``pp`` groups; group ``g``'s
+    round ``r`` runs on stage ``s`` at tick ``t = g + r*pp + s``, so all
+    stages stay busy after a (pp-1)-tick warmup."""
+    c = config
+    pp = mesh.shape["pp"]
+    slots = tokens.shape[0]
+    m = slots // pp
+    maxp = block_tables.shape[1]
+    T = n_steps * pp + pp - 1
+
+    bt_g = block_tables.reshape(pp, m, maxp)
+    tok_g = tokens.reshape(pp, m)
+    pos_g = pos.reshape(pp, m)
+    temp_g = temps.reshape(pp, m)
+    eos_g = eos_ids.reshape(pp, m)
+    rem_g = remaining.reshape(pp, m)
+    # slot i's trash page is page i (the unpipelined decode_loop invariant)
+    trash_g = jnp.arange(slots, dtype=jnp.int32).reshape(pp, m)
+
+    def per_device(layers_local, kp, vp, embed, final_norm, lm_head,
+                   bt_g, tok_g, pos_g, temp_g, eos_g, rem_g, key):
+        stage = lax.axis_index("pp")
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def tick(carry, t):
+            rot, outputs, kp, vp, key = carry
+            g = (t - stage) % pp
+            roundr = (t - stage) // pp
+            live_round = (t >= stage) & (roundr < n_steps)
+            inject = (stage == 0) & (t < pp)           # group g's first visit
+            tok_in = jnp.where(inject, tok_g[g], rot["tok"])
+            cpos = jnp.where(inject, pos_g[g], rot["pos"])
+            crem = jnp.where(inject, rem_g[g], rot["rem"])
+            cdone = jnp.where(inject, rem_g[g] <= 0, rot["done"])
+            done_eff = cdone | ~live_round
+            bt = bt_g[g]
+            emb = embed[tok_in][:, None].astype(c.dtype)       # [m, 1, E]
+            x = jnp.where(stage == 0, emb, rot["act"])
+            real_page = jnp.take_along_axis(
+                bt, jnp.minimum(cpos // page_size, maxp - 1)[:, None],
+                axis=1)[:, 0]
+            write_idx = jnp.where(done_eff, trash_g[g], real_page)
+
+            def body(xc, xs):
+                layer, kpl, vpl = xs
+                x2, kpl, vpl = decode_block(
+                    xc, layer, kpl, vpl, bt, cpos, write_idx, c, page_size)
+                return x2, (kpl, vpl)
+
+            x, (kp, vp) = lax.scan(body, x, (layers_local, kp, vp))
+
+            # Last stage: logits + sample (computed on every stage for
+            # SPMD uniformity; only the last stage's result is used).
+            hidden = rms_norm(x, final_norm, eps=c.norm_eps)
+            logits = jnp.einsum(
+                "bse,ev->bsv", hidden, lm_head)[:, 0].astype(jnp.float32)
+            key, sub = jax.random.split(key)
+            greedy = jnp.argmax(logits, axis=-1)
+            temps_c = temp_g[g]
+            sampled = jax.random.categorical(
+                sub, logits / jnp.maximum(temps_c, 1e-6)[:, None])
+            new_tok = jnp.where(temps_c > 0.0, sampled, greedy).astype(jnp.int32)
+            rem2 = crem - jnp.where(done_eff, 0, 1)
+            done2 = done_eff | (new_tok == eos_g[g]) | (rem2 <= 0)
+
+            is_last = stage == pp - 1
+            rc = jnp.clip(roundr, 0, n_steps - 1)
+            ok = live_round & is_last
+            vals = jnp.where(ok, new_tok, outputs[rc, g])
+            outputs = outputs.at[rc, g].set(vals)
+
+            rot_next = {
+                "act": x,
+                "tok": jnp.where(is_last, new_tok, tok_in),
+                "pos": jnp.where(is_last, cpos + 1, cpos),
+                "rem": jnp.where(is_last, rem2, crem),
+                "done": jnp.where(is_last, done2, cdone),
+            }
+            rot_next = lax.ppermute(rot_next, "pp", perm=perm)
+            return (rot_next, outputs, kp, vp, key), None
+
+        rot0 = {
+            "act": jnp.zeros((m, 1, c.hidden), c.dtype),
+            "tok": jnp.zeros((m,), jnp.int32),
+            "pos": jnp.zeros((m,), jnp.int32),
+            "rem": jnp.zeros((m,), jnp.int32),
+            "done": jnp.zeros((m,), bool),
+        }
+        outputs0 = jnp.zeros((n_steps, pp, m), jnp.int32)
+        (_, outputs, kp, vp, key), _ = lax.scan(
+            tick, (rot0, outputs0, kp, vp, key), jnp.arange(T))
+        outputs = lax.psum(
+            jnp.where(stage == pp - 1, outputs, jnp.zeros_like(outputs)), "pp")
+        return outputs.reshape(n_steps, slots), key, {"k": kp, "v": vp}
+
+    layer_spec = jax.tree.map(lambda _: P("pp"), params["layers"])
+    fn = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(layer_spec, P("pp"), P("pp"), P(), P(), P(),
+                  P(), P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), {"k": P("pp"), "v": P("pp")}),
+        check_vma=False,
+    )
+    return fn(params["layers"], pages["k"], pages["v"], params["embed"],
+              params["final_norm"], params["lm_head"],
+              bt_g, tok_g, pos_g, temp_g, eos_g, rem_g, key)

@@ -38,6 +38,7 @@ class LLMDeployment:
         prefill_chunk_size: int = 64,
         decode_steps_per_dispatch: int = 8,
         tensor_parallel: int = 1,
+        pipeline_parallel: int = 1,
         num_hosts: int = 1,
         shard_resources: dict | None = None,
         shard_runtime_env: dict | None = None,
@@ -61,22 +62,25 @@ class LLMDeployment:
                 num_pages=InferenceEngine.total_pages(max_slots, max_len, page_size),
                 page_size=page_size,
                 tp=tensor_parallel if tensor_parallel > 1 else None,
+                pp=pipeline_parallel if pipeline_parallel > 1 else None,
                 seed=seed,
                 bundle_resources=shard_resources,
                 topology=topology,
                 runtime_env=shard_runtime_env,
             )
-        elif tensor_parallel > 1:
+        elif tensor_parallel > 1 or pipeline_parallel > 1:
             # Shard the engine across this replica's visible chips (e.g.
-            # the 4/8 chips of a TPU host); XLA runs the same programs
-            # SPMD with collectives over ICI.
+            # the 4/8 chips of a TPU host): tp runs the same programs
+            # SPMD with XLA collectives over ICI; pp stages layers with
+            # ppermute activation rotation (llm/pp_model.py).
             import jax
 
             from ..parallel import MeshConfig, create_mesh
 
             n = len(jax.devices())
             mesh = create_mesh(MeshConfig(
-                tp=tensor_parallel, dp=max(1, n // tensor_parallel)))
+                tp=tensor_parallel, pp=pipeline_parallel,
+                dp=max(1, n // (tensor_parallel * pipeline_parallel))))
         self.engine = InferenceEngine(
             preset, max_slots=max_slots, max_len=max_len, page_size=page_size,
             prefill_chunk_size=prefill_chunk_size,
@@ -335,6 +339,7 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
                   max_slots: int = 8, max_len: int = 256,
                   page_size: int = 16, prefill_chunk_size: int = 64,
                   decode_steps_per_dispatch: int = 8, tensor_parallel: int = 1,
+                  pipeline_parallel: int = 1,
                   num_hosts: int = 1, shard_resources: dict | None = None,
                   shard_runtime_env: dict | None = None,
                   topology: str | None = None,
@@ -359,6 +364,7 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
     return dep.bind(preset, model_id=model_id, max_slots=max_slots, max_len=max_len,
                     page_size=page_size, prefill_chunk_size=prefill_chunk_size,
                     decode_steps_per_dispatch=decode_steps_per_dispatch,
-                    tensor_parallel=tensor_parallel, num_hosts=num_hosts,
+                    tensor_parallel=tensor_parallel,
+                    pipeline_parallel=pipeline_parallel, num_hosts=num_hosts,
                     shard_resources=shard_resources,
                     shard_runtime_env=shard_runtime_env, topology=topology)

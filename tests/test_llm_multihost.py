@@ -56,6 +56,34 @@ def test_multihost_engine_token_parity(ray_cluster, small_cfg):
         executor.shutdown()
 
 
+def test_multihost_pp_token_parity(ray_cluster, small_cfg):
+    """Pipeline parallelism across hosts: 2 shard processes × 1 device
+    each form a pp=2 mesh — each host holds HALF the layers and half the
+    page pool, activations cross hosts via ppermute (llm/pp_model.py).
+    Tokens must match the single-process engine exactly (greedy)."""
+    prompts = [list(range(1, 22)), [7, 3, 7, 3, 7]]
+
+    ref = InferenceEngine(small_cfg, max_slots=2, max_len=64, page_size=8, seed=0)
+    expected = [ref.generate(list(p), max_new_tokens=6) for p in prompts]
+
+    executor = create_sharded_executor(
+        small_cfg, 2,
+        max_slots=2,
+        num_pages=InferenceEngine.total_pages(2, 64, 8),
+        page_size=8,
+        pp=2,
+        seed=0,
+        runtime_env=SHARD_ENV,
+    )
+    try:
+        eng = InferenceEngine(small_cfg, max_slots=2, max_len=64, page_size=8,
+                              executor=executor, seed=0)
+        got = [eng.generate(list(p), max_new_tokens=6) for p in prompts]
+        assert got == expected
+    finally:
+        executor.shutdown()
+
+
 def test_multihost_deployment_generates(ray_cluster):
     """The Serve deployment path: ``num_hosts=2`` builds the shard fleet
     behind one replica-facing engine; requests flow scheduler -> shards."""
