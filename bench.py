@@ -144,13 +144,37 @@ def run_framework() -> dict:
     return out
 
 
-def run_raw(preset: str | None = None, batch: int | None = None) -> float:
-    """The same train step without the framework (overhead comparison;
-    also reused for the 8B-shape secondary perf point)."""
+def _run_chip_subprocess(code: str, what: str, timeout: float = 900) -> dict:
+    """Run a measurement snippet in a fresh process that owns the chip
+    (the driver stays on CPU); returns the last JSON OBJECT line of its
+    stdout. One shared scaffold so the env handling and the axon-fence
+    parse convention can't drift between benchmarks."""
     import subprocess
 
+    env = dict(os.environ)
+    if not ALLOW_CPU:
+        env.pop("JAX_PLATFORMS", None)  # the subprocess owns the chip
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, env=env,
+        timeout=timeout,
+    )
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except Exception:
+            continue
+        if isinstance(parsed, dict):
+            return parsed
+    raise RuntimeError(f"{what} benchmark failed: {out.stderr[-2000:]}")
+
+
+def run_raw(preset: str | None = None, batch: int | None = None,
+            seq: int | None = None) -> float:
+    """The same train step without the framework (overhead comparison;
+    also reused for the 8B-shape and long-context perf points)."""
     preset = preset or PRESET
     batch = batch or BATCH
+    seq = seq or SEQ
     code = r"""
 import dataclasses, functools, json, os, time
 import jax, jax.numpy as jnp, optax
@@ -180,19 +204,62 @@ for _ in range(%d):
     params, opt_state, loss = step(params, opt_state, batch)
 float(jax.device_get(loss))
 print(json.dumps({"raw": %d * %d * %d / (time.perf_counter() - t0)}))
-""" % (preset, batch, SEQ, WARMUP_STEPS, TIMED_STEPS, batch, SEQ, TIMED_STEPS)
-    env = dict(os.environ)
-    if not ALLOW_CPU:
-        env.pop("JAX_PLATFORMS", None)  # the raw subprocess owns the chip
-    out = subprocess.run(
-        [sys.executable, "-c", code], capture_output=True, text=True, env=env, timeout=900
-    )
-    for line in reversed(out.stdout.strip().splitlines()):
-        try:
-            return json.loads(line)["raw"]
-        except Exception:
-            continue
-    raise RuntimeError(f"raw benchmark failed: {out.stderr[-2000:]}")
+""" % (preset, batch, seq, WARMUP_STEPS, TIMED_STEPS, batch, seq, TIMED_STEPS)
+    return _run_chip_subprocess(code, "raw")["raw"]
+
+
+def run_longctx() -> dict:
+    """Long-context points on the real chip (VERDICT r3 item 8):
+    the Pallas flash kernel at llama3-1b attention shapes (Hq=32, Hkv=8,
+    head_dim=64, GQA) swept over seq 512 → 32768, fwd+bwd TFLOP/s each,
+    plus a full 1B train step at seq 8192 (remat, batch 1) for the
+    end-to-end long-context tokens/s."""
+    code = r"""
+import json, os, time
+import jax, jax.numpy as jnp
+out = {}
+B, Hq, Hkv, D = 1, 32, 8, 64
+from ray_tpu.ops import flash_attention
+kq, kk, kv, kg = jax.random.split(jax.random.PRNGKey(0), 4)
+for S in (512, 4096, 32768):
+    q = jax.random.normal(kq, (B, Hq, S, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, Hkv, S, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, Hkv, S, D), jnp.bfloat16)
+    g = jax.random.normal(kg, (B, Hq, S, D), jnp.bfloat16)
+
+    @jax.jit
+    def fwdbwd(q, k, v, g):
+        def f(q, k, v):
+            return (flash_attention(q, k, v, causal=True).astype(jnp.float32) * g).sum()
+        l, grads = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+        return l, grads
+
+    l, grads = fwdbwd(q, k, v, g)   # compile
+    float(jax.device_get(l))
+    iters = 20 if S <= 4096 else 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        l, grads = fwdbwd(q, k, v, g)
+    float(jax.device_get(l))
+    dt = (time.perf_counter() - t0) / iters
+    # causal fwd = 2*B*Hq*S^2*D FLOP (QK^T + PV, halved by causality);
+    # bwd recomputes fwd scores and adds dQ/dK/dV ~ 2.5x fwd
+    flops = 3.5 * 2 * B * Hq * S * S * D
+    out[f"flash_fwdbwd_tflops_s{S}"] = round(flops / dt / 1e12, 2)
+print(json.dumps(out))
+"""
+    metrics = _run_chip_subprocess(code, "longctx flash")
+    # end-to-end long-context train point: 1B, seq 8192, batch 1, remat
+    try:
+        tok_s = run_raw(preset="llama3-1b", batch=1, seq=8192)
+        from ray_tpu.models.llama import PRESETS as _P, train_flops_per_token
+
+        metrics["train_tok_s_1b_seq8k"] = round(tok_s, 1)
+        metrics["mfu_1b_seq8k"] = round(
+            tok_s * train_flops_per_token(_P["llama3-1b"], 8192) / 197e12, 4)
+    except Exception as e:
+        metrics["longctx_train_error"] = f"{type(e).__name__}: {e}"
+    return metrics
 
 
 def run_serve_bench() -> dict:
@@ -326,6 +393,13 @@ def main() -> None:
         except Exception as e:
             print(f"8b-proxy bench failed: {e}", file=sys.stderr)
             extra_8b = {"8b_proxy_error": f"{type(e).__name__}: {e}"}
+    extra_longctx: dict = {}
+    if os.environ.get("RAY_TPU_BENCH_SKIP_LONGCTX") != "1" and not ALLOW_CPU:
+        try:
+            extra_longctx = run_longctx()
+        except Exception as e:
+            print(f"longctx bench failed: {e}", file=sys.stderr)
+            extra_longctx = {"longctx_error": f"{type(e).__name__}: {e}"}
     value = fw["tokens_per_sec_per_chip"]
     baseline = None
     if os.path.exists("BENCH_BASELINE.json"):
@@ -344,6 +418,7 @@ def main() -> None:
         "framework_overhead_pct": round(100 * (1 - value / raw), 2) if raw else None,
         **serve_metrics,
         **extra_8b,
+        **extra_longctx,
     }))
 
 
