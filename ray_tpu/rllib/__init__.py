@@ -12,15 +12,18 @@ accelerator.
 """
 
 from .algorithm import Algorithm, AlgorithmConfig
-from .env import CartPole, GridWorld
+from .env import CartPole, GridWorld, Pendulum
 from .env_runner import EnvRunner, EnvRunnerGroup
 from .impala import APPO, APPOConfig, IMPALA, IMPALAConfig
 from .learner import Learner
 from .learner_group import LearnerGroup
 from .dqn import DQN, DQNConfig
 from .offline import BC, BCConfig, CQL, CQLConfig, collect_offline_data
+from .multi_agent import (MultiAgentCartPole, MultiAgentEnvRunner,
+                          MultiAgentPPO, MultiAgentPPOConfig)
 from .ppo import PPO, PPOConfig
 from .replay import ReplayBuffer
+from .sac import SAC, SACConfig
 
 __all__ = [
     "Algorithm",
@@ -44,5 +47,12 @@ __all__ = [
     "CQL",
     "CQLConfig",
     "collect_offline_data",
+    "MultiAgentCartPole",
+    "MultiAgentEnvRunner",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
+    "Pendulum",
     "ReplayBuffer",
+    "SAC",
+    "SACConfig",
 ]

@@ -76,6 +76,69 @@ class CartPole:
         return self.state.copy(), rewards, done, info
 
 
+class Pendulum:
+    """Classic torque-controlled pendulum swing-up, vectorized over N
+    copies — the canonical continuous-control task (SAC's smoke test in
+    the reference: ``rllib/algorithms/sac/sac.py`` tuned examples).
+
+    obs = [cos θ, sin θ, θ̇]; action = torque in [-2, 2] (continuous);
+    reward = -(θ² + 0.1 θ̇² + 0.001 a²); episodes truncate at 200 steps
+    (never terminate), matching the canonical dynamics so learning curves
+    are comparable to published SAC results.
+    """
+
+    obs_dim = 3
+    action_dim = 1
+    max_action = 2.0
+    n_actions = None  # continuous
+    max_steps = 200
+
+    def __init__(self, num_envs: int = 1, seed: int = 0):
+        self.n = num_envs
+        self.rng = np.random.default_rng(seed)
+        self.theta = np.zeros(num_envs, np.float32)
+        self.theta_dot = np.zeros(num_envs, np.float32)
+        self.steps = np.zeros(num_envs, np.int32)
+
+    def _obs(self) -> np.ndarray:
+        return np.stack(
+            [np.cos(self.theta), np.sin(self.theta), self.theta_dot],
+            axis=1).astype(np.float32)
+
+    def _reset_where(self, mask: np.ndarray) -> None:
+        k = int(mask.sum())
+        if k:
+            self.theta[mask] = self.rng.uniform(-np.pi, np.pi, k)
+            self.theta_dot[mask] = self.rng.uniform(-1.0, 1.0, k)
+            self.steps[mask] = 0
+
+    def reset(self) -> np.ndarray:
+        self._reset_where(np.ones(self.n, bool))
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        g, m, l, dt = 10.0, 1.0, 1.0, 0.05
+        u = np.clip(np.asarray(actions, np.float32).reshape(self.n), -2.0, 2.0)
+        th = ((self.theta + np.pi) % (2 * np.pi)) - np.pi  # normalize
+        costs = th**2 + 0.1 * self.theta_dot**2 + 0.001 * u**2
+        new_dot = self.theta_dot + (
+            3 * g / (2 * l) * np.sin(self.theta) + 3.0 / (m * l**2) * u) * dt
+        new_dot = np.clip(new_dot, -8.0, 8.0)
+        self.theta = self.theta + new_dot * dt
+        self.theta_dot = new_dot.astype(np.float32)
+        self.steps += 1
+
+        truncated = self.steps >= self.max_steps
+        terminated = np.zeros(self.n, bool)
+        done = truncated
+        rewards = (-costs).astype(np.float32)
+        terminal_obs = self._obs()
+        self._reset_where(done)
+        info = {"terminated": terminated, "truncated": truncated,
+                "terminal_obs": terminal_obs}
+        return self._obs(), rewards, done, info
+
+
 class GridWorld:
     """5x5 grid, reach the goal corner; -0.01 per step, +1 at goal.
     Cheap deterministic env for unit tests of the rollout plumbing."""

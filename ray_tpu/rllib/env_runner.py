@@ -100,10 +100,12 @@ class EnvRunnerGroup:
     machinery — the reference's EnvRunner polymorphism."""
 
     def __init__(self, env_cls, *, num_env_runners: int = 0, num_envs_per_runner: int = 8,
-                 rollout_len: int = 64, seed: int = 0, runner_cls: type | None = None):
+                 rollout_len: int = 64, seed: int = 0, runner_cls: type | None = None,
+                 runner_kwargs: dict | None = None):
         runner_cls = runner_cls or EnvRunner
+        kw = runner_kwargs or {}
         if num_env_runners == 0:
-            self._local = runner_cls(env_cls, num_envs_per_runner, rollout_len, seed)
+            self._local = runner_cls(env_cls, num_envs_per_runner, rollout_len, seed, **kw)
             self._actors = []
         else:
             from ..core import api as ray
@@ -111,7 +113,8 @@ class EnvRunnerGroup:
             self._local = None
             cls = ray.remote(runner_cls)
             self._actors = [
-                cls.remote(env_cls, num_envs_per_runner, rollout_len, seed + 1000 * i)
+                cls.remote(env_cls, num_envs_per_runner, rollout_len,
+                           seed + 1000 * i, **kw)
                 for i in range(num_env_runners)
             ]
 

@@ -36,3 +36,51 @@ def forward(params: dict, obs: jax.Array):
     logits = x @ params["pi"]["w"] + params["pi"]["b"]
     value = (x @ params["vf"]["w"] + params["vf"]["b"])[:, 0]
     return logits, value
+
+
+# ---------------------------------------------------------------- SAC nets
+# Continuous control (reference: rllib/algorithms/sac/sac_catalog.py):
+# a squashed-Gaussian policy head and twin Q networks over (obs, action).
+
+
+def _dense_stack(key, dims):
+    layers = []
+    for k, (i, o) in zip(jax.random.split(key, len(dims) - 1),
+                         zip(dims[:-1], dims[1:])):
+        layers.append({
+            "w": jax.random.normal(k, (i, o), jnp.float32) * (2.0 / i) ** 0.5,
+            "b": jnp.zeros((o,), jnp.float32),
+        })
+    return layers
+
+
+def init_gaussian_policy(key, obs_dim: int, action_dim: int, hidden: int = 64) -> dict:
+    kt, kh = jax.random.split(key)
+    return {
+        "torso": _dense_stack(kt, (obs_dim, hidden, hidden)),
+        # one head emits [mean, log_std] stacked
+        "head": _dense_stack(kh, (hidden, 2 * action_dim))[0],
+    }
+
+
+def gaussian_forward(policy: dict, obs: jax.Array):
+    """obs [B, D] -> (mean [B, A], log_std [B, A]), log_std clamped to
+    the SAC-standard [-20, 2]."""
+    x = obs
+    for layer in policy["torso"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    out = x @ policy["head"]["w"] + policy["head"]["b"]
+    mean, log_std = jnp.split(out, 2, axis=-1)
+    return mean, jnp.clip(log_std, -20.0, 2.0)
+
+
+def init_q(key, obs_dim: int, action_dim: int, hidden: int = 64) -> list:
+    return _dense_stack(key, (obs_dim + action_dim, hidden, hidden, 1))
+
+
+def q_forward(qnet: list, obs: jax.Array, action: jax.Array) -> jax.Array:
+    """(obs [B, D], action [B, A]) -> q [B]."""
+    x = jnp.concatenate([obs, action], axis=-1)
+    for layer in qnet[:-1]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    return (x @ qnet[-1]["w"] + qnet[-1]["b"])[:, 0]

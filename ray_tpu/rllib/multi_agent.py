@@ -1,0 +1,288 @@
+"""Multi-agent training: per-agent policies over a shared environment.
+
+Equivalent of the reference's multi-agent stack —
+``rllib/env/multi_agent_env.py`` (dict-keyed per-agent obs/actions),
+``rllib/env/multi_agent_env_runner.py`` (routes each agent through its
+mapped policy module), and the ``policies`` / ``policy_mapping_fn``
+config surface (``rllib/algorithms/algorithm_config.py`` multi_agent()).
+Design here: the env exposes fixed agent ids with per-agent vectorized
+arrays, the runner samples EVERY agent each step (simultaneous-move
+games), groups fragments BY POLICY, and MultiAgentPPO keeps one
+LearnerGroup per policy — shared policies simply receive the
+concatenated fragments of all agents mapped to them.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .algorithm import Algorithm
+from .env import CartPole
+from .env_runner import EnvRunnerGroup, _np_forward, _softmax
+from .learner_group import LearnerGroup
+from .ppo import PPOConfig, compute_gae, make_ppo_loss
+from . import models
+
+
+class MultiAgentCartPole:
+    """N independent cart-poles, one per agent id — the reference's
+    standard multi-agent smoke env (``rllib/examples/envs/classes/
+    multi_agent/``). Agents step simultaneously; each has its own
+    episode lifecycle."""
+
+    def __init__(self, num_agents: int = 2, num_envs: int = 1, seed: int = 0):
+        self.agent_ids = [f"agent_{i}" for i in range(num_agents)]
+        self._envs = {
+            aid: CartPole(num_envs=num_envs, seed=seed + 7919 * i)
+            for i, aid in enumerate(self.agent_ids)
+        }
+        self.n = num_envs
+
+    @property
+    def obs_dims(self) -> dict:
+        return {aid: e.obs_dim for aid, e in self._envs.items()}
+
+    @property
+    def n_actions_map(self) -> dict:
+        return {aid: e.n_actions for aid, e in self._envs.items()}
+
+    def reset(self) -> dict:
+        return {aid: e.reset() for aid, e in self._envs.items()}
+
+    def step(self, action_dict: dict):
+        obs, rewards, dones, infos = {}, {}, {}, {}
+        for aid, env in self._envs.items():
+            obs[aid], rewards[aid], dones[aid], infos[aid] = env.step(
+                action_dict[aid])
+        return obs, rewards, dones, infos
+
+
+class MultiAgentEnvRunner:
+    """Samples every agent through its mapped policy each step and
+    returns fragments grouped by POLICY id (concatenated over the agents
+    that share a policy, along the env axis)."""
+
+    def __init__(self, env_cls, num_envs: int = 8, rollout_len: int = 64,
+                 seed: int = 0, *, policy_mapping_fn=None, env_kwargs=None):
+        self.env = env_cls(num_envs=num_envs, seed=seed, **(env_kwargs or {}))
+        self.mapping = policy_mapping_fn or (lambda aid: aid)
+        self.num_envs = num_envs
+        self.rollout_len = rollout_len
+        self.rng = np.random.default_rng(seed ^ 0x3A)
+        self.obs = self.env.reset()
+        self._ep_return = {a: np.zeros(num_envs, np.float32)
+                           for a in self.env.agent_ids}
+        self._completed: dict[str, list[float]] = {a: [] for a in self.env.agent_ids}
+
+    def sample(self, weights: dict) -> dict:
+        """weights: {policy_id: params}. Returns {policy_id: fragment}
+        with the same keys PPO's single-agent fragment carries."""
+        T, N = self.rollout_len, self.num_envs
+        agents = self.env.agent_ids
+        obs_dims = self.env.obs_dims
+        bufs = {
+            a: {
+                "obs": np.zeros((T, N, obs_dims[a]), np.float32),
+                "actions": np.zeros((T, N), np.int64),
+                "logp": np.zeros((T, N), np.float32),
+                "values": np.zeros((T, N), np.float32),
+                "rewards": np.zeros((T, N), np.float32),
+                "dones": np.zeros((T, N), np.bool_),
+                "trunc_values": np.zeros((T, N), np.float32),
+            }
+            for a in agents
+        }
+        for t in range(T):
+            action_dict = {}
+            for a in agents:
+                w = weights[self.mapping(a)]
+                logits, value = _np_forward(w, self.obs[a])
+                probs = _softmax(logits)
+                acts = (probs.cumsum(axis=1) > self.rng.random((N, 1))).argmax(axis=1)
+                bufs[a]["obs"][t] = self.obs[a]
+                bufs[a]["actions"][t] = acts
+                bufs[a]["logp"][t] = np.log(probs[np.arange(N), acts] + 1e-10)
+                bufs[a]["values"][t] = value
+                action_dict[a] = acts
+            self.obs, rewards, dones, infos = self.env.step(action_dict)
+            for a in agents:
+                bufs[a]["rewards"][t] = rewards[a]
+                bufs[a]["dones"][t] = dones[a]
+                truncated = infos[a]["truncated"]
+                if truncated.any():
+                    _, v_term = _np_forward(
+                        weights[self.mapping(a)], infos[a]["terminal_obs"])
+                    bufs[a]["trunc_values"][t, truncated] = v_term[truncated]
+                self._ep_return[a] += rewards[a]
+                for i in np.nonzero(dones[a])[0]:
+                    self._completed[a].append(float(self._ep_return[a][i]))
+                    self._ep_return[a][i] = 0.0
+
+        # bootstrap values + episode stats, then group agents by policy
+        for a in agents:
+            _, bufs[a]["last_value"] = _np_forward(
+                weights[self.mapping(a)], self.obs[a])
+            bufs[a]["episode_returns"] = np.asarray(
+                self._completed[a], np.float32)
+            self._completed[a] = []
+        by_policy: dict[str, dict] = {}
+        for a in agents:
+            pid = self.mapping(a)
+            by_policy.setdefault(pid, []).append(bufs[a])
+        out = {}
+        for pid, frags in by_policy.items():
+            out[pid] = {
+                k: np.concatenate([f[k] for f in frags],
+                                  axis=1 if np.ndim(frags[0][k]) >= 2 else 0)
+                for k in ("obs", "actions", "logp", "values", "rewards",
+                          "dones", "trunc_values")
+            }
+            out[pid]["last_value"] = np.concatenate(
+                [f["last_value"] for f in frags])
+            out[pid]["episode_returns"] = np.concatenate(
+                [f["episode_returns"] for f in frags])
+        return out
+
+
+class MultiAgentPPOConfig(PPOConfig):
+    def __init__(self):
+        super().__init__()
+        self.policies: list[str] | None = None       # default: one per agent
+        self.policy_mapping_fn = None                # default: aid -> aid
+        self.env_kwargs: dict = {}
+
+    def multi_agent(self, *, policies=None, policy_mapping_fn=None,
+                    env_kwargs=None) -> "MultiAgentPPOConfig":
+        if policies is not None:
+            self.policies = list(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        if env_kwargs is not None:
+            self.env_kwargs = dict(env_kwargs)
+        return self
+
+
+class MultiAgentPPO(Algorithm):
+    """Independent PPO per policy (the reference's default multi-agent
+    mode): one LearnerGroup per policy id, updates driven from that
+    policy's own fragments."""
+
+    def _setup(self) -> None:
+        c: MultiAgentPPOConfig = self.config  # type: ignore[assignment]
+        probe = c.env_cls(num_envs=1, **c.env_kwargs)
+        mapping = c.policy_mapping_fn or (lambda aid: aid)
+        policies = c.policies or sorted({mapping(a) for a in probe.agent_ids})
+        # each policy's obs/action space: taken from any agent mapped to it
+        spec: dict[str, tuple[int, int]] = {}
+        for a in probe.agent_ids:
+            pid = mapping(a)
+            dims = (probe.obs_dims[a], probe.n_actions_map[a])
+            if pid in spec and spec[pid] != dims:
+                raise ValueError(
+                    f"policy {pid!r} shared by agents with different spaces "
+                    f"{spec[pid]} vs {dims}")
+            spec[pid] = dims
+        missing = [p for p in policies if p not in spec]
+        if missing:
+            raise ValueError(f"policies {missing} have no mapped agents")
+        unmapped = sorted({mapping(a) for a in probe.agent_ids} - set(policies))
+        if unmapped:
+            raise ValueError(
+                f"agents map to policy ids {unmapped} absent from "
+                f"policies={policies}")
+
+        self.policy_ids = policies
+        self.learner_groups = {}
+        for i, pid in enumerate(policies):
+            obs_dim, n_actions = spec[pid]
+            self.learner_groups[pid] = LearnerGroup(
+                make_ppo_loss(c.clip_eps, c.vf_coeff, c.entropy_coeff),
+                (lambda od, na: lambda key: models.init_policy(
+                    key, od, na, c.hidden))(obs_dim, n_actions),
+                num_learners=c.num_learners,
+                lr=c.lr,
+                max_grad_norm=c.max_grad_norm,
+                seed=c.seed + i,
+            )
+        self.env_runner_group = EnvRunnerGroup(
+            c.env_cls,
+            num_env_runners=c.num_env_runners,
+            num_envs_per_runner=c.num_envs_per_runner,
+            rollout_len=c.rollout_len,
+            seed=c.seed,
+            runner_cls=MultiAgentEnvRunner,
+            runner_kwargs={"policy_mapping_fn": c.policy_mapping_fn,
+                           "env_kwargs": c.env_kwargs},
+        )
+        self.rng = np.random.default_rng(c.seed)
+        self._recent_returns: dict[str, list[float]] = {p: [] for p in policies}
+
+    def training_step(self) -> dict:
+        c: MultiAgentPPOConfig = self.config  # type: ignore[assignment]
+        weights = {pid: lg.get_weights() for pid, lg in self.learner_groups.items()}
+        samples = self.env_runner_group.sample(weights)
+
+        metrics: dict = {}
+        total_steps = 0
+        for pid in self.policy_ids:
+            flat = {"obs": [], "actions": [], "logp_old": [],
+                    "advantages": [], "returns": []}
+            for per_runner in samples:
+                s = per_runner.get(pid)
+                if s is None:
+                    continue
+                adv, ret = compute_gae(s, c.gamma, c.gae_lambda)
+                T, N = s["rewards"].shape
+                flat["obs"].append(s["obs"].reshape(T * N, -1))
+                flat["actions"].append(s["actions"].reshape(-1))
+                flat["logp_old"].append(s["logp"].reshape(-1))
+                flat["advantages"].append(adv.reshape(-1))
+                flat["returns"].append(ret.reshape(-1))
+                self._recent_returns[pid].extend(s["episode_returns"].tolist())
+            if not flat["obs"]:
+                continue
+            batch = {k: np.concatenate(v) for k, v in flat.items()}
+            size = len(batch["actions"])
+            total_steps += size
+            lg = self.learner_groups[pid]
+            for _ in range(c.num_epochs):
+                order = self.rng.permutation(size)
+                for start in range(0, size, c.minibatch_size):
+                    idx = order[start:start + c.minibatch_size]
+                    m = lg.update({k: v[idx] for k, v in batch.items()})
+            self._recent_returns[pid] = self._recent_returns[pid][-100:]
+            metrics[pid] = {
+                **{k: float(v) for k, v in m.items()},
+                "episode_return_mean": (
+                    float(np.mean(self._recent_returns[pid]))
+                    if self._recent_returns[pid] else 0.0),
+            }
+        all_ret = [r for rs in self._recent_returns.values() for r in rs]
+        metrics["episode_return_mean"] = (
+            float(np.mean(all_ret)) if all_ret else 0.0)
+        metrics["num_env_steps_sampled"] = total_steps
+        return metrics
+
+    def get_state(self) -> dict:
+        return {
+            "iteration": self.iteration,
+            "learners": {p: lg.get_state() for p, lg in self.learner_groups.items()},
+        }
+
+    def set_state(self, state: dict) -> None:
+        self.iteration = state["iteration"]
+        for p, s in state["learners"].items():
+            self.learner_groups[p].set_state(s)
+
+    def stop(self) -> None:
+        # Algorithm.stop() only knows the single-policy attribute names;
+        # shut down every policy's learner group too.
+        for lg in getattr(self, "learner_groups", {}).values():
+            try:
+                lg.shutdown()
+            except Exception:
+                pass
+        super().stop()
+
+
+MultiAgentPPOConfig.algo_cls = MultiAgentPPO

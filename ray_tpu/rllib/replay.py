@@ -13,11 +13,15 @@ import numpy as np
 
 
 class ReplayBuffer:
-    def __init__(self, capacity: int, obs_dim: int, *, seed: int = 0):
+    def __init__(self, capacity: int, obs_dim: int, *, seed: int = 0,
+                 action_dim: int | None = None):
+        """``action_dim=None`` stores discrete int actions; an int stores
+        continuous float32 action vectors (SAC)."""
         self.capacity = capacity
         self._obs = np.zeros((capacity, obs_dim), np.float32)
         self._next_obs = np.zeros((capacity, obs_dim), np.float32)
-        self._actions = np.zeros(capacity, np.int64)
+        self._actions = (np.zeros(capacity, np.int64) if action_dim is None
+                         else np.zeros((capacity, action_dim), np.float32))
         self._rewards = np.zeros(capacity, np.float32)
         # 1.0 only for TRUE terminations: time-limit truncations bootstrap.
         self._terminated = np.zeros(capacity, np.float32)

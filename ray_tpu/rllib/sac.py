@@ -1,0 +1,303 @@
+"""SAC (soft actor-critic) for continuous control.
+
+Equivalent of ``rllib/algorithms/sac/sac.py`` + ``sac_learner`` (torch):
+squashed-Gaussian policy, twin Q networks with polyak-averaged targets,
+and automatic entropy-temperature tuning. TPU redesign: the whole update
+— critic step, actor step, alpha step, polyak — is ONE jitted function
+over a state pytree, so a training iteration dispatches once per
+minibatch instead of the reference's per-loss-term optimizer round
+trips; rollouts stay numpy on the env runners (same split as PPO/DQN).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .env_runner import EnvRunnerGroup
+from .models import gaussian_forward, init_gaussian_policy, init_q, q_forward
+from .replay import ReplayBuffer
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        # Defaults solve Pendulum in ~50 iterations (~30k env steps):
+        # ~1 update per 2 env steps, 128-wide nets (the reference's SAC
+        # tuned-example ballpark).
+        self.gamma = 0.99
+        self.lr = 1e-3
+        self.alpha_lr = 1e-3
+        self.hidden = 128
+        self.buffer_size = 100_000
+        self.batch_size = 128
+        self.learning_starts = 500
+        self.updates_per_iteration = 128
+        self.tau = 0.005               # polyak rate for the target critics
+        self.target_entropy = None     # default: -action_dim
+        self.init_alpha = 1.0
+        self.rollout_len = 16
+
+    def training(self, *, gamma=None, buffer_size=None, batch_size=None,
+                 learning_starts=None, updates_per_iteration=None, tau=None,
+                 target_entropy=None, init_alpha=None, alpha_lr=None,
+                 hidden=None, **kwargs):
+        for name, val in (("gamma", gamma), ("buffer_size", buffer_size),
+                          ("batch_size", batch_size),
+                          ("learning_starts", learning_starts),
+                          ("updates_per_iteration", updates_per_iteration),
+                          ("tau", tau), ("target_entropy", target_entropy),
+                          ("init_alpha", init_alpha), ("alpha_lr", alpha_lr),
+                          ("hidden", hidden)):
+            if val is not None:
+                setattr(self, name, val)
+        return super().training(**kwargs)
+
+
+def _sample_squashed(policy, obs, key, max_action: float):
+    """Reparameterized tanh-Gaussian sample with its log-prob (the
+    change-of-variables correction included)."""
+    mean, log_std = gaussian_forward(policy, obs)
+    eps = jax.random.normal(key, mean.shape)
+    pre = mean + jnp.exp(log_std) * eps
+    tanh_a = jnp.tanh(pre)
+    logp_gauss = (-0.5 * (eps**2 + _LOG_2PI) - log_std).sum(axis=-1)
+    correction = jnp.log(
+        max_action * (1.0 - tanh_a**2) + 1e-6).sum(axis=-1)
+    return tanh_a * max_action, logp_gauss - correction
+
+
+def make_sac_update(*, gamma: float, tau: float, target_entropy: float,
+                    max_action: float, lr: float, alpha_lr: float):
+    """Build (init_opt_states, jitted update). State pytree:
+    {params: {policy, q1, q2}, target: {q1, q2}, log_alpha, opt: {...}}."""
+    pi_opt = optax.adam(lr)
+    q_opt = optax.adam(lr)
+    a_opt = optax.adam(alpha_lr)
+
+    def init_opt(params, log_alpha):
+        return {
+            "pi": pi_opt.init(params["policy"]),
+            "q": q_opt.init({"q1": params["q1"], "q2": params["q2"]}),
+            "alpha": a_opt.init(log_alpha),
+        }
+
+    @jax.jit
+    def update(state, batch, key):
+        params, target = state["params"], state["target"]
+        log_alpha, opt = state["log_alpha"], state["opt"]
+        alpha = jnp.exp(log_alpha)
+        k_next, k_cur = jax.random.split(key)
+
+        # ---- critic: y = r + γ(1-term)(min Q'(s', a') - α log π(a'|s'))
+        a_next, logp_next = _sample_squashed(
+            params["policy"], batch["next_obs"], k_next, max_action)
+        q_next = jnp.minimum(
+            q_forward(target["q1"], batch["next_obs"], a_next),
+            q_forward(target["q2"], batch["next_obs"], a_next),
+        ) - alpha * logp_next
+        y = batch["rewards"] + gamma * (1.0 - batch["terminated"]) * q_next
+        y = jax.lax.stop_gradient(y)
+
+        def critic_loss(qs):
+            q1 = q_forward(qs["q1"], batch["obs"], batch["actions"])
+            q2 = q_forward(qs["q2"], batch["obs"], batch["actions"])
+            return ((q1 - y) ** 2 + (q2 - y) ** 2).mean(), q1.mean()
+
+        (closs, q_mean), cgrads = jax.value_and_grad(critic_loss, has_aux=True)(
+            {"q1": params["q1"], "q2": params["q2"]})
+        qup, opt_q = q_opt.update(cgrads, opt["q"])
+        new_qs = optax.apply_updates({"q1": params["q1"], "q2": params["q2"]}, qup)
+
+        # ---- actor: α log π(a|s) - min Q(s, a), a reparameterized
+        def actor_loss(policy):
+            a, logp = _sample_squashed(policy, batch["obs"], k_cur, max_action)
+            q = jnp.minimum(q_forward(new_qs["q1"], batch["obs"], a),
+                            q_forward(new_qs["q2"], batch["obs"], a))
+            return (alpha * logp - q).mean(), logp.mean()
+
+        (aloss, logp_mean), pgrads = jax.value_and_grad(actor_loss, has_aux=True)(
+            params["policy"])
+        pup, opt_pi = pi_opt.update(pgrads, opt["pi"])
+        new_policy = optax.apply_updates(params["policy"], pup)
+
+        # ---- temperature: drive E[log π] toward -target_entropy
+        def alpha_loss(la):
+            return -(la * jax.lax.stop_gradient(logp_mean + target_entropy))
+
+        alps, agrads = jax.value_and_grad(alpha_loss)(log_alpha)
+        aup, opt_a = a_opt.update(agrads, opt["alpha"])
+        new_log_alpha = optax.apply_updates(log_alpha, aup)
+
+        # ---- polyak target tracking
+        new_target = jax.tree.map(
+            lambda t, o: (1.0 - tau) * t + tau * o, target, new_qs)
+
+        new_state = {
+            "params": {"policy": new_policy, **new_qs},
+            "target": new_target,
+            "log_alpha": new_log_alpha,
+            "opt": {"pi": opt_pi, "q": opt_q, "alpha": opt_a},
+        }
+        metrics = {
+            "critic_loss": closs,
+            "actor_loss": aloss,
+            "alpha_loss": alps,
+            "alpha": alpha,
+            "q_mean": q_mean,
+            "logp_mean": logp_mean,
+        }
+        return new_state, metrics
+
+    return init_opt, update
+
+
+def _np_gaussian(policy, obs: np.ndarray):
+    x = obs
+    for layer in policy["torso"]:
+        x = np.tanh(x @ np.asarray(layer["w"]) + np.asarray(layer["b"]))
+    out = x @ np.asarray(policy["head"]["w"]) + np.asarray(policy["head"]["b"])
+    mean, log_std = np.split(out, 2, axis=-1)
+    return mean, np.clip(log_std, -20.0, 2.0)
+
+
+class SACEnvRunner:
+    """Continuous-action transition collector: samples from the
+    squashed Gaussian in numpy (no device round trip per env step)."""
+
+    def __init__(self, env_cls, num_envs: int = 8, rollout_len: int = 32,
+                 seed: int = 0):
+        self.env = env_cls(num_envs=num_envs, seed=seed)
+        self.num_envs = num_envs
+        self.rollout_len = rollout_len
+        self.rng = np.random.default_rng(seed ^ 0x5AC)
+        self.obs = self.env.reset()
+        self._ep_return = np.zeros(num_envs, np.float32)
+        self._completed: list[float] = []
+
+    def sample(self, weights, random_actions: bool = False) -> dict:
+        T, N = self.rollout_len, self.num_envs
+        A = self.env.action_dim
+        max_a = self.env.max_action
+        obs_b = np.zeros((T, N, self.env.obs_dim), np.float32)
+        act_b = np.zeros((T, N, A), np.float32)
+        rew_b = np.zeros((T, N), np.float32)
+        next_b = np.zeros((T, N, self.env.obs_dim), np.float32)
+        term_b = np.zeros((T, N), np.float32)
+        for t in range(T):
+            if random_actions:  # warmup: uniform exploration
+                actions = self.rng.uniform(-max_a, max_a, (N, A)).astype(np.float32)
+            else:
+                mean, log_std = _np_gaussian(weights, self.obs)
+                pre = mean + np.exp(log_std) * self.rng.standard_normal(mean.shape)
+                actions = (np.tanh(pre) * max_a).astype(np.float32)
+            obs_b[t], act_b[t] = self.obs, actions
+            self.obs, rewards, dones, info = self.env.step(actions[:, 0] if A == 1 else actions)
+            rew_b[t] = rewards
+            next_b[t] = np.where(dones[:, None], info["terminal_obs"], self.obs)
+            term_b[t] = info["terminated"].astype(np.float32)
+            self._ep_return += rewards
+            for i in np.nonzero(dones)[0]:
+                self._completed.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+        completed, self._completed = self._completed, []
+        return {
+            "obs": obs_b.reshape(T * N, -1),
+            "actions": act_b.reshape(T * N, A),
+            "rewards": rew_b.reshape(-1),
+            "next_obs": next_b.reshape(T * N, -1),
+            "terminated": term_b.reshape(-1),
+            "episode_returns": np.asarray(completed, np.float32),
+        }
+
+
+class SAC(Algorithm):
+    def _setup(self) -> None:
+        c: SACConfig = self.config  # type: ignore[assignment]
+        env_probe = c.env_cls(num_envs=1)
+        obs_dim, act_dim = env_probe.obs_dim, env_probe.action_dim
+        self._max_action = float(env_probe.max_action)
+        target_entropy = (c.target_entropy if c.target_entropy is not None
+                          else -float(act_dim))
+
+        key = jax.random.PRNGKey(c.seed)
+        kp, k1, k2, self._key = jax.random.split(key, 4)
+        params = {
+            "policy": init_gaussian_policy(kp, obs_dim, act_dim, c.hidden),
+            "q1": init_q(k1, obs_dim, act_dim, c.hidden),
+            "q2": init_q(k2, obs_dim, act_dim, c.hidden),
+        }
+        log_alpha = jnp.asarray(math.log(c.init_alpha), jnp.float32)
+        init_opt, self._update = make_sac_update(
+            gamma=c.gamma, tau=c.tau, target_entropy=target_entropy,
+            max_action=self._max_action, lr=c.lr, alpha_lr=c.alpha_lr)
+        self.state = {
+            "params": params,
+            "target": {"q1": params["q1"], "q2": params["q2"]},
+            "log_alpha": log_alpha,
+            "opt": init_opt(params, log_alpha),
+        }
+        self.env_runner_group = EnvRunnerGroup(
+            c.env_cls,
+            num_env_runners=c.num_env_runners,
+            num_envs_per_runner=c.num_envs_per_runner,
+            rollout_len=c.rollout_len,
+            seed=c.seed,
+            runner_cls=SACEnvRunner,
+        )
+        self.buffer = ReplayBuffer(c.buffer_size, obs_dim, seed=c.seed,
+                                   action_dim=act_dim)
+        self._env_steps = 0
+        self._recent_returns: list[float] = []
+
+    def _weights(self):
+        return jax.tree.map(np.asarray, self.state["params"]["policy"])
+
+    def training_step(self) -> dict:
+        c: SACConfig = self.config  # type: ignore[assignment]
+        warmup = len(self.buffer) < c.learning_starts
+        samples = self.env_runner_group.sample(
+            self._weights(), random_actions=warmup)
+        for s in samples:
+            self.buffer.add_batch(s["obs"], s["actions"], s["rewards"],
+                                  s["next_obs"], s["terminated"])
+            self._env_steps += len(s["actions"])
+            self._recent_returns.extend(s["episode_returns"].tolist())
+
+        metrics: dict = {}
+        if len(self.buffer) >= c.learning_starts:
+            for _ in range(c.updates_per_iteration):
+                batch = self.buffer.sample(c.batch_size)
+                self._key, sub = jax.random.split(self._key)
+                self.state, m = self._update(self.state, batch, sub)
+            metrics = {k: float(v) for k, v in m.items()}
+
+        self._recent_returns = self._recent_returns[-100:]
+        metrics["episode_return_mean"] = (
+            float(np.mean(self._recent_returns)) if self._recent_returns else 0.0
+        )
+        metrics["num_env_steps_sampled"] = self._env_steps
+        metrics["buffer_size"] = len(self.buffer)
+        return metrics
+
+    def get_state(self) -> dict:
+        return {
+            "iteration": self.iteration,
+            "state": jax.tree.map(np.asarray, self.state),
+            "env_steps": self._env_steps,
+        }
+
+    def set_state(self, state: dict) -> None:
+        self.iteration = state["iteration"]
+        self.state = jax.tree.map(jnp.asarray, state["state"])
+        self._env_steps = state["env_steps"]
+
+
+SACConfig.algo_cls = SAC
