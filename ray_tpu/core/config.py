@@ -67,6 +67,12 @@ class RayTpuConfig:
     # Fork default-env workers from a warm pre-imported zygote process
     # instead of paying interpreter boot + imports per worker.
     enable_worker_zygote: bool = True
+    # Ray Client sessions: the client pings every interval; the proxy
+    # reaps sessions silent for the timeout (kills session-owned actors,
+    # drops refs/streams, finishes the client job) — crash cleanup for
+    # drivers that never call disconnect (ref: ray client reconnect grace).
+    client_ping_interval_s: float = 5.0
+    client_session_timeout_s: float = 30.0
     # Object-manager push: chunks a holder keeps in flight toward one
     # receiver (reference push_manager.h:30 sender-side flow control).
     push_manager_chunks_in_flight: int = 8

@@ -33,6 +33,7 @@ class ClientServer:
     bootstrap or any ``ray_tpu.init()``'d process)."""
 
     def __init__(self, host: str = "0.0.0.0", port: int = 10001):
+        from ..core.config import get_config
         from ..core.worker import global_worker
 
         self._worker = global_worker()
@@ -45,20 +46,88 @@ class ClientServer:
         # Actors each client session OWNS (non-detached, unnamed): killed
         # on disconnect, like handle-GC in a local driver.
         self._client_actors: dict[str, list[bytes]] = {}
+        # Session metadata: last_seen (heartbeat reaping), the client's
+        # GCS job id (per-client job isolation for observability), and
+        # open streaming generators.
+        self._sessions: dict[str, dict] = {}
         self._lock = threading.Lock()
+        self._timeout = get_config().client_session_timeout_s
+        self._stopping = False
         self._io.run_sync(self._server.start())
         self.address = self._server.address
+        self._reaper = threading.Thread(
+            target=self._reap_loop, name="raytpu-client-reaper", daemon=True)
+        self._reaper.start()
 
     def stop(self) -> None:
+        self._stopping = True
         try:
             self._io.run_sync(self._server.stop())
         except Exception:
             pass
         self._io.stop()
 
+    def _reap_loop(self) -> None:
+        """Crash cleanup: a client that vanishes without disconnecting
+        (killed process, severed network) stops pinging; its session-owned
+        actors/refs/streams are reclaimed after the timeout — the
+        reference's client reconnect-grace expiry."""
+        import time as _time
+
+        while not self._stopping:
+            _time.sleep(min(5.0, self._timeout / 3))
+            now = _time.monotonic()
+            with self._lock:
+                dead = [cid for cid, s in self._sessions.items()
+                        if now - s["last_seen"] > self._timeout]
+            for cid in dead:
+                self._cleanup_session(cid, reason="session timeout")
+
+    def _cleanup_session(self, client_id: str, *, reason: str) -> None:
+        import logging
+
+        with self._lock:
+            self._refs.pop(client_id, None)
+            actors = self._client_actors.pop(client_id, [])
+            session = self._sessions.pop(client_id, None)
+        if session is not None:
+            logging.getLogger(__name__).info(
+                "client session %s cleaned up (%s): %d actors, %d streams",
+                client_id[:12], reason, len(actors),
+                len(session.get("streams", {})))
+        for state in (session or {}).get("streams", {}).values():
+            try:
+                state["gen"].close()
+            except Exception:
+                pass
+        for actor_id in actors:
+            # Session-owned actors die with the session (the handle-GC
+            # semantics a local driver would have given them).
+            try:
+                self._worker.kill_actor(actor_id)
+            except Exception:
+                pass
+        if session and session.get("job_id") is not None:
+            try:
+                self._worker._gcs_call("FinishJob", {"job_id": session["job_id"]})
+            except Exception:
+                pass
+
     # ------------------------------------------------------------- helpers
     def _client(self, p: dict) -> dict:
+        """Touch the session and return its ref registry. Unknown (never
+        seen or already-reaped) sessions are REJECTED rather than
+        resurrected: a client partitioned past the timeout must fail fast
+        with 'session expired', not keep running against destroyed state."""
+        import time as _time
+
         with self._lock:
+            session = self._sessions.get(p["client_id"])
+            if session is None:
+                raise RayTpuError(
+                    "client session expired or unknown — reconnect with "
+                    "ray_tpu.init(address='ray://...')")
+            session["last_seen"] = _time.monotonic()
             return self._refs.setdefault(p["client_id"], {})
 
     def _resolve(self, p: dict, wire_args: list) -> tuple[tuple, dict]:
@@ -84,6 +153,106 @@ class ClientServer:
         return rid
 
     # ------------------------------------------------------------ handlers
+    async def handle_ClientHello(self, p: dict) -> dict:
+        """Session start (the ONLY call that may create a session):
+        register a per-client JOB in the GCS (the reference attaches each
+        ray:// driver as its own job — job-level observability and
+        lifetime isolation), return the ping interval."""
+        import time as _time
+
+        from ..core.config import get_config
+
+        reply = self._worker._gcs_call(
+            "AddJob", {"driver_address": f"ray-client:{p['client_id'][:12]}"})
+        with self._lock:
+            self._sessions[p["client_id"]] = {
+                "last_seen": _time.monotonic(),
+                "job_id": reply.get("job_id"),
+                "streams": {},
+            }
+        return {"job_id": reply.get("job_id"),
+                "ping_interval_s": get_config().client_ping_interval_s}
+
+    async def handle_ClientPing(self, p: dict) -> dict:
+        self._client(p)  # touches last_seen
+        return {}
+
+    def _register_stream(self, p: dict, gen) -> str:
+        sid = uuid.uuid4().hex
+        self._client(p)
+        with self._lock:
+            # next: the index the client may request next; last: cached
+            # reply for index next-1 so a RETRIED StreamNext (transport
+            # drop after the server consumed the item) replays instead of
+            # silently skipping an item.
+            self._sessions[p["client_id"]]["streams"][sid] = {
+                "gen": gen, "next": 0, "last": None}
+        return sid
+
+    async def handle_ClientStreamNext(self, p: dict) -> dict:
+        """Idempotent by item index: the client sends the index it wants;
+        a duplicate request (RPC retry) replays the cached reply."""
+        import asyncio
+
+        self._client(p)
+        with self._lock:
+            state = self._sessions.get(p["client_id"], {}).get(
+                "streams", {}).get(p["stream"])
+        if state is None:
+            return {"error": cloudpickle.dumps(
+                RayTpuError(f"unknown stream {p['stream']!r}"))}
+        idx = p.get("index", state["next"])
+        if idx == state["next"] - 1 and state["last"] is not None:
+            return state["last"]  # retry replay
+        if idx != state["next"]:
+            return {"error": cloudpickle.dumps(RayTpuError(
+                f"stream cursor mismatch: asked {idx}, next {state['next']}"))}
+
+        gen = state["gen"]
+        loop = asyncio.get_running_loop()
+        # Loop-native wait for availability: no executor thread parks for
+        # the whole (possibly unbounded) producer wait — with many idle
+        # token streams that would starve every other client RPC.
+        fut = loop.create_future()
+        if gen._stream.add_item_waiter(gen._cursor, loop, fut):
+            try:
+                await asyncio.wait_for(fut, p.get("timeout"))
+            except asyncio.TimeoutError:
+                from ..core.status import GetTimeoutError
+
+                return {"error": cloudpickle.dumps(GetTimeoutError(
+                    f"timed out waiting for stream item {idx}"))}
+
+        _END = object()  # StopIteration cannot cross an asyncio Future
+
+        def step():
+            try:
+                # item (or end) is available: returns without blocking
+                return gen._next_sync(30.0)
+            except StopIteration:
+                return _END
+
+        try:
+            ref = await loop.run_in_executor(None, step)
+        except Exception as e:
+            inner = getattr(e, "_inner", e)
+            reply = {"error": cloudpickle.dumps(inner)}
+        else:
+            reply = {"done": True} if ref is _END else {"ref": self._track(p, ref)}
+        with self._lock:
+            state["last"] = reply
+            state["next"] += 1
+        return reply
+
+    async def handle_ClientStreamClose(self, p: dict) -> dict:
+        self._client(p)
+        with self._lock:
+            state = self._sessions.get(p["client_id"], {}).get(
+                "streams", {}).pop(p["stream"], None)
+        if state is not None:
+            state["gen"].close()
+        return {}
+
     async def handle_ClientPut(self, p: dict) -> dict:
         import asyncio
 
@@ -133,9 +302,8 @@ class ClientServer:
         loop = asyncio.get_running_loop()
         refs = await loop.run_in_executor(
             None, lambda: self._worker.submit_task(fn, args, kwargs, **opts))
-        if not isinstance(refs, list):  # streaming unsupported over client v1
-            return {"error": cloudpickle.dumps(
-                RayTpuError("streaming tasks are not supported over ray:// yet"))}
+        if not isinstance(refs, list):  # ObjectRefGenerator (streaming)
+            return {"stream": self._register_stream(p, refs)}
         return {"refs": [self._track(p, r) for r in refs]}
 
     async def handle_ClientCreateActor(self, p: dict) -> dict:
@@ -163,7 +331,10 @@ class ClientServer:
         refs = await loop.run_in_executor(
             None, lambda: self._worker.submit_actor_task(
                 bytes.fromhex(p["actor_id"]), p["method"], args, kwargs,
-                num_returns=p.get("num_returns", 1)))
+                num_returns=p.get("num_returns", 1),
+                generator_backpressure=p.get("generator_backpressure", 0)))
+        if not isinstance(refs, list):  # ObjectRefGenerator (streaming)
+            return {"stream": self._register_stream(p, refs)}
         return {"refs": [self._track(p, r) for r in refs]}
 
     async def handle_ClientKillActor(self, p: dict) -> dict:
@@ -184,17 +355,77 @@ class ClientServer:
         return self._worker._gcs_call(p["method"], p.get("payload") or {})
 
     async def handle_ClientDisconnect(self, p: dict) -> dict:
-        with self._lock:
-            self._refs.pop(p["client_id"], None)
-            actors = self._client_actors.pop(p["client_id"], [])
-        for actor_id in actors:
-            # Session-owned actors die with the session (the handle-GC
-            # semantics a local driver would have given them).
-            try:
-                self._worker.kill_actor(actor_id)
-            except Exception:
-                pass
+        self._cleanup_session(p["client_id"], reason="disconnect")
         return {}
+
+
+class ClientObjectRefGenerator:
+    """Client-side view of a server-held ``ObjectRefGenerator``: iterating
+    yields ObjectRefs (fetched one server round trip per item), matching
+    the local streaming surface; ``close()`` cancels the producer."""
+
+    def __init__(self, worker: "ClientWorker", stream_id: str):
+        self._worker = worker
+        self._stream_id = stream_id
+        self._index = 0
+        self._closed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self._next_sync(timeout=None)
+
+    def _next_sync(self, timeout: float | None):
+        if self._closed:
+            raise StopIteration
+        reply = self._worker._call(
+            "ClientStreamNext",
+            {"stream": self._stream_id, "index": self._index, "timeout": timeout},
+            timeout=None if timeout is None else timeout + 30.0)
+        self._index += 1
+        if reply.get("done"):
+            self._closed = True
+            raise StopIteration
+        return self._worker._make_ref(reply["ref"])
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        import asyncio
+
+        _END = object()  # StopIteration cannot cross an asyncio Future
+
+        def step():
+            try:
+                return self._next_sync(None)
+            except StopIteration:
+                return _END
+
+        ref = await asyncio.get_running_loop().run_in_executor(None, step)
+        if ref is _END:
+            raise StopAsyncIteration
+        return ref
+
+    def completed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._worker._call("ClientStreamClose",
+                               {"stream": self._stream_id}, timeout=10.0)
+        except Exception:
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class ClientWorker:
@@ -213,7 +444,23 @@ class ClientWorker:
         self.mode = "client"
         self._ref_lock = threading.Lock()
         self._local_refs: dict[bytes, str] = {}  # ObjectID binary -> server rid
+        self._stop_ping = threading.Event()
+        self._ping_thread: threading.Thread | None = None
         install_refcount_hooks(lambda r: None, lambda r: None)
+
+    def _start_ping(self, interval: float) -> None:
+        """Heartbeat so the proxy can tell a live-but-idle client from a
+        crashed one (session reaping on the server side)."""
+        def loop():
+            while not self._stop_ping.wait(interval):
+                try:
+                    self._call("ClientPing", {}, timeout=15.0)
+                except Exception:
+                    pass  # transient; the retryable RPC client reconnects
+
+        self._ping_thread = threading.Thread(
+            target=loop, name="raytpu-client-ping", daemon=True)
+        self._ping_thread.start()
 
     # ------------------------------------------------------------ plumbing
     def _call(self, method: str, payload: dict, timeout: float | None = 300.0) -> dict:
@@ -276,14 +523,14 @@ class ClientWorker:
         return ([by_rid[r] for r in reply["ready"]],
                 [by_rid[r] for r in reply["not_ready"]])
 
-    def submit_task(self, fn, args, kwargs, **options) -> list[ObjectRef]:
-        if options.get("num_returns") == "streaming":
-            raise RayTpuError("streaming tasks are not supported over ray:// yet")
+    def submit_task(self, fn, args, kwargs, **options):
         reply = self._call("ClientSubmitTask", {
             "fn": cloudpickle.dumps(fn),
             "args": self._wire_args(args, kwargs),
             "options": options,
         })
+        if "stream" in reply:
+            return ClientObjectRefGenerator(self, reply["stream"])
         return [self._make_ref(r) for r in reply["refs"]]
 
     def create_actor(self, cls, args, kwargs, **options) -> bytes:
@@ -296,12 +543,13 @@ class ClientWorker:
 
     def submit_actor_task(self, actor_id: bytes, method: str, args, kwargs,
                           *, num_returns=1, generator_backpressure: int = 0):
-        if num_returns == "streaming":
-            raise RayTpuError("streaming actor calls are not supported over ray:// yet")
         reply = self._call("ClientActorCall", {
             "actor_id": actor_id.hex(), "method": method,
             "args": self._wire_args(args, kwargs), "num_returns": num_returns,
+            "generator_backpressure": generator_backpressure,
         })
+        if "stream" in reply:
+            return ClientObjectRefGenerator(self, reply["stream"])
         return [self._make_ref(r) for r in reply["refs"]]
 
     def kill_actor(self, actor_id: bytes) -> None:
@@ -323,6 +571,9 @@ class ClientWorker:
         return self._call("ClientGcsCall", {"method": method, "payload": payload})
 
     def shutdown(self) -> None:
+        self._stop_ping.set()
+        if self._ping_thread is not None:
+            self._ping_thread.join(timeout=2.0)
         try:
             self._call("ClientDisconnect", {}, timeout=5.0)
         except Exception:
@@ -341,6 +592,10 @@ class ClientWorker:
 def connect(address: str) -> ClientWorker:
     """``ray_tpu.init(address="ray://...")`` entry point."""
     worker = ClientWorker(address)
-    # round-trip to fail fast on a bad address
-    worker._call("ClientGetActorByName", {"name": "__probe__"}, timeout=15.0)
+    # handshake: fails fast on a bad address, registers the per-client
+    # job, and returns the heartbeat cadence
+    reply = worker._call("ClientHello", {}, timeout=15.0)
+    if reply.get("job_id") is not None:
+        worker.job_id = JobID.from_int(reply["job_id"])
+    worker._start_ping(float(reply.get("ping_interval_s") or 5.0))
     return worker
