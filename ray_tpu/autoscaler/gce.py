@@ -27,12 +27,42 @@ import urllib.error
 import urllib.request
 from typing import Any, Callable
 
-from .node_provider import NodeProvider
+from .node_provider import NodeLaunchError, NodeProvider
 
 _METADATA_TOKEN_URL = (
     "http://metadata.google.internal/computeMetadata/v1/instance/"
     "service-accounts/default/token"
 )
+
+# Capacity-class failure markers in Cloud TPU error bodies: quota
+# exhaustion and zone stockout (the dominant real-world TPU launch
+# failures; the reconciler backs off this node type and tries others).
+# Deliberately SPECIFIC — a 403 "API not enabled ... check quota project"
+# config error must NOT match, or a permanent misconfiguration would be
+# retried silently forever.
+_CAPACITY_MARKERS = ("RESOURCE_EXHAUSTED", "QUOTA_EXCEEDED",
+                     "Quota exceeded", "quota exceeded",
+                     "stockout", "out of capacity", "no more capacity",
+                     "insufficient capacity", "There is no more capacity")
+
+
+def _classify_launch_error(e: Exception) -> Exception:
+    """Wrap a create-node failure: HTTP 429 always, or an error whose
+    body carries a capacity marker, becomes a TRANSIENT NodeLaunchError;
+    anything else (auth, API-disabled, bad request) passes through."""
+    if isinstance(e, NodeLaunchError):
+        return e
+    code = getattr(e, "code", None)
+    body = ""
+    try:
+        body = e.read().decode(errors="replace") if hasattr(e, "read") else str(e)
+    except Exception:
+        body = str(e)
+    if code == 429 or any(m in body for m in _CAPACITY_MARKERS):
+        return NodeLaunchError(
+            f"TPU capacity unavailable (HTTP {code}): {body[:300]}",
+            transient=True, reason="quota/stockout")
+    return e
 
 
 class GceTransport:
@@ -150,10 +180,10 @@ class GceTpuNodeProvider(NodeProvider):
                 f"{self.API}/{self._parent()}/nodes?nodeId={instance_id}",
                 body,
             )
-        except Exception:
+        except Exception as e:
             with self._lock:
                 self._instances.pop(instance_id, None)
-            raise
+            raise _classify_launch_error(e) from e
         return instance_id
 
     def terminate_node(self, instance_id: str) -> None:

@@ -13,6 +13,19 @@ from __future__ import annotations
 import threading
 
 
+class NodeLaunchError(Exception):
+    """A node launch the provider could not fulfil. ``transient=True``
+    marks capacity-class failures (quota exhausted, zone stockout — the
+    dominant real TPU failure) the reconciler should back off on and
+    route around, rather than config errors worth surfacing loudly."""
+
+    def __init__(self, message: str, *, transient: bool = False,
+                 reason: str = ""):
+        super().__init__(message)
+        self.transient = transient
+        self.reason = reason
+
+
 class NodeProvider:
     def create_node(self, node_type: str, resources: dict) -> str:
         """Launch a node of `node_type`; returns a provider instance id."""

@@ -80,6 +80,19 @@ class RayTpuConfig:
     # excess pulls queue by class get > wait > task-arg
     # (reference pull_manager.h:51 prioritized bundles).
     pull_manager_max_concurrent: int = 4
+    # Receiver-side push watchdog: abandon an in-flight inbound push when
+    # no chunk lands for this long (holder died mid-stream), and cap the
+    # total wall time one push may take before falling back to a pull.
+    object_push_stall_timeout_s: float = 10.0
+    object_push_complete_timeout_s: float = 120.0
+    # GC grace for unsealed partial-receive allocations with no progress
+    # (unsealed objects are neither spillable nor evictable).
+    object_receive_gc_grace_s: float = 60.0
+    # Per-chunk transfer RPC timeout (push and pull chunk calls).
+    object_transfer_rpc_timeout_s: float = 60.0
+    # Owner/object-directory control RPCs (GetObjectLocations, location
+    # add/remove) — small messages, but cross-node.
+    object_directory_rpc_timeout_s: float = 10.0
     # Device-release fence: how long to wait for a TPU-holding worker
     # process to exit (after SIGTERM, then SIGKILL) before re-granting the
     # TPU resource anyway. The libtpu device lock is exclusive per process

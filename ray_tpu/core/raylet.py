@@ -422,7 +422,7 @@ class Raylet:
             # spillable or evictable.
             now = time.monotonic()
             for oid, state in list(self._receiving.items()):
-                if now - state["last_progress"] > 60.0:
+                if now - state["last_progress"] > cfg.object_receive_gc_grace_s:
                     self._receiving.pop(oid, None)
                     try:
                         self.store.delete(oid, force=True)
@@ -1426,7 +1426,9 @@ class Raylet:
         self.transfer_stats["pulls_started"] += 1
         try:
             owner = RpcClient(owner_address)
-            status = await owner.call("GetObjectLocations", {"id": oid}, timeout=10.0)
+            status = await owner.call(
+                "GetObjectLocations", {"id": oid},
+                timeout=get_config().object_directory_rpc_timeout_s)
             locations = [n for n in status.get("locations", []) if n != self.node_id.hex()]
             # Fan-out: prefer SECONDARY holders (earlier receivers) over
             # the primary, rotating among them by a node-local stamp — a
@@ -1458,8 +1460,10 @@ class Raylet:
                     # Generic transfer failures (e.g. THIS node's store is
                     # full) must not wipe live copies from the directory.
                     try:
-                        await owner.call("RemoveObjectLocation", {
-                            "id": oid, "node_id": node_id}, timeout=10.0)
+                        await owner.call(
+                            "RemoveObjectLocation",
+                            {"id": oid, "node_id": node_id},
+                            timeout=get_config().object_directory_rpc_timeout_s)
                     except Exception:
                         pass
                 except Exception as e:
@@ -1467,8 +1471,10 @@ class Raylet:
                                    oid.hex()[:12], node_id[:8], e)
             if ok:
                 try:
-                    await owner.call("AddObjectLocation", {
-                        "id": oid, "node_id": self.node_id.hex()}, timeout=10.0)
+                    await owner.call(
+                        "AddObjectLocation",
+                        {"id": oid, "node_id": self.node_id.hex()},
+                        timeout=get_config().object_directory_rpc_timeout_s)
                 except Exception:
                     pass  # directory update is best-effort
             await owner.close()
@@ -1505,7 +1511,7 @@ class Raylet:
                 # no-progress grace also covers the window BEFORE the
                 # first chunk (a busy holder may need seconds to start).
                 started = time.monotonic()
-                deadline = started + 120.0
+                deadline = started + get_config().object_push_complete_timeout_s
                 while time.monotonic() < deadline:
                     try:
                         await asyncio.wait_for(asyncio.shield(fut), 2.0)
@@ -1513,8 +1519,9 @@ class Raylet:
                     except asyncio.TimeoutError:
                         state = self._receiving.get(oid)
                         last = state["last_progress"] if state else started
-                        if time.monotonic() - last > 10.0:
-                            break  # no chunk for 10s: holder is gone
+                        if (time.monotonic() - last
+                                > get_config().object_push_stall_timeout_s):
+                            break  # no chunk in the window: holder is gone
                 if self.store.contains(oid) == 2:
                     return
                 raise KeyError(f"push of {oid.hex()} did not complete")
@@ -1574,7 +1581,7 @@ class Raylet:
                 window.append(spawn(client.call("PushObjectChunk", {
                     "id": oid, "offset": pos, "data": data,
                     "data_size": data_size, "meta_size": meta_size,
-                }, timeout=60.0)))
+                }, timeout=cfg.object_transfer_rpc_timeout_s)))
                 self.transfer_stats["chunks_served"] += 1
                 pos += size
                 if len(window) >= cfg.push_manager_chunks_in_flight:
@@ -1628,7 +1635,7 @@ class Raylet:
         client = self._store_client(node_address)
         first = await client.call(
             "FetchObjectChunk", {"id": oid, "offset": 0, "size": cfg.object_manager_chunk_size},
-            timeout=30.0,
+            timeout=cfg.object_transfer_rpc_timeout_s,
         )
         if not first.get("found"):
             raise ObjectMissingOnHolder(f"{oid.hex()} not on {node_address}")
@@ -1643,7 +1650,7 @@ class Raylet:
             r = await client.call(
                 "FetchObjectChunk",
                 {"id": oid, "offset": pos, "size": cfg.object_manager_chunk_size},
-                timeout=30.0,
+                timeout=cfg.object_transfer_rpc_timeout_s,
             )
             data = r["data"]
             self.store.write(offset + pos, data)
