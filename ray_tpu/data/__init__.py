@@ -26,8 +26,10 @@ from .dataset import (
     read_text,
 )
 from .iterator import DataIterator
+from . import preprocessors
 
 __all__ = [
+    "preprocessors",
     "ActorPoolStrategy",
     "BlockAccessor",
     "DataIterator",

@@ -1,0 +1,293 @@
+"""Dataset preprocessors: fit statistics once, transform anywhere.
+
+Equivalent of the reference's ``python/ray/data/preprocessors/`` —
+``Preprocessor`` (fit/transform/transform_batch contract,
+``preprocessor.py``), scalers (``scaler.py``), encoders
+(``encoder.py``), imputer (``imputer.py``), concatenator
+(``concatenator.py``), chain (``chain.py``). TPU-shaped differences:
+fitting streams ONE pass over the dataset accumulating sufficient
+statistics host-side (datasets are token/tensor streams, not pandas
+frames), and transforms are numpy ``map_batches`` fns so they fuse into
+the streaming executor like any other map stage and feed
+``iter_batches`` -> ``jax.device_put`` directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import numpy as np
+
+
+class PreprocessorNotFittedError(RuntimeError):
+    pass
+
+
+class Preprocessor:
+    """Base contract: ``fit(ds)`` computes state, ``transform(ds)`` adds
+    a map stage, ``transform_batch(batch)`` applies to one numpy-dict
+    batch (serving-time single-record path)."""
+
+    _is_fittable = True
+
+    def __init__(self):
+        self.stats_: dict[str, Any] = {}
+        self._fitted = False
+
+    # -------------------------------------------------------------- public
+    def fit(self, ds) -> "Preprocessor":
+        self._fit(ds)
+        self._fitted = True
+        return self
+
+    def fit_transform(self, ds):
+        return self.fit(ds).transform(ds)
+
+    def transform(self, ds):
+        self._check_fitted()
+        return ds.map_batches(self.transform_batch, batch_format="numpy")
+
+    def transform_batch(self, batch: dict) -> dict:
+        self._check_fitted()
+        return self._transform_numpy(dict(batch))
+
+    def _check_fitted(self) -> None:
+        if self._is_fittable and not self._fitted:
+            raise PreprocessorNotFittedError(
+                f"{type(self).__name__} must be fit before transform")
+
+    # ------------------------------------------------------------ override
+    def _fit(self, ds) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _transform_numpy(self, batch: dict) -> dict:  # pragma: no cover
+        raise NotImplementedError
+
+
+def _iter_np_batches(ds) -> Iterable[dict]:
+    for batch in ds.iter_batches(batch_size=4096, batch_format="numpy"):
+        yield batch
+
+
+class StandardScaler(Preprocessor):
+    """Column-wise (x - mean) / std, std 0 -> 1 (ref scaler.py)."""
+
+    def __init__(self, columns: list[str]):
+        super().__init__()
+        self.columns = list(columns)
+
+    def _fit(self, ds) -> None:
+        n = 0
+        s = {c: 0.0 for c in self.columns}
+        sq = {c: 0.0 for c in self.columns}
+        for batch in _iter_np_batches(ds):
+            for c in self.columns:
+                col = np.asarray(batch[c], np.float64)
+                s[c] += float(col.sum())
+                sq[c] += float((col ** 2).sum())
+            n += len(next(iter(batch.values())))
+        for c in self.columns:
+            mean = s[c] / max(n, 1)
+            var = max(sq[c] / max(n, 1) - mean ** 2, 0.0)
+            std = var ** 0.5
+            self.stats_[f"mean({c})"] = mean
+            self.stats_[f"std({c})"] = std if std > 0 else 1.0
+
+    def _transform_numpy(self, batch: dict) -> dict:
+        for c in self.columns:
+            batch[c] = ((np.asarray(batch[c], np.float64)
+                         - self.stats_[f"mean({c})"])
+                        / self.stats_[f"std({c})"]).astype(np.float32)
+        return batch
+
+
+class MinMaxScaler(Preprocessor):
+    """Column-wise (x - min) / (max - min), degenerate range -> 0."""
+
+    def __init__(self, columns: list[str]):
+        super().__init__()
+        self.columns = list(columns)
+
+    def _fit(self, ds) -> None:
+        lo = {c: np.inf for c in self.columns}
+        hi = {c: -np.inf for c in self.columns}
+        for batch in _iter_np_batches(ds):
+            for c in self.columns:
+                col = np.asarray(batch[c], np.float64)
+                lo[c] = min(lo[c], float(col.min()))
+                hi[c] = max(hi[c], float(col.max()))
+        for c in self.columns:
+            self.stats_[f"min({c})"] = lo[c]
+            self.stats_[f"max({c})"] = hi[c]
+
+    def _transform_numpy(self, batch: dict) -> dict:
+        for c in self.columns:
+            lo = self.stats_[f"min({c})"]
+            span = self.stats_[f"max({c})"] - lo
+            col = np.asarray(batch[c], np.float64)
+            batch[c] = (np.zeros_like(col, np.float32) if span == 0
+                        else ((col - lo) / span).astype(np.float32))
+        return batch
+
+
+class LabelEncoder(Preprocessor):
+    """String/any labels -> contiguous int ids (sorted order; unseen
+    labels at transform raise, matching the reference)."""
+
+    def __init__(self, label_column: str):
+        super().__init__()
+        self.label_column = label_column
+
+    def _fit(self, ds) -> None:
+        values: set = set()
+        for batch in _iter_np_batches(ds):
+            values.update(np.asarray(batch[self.label_column]).tolist())
+        ordered = sorted(values, key=lambda v: (str(type(v)), v))
+        self.stats_[f"unique_values({self.label_column})"] = {
+            v: i for i, v in enumerate(ordered)}
+
+    def _transform_numpy(self, batch: dict) -> dict:
+        mapping = self.stats_[f"unique_values({self.label_column})"]
+        col = np.asarray(batch[self.label_column]).tolist()
+        try:
+            batch[self.label_column] = np.asarray(
+                [mapping[v] for v in col], np.int64)
+        except KeyError as e:
+            raise ValueError(
+                f"label {e} not seen during fit for "
+                f"{self.label_column!r}") from None
+        return batch
+
+    def inverse_transform_batch(self, batch: dict) -> dict:
+        self._check_fitted()
+        mapping = self.stats_[f"unique_values({self.label_column})"]
+        inverse = {i: v for v, i in mapping.items()}
+        batch = dict(batch)
+        batch[self.label_column] = np.asarray(
+            [inverse[int(i)] for i in np.asarray(batch[self.label_column])])
+        return batch
+
+
+class OneHotEncoder(Preprocessor):
+    """Each categorical column -> one 0/1 column per seen value, named
+    ``{col}_{value}``; the source column is dropped. Unseen values
+    one-hot to all zeros (the reference's handle-unknown behavior)."""
+
+    def __init__(self, columns: list[str]):
+        super().__init__()
+        self.columns = list(columns)
+
+    def _fit(self, ds) -> None:
+        values: dict[str, set] = {c: set() for c in self.columns}
+        for batch in _iter_np_batches(ds):
+            for c in self.columns:
+                values[c].update(np.asarray(batch[c]).tolist())
+        for c in self.columns:
+            ordered = sorted(values[c], key=lambda v: (str(type(v)), v))
+            self.stats_[f"unique_values({c})"] = ordered
+
+    def _transform_numpy(self, batch: dict) -> dict:
+        for c in self.columns:
+            col = np.asarray(batch.pop(c)).tolist()
+            for v in self.stats_[f"unique_values({c})"]:
+                batch[f"{c}_{v}"] = np.asarray(
+                    [1 if x == v else 0 for x in col], np.int8)
+        return batch
+
+
+class SimpleImputer(Preprocessor):
+    """Fill NaNs: strategy "mean" (fitted per column) or "constant"
+    (``fill_value``, no fit needed)."""
+
+    def __init__(self, columns: list[str], strategy: str = "mean",
+                 fill_value: float | None = None):
+        super().__init__()
+        if strategy not in ("mean", "constant"):
+            raise ValueError(f"unknown strategy {strategy!r}")
+        if strategy == "constant" and fill_value is None:
+            raise ValueError("strategy='constant' needs fill_value")
+        self.columns = list(columns)
+        self.strategy = strategy
+        self.fill_value = fill_value
+        self._is_fittable = strategy != "constant"
+        if not self._is_fittable:
+            self._fitted = True
+
+    def _fit(self, ds) -> None:
+        n = {c: 0 for c in self.columns}
+        s = {c: 0.0 for c in self.columns}
+        for batch in _iter_np_batches(ds):
+            for c in self.columns:
+                col = np.asarray(batch[c], np.float64)
+                live = ~np.isnan(col)
+                n[c] += int(live.sum())
+                s[c] += float(col[live].sum())
+        for c in self.columns:
+            self.stats_[f"mean({c})"] = s[c] / max(n[c], 1)
+
+    def _transform_numpy(self, batch: dict) -> dict:
+        for c in self.columns:
+            col = np.asarray(batch[c], np.float64)
+            fill = (self.fill_value if self.strategy == "constant"
+                    else self.stats_[f"mean({c})"])
+            batch[c] = np.where(np.isnan(col), fill, col).astype(np.float32)
+        return batch
+
+
+class Concatenator(Preprocessor):
+    """Stack numeric columns into ONE 2-D feature column (the
+    model-input shape ``iter_batches`` feeds to jax) — ref
+    concatenator.py."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: list[str] | None = None,
+                 output_column_name: str = "concat",
+                 exclude: list[str] | None = None):
+        super().__init__()
+        self.columns = list(columns) if columns is not None else None
+        self.output_column_name = output_column_name
+        self.exclude = set(exclude or [])
+        self._fitted = True
+
+    def _transform_numpy(self, batch: dict) -> dict:
+        cols = (self.columns if self.columns is not None
+                else [c for c in batch if c not in self.exclude])
+        parts = []
+        for c in cols:
+            a = np.asarray(batch.pop(c), np.float32)
+            parts.append(a[:, None] if a.ndim == 1 else a.reshape(len(a), -1))
+        batch[self.output_column_name] = np.concatenate(parts, axis=1)
+        return batch
+
+
+class Chain(Preprocessor):
+    """Sequential preprocessors: each stage fits on the PREVIOUS stage's
+    transformed output (ref chain.py)."""
+
+    def __init__(self, *preprocessors: Preprocessor):
+        super().__init__()
+        self.preprocessors = list(preprocessors)
+        # fittability derives from the stages (reference chain.py): a
+        # chain of stateless stages needs no fit before transform
+        self._is_fittable = any(p._is_fittable for p in self.preprocessors)
+        if not self._is_fittable:
+            self._fitted = True
+
+    def _fit(self, ds) -> None:
+        for p in self.preprocessors:
+            if p._is_fittable:
+                p.fit(ds)
+            ds = p.transform(ds)
+
+    def _transform_numpy(self, batch: dict) -> dict:
+        for p in self.preprocessors:
+            batch = p.transform_batch(batch)
+        return batch
+
+    def fit_transform(self, ds):
+        self.fit(ds)
+        # reuse the already-fitted stages rather than re-walking the chain
+        for p in self.preprocessors:
+            ds = p.transform(ds)
+        return ds

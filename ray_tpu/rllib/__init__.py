@@ -18,7 +18,8 @@ from .impala import APPO, APPOConfig, IMPALA, IMPALAConfig
 from .learner import Learner
 from .learner_group import LearnerGroup
 from .dqn import DQN, DQNConfig
-from .offline import BC, BCConfig, CQL, CQLConfig, collect_offline_data
+from .offline import (BC, BCConfig, CQL, CQLConfig, MARWIL, MARWILConfig,
+                      collect_offline_data)
 from .multi_agent import (MultiAgentCartPole, MultiAgentEnvRunner,
                           MultiAgentPPO, MultiAgentPPOConfig)
 from .ppo import PPO, PPOConfig
@@ -47,6 +48,8 @@ __all__ = [
     "CQL",
     "CQLConfig",
     "collect_offline_data",
+    "MARWIL",
+    "MARWILConfig",
     "MultiAgentCartPole",
     "MultiAgentEnvRunner",
     "MultiAgentPPO",

@@ -143,6 +143,22 @@ class _OfflineAlgorithm(Algorithm):
                 [float(r["terminated"]) for r in rows], np.float32)
         return out
 
+    def _build_learner_group(self, loss_fn) -> None:
+        """Shared by every offline algorithm: infer obs/action dims from
+        the materialized transitions (eval env may widen the action
+        space) and construct the LearnerGroup."""
+        c: OfflineConfig = self.config  # type: ignore[assignment]
+        obs_dim = self._transitions["obs"].shape[1]
+        n_actions = int(self._transitions["actions"].max()) + 1
+        if c.eval_env_cls is not None:
+            n_actions = max(n_actions, c.eval_env_cls(num_envs=1).n_actions)
+        self.learner_group = LearnerGroup(
+            loss_fn,
+            lambda key: models.init_policy(key, obs_dim, n_actions, c.hidden),
+            num_learners=c.num_learners, lr=c.lr,
+            max_grad_norm=c.max_grad_norm, seed=c.seed,
+        )
+
     def _evaluate(self) -> float | None:
         c: OfflineConfig = self.config  # type: ignore[assignment]
         if c.eval_env_cls is None:
@@ -184,16 +200,7 @@ class BC(_OfflineAlgorithm):
     def _setup(self) -> None:
         c: BCConfig = self.config  # type: ignore[assignment]
         self._transitions = self._load_transitions()
-        obs_dim = self._transitions["obs"].shape[1]
-        n_actions = int(self._transitions["actions"].max()) + 1
-        if c.eval_env_cls is not None:
-            n_actions = max(n_actions, c.eval_env_cls(num_envs=1).n_actions)
-        self.learner_group = LearnerGroup(
-            make_bc_loss(),
-            lambda key: models.init_policy(key, obs_dim, n_actions, c.hidden),
-            num_learners=c.num_learners, lr=c.lr,
-            max_grad_norm=c.max_grad_norm, seed=c.seed,
-        )
+        self._build_learner_group(make_bc_loss())
         self.rng = np.random.default_rng(c.seed)
 
     def training_step(self) -> dict:
@@ -273,16 +280,7 @@ class CQL(_OfflineAlgorithm):
         self._transitions = self._load_transitions()
         if "rewards" not in self._transitions:
             raise ValueError("CQL needs full transitions (reward/next_obs/terminated)")
-        obs_dim = self._transitions["obs"].shape[1]
-        n_actions = int(self._transitions["actions"].max()) + 1
-        if c.eval_env_cls is not None:
-            n_actions = max(n_actions, c.eval_env_cls(num_envs=1).n_actions)
-        self.learner_group = LearnerGroup(
-            make_cql_loss(c.gamma, c.cql_alpha),
-            lambda key: models.init_policy(key, obs_dim, n_actions, c.hidden),
-            num_learners=c.num_learners, lr=c.lr,
-            max_grad_norm=c.max_grad_norm, seed=c.seed,
-        )
+        self._build_learner_group(make_cql_loss(c.gamma, c.cql_alpha))
         self.rng = np.random.default_rng(c.seed)
         self._target_params = self.learner_group.get_weights()
         self._updates = 0
@@ -318,3 +316,114 @@ class CQL(_OfflineAlgorithm):
 
 
 CQLConfig.algo_cls = CQL
+
+
+class MARWILConfig(OfflineConfig):
+    """Monotonic advantage re-weighted imitation learning (reference
+    ``rllib/algorithms/marwil/marwil.py``): BC where each action's
+    log-prob is weighted by exp(beta * normalized advantage) — beta=0 IS
+    plain BC; beta>0 imitates good actions preferentially."""
+
+    def __init__(self):
+        super().__init__()
+        self.beta = 1.0
+        self.gamma = 0.99
+        self.vf_coeff = 1.0
+        # moving-average horizon for the advantage-norm c^2 (the
+        # reference's moving_average_sqd_adv_norm_update_rate)
+        self.adv_norm_update_rate = 1e-3
+
+    def training(self, *, beta=None, gamma=None, vf_coeff=None,
+                 adv_norm_update_rate=None, **kwargs):
+        for name, val in (("beta", beta), ("gamma", gamma),
+                          ("vf_coeff", vf_coeff),
+                          ("adv_norm_update_rate", adv_norm_update_rate)):
+            if val is not None:
+                setattr(self, name, val)
+        return super().training(**kwargs)
+
+
+def make_marwil_loss(beta: float, gamma: float, vf_coeff: float):
+    """batch: obs, actions, rewards, next_obs, terminated, adv_norm
+    (scalar: the moving c = sqrt(E[adv^2]) maintained by the algorithm).
+    One-step TD advantage against the learned value head; weight =
+    exp(beta * adv / c), clipped for stability."""
+
+    def loss_fn(params, batch):
+        logits, v = models.forward(params, batch["obs"])
+        _, v_next = models.forward(params, batch["next_obs"])
+        td_target = batch["rewards"] + gamma * (
+            1.0 - batch["terminated"]) * jax.lax.stop_gradient(v_next)
+        adv = jax.lax.stop_gradient(td_target) - v
+        vf_loss = (adv ** 2).mean()
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], axis=1)[:, 0]
+        c = jnp.maximum(batch["adv_norm"], 1e-8)
+        weight = jnp.exp(jnp.clip(
+            beta * jax.lax.stop_gradient(adv) / c, -20.0, 2.0))
+        policy_loss = -(weight * logp).mean()
+        loss = policy_loss + vf_coeff * vf_loss
+        acc = (jnp.argmax(logits, axis=1) == batch["actions"]).mean()
+        return loss, {
+            "marwil_loss": loss,
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "action_accuracy": acc,
+            "mean_sqd_adv": (adv ** 2).mean(),
+        }
+
+    return loss_fn
+
+
+class MARWIL(_OfflineAlgorithm):
+    def _setup(self) -> None:
+        c: MARWILConfig = self.config  # type: ignore[assignment]
+        self._transitions = self._load_transitions()
+        if "rewards" not in self._transitions:
+            raise ValueError(
+                "MARWIL needs reward/next_obs/terminated columns in the "
+                "offline dataset (collect_offline_data writes them)")
+        self._build_learner_group(make_marwil_loss(c.beta, c.gamma, c.vf_coeff))
+        self.rng = np.random.default_rng(c.seed)
+        self._ma_sqd_adv = 1.0  # moving E[adv^2]; c = sqrt of this
+
+    def training_step(self) -> dict:
+        c: MARWILConfig = self.config  # type: ignore[assignment]
+        data, metrics = self._transitions, {}
+        n = len(data["actions"])
+        for _ in range(c.updates_per_iteration):
+            idx = self.rng.integers(0, n, min(c.batch_size, n))
+            batch = {
+                "obs": data["obs"][idx],
+                "actions": data["actions"][idx],
+                "rewards": data["rewards"][idx],
+                "next_obs": data["next_obs"][idx],
+                "terminated": data["terminated"][idx],
+                # per-ROW so LearnerGroup._shard_batch can index it
+                "adv_norm": np.full(len(idx),
+                                    max(self._ma_sqd_adv, 1e-8) ** 0.5,
+                                    np.float32),
+            }
+            metrics = self.learner_group.update(batch)
+            rate = c.adv_norm_update_rate
+            self._ma_sqd_adv += rate * (
+                float(metrics["mean_sqd_adv"]) - self._ma_sqd_adv)
+        ret = self._evaluate()
+        if ret is not None:
+            metrics["episode_return_mean"] = ret
+        metrics["adv_norm"] = self._ma_sqd_adv ** 0.5
+        return metrics
+
+    def get_state(self) -> dict:
+        return {"iteration": self.iteration,
+                "learner": self.learner_group.get_state(),
+                "ma_sqd_adv": self._ma_sqd_adv}
+
+    def set_state(self, state: dict) -> None:
+        self.iteration = state["iteration"]
+        self.learner_group.set_state(state["learner"])
+        self._ma_sqd_adv = state["ma_sqd_adv"]
+
+
+MARWILConfig.algo_cls = MARWIL

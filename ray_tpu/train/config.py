@@ -70,6 +70,9 @@ class RunConfig:
     storage_path: str | None = None
     checkpoint_config: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
     failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+    # Tune lifecycle callbacks (tune.Callback instances — e.g. the
+    # bundled Json/CSV/TBX logger callbacks); ignored by bare Train runs.
+    callbacks: list = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass

@@ -7,6 +7,12 @@ one-worker train run.
 """
 
 from ..train.session import get_checkpoint, get_context, report
+from .callback import (
+    Callback,
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    TBXLoggerCallback,
+)
 from .schedulers import (
     AsyncHyperBandScheduler,
     FIFOScheduler,
@@ -29,6 +35,10 @@ ASHAScheduler = AsyncHyperBandScheduler
 
 __all__ = [
     "ASHAScheduler",
+    "Callback",
+    "CSVLoggerCallback",
+    "JsonLoggerCallback",
+    "TBXLoggerCallback",
     "AsyncHyperBandScheduler",
     "BasicVariantGenerator",
     "FIFOScheduler",

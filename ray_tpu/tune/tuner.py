@@ -18,6 +18,7 @@ from ..core import api as ray
 from ..train.checkpoint import Checkpoint, CheckpointManager
 from ..train.config import CheckpointConfig, Result, RunConfig
 from ..train.worker_group import TrainWorker
+from .callback import CallbackList
 from .schedulers import CONTINUE, STOP, FIFOScheduler, PopulationBasedTraining
 from .search import BasicVariantGenerator
 
@@ -165,6 +166,7 @@ class Tuner:
     def fit(self) -> ResultGrid:
         tc = self._tune_config
         ckpt_cfg = self._run_config.checkpoint_config or CheckpointConfig()
+        callbacks = CallbackList(getattr(self._run_config, "callbacks", None))
         searcher = None  # sequential (suggest/on_trial_complete) searcher
         to_suggest = 0
         if self._restore_dir is not None:
@@ -198,6 +200,7 @@ class Tuner:
                     os.makedirs(t.dir, exist_ok=True)
                     t.ckpt_manager = CheckpointManager(ckpt_cfg)
         self._save_experiment_state(exp_dir, trials)
+        callbacks.setup(experiment_dir=exp_dir)
 
         def new_trial(cfg: dict) -> Trial:
             t = Trial(cfg, os.path.join(exp_dir, f"trial_{len(trials):05d}"))
@@ -219,76 +222,87 @@ class Tuner:
                 timeout=60,
             )
             trial.state = "RUNNING"
+            callbacks.on_trial_start(trial)
 
         def finish(trial: Trial) -> None:
             nonlocal to_suggest
             if searcher is not None:
                 searcher.on_trial_complete(trial.config, trial.last_metrics)
+            if trial.state == "ERROR":
+                callbacks.on_trial_error(trial)
+            else:
+                callbacks.on_trial_complete(trial)
 
-        while pending or running or to_suggest > 0:
-            while to_suggest > 0 and len(running) + len(pending) < tc.max_concurrent_trials:
-                pending.append(new_trial(searcher.suggest()))
-                to_suggest -= 1
-            while pending and len(running) < tc.max_concurrent_trials:
-                trial = pending.pop(0)
-                start(trial)
-                running.append(trial)
+        try:
+            while pending or running or to_suggest > 0:
+                while to_suggest > 0 and len(running) + len(pending) < tc.max_concurrent_trials:
+                    pending.append(new_trial(searcher.suggest()))
+                    to_suggest -= 1
+                while pending and len(running) < tc.max_concurrent_trials:
+                    trial = pending.pop(0)
+                    start(trial)
+                    running.append(trial)
 
-            time.sleep(0.1)
-            for trial in list(running):
-                try:
-                    poll = ray.get(trial.actor.poll.remote(), timeout=30)
-                except Exception as e:
-                    trial.state = "ERROR"
-                    trial.error = str(e)
-                    running.remove(trial)
-                    finish(trial)
-                    continue
-                decision = CONTINUE
-                for entry in poll["reports"]:
-                    metrics = entry["metrics"]
-                    trial.last_metrics = metrics
-                    trial.metrics_history.append(metrics)
-                    if "checkpoint_path" in entry:
-                        trial.ckpt_manager.register(Checkpoint(entry["checkpoint_path"]), metrics)
-                    decision = scheduler.on_result(trial, metrics)
-                    if decision == STOP:
-                        break
-                    if isinstance(scheduler, PopulationBasedTraining):
-                        new_cfg = scheduler.maybe_exploit(trial, metrics, trials)
-                        if new_cfg is not None:
-                            donor = next(
-                                t for t in trials
-                                if t.trial_id == new_cfg["_pbt_exploit_from"]
-                            )
-                            trial.config = {k: v for k, v in new_cfg.items()
-                                            if k != "_pbt_exploit_from"}
-                            donor_ckpt = donor.ckpt_manager.latest if donor.ckpt_manager else None
-                            trial.resume_path = donor_ckpt.path if donor_ckpt else None
-                            ray.kill(trial.actor)
-                            start(trial)
-                            decision = CONTINUE
+                time.sleep(0.1)
+                for trial in list(running):
+                    try:
+                        poll = ray.get(trial.actor.poll.remote(), timeout=30)
+                    except Exception as e:
+                        trial.state = "ERROR"
+                        trial.error = str(e)
+                        running.remove(trial)
+                        finish(trial)
+                        continue
+                    decision = CONTINUE
+                    for entry in poll["reports"]:
+                        metrics = entry["metrics"]
+                        trial.last_metrics = metrics
+                        trial.metrics_history.append(metrics)
+                        callbacks.on_trial_result(trial, metrics)
+                        if "checkpoint_path" in entry:
+                            trial.ckpt_manager.register(Checkpoint(entry["checkpoint_path"]), metrics)
+                        decision = scheduler.on_result(trial, metrics)
+                        if decision == STOP:
                             break
-                if decision == STOP:
-                    trial.state = "TERMINATED"
-                    ray.kill(trial.actor)
-                    running.remove(trial)
-                    finish(trial)
-                    self._save_experiment_state(exp_dir, trials)
-                elif poll.get("error"):
-                    trial.state = "ERROR"
-                    trial.error = poll["error"]
-                    ray.kill(trial.actor)
-                    running.remove(trial)
-                    finish(trial)
-                    self._save_experiment_state(exp_dir, trials)
-                elif poll.get("done"):
-                    trial.state = "TERMINATED"
-                    ray.kill(trial.actor)
-                    running.remove(trial)
-                    finish(trial)
-                    self._save_experiment_state(exp_dir, trials)
+                        if isinstance(scheduler, PopulationBasedTraining):
+                            new_cfg = scheduler.maybe_exploit(trial, metrics, trials)
+                            if new_cfg is not None:
+                                donor = next(
+                                    t for t in trials
+                                    if t.trial_id == new_cfg["_pbt_exploit_from"]
+                                )
+                                trial.config = {k: v for k, v in new_cfg.items()
+                                                if k != "_pbt_exploit_from"}
+                                donor_ckpt = donor.ckpt_manager.latest if donor.ckpt_manager else None
+                                trial.resume_path = donor_ckpt.path if donor_ckpt else None
+                                ray.kill(trial.actor)
+                                start(trial)
+                                decision = CONTINUE
+                                break
+                    if decision == STOP:
+                        trial.state = "TERMINATED"
+                        ray.kill(trial.actor)
+                        running.remove(trial)
+                        finish(trial)
+                        self._save_experiment_state(exp_dir, trials)
+                    elif poll.get("error"):
+                        trial.state = "ERROR"
+                        trial.error = poll["error"]
+                        ray.kill(trial.actor)
+                        running.remove(trial)
+                        finish(trial)
+                        self._save_experiment_state(exp_dir, trials)
+                    elif poll.get("done"):
+                        trial.state = "TERMINATED"
+                        ray.kill(trial.actor)
+                        running.remove(trial)
+                        finish(trial)
+                        self._save_experiment_state(exp_dir, trials)
 
+        finally:
+            # error paths (actor-start timeout, Ctrl-C) must still
+            # close logger files / flush TB writers
+            callbacks.on_experiment_end(trials)
         self._save_experiment_state(exp_dir, trials)
         results = [
             Result(

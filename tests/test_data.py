@@ -326,3 +326,73 @@ def test_read_images(ray_cluster, tmp_path):
     imgs = sorted(rows, key=lambda r: r["path"])
     assert np.asarray(imgs[0]["image"]).shape == (4, 4, 3)
     assert int(np.asarray(imgs[3]["image"]).mean()) == 30
+
+
+def test_preprocessors_scalers_encoders_chain(ray_cluster):
+    """Preprocessor contract (fit -> transform -> transform_batch) and
+    the standard library: scalers, encoders, imputer, concatenator,
+    chain (reference python/ray/data/preprocessors/)."""
+    import numpy as np
+    import pytest as _pytest
+
+    from ray_tpu import data
+    from ray_tpu.data.preprocessors import (
+        Chain, Concatenator, LabelEncoder, MinMaxScaler, OneHotEncoder,
+        PreprocessorNotFittedError, SimpleImputer, StandardScaler)
+
+    rows = [{"x": float(i), "y": float(i * 2), "cat": ["a", "b", "c"][i % 3],
+             "label": ["pos", "neg"][i % 2]} for i in range(30)]
+    ds = data.from_items(rows)
+
+    with _pytest.raises(PreprocessorNotFittedError):
+        StandardScaler(["x"]).transform(ds)
+
+    # StandardScaler: mean ~0 std ~1
+    sc = StandardScaler(["x", "y"]).fit(ds)
+    out = sc.transform(ds).take_all()
+    xs = np.asarray([r["x"] for r in out])
+    assert abs(xs.mean()) < 1e-6 and abs(xs.std() - 1.0) < 1e-6
+
+    # MinMaxScaler: [0, 1]
+    mm = MinMaxScaler(["x"]).fit(ds)
+    out = mm.transform(ds).take_all()
+    xs = [r["x"] for r in out]
+    assert min(xs) == 0.0 and max(xs) == 1.0
+
+    # LabelEncoder: ints + inverse; unseen label raises
+    le = LabelEncoder("label").fit(ds)
+    out = le.transform(ds).take_all()
+    assert {r["label"] for r in out} == {0, 1}
+    back = le.inverse_transform_batch({"label": np.asarray([0, 1])})
+    assert set(back["label"].tolist()) == {"neg", "pos"}
+    with _pytest.raises(ValueError, match="not seen"):
+        le.transform_batch({"label": np.asarray(["mystery"])})
+
+    # OneHotEncoder: per-value 0/1 columns, source dropped, unseen -> zeros
+    oh = OneHotEncoder(["cat"]).fit(ds)
+    b = oh.transform_batch({"cat": np.asarray(["a", "zz"])})
+    assert "cat" not in b
+    assert b["cat_a"].tolist() == [1, 0]
+    assert b["cat_b"].tolist() == [0, 0] and b["cat_c"].tolist() == [0, 0]
+
+    # SimpleImputer: mean fill
+    ds_nan = data.from_items([{"v": 1.0}, {"v": float("nan")}, {"v": 3.0}])
+    imp = SimpleImputer(["v"]).fit(ds_nan)
+    vals = sorted(r["v"] for r in imp.transform(ds_nan).take_all())
+    assert vals == [1.0, 2.0, 3.0]
+
+    # Concatenator: 2-D feature column
+    cat = Concatenator(columns=["x", "y"], output_column_name="features")
+    b = cat.transform_batch({"x": np.asarray([1.0, 2.0]),
+                             "y": np.asarray([3.0, 4.0])})
+    assert b["features"].shape == (2, 2)
+
+    # Chain: scale -> encode -> concat, fit end-to-end, batch path too
+    chain = Chain(StandardScaler(["x"]), LabelEncoder("label"),
+                  Concatenator(columns=["x", "y"], output_column_name="f"))
+    out = chain.fit_transform(ds).take_all()
+    assert set(out[0]) == {"cat", "label", "f"}
+    b = chain.transform_batch({"x": np.asarray([0.0]), "y": np.asarray([1.0]),
+                               "cat": np.asarray(["a"]),
+                               "label": np.asarray(["pos"])})
+    assert b["f"].shape == (1, 2) and b["label"].tolist() == [1]

@@ -225,3 +225,67 @@ def test_median_stopping_rule(ray_cluster, tmp_path):
     assert min(histories) < 11, histories
     best = grid.get_best_result(metric="acc", mode="max")
     assert best.metrics["acc"] >= 9.0
+
+
+def test_callbacks_and_file_loggers(ray_cluster, tmp_path):
+    """Callback lifecycle hooks fire in order and the bundled loggers
+    write result.json / progress.csv / TB event files per trial
+    (reference tune/callback.py + tune/logger/)."""
+    import csv
+    import glob
+    import json
+    import os
+
+    from ray_tpu import train, tune
+    from ray_tpu.tune import (CSVLoggerCallback, Callback,
+                              JsonLoggerCallback, TBXLoggerCallback)
+
+    events = []
+
+    class Recorder(Callback):
+        def setup(self, **info):
+            events.append(("setup", info.get("experiment_dir")))
+
+        def on_trial_start(self, trial):
+            events.append(("start", trial.trial_id))
+
+        def on_trial_result(self, trial, result):
+            events.append(("result", trial.trial_id, result["score"]))
+
+        def on_trial_complete(self, trial):
+            events.append(("complete", trial.trial_id))
+
+        def on_experiment_end(self, trials):
+            events.append(("end", len(trials)))
+
+    def trainable(config):
+        for i in range(3):
+            tune.report({"score": config["x"] * (i + 1),
+                         "training_iteration": i + 1})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=train.RunConfig(
+            name="cbtest", storage_path=str(tmp_path),
+            callbacks=[Recorder(), JsonLoggerCallback(),
+                       CSVLoggerCallback(), TBXLoggerCallback()]),
+    )
+    results = tuner.fit()
+    assert len(results) == 2 and not results.errors
+
+    kinds = [e[0] for e in events]
+    assert kinds[0] == "setup" and kinds[-1] == "end"
+    assert kinds.count("start") == 2 and kinds.count("complete") == 2
+    assert kinds.count("result") == 6  # 2 trials x 3 reports
+
+    trial_dirs = sorted(glob.glob(str(tmp_path / "cbtest" / "trial_*")))
+    assert len(trial_dirs) == 2
+    for d in trial_dirs:
+        lines = [json.loads(l) for l in open(os.path.join(d, "result.json"))]
+        assert len(lines) == 3 and "score" in lines[0]
+        with open(os.path.join(d, "progress.csv")) as f:
+            rows = list(csv.DictReader(f))
+        assert len(rows) == 3 and float(rows[-1]["score"]) > 0
+        assert glob.glob(os.path.join(d, "events.out.tfevents.*"))
