@@ -188,10 +188,9 @@ class OneHotEncoder(Preprocessor):
 
     def _transform_numpy(self, batch: dict) -> dict:
         for c in self.columns:
-            col = np.asarray(batch.pop(c)).tolist()
+            col = np.asarray(batch.pop(c))
             for v in self.stats_[f"unique_values({c})"]:
-                batch[f"{c}_{v}"] = np.asarray(
-                    [1 if x == v else 0 for x in col], np.int8)
+                batch[f"{c}_{v}"] = (col == v).astype(np.int8)
         return batch
 
 
@@ -285,9 +284,3 @@ class Chain(Preprocessor):
             batch = p.transform_batch(batch)
         return batch
 
-    def fit_transform(self, ds):
-        self.fit(ds)
-        # reuse the already-fitted stages rather than re-walking the chain
-        for p in self.preprocessors:
-            ds = p.transform(ds)
-        return ds

@@ -110,3 +110,26 @@ def test_flash_attention_grads_match_reference():
                   argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4)
+
+
+def test_flash_block_fits_seq_divisors():
+    """Default blocks (1024) must CLAMP to a divisor of odd-but-tileable
+    seqs (1536 -> 768) so those shapes stay on the Pallas kernel instead
+    of silently falling back to the unblocked reference."""
+    import numpy as np
+
+    from ray_tpu.ops.attention import _fit_block, flash_attention, mha_reference
+
+    assert _fit_block(1024, 2048) == 1024
+    assert _fit_block(1024, 1536) == 768
+    assert _fit_block(1024, 512) == 512
+    assert _fit_block(512, 48) == 48
+    # ragged (not a multiple of 16): no divisor works -> caller falls back
+    assert 100 % _fit_block(1024, 100) != 0
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 1536, 64), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 1536, 64), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 1, 1536, 64), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v)),
+        np.asarray(mha_reference(q, k, v)), atol=2e-3)
