@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh
 
-from ..ops import flash_attention, mha_reference, ring_attention, rms_norm, apply_rope
+from ..ops import (flash_attention, mha_reference, ring_attention, rms_norm,
+                   apply_rope, ulysses_attention)
 from ..parallel.sharding import shard_constraint
 
 
@@ -153,13 +154,15 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
 
 
 def _attention(q, k, v, config: LlamaConfig, mesh: Mesh | None):
-    if config.attn_impl == "ring" and mesh is not None and mesh.shape["sp"] > 1:
+    if (config.attn_impl in ("ring", "ulysses") and mesh is not None
+            and mesh.shape["sp"] > 1):
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
+        inner = ring_attention if config.attn_impl == "ring" else ulysses_attention
         spec = P(("dcn", "dp", "fsdp"), "tp", "sp", None)
         fn = shard_map(
-            functools.partial(ring_attention, axis="sp", causal=True),
+            functools.partial(inner, axis="sp", causal=True),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )

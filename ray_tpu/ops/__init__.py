@@ -7,6 +7,7 @@ green-field — the reference has none (SURVEY.md §5.7).
 
 from .attention import flash_attention, mha_reference
 from .ring_attention import ring_attention
+from .ulysses import ulysses_attention
 from .norms import rms_norm
 from .rope import apply_rope, rope_frequencies
 
@@ -14,6 +15,7 @@ __all__ = [
     "flash_attention",
     "mha_reference",
     "ring_attention",
+    "ulysses_attention",
     "rms_norm",
     "apply_rope",
     "rope_frequencies",

@@ -1,0 +1,62 @@
+"""Ulysses attention: all-to-all sequence parallelism.
+
+Green-field like ring attention (the reference has no sequence/context
+parallelism of its own, SURVEY.md §5.7); this is the DeepSpeed-Ulysses
+strategy: sequence-sharded Q/K/V are reshuffled over the ``sp`` axis
+with ONE all-to-all so each device holds the FULL sequence for a
+subset of heads, runs the ordinary (Pallas flash) attention locally,
+and a second all-to-all restores sequence sharding. Two collectives per
+attention vs ring's (n-1) ppermute hops — cheaper when head count
+divides well and the sequence fits one device's HBM; ring wins when the
+full sequence per device does not fit. Both are selectable via
+``LlamaConfig.attn_impl`` ("ulysses" | "ring").
+
+Call inside ``shard_map`` with the sequence axis mapped to ``sp``.
+"""
+
+from __future__ import annotations
+
+from jax import lax
+
+from .attention import flash_attention
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    *,
+    axis: str = "sp",
+    causal: bool = True,
+    sm_scale: float | None = None,
+):
+    """q [B,Hq,Sl,D], k/v [B,Hkv,Sl,D] — Sl is the per-device sequence
+    chunk (chunks in ring order across the axis). Hq must be divisible
+    by the axis size; Hkv must divide it or be divisible by it (smaller
+    Hkv is replicated up). Returns the local output chunk [B,Hq,Sl,D]."""
+    import jax.numpy as jnp
+
+    n = lax.axis_size(axis)
+    hq, hkv = q.shape[1], k.shape[1]
+    if hq % n:
+        raise ValueError(
+            f"ulysses needs query heads divisible by the sp axis: "
+            f"Hq={hq}, sp={n}")
+    if hkv % n:
+        # GQA with fewer KV heads than sp ranks: replicate KV heads up to
+        # the axis size (the standard Ulysses workaround — ships
+        # replicated KV through the all-to-all; ring attention avoids
+        # this and is preferable at extreme GQA ratios).
+        if n % hkv:
+            raise ValueError(
+                f"ulysses needs Hkv to divide (or be divisible by) sp: "
+                f"Hkv={hkv}, sp={n}")
+        k = jnp.repeat(k, n // hkv, axis=1)
+        v = jnp.repeat(v, n // hkv, axis=1)
+    # heads -> devices, sequence gathered: [B, H/n, Sl*n, D]
+    q = lax.all_to_all(q, axis, split_axis=1, concat_axis=2, tiled=True)
+    k = lax.all_to_all(k, axis, split_axis=1, concat_axis=2, tiled=True)
+    v = lax.all_to_all(v, axis, split_axis=1, concat_axis=2, tiled=True)
+    o = flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    # back: sequence -> devices, heads gathered
+    return lax.all_to_all(o, axis, split_axis=2, concat_axis=1, tiled=True)
