@@ -14,6 +14,7 @@ from .core.api import (
     ActorClass,
     ActorHandle,
     available_resources,
+    cancel,
     cluster_resources,
     get,
     get_actor,

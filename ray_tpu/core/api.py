@@ -120,6 +120,15 @@ def kill(actor: "ActorHandle") -> None:
     global_worker().kill_actor(actor._actor_id)
 
 
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    """Cancel the task producing ``ref`` (reference ``ray.cancel``):
+    queued tasks are dropped and fail with TaskCancelledError; a running
+    task is interrupted at its next Python bytecode; ``force=True`` kills
+    the executing worker. Best-effort — a task that already finished is
+    untouched; cancelled tasks are never retried."""
+    global_worker().cancel(ref, force=force)
+
+
 def get_actor(name: str) -> "ActorHandle":
     found = global_worker().get_actor_by_name(name)
     if found is None:

@@ -236,6 +236,17 @@ class CoreWorker:
         else:
             self.current_task_id = TaskID.nil()
         self._task_queues: dict[tuple, list] = {}
+        # ray_tpu.cancel bookkeeping: cancelled task ids (never retried),
+        # dispatched-task -> executing worker address, and (executor side)
+        # task -> thread ident for the async-interrupt path.
+        self._cancelled_tasks: set[bytes] = set()
+        self._dispatched_to: dict[bytes, str] = {}
+        # executor side: task -> thread ident (guarded by _exec_lock so a
+        # CancelTask async-interrupt can never target a thread that moved
+        # on to another task), plus cancels that arrived before execution
+        self._exec_threads: dict[bytes, int] = {}
+        self._exec_lock = threading.Lock()
+        self._cancelled_inbound: set[bytes] = set()
         self._pipelines: dict[tuple, int] = {}
         self._spread_salt = 0
         self._queue_lock = threading.Lock()
@@ -836,7 +847,56 @@ class CoreWorker:
             salt,
         )
 
+    def cancel(self, ref, *, force: bool = False) -> None:
+        """Cancel the task producing ``ref`` (reference ``ray.cancel``,
+        ``_private/worker.py:3086``). Queued tasks are dropped; a RUNNING
+        task gets TaskCancelledError raised asynchronously in its executor
+        thread (takes effect at the next Python bytecode — a task blocked
+        in a C call is only reachable with ``force``); ``force=True``
+        kills the executing worker process. Cancelled tasks never retry;
+        already-finished tasks are untouched (best-effort semantics)."""
+        oid = ref.id() if hasattr(ref, "id") else ref
+        task_id = oid.task_id().binary()
+        if oid.is_put():
+            raise ValueError("ray_tpu.cancel only applies to task returns, "
+                             "not ray_tpu.put objects")
+        self._cancelled_tasks.add(task_id)
+        # queued (pre-dispatch): drop + fail in place
+        with self._queue_lock:
+            dropped = None
+            for key, queue in self._task_queues.items():
+                for spec in queue:
+                    if spec.task_id == task_id:
+                        dropped = spec
+                        queue.remove(spec)
+                        break
+                if dropped is not None:
+                    break
+        if dropped is not None:
+            self._fail_task(dropped, TaskCancelledError(task_id.hex()[:12]))
+            return
+        # dispatched: interrupt (or kill) the executing worker
+        addr = self._dispatched_to.get(task_id)
+        if addr is None:
+            return  # finished, unknown, or actor task — no-op
+        async def _send():
+            client = RpcClient(addr)
+            try:
+                await client.call("CancelTask",
+                                  {"task_id": task_id, "force": force},
+                                  timeout=10.0)
+            except Exception as e:
+                logger.debug("CancelTask to %s failed: %s", addr, e)
+            finally:
+                await client.close()
+        self.io.run_coro(_send())
+
     def _enqueue_task(self, spec: TaskSpec) -> None:
+        if spec.task_id in self._cancelled_tasks:
+            # cancelled tasks never (re)enter the queue — a retry after a
+            # force-kill must fail, not resubmit
+            self._fail_task(spec, TaskCancelledError(spec.task_id.hex()[:12]))
+            return
         key = self._shape_key(spec)
         with self._queue_lock:
             self._task_queues.setdefault(key, []).append(spec)
@@ -1011,17 +1071,23 @@ class CoreWorker:
 
     async def _push_and_complete(self, spec: TaskSpec, worker: RpcClient, worker_id: str) -> bool:
         """Returns False when the worker died (the caller must drop the lease)."""
+        self._dispatched_to[spec.task_id] = worker.address
         try:
             reply = await worker.call("PushTask", {"spec": spec.to_wire()}, timeout=None)
         except RpcError as e:
             # Worker died mid-task (PushNormalTask failure path →
             # FailOrRetryPendingTask, task_manager.h:491).
-            if self.task_manager.consume_retry(spec.task_id):
+            self._dispatched_to.pop(spec.task_id, None)
+            if spec.task_id in self._cancelled_tasks:
+                # force-cancel kills the worker: that death IS the cancel
+                self._fail_task(spec, TaskCancelledError(spec.task_id.hex()[:12]))
+            elif self.task_manager.consume_retry(spec.task_id):
                 logger.warning("Retrying task %s after worker failure: %s", spec.name, e)
                 self._enqueue_task(spec)
             else:
                 self._fail_task(spec, WorkerCrashedError(f"Worker died executing {spec.name}: {e}"))
             return False
+        self._dispatched_to.pop(spec.task_id, None)
         if not await self._maybe_reexport(spec, reply):
             self._handle_task_reply(spec, reply)
         return True
@@ -1035,12 +1101,17 @@ class CoreWorker:
         single-task death path)."""
         if len(specs) == 1:
             return await self._push_and_complete(specs[0], worker, worker_id)
+        for spec in specs:
+            self._dispatched_to[spec.task_id] = worker.address
         try:
             reply = await worker.call(
                 "PushTasks", {"specs": [s.to_wire() for s in specs]}, timeout=None)
         except RpcError as e:
             for spec in specs:
-                if self.task_manager.consume_retry(spec.task_id):
+                self._dispatched_to.pop(spec.task_id, None)
+                if spec.task_id in self._cancelled_tasks:
+                    self._fail_task(spec, TaskCancelledError(spec.task_id.hex()[:12]))
+                elif self.task_manager.consume_retry(spec.task_id):
                     logger.warning("Retrying task %s after worker failure: %s", spec.name, e)
                     self._enqueue_task(spec)
                 else:
@@ -1048,6 +1119,7 @@ class CoreWorker:
                         f"Worker died executing {spec.name}: {e}"))
             return False
         for spec, r in zip(specs, reply["replies"]):
+            self._dispatched_to.pop(spec.task_id, None)
             if not await self._maybe_reexport(spec, r):
                 self._handle_task_reply(spec, r)
         return True
@@ -1101,6 +1173,7 @@ class CoreWorker:
         return True
 
     def _handle_task_reply(self, spec: TaskSpec, reply: dict) -> None:
+        self._cancelled_tasks.discard(spec.task_id)
         task_id = TaskID(spec.task_id)
         if spec.num_returns == -1:
             # Streaming task finished: items arrived via ReportGeneratorItem;
@@ -1127,6 +1200,7 @@ class CoreWorker:
         self._release_submitted_refs(spec)
 
     def _fail_task(self, spec: TaskSpec, error: Exception) -> None:
+        self._cancelled_tasks.discard(spec.task_id)
         self.task_events.record(spec.task_id, spec.name, "FAILED", kind=spec.kind,
                                 extra={"error": str(error)[:200]})
         task_id = TaskID(spec.task_id)
@@ -1570,6 +1644,39 @@ class CoreWorker:
                 self.refcounter.remove_borrower(ObjectID(key))
 
     # ------------------------------------------------------------ executor
+    async def handle_CancelTask(self, p: dict) -> dict:
+        """Owner asks this EXECUTOR to cancel a running task. Non-force:
+        raise TaskCancelledError asynchronously in the executing thread
+        (CPython PyThreadState_SetAsyncExc — lands at the next bytecode).
+        Force: the whole worker process exits; the owner's push RPC fails,
+        and the cancelled marker turns that death into TaskCancelledError
+        instead of a retry."""
+        import ctypes
+
+        task_id = p["task_id"]
+        if p.get("force"):
+            import asyncio
+
+            import os as _os
+            import signal as _signal
+
+            # give the reply a moment to flush, then die hard
+            asyncio.get_running_loop().call_later(
+                0.05, lambda: _os.kill(_os.getpid(), _signal.SIGKILL))
+            return {"found": True, "killing": True}
+        with self._exec_lock:
+            ident = self._exec_threads.get(task_id)
+            if ident is None:
+                # dispatched but not yet executing: mark so _execute_task
+                # refuses to run the body when it gets the thread
+                self._cancelled_inbound.add(task_id)
+                return {"found": False, "pending": True}
+            # under the lock the thread cannot pop its entry, so the
+            # async exception targets the right task
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(ident), ctypes.py_object(TaskCancelledError))
+        return {"found": True}
+
     async def handle_PushTask(self, p: dict) -> dict:
         import asyncio
 
@@ -1633,6 +1740,19 @@ class CoreWorker:
         prev_task_id = self.current_task_id
         self.current_task_id = TaskID(spec.task_id)
         self.task_events.record(spec.task_id, spec.name, "RUNNING", kind=spec.kind)
+        with self._exec_lock:
+            if spec.task_id in self._cancelled_inbound:
+                # cancel arrived before execution (batched push / pool
+                # backlog): never run the body
+                self._cancelled_inbound.discard(spec.task_id)
+                self.current_task_id = prev_task_id
+                metadata, blob, _ = serialization.serialize_error(
+                    RayTaskError(spec.name, "task cancelled",
+                                 TaskCancelledError(spec.task_id.hex()[:12])))
+                return {"returns": [
+                    {"t": "v", "meta": metadata, "blob": blob, "contained": []}
+                    for _ in range(max(spec.num_returns, 1))]}
+            self._exec_threads[spec.task_id] = threading.get_ident()
         try:
             args, kwargs = self._deserialize_args(spec)
             if spec.kind == TASK_KIND_ACTOR_CREATION:
@@ -1706,6 +1826,8 @@ class CoreWorker:
                         "stream_error": {"meta": metadata, "blob": blob}}
             return {"returns": [{"t": "v", "meta": metadata, "blob": blob} for _ in range(spec.num_returns)]}
         finally:
+            with self._exec_lock:
+                self._exec_threads.pop(spec.task_id, None)
             self.current_task_id = prev_task_id
 
     def _deserialize_args(self, spec: TaskSpec) -> tuple[tuple, dict]:

@@ -337,6 +337,18 @@ class ClientServer:
             return {"stream": self._register_stream(p, refs)}
         return {"refs": [self._track(p, r) for r in refs]}
 
+    async def handle_ClientCancel(self, p: dict) -> dict:
+        refs = self._client(p)
+        ref = refs.get(p["ref"])
+        if ref is None:
+            return {"error": cloudpickle.dumps(
+                RayTpuError(f"unknown client ref {p['ref']!r}"))}
+        try:
+            self._worker.cancel(ref, force=bool(p.get("force")))
+        except Exception as e:
+            return {"error": cloudpickle.dumps(e)}
+        return {}
+
     async def handle_ClientKillActor(self, p: dict) -> dict:
         self._worker.kill_actor(bytes.fromhex(p["actor_id"]))
         return {}
@@ -551,6 +563,9 @@ class ClientWorker:
         if "stream" in reply:
             return ClientObjectRefGenerator(self, reply["stream"])
         return [self._make_ref(r) for r in reply["refs"]]
+
+    def cancel(self, ref, *, force: bool = False) -> None:
+        self._call("ClientCancel", {"ref": self._rid(ref), "force": force})
 
     def kill_actor(self, actor_id: bytes) -> None:
         self._call("ClientKillActor", {"actor_id": actor_id.hex()})

@@ -152,3 +152,93 @@ def test_runtime_env_actor():
 
     a = EnvActor.options(runtime_env={"env_vars": {"RAY_TPU_TEST_FLAVOR": "actorenv"}}).remote()
     assert ray_tpu.get(a.flavor.remote(), timeout=60) == "actorenv"
+
+
+def test_cancel_queued_running_and_force(ray_cluster):
+    """ray_tpu.cancel (reference _private/worker.py:3086): a queued task
+    fails with TaskCancelledError without running; a running task is
+    interrupted at its next bytecode; force=True kills a hard-blocked
+    worker — and a cancelled task is never retried."""
+    import time
+
+    import pytest as _pytest
+
+    import ray_tpu
+    from ray_tpu import TaskCancelledError
+
+    # -- running task: interrupted at the next bytecode ------------------
+    @ray_tpu.remote(max_retries=3)
+    def spin():
+        t0 = time.time()
+        while time.time() - t0 < 60:
+            sum(range(1000))  # plenty of bytecode boundaries
+        return "finished"
+
+    ref = spin.remote()
+    time.sleep(2.0)  # let it lease + start
+    ray_tpu.cancel(ref)
+    with _pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+
+    # -- queued task: dropped before it ever runs ------------------------
+    @ray_tpu.remote(num_cpus=0)
+    class Gate:
+        def __init__(self):
+            self.started = 0
+            self.open = False
+
+        def arrive(self):
+            self.started += 1
+
+        def count(self):
+            return self.started
+
+        def release(self):
+            self.open = True
+
+        def is_open(self):
+            return self.open
+
+    gate = Gate.remote()
+    n_cpus = int(ray_tpu.cluster_resources().get("CPU", 4))
+
+    @ray_tpu.remote(num_cpus=1)
+    def blocker(g):
+        ray_tpu.get(g.arrive.remote(), timeout=60)
+        while not ray_tpu.get(g.is_open.remote(), timeout=60):
+            time.sleep(0.05)
+        return "done"
+
+    @ray_tpu.remote(num_cpus=1)
+    def never():
+        return "ran"
+
+    # hold EVERY cpu; wait until all blockers are confirmed running
+    blockers = [blocker.remote(gate) for _ in range(n_cpus)]
+    deadline = time.time() + 60
+    while ray_tpu.get(gate.count.remote(), timeout=60) < n_cpus:
+        assert time.time() < deadline, "blockers never started"
+        time.sleep(0.05)
+    queued = never.remote()   # no CPU free: must queue
+    time.sleep(0.3)
+    ray_tpu.cancel(queued)
+    ray_tpu.get(gate.release.remote(), timeout=60)
+    with _pytest.raises(TaskCancelledError):
+        ray_tpu.get(queued, timeout=30)
+    assert ray_tpu.get(blockers[0], timeout=60) == "done"
+
+    # -- force: a worker hard-blocked in a C call dies, no retry ---------
+    @ray_tpu.remote(max_retries=2)
+    def hard_block():
+        time.sleep(120)  # C-level block: async exc can't land
+        return "never"
+
+    ref = hard_block.remote()
+    time.sleep(2.0)
+    ray_tpu.cancel(ref, force=True)
+    with _pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+
+    # put objects are not cancellable
+    with _pytest.raises(ValueError, match="task returns"):
+        ray_tpu.cancel(ray_tpu.put(1))
