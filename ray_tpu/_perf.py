@@ -162,6 +162,47 @@ def main(quick: bool = False, stress: bool = False) -> list[dict]:
         timeit(f"stress: create+call+kill {n} actors", actor_wave, n, results,
                unit="actors/s")
 
+        # single-node envelope rows (BASELINE.md: object args to one task,
+        # returns from one task, plasma objects in one ray.get —
+        # reference release/benchmarks/single_node/test_single_node.py)
+        n_args = 2000
+
+        @ray_tpu.remote
+        def count_args(*args):
+            return len(args)
+
+        arg_refs = [ray_tpu.put(i) for i in range(n_args)]
+
+        def many_args():
+            assert ray_tpu.get(count_args.remote(*arg_refs), timeout=600) == n_args
+
+        timeit(f"stress: {n_args} object args to one task", many_args, n_args,
+               results, unit="args/s")
+        del arg_refs
+
+        n_rets = 1000
+
+        @ray_tpu.remote(num_returns=n_rets)
+        def many_returns():
+            return list(range(n_rets))
+
+        def returns_wave():
+            refs = many_returns.remote()
+            assert ray_tpu.get(refs[-1], timeout=600) == n_rets - 1
+
+        timeit(f"stress: {n_rets} returns from one task", returns_wave, n_rets,
+               results, unit="returns/s")
+
+        n_get = 5000
+        put_refs = [ray_tpu.put(i) for i in range(n_get)]
+
+        def bulk_get():
+            vals = ray_tpu.get(put_refs, timeout=600)
+            assert vals[-1] == n_get - 1
+
+        timeit(f"stress: one ray.get of {n_get} objects", bulk_get, n_get,
+               results, unit="objects/s")
+
     return results
 
 
