@@ -299,10 +299,13 @@ def run_serve_bench() -> dict:
             "runtime_env": {"env_vars": {"JAX_PLATFORMS": None}},
         },
     )
-    # Generous health window: the replica inits 1B params + compiles on
-    # the chip (~40s), and the chip may still be releasing from the train
-    # bench that ran moments earlier.
-    serve.run(app, name="llm-bench", timeout_s=600.0)
+    # Health window covers 1B param init + on-chip compile (~40s). Chip
+    # handoff from the train bench that ran moments earlier is the
+    # raylet's job now: the GRANT-side TPU fence probes the libtpu
+    # device lock before handing out the lease (raylet
+    # _await_tpu_grant_fence), so the window no longer papers over
+    # crash-looping replicas.
+    serve.run(app, name="llm-bench", timeout_s=120.0)
     addr = serve.http_address()
 
     def one_request(prompt: str, timeout: float = 600.0):

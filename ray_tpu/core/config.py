@@ -98,6 +98,13 @@ class RayTpuConfig:
     # TPU resource anyway. The libtpu device lock is exclusive per process
     # and only the kernel releases it, on process death.
     tpu_release_fence_timeout_s: float = 30.0
+    # Grant-side fence: how long the node's FIRST outstanding TPU lease
+    # waits for the host's libtpu device lock to be free (the holder may
+    # be a process the raylet never tracked — a benchmark phase, a stray
+    # trainer). Longer than the release fence: an external holder's
+    # teardown (checkpoint flush, host transfer drain) is invisible, so
+    # give it real time before granting into a crash-loop.
+    tpu_grant_fence_timeout_s: float = 90.0
 
     # --- fault tolerance -----------------------------------------------------
     task_max_retries: int = 3

@@ -210,6 +210,10 @@ class Raylet:
         # node-pool lease): bundle teardown withholds these from its
         # release; the fence re-grants them when the holder is dead.
         self._fence_pending: dict[tuple | None, float] = {}
+        # TPU grants past the fence but not yet recorded on a worker's
+        # lease_resources (spawn in progress): the grant fence must not
+        # probe the device lock against these legitimate holders.
+        self._tpu_grants_inflight: int = 0
         # Forkserver for default-env workers (worker_zygote.py).
         self._zygote_proc: subprocess.Popen | None = None
         self._zygote_booting = False
@@ -459,6 +463,65 @@ class Raylet:
             return True
         self._release_into(lease, bundle_key)
         return False
+
+    @staticmethod
+    def _tpu_device_locked() -> bool:
+        """Probe the host's libtpu device lock (an flock on
+        ``/tmp/libtpu_lockfile``): True while some process — tracked
+        worker or not — holds the chip. Read ``/proc/locks`` instead of
+        flocking the file ourselves: even a momentary LOCK_EX|LOCK_NB
+        probe could race a starting worker's own non-blocking libtpu
+        acquisition and fail ITS device init — the exact crash this
+        fence exists to prevent."""
+        path = os.environ.get("RAY_TPU_LOCKFILE", "/tmp/libtpu_lockfile")
+        try:
+            st = os.stat(path)
+        except OSError:
+            return False  # no lockfile -> nobody has initialized a chip
+        want = f"{os.major(st.st_dev):02x}:{os.minor(st.st_dev):02x}:{st.st_ino}"
+        try:
+            with open("/proc/locks") as f:
+                for line in f:
+                    # e.g. "1: FLOCK  ADVISORY  WRITE 1234 fd:00:5678 0 EOF"
+                    parts = line.split()
+                    if len(parts) >= 6 and parts[1] == "FLOCK" \
+                            and parts[3] == "WRITE" and parts[5] == want:
+                        return True
+        except OSError:
+            return False
+        return False
+
+    async def _await_tpu_grant_fence(self, request: ResourceSet) -> None:
+        """GRANT-side TPU fence (complements the death-release fence in
+        ``_fenced_tpu_release``): before handing out the node's FIRST
+        outstanding TPU lease, wait for the libtpu device lock to be
+        free. The release fence only covers workers this raylet tracks;
+        the chip may still be held by an arbitrary process (a benchmark
+        phase, a stray trainer) whose exit we cannot observe — without
+        this probe the first replica after such a handoff crash-loops on
+        device init. Skipped when a tracked worker already holds a TPU
+        lease OR another TPU grant is mid-spawn (on multi-chip hosts the
+        per-chip visibility envs mean the global lockfile probe would
+        false-positive against a legitimate co-holder). Times out after
+        ``tpu_grant_fence_timeout_s`` and grants anyway — the worker
+        then retries exactly as before this fence existed."""
+        if request.to_dict().get("TPU", 0.0) <= 0:
+            return
+        if self._tpu_grants_inflight > 0:
+            return
+        for w in self._workers.values():
+            if w.lease_resources.to_dict().get("TPU", 0.0) > 0:
+                return
+        timeout = get_config().tpu_grant_fence_timeout_s
+        deadline = time.monotonic() + timeout
+        loop = asyncio.get_running_loop()
+        while await loop.run_in_executor(None, self._tpu_device_locked):
+            if time.monotonic() > deadline:
+                logger.warning(
+                    "TPU grant fence: device lock still held after %.0fs; "
+                    "granting anyway", timeout)
+                return
+            await asyncio.sleep(0.25)
 
     def _release_into(self, res: ResourceSet, bundle_key: tuple | None) -> None:
         if res.is_empty():
@@ -928,13 +991,21 @@ class Raylet:
         if not await self._acquire_resources_queued(request, priority, deadline):
             return {"granted": False, "reason": "timed out waiting for resources"}
 
+        inflight = False
         try:
+            await self._await_tpu_grant_fence(request)
+            if request.to_dict().get("TPU", 0.0) > 0:
+                self._tpu_grants_inflight += 1
+                inflight = True
             worker = await self._get_idle_worker(
                 get_config().worker_register_timeout_s, spec.get("runtime_env")
             )
         except Exception as e:
             self.resources.release(request)  # never leak the reservation
             return {"granted": False, "reason": f"worker start failed: {e}"}
+        finally:
+            if inflight:
+                self._tpu_grants_inflight -= 1
         if worker is None:
             self.resources.release(request)
             return {"granted": False, "reason": "no worker available"}
@@ -976,7 +1047,12 @@ class Raylet:
                 await asyncio.wait_for(fut, 0.5)
             except asyncio.TimeoutError:
                 pass
+        inflight = False
         try:
+            await self._await_tpu_grant_fence(request)
+            if request.to_dict().get("TPU", 0.0) > 0:
+                self._tpu_grants_inflight += 1
+                inflight = True
             worker = await self._get_idle_worker(
                 get_config().worker_register_timeout_s, spec.get("runtime_env")
             )
@@ -985,6 +1061,9 @@ class Raylet:
             reason = f"worker start failed: {e}"
         else:
             reason = "no worker available"
+        finally:
+            if inflight:
+                self._tpu_grants_inflight -= 1
         if worker is None:
             b = self._pg_bundles.get(key)
             if b is not None:

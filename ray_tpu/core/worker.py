@@ -246,7 +246,10 @@ class CoreWorker:
         # on to another task), plus cancels that arrived before execution
         self._exec_threads: dict[bytes, int] = {}
         self._exec_lock = threading.Lock()
-        self._cancelled_inbound: set[bytes] = set()
+        # insertion-ordered dict so the oldest markers (cancels whose
+        # task never arrived here) are evicted first once the set is
+        # over its size bound — it cannot accumulate forever
+        self._cancelled_inbound: dict[bytes, None] = {}
         self._pipelines: dict[tuple, int] = {}
         self._spread_salt = 0
         self._queue_lock = threading.Lock()
@@ -860,6 +863,9 @@ class CoreWorker:
         if oid.is_put():
             raise ValueError("ray_tpu.cancel only applies to task returns, "
                              "not ray_tpu.put objects")
+        if self.task_manager.get_pending(task_id) is None:
+            return  # already finished (or never ours): best-effort no-op,
+                    # and no marker left behind to leak
         self._cancelled_tasks.add(task_id)
         # queued (pre-dispatch): drop + fail in place
         with self._queue_lock:
@@ -1668,8 +1674,14 @@ class CoreWorker:
             ident = self._exec_threads.get(task_id)
             if ident is None:
                 # dispatched but not yet executing: mark so _execute_task
-                # refuses to run the body when it gets the thread
-                self._cancelled_inbound.add(task_id)
+                # refuses to run the body when it gets the thread. Bound
+                # the set: markers for tasks that never execute here
+                # (e.g. re-routed after a lease change) are evicted
+                # oldest-first past the cap instead of leaking.
+                self._cancelled_inbound[task_id] = None
+                while len(self._cancelled_inbound) > 4096:
+                    self._cancelled_inbound.pop(
+                        next(iter(self._cancelled_inbound)))
                 return {"found": False, "pending": True}
             # under the lock the thread cannot pop its entry, so the
             # async exception targets the right task
@@ -1744,11 +1756,17 @@ class CoreWorker:
             if spec.task_id in self._cancelled_inbound:
                 # cancel arrived before execution (batched push / pool
                 # backlog): never run the body
-                self._cancelled_inbound.discard(spec.task_id)
+                self._cancelled_inbound.pop(spec.task_id, None)
                 self.current_task_id = prev_task_id
                 metadata, blob, _ = serialization.serialize_error(
                     RayTaskError(spec.name, "task cancelled",
                                  TaskCancelledError(spec.task_id.hex()[:12])))
+                if spec.num_returns == -1:
+                    # Streaming task: reply in stream form so the owner
+                    # raises TaskCancelledError at the consumer instead
+                    # of finishing a clean empty stream.
+                    return {"returns": [], "streamed": 0,
+                            "stream_error": {"meta": metadata, "blob": blob}}
                 return {"returns": [
                     {"t": "v", "meta": metadata, "blob": blob, "contained": []}
                     for _ in range(max(spec.num_returns, 1))]}

@@ -141,6 +141,7 @@ class InferenceEngine:
         mesh=None,
         executor=None,
         seed: int = 0,
+        attention_impl: str = "auto",
     ):
         self.config = PRESETS[config] if isinstance(config, str) else config
         self.mesh = mesh
@@ -162,7 +163,7 @@ class InferenceEngine:
             executor = LocalEngineExecutor(
                 self.config, params, max_slots=max_slots,
                 num_pages=self.num_pages, page_size=page_size, mesh=mesh,
-                seed=seed,
+                seed=seed, attention_impl=attention_impl,
             )
         self.executor = executor
         self.allocator = PageAllocator(self.num_pages)
@@ -248,11 +249,17 @@ class InferenceEngine:
             self._active.pop(r.slot, None)
             self._free_slots.append(r.slot)
             self._block_tables[r.slot, :] = r.slot  # back to trash page
+            # Reset the host pos mirror too: the executor's live_pages
+            # bucket is max over ALL slots, and a stale 8k pos from a
+            # retired request would inflate every later batch's
+            # attention width for the engine's lifetime.
+            self._pos[r.slot] = 0
         elif r.slot >= 0 and r.slot in self._free_slots:
             pass  # already retired
         elif r.slot >= 0:
             self._free_slots.append(r.slot)
             self._block_tables[r.slot, :] = r.slot
+            self._pos[r.slot] = 0
         if r.block_table:
             if self.enable_prefix_cache and r.finish_reason != "admission_failed":
                 # Register only pages whose K/V was actually COMPUTED: a

@@ -50,6 +50,7 @@ class LocalEngineExecutor:
         page_size: int,
         mesh=None,
         seed: int = 0,
+        attention_impl: str = "auto",
     ):
         self.config = PRESETS[config] if isinstance(config, str) else config
         if params is None:
@@ -57,6 +58,26 @@ class LocalEngineExecutor:
         self.mesh = mesh
         self.max_slots = max_slots
         self.page_size = page_size
+        # "paged" = Pallas paged-attention decode kernel; "dense" =
+        # bucketed gather (width capped by the host-computed live_pages
+        # bound, so its cost tracks the batch-max LIVE context, not pool
+        # capacity); "auto" = dense. Dense wins on v5e today: the kernel
+        # must receive the pool as ppb separate operands (Mosaic can't
+        # DMA-slice unaligned minor dims or lane-reshape), and XLA
+        # inserts pool-sized copies around a mutating multi-operand
+        # custom call in a loop — see PERF.md "paged-attention kernel".
+        # The kernel stays parity-tested for the skewed-batch upside
+        # once those toolchain limits lift.
+        if attention_impl not in ("auto", "paged", "dense"):
+            raise ValueError(f"unknown attention_impl {attention_impl!r}")
+        if attention_impl == "paged" and mesh is not None:
+            # Refuse rather than silently fall back: the kernel is not
+            # shard_map-wrapped for sharded page pools, and the pp
+            # pipeline path doesn't thread paged/live_pages at all.
+            raise ValueError(
+                "attention_impl='paged' is single-device only (the Pallas "
+                "kernel does not run over a mesh); use 'dense'")
+        self.paged_attention = attention_impl == "paged"
         pages = init_pages(self.config, num_pages, page_size)
         self._replicated = None
         self._pp = mesh.shape.get("pp", 1) if mesh is not None else 1
@@ -133,7 +154,8 @@ class LocalEngineExecutor:
             pg = {"k": self._pages_sharding, "v": self._pages_sharding}
             self._decode_loop = jax.jit(
                 decode_loop.__wrapped__,
-                static_argnames=("config", "page_size", "n_steps"),
+                static_argnames=("config", "page_size", "n_steps", "paged",
+                                 "live_pages"),
                 donate_argnames=("pages",),
                 out_shardings=(rep, rep, pg),
             )
@@ -141,7 +163,7 @@ class LocalEngineExecutor:
                 sample_first_batch.__wrapped__, out_shardings=(rep, rep))
             self._prefill = jax.jit(
                 prefill_chunk.__wrapped__,
-                static_argnames=("config", "page_size"),
+                static_argnames=("config", "page_size", "live_pages"),
                 donate_argnames=("pages",),
                 out_shardings=(pg, rep),
             )
@@ -157,14 +179,31 @@ class LocalEngineExecutor:
             return jax.device_put(x, self._replicated)
         return jnp.asarray(x)
 
+    @staticmethod
+    def _bucket_pages(needed: int, max_pages: int) -> int:
+        """Round a live-page requirement up to a power of two (≥ 8), so
+        the static ``live_pages`` cap takes O(log(max_pages)) distinct
+        values — bounding recompiles while keeping attention cost
+        proportional to live context rather than pool capacity."""
+        b = 8
+        while b < needed:
+            b *= 2
+        return min(b, max_pages)
+
     # ------------------------------------------------------------- operations
     def prefill(self, block_table: np.ndarray, tokens: np.ndarray,
                 start_pos: int, handle: int | None, take: int) -> None:
+        if self._pp > 1:
+            kwargs = {}
+        else:
+            # Context gathered is [0, start_pos): cap the gather width.
+            kwargs = {"live_pages": self._bucket_pages(
+                -(-int(start_pos) // self.page_size), block_table.shape[0])}
         self.pages, hidden = self._prefill(
             self.params, self.pages, self._put(block_table.astype(np.int32)),
             self._put(tokens.astype(np.int32)),
             self._put(np.int32(start_pos)),
-            config=self.config, page_size=self.page_size,
+            config=self.config, page_size=self.page_size, **kwargs,
         )
         if handle is not None:  # final chunk: stash for first-token sampling
             self._hidden[handle] = hidden[take - 1]
@@ -187,6 +226,16 @@ class LocalEngineExecutor:
     def decode(self, block_tables: np.ndarray, tokens: np.ndarray,
                pos: np.ndarray, temps: np.ndarray, eos_ids: np.ndarray,
                remaining: np.ndarray, n_steps: int) -> np.ndarray:
+        if self._pp > 1:
+            kwargs = {}
+        else:
+            # Attend positions reach max(pos) + n_steps - 1 by the last
+            # fused step; bucket the page bound to a power of two.
+            needed = (int(pos.max()) + n_steps - 1) // self.page_size + 1
+            kwargs = {
+                "paged": self.paged_attention,
+                "live_pages": self._bucket_pages(needed, block_tables.shape[1]),
+            }
         toks, self._key, self.pages = self._decode_loop(
             self.params, self.pages, self._put(block_tables.astype(np.int32)),
             self._put(tokens.astype(np.int32)), self._put(pos.astype(np.int32)),
@@ -194,7 +243,7 @@ class LocalEngineExecutor:
             self._put(eos_ids.astype(np.int32)),
             self._put(remaining.astype(np.int32)),
             self._key, config=self.config, page_size=self.page_size,
-            n_steps=n_steps,
+            n_steps=n_steps, **kwargs,
         )
         return np.asarray(toks)  # [n_steps, slots] — the one sync
 

@@ -24,10 +24,21 @@ Design points:
     entirely — the block table points at the shared pages (engine-side
     refcounting; pages are immutable once full).
   * **Decode** (``decode_step``): one batched step over all slots;
-    context K/V is gathered per-slot via the block tables. Inactive slots
+    context K/V is read per-slot via the block tables. Inactive slots
     point at a per-slot trash page so their (ignored) writes never
     corrupt live pages — branchless, one compiled program for every
     occupancy.
+  * **The pool rides the layer scan as CARRY, never as scan xs.** The
+    stacked pool is donated and updated in place layer by layer
+    (``pool.at[l, pages, ...]``); gathers index ``pool[l, tables]``
+    directly. Slicing the pool per layer as scan xs/ys (the obvious
+    structure) makes XLA materialize pool-sized copies every layer of
+    every step — measured ~45% of decode wall time at 2k capacity and
+    ~3x total at 8k. Pool touches must stay at page granularity.
+  * **Capacity-independent cost.** ``live_pages`` (a static,
+    host-computed, power-of-two-bucketed bound on any slot's live page
+    count) caps the attention width — gather or kernel grid — so a
+    200-token batch costs the same under a 2k and an 8k ``max_len``.
 
 Invariant (same as the reference's page model): before any step at
 position ``pos``, pages hold K/V for ``[0, pos)``; the step writes
@@ -44,6 +55,7 @@ from jax import lax
 
 from ..models.llama import LlamaConfig
 from ..ops import apply_rope, rms_norm
+from ..ops.paged_attention import paged_decode_attention
 
 
 def init_pages(config: LlamaConfig, num_pages: int, page_size: int) -> dict:
@@ -67,22 +79,29 @@ def _mlp(x, layer, c: LlamaConfig):
     return x + jnp.einsum("bsm,me->bse", ff, layer["w_down"])
 
 
-def _gather_ctx(pages_l, block_table):
-    """pages_l [P, KH, page, D] + block_table [B] -> [KH, B*page, D]."""
-    g = pages_l[block_table]                       # [B, KH, page, D]
-    g = jnp.swapaxes(g, 0, 1)                      # [KH, B, page, D]
-    return g.reshape(g.shape[0], -1, g.shape[-1])  # [KH, ctx, D]
+def _gather_ctx(pool, l, tables):
+    """Layer-indexed page gather: pool [L, P, KH, page, D], tables
+    [..., B] int32 -> [..., KH, B*page, D]. One gather op — the [P, ...]
+    layer slice is never materialized."""
+    g = pool[l, tables]                        # [..., B, KH, page, D]
+    g = jnp.swapaxes(g, -4, -3)                # [..., KH, B, page, D]
+    return g.reshape(*g.shape[:-3], -1, g.shape[-1])
 
 
-@functools.partial(jax.jit, static_argnames=("config", "page_size"),
+@functools.partial(jax.jit,
+                   static_argnames=("config", "page_size", "live_pages"),
                    donate_argnames=("pages",))
 def prefill_chunk(params, pages: dict, block_table, tokens, start_pos,
-                  config: LlamaConfig, page_size: int):
+                  config: LlamaConfig, page_size: int,
+                  live_pages: int | None = None):
     """Process one page-aligned prompt chunk.
 
     tokens:      [C] int32, C a multiple of ``page_size`` (static bucket).
     block_table: [max_pages_per_seq] int32 — this sequence's pages.
     start_pos:   scalar int32, multiple of ``page_size``.
+    live_pages:  static host-computed bound ≥ ``start_pos // page_size``
+                 — caps the context-gather width so chunk cost scales
+                 with written context, not pool capacity.
 
     Attends over previously-written context ``[0, start_pos)`` (gathered
     via the block table) plus the chunk itself (causal), writes the
@@ -92,7 +111,10 @@ def prefill_chunk(params, pages: dict, block_table, tokens, start_pos,
     C = tokens.shape[0]
     n_chunk_pages = C // page_size
     positions = start_pos + jnp.arange(C, dtype=jnp.int32)
-    max_ctx = block_table.shape[0] * page_size
+    gather_table = block_table
+    if live_pages is not None and live_pages < block_table.shape[0]:
+        gather_table = block_table[:live_pages]
+    max_ctx = gather_table.shape[0] * page_size
     ctx_pos = jnp.arange(max_ctx, dtype=jnp.int32)
     ctx_live = ctx_pos < start_pos                      # [ctx]
     causal = jnp.arange(C)[:, None] >= jnp.arange(C)[None, :]
@@ -101,17 +123,17 @@ def prefill_chunk(params, pages: dict, block_table, tokens, start_pos,
     first = start_pos // page_size
     write_ids = lax.dynamic_slice(block_table, (first,), (n_chunk_pages,))
 
-    x = params["embed"][tokens][None].astype(c.dtype)   # [1, C, E]
+    x0 = params["embed"][tokens][None].astype(c.dtype)   # [1, C, E]
 
     def body(carry, xs):
-        x = carry
-        layer, kp, vp = xs                              # kp/vp [P, KH, page, D]
+        x, kf, vf = carry
+        layer, l = xs
         h = rms_norm(x, layer["attn_norm"], eps=c.norm_eps)
         q, k, v = _project_qkv(h, layer)                # [1, H|KH, C, D]
         q = apply_rope(q, positions, theta=c.rope_theta)
         k = apply_rope(k, positions, theta=c.rope_theta)
-        ck = _gather_ctx(kp, block_table)               # [KH, ctx, D]
-        cv = _gather_ctx(vp, block_table)
+        ck = _gather_ctx(kf, l, gather_table)           # [KH, ctx, D]
+        cv = _gather_ctx(vf, l, gather_table)
         qg = q[0].reshape(kh, g, C, c.head_dim)
         # context scores [KH, G, C, ctx] + in-chunk causal scores [.., C]
         s_ctx = jnp.einsum("kgcd,ktd->kgct", qg, ck).astype(jnp.float32)
@@ -132,51 +154,77 @@ def prefill_chunk(params, pages: dict, block_table, tokens, start_pos,
             k[0].reshape(kh, n_chunk_pages, page_size, c.head_dim), 0, 1)
         v_pages = jnp.swapaxes(
             v[0].reshape(kh, n_chunk_pages, page_size, c.head_dim), 0, 1)
-        kp = kp.at[write_ids].set(k_pages)
-        vp = vp.at[write_ids].set(v_pages)
-        return x2, (kp, vp)
+        kf = kf.at[l, write_ids].set(k_pages)
+        vf = vf.at[l, write_ids].set(v_pages)
+        return (x2, kf, vf), None
 
-    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], pages["k"], pages["v"]))
+    (x, new_k, new_v), _ = lax.scan(
+        body, (x0, pages["k"], pages["v"]),
+        (params["layers"], jnp.arange(c.n_layers)))
     hidden = rms_norm(x, params["final_norm"], eps=c.norm_eps)[0]  # [C, E]
     return {"k": new_k, "v": new_v}, hidden
 
 
-def decode_block(x, layer, kp, vp, block_tables, pos, write_idx,
-                 c: LlamaConfig, page_size: int):
-    """One decoder block for a [n, 1, E] single-token batch against a
-    page-pool slice (kp/vp: [P, KH, page, D]). Shared by the unpipelined
+def decode_block(x, layer, kf, vf, l, block_tables, pos, write_idx,
+                 c: LlamaConfig, page_size: int, paged: bool = False,
+                 live_pages: int | None = None):
+    """One decoder block for a [n, 1, E] single-token batch against the
+    FULL page pool (kf/vf: [L, P, KH, page, D]; ``l`` is this layer's
+    index into it — traced, so the pool is only touched at gather/scatter
+    granularity and updates stay in place). Shared by the unpipelined
     decode (``_decode_logits``) and the pp pipeline (``pp_model``) so the
-    two paths stay bitwise-identical (greedy parity depends on it)."""
+    two paths stay bitwise-identical (greedy parity depends on it).
+
+    ``paged=True`` routes context attention through the Pallas
+    paged-attention kernel (``ops/paged_attention.py``): HBM traffic per
+    step proportional to each slot's LIVE context. ``paged=False`` is the
+    dense gather — width capped by ``live_pages`` — kept as the CPU/test
+    default and the numerical ground truth."""
     n = x.shape[0]
     kh, g = c.n_kv_heads, c.n_heads // c.n_kv_heads
-    max_ctx = block_tables.shape[1] * page_size
     offset = pos % page_size
-    live = jnp.arange(max_ctx)[None] <= pos[:, None]       # [n, ctx]
     h = rms_norm(x, layer["attn_norm"], eps=c.norm_eps)
     q, k, v = _project_qkv(h, layer)                   # [n, H|KH, 1, D]
     q = apply_rope(q, pos[:, None], theta=c.rope_theta)
     k = apply_rope(k, pos[:, None], theta=c.rope_theta)
-    # Write each slot's new K/V at (its current page, offset). Distinct
-    # slots own distinct pages (trash pages for inactive slots), so
-    # the scatter has no conflicting indices.
-    kp = kp.at[write_idx, :, offset, :].set(k[:, :, 0])
-    vp = vp.at[write_idx, :, offset, :].set(v[:, :, 0])
-    ck = jax.vmap(_gather_ctx, in_axes=(None, 0))(kp, block_tables)  # [n, KH, ctx, D]
-    cv = jax.vmap(_gather_ctx, in_axes=(None, 0))(vp, block_tables)
     qg = q[:, :, 0].reshape(n, kh, g, c.head_dim)
-    scores = jnp.einsum("nkgd,nktd->nkgt", qg, ck).astype(jnp.float32)
-    scores *= c.head_dim ** -0.5
-    scores = jnp.where(live[:, None, None], scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
-    attn = jnp.einsum("nkgt,nktd->nkgd", probs, cv).reshape(
-        n, 1, c.n_heads * c.head_dim)
+    if paged:
+        # The kernel both attends AND writes the current token's K/V
+        # into the pool (aliased outputs): any pool-mutating XLA scatter
+        # beside the opaque custom call would force a pool-sized copy
+        # per step.
+        attn, kf, vf = paged_decode_attention(
+            qg, kf, vf, block_tables, pos, k[:, :, 0], v[:, :, 0],
+            page_size=page_size, live_pages=live_pages, layer=l,
+            write_idx=write_idx)
+        attn = attn.reshape(n, 1, c.n_heads * c.head_dim)
+    else:
+        # Write each slot's new K/V at (its current page, offset), then
+        # attend over the gathered context [0, pos]. Distinct slots own
+        # distinct pages (trash pages for inactive slots), so the
+        # scatter has no conflicting indices.
+        kf = kf.at[l, write_idx, :, offset, :].set(k[:, :, 0])
+        vf = vf.at[l, write_idx, :, offset, :].set(v[:, :, 0])
+        if live_pages is not None and live_pages < block_tables.shape[1]:
+            block_tables = block_tables[:, :live_pages]
+        max_ctx = block_tables.shape[1] * page_size
+        live = jnp.arange(max_ctx)[None] <= pos[:, None]   # [n, ctx]
+        ck = _gather_ctx(kf, l, block_tables)          # [n, KH, ctx, D]
+        cv = _gather_ctx(vf, l, block_tables)
+        scores = jnp.einsum("nkgd,nktd->nkgt", qg, ck).astype(jnp.float32)
+        scores *= c.head_dim ** -0.5
+        scores = jnp.where(live[:, None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
+        attn = jnp.einsum("nkgt,nktd->nkgd", probs, cv).reshape(
+            n, 1, c.n_heads * c.head_dim)
     out = jnp.einsum("bsf,fe->bse", attn,
                      layer["wo"].reshape(c.n_heads * c.head_dim, c.hidden))
-    return _mlp(x + out, layer, c), kp, vp
+    return _mlp(x + out, layer, c), kf, vf
 
 
 def _decode_logits(params, pages: dict, block_tables, tokens, pos,
-                   config: LlamaConfig, page_size: int, write_page_idx=None):
+                   config: LlamaConfig, page_size: int, write_page_idx=None,
+                   paged: bool = False, live_pages: int | None = None):
     """One batched decode step over all slots.
 
     block_tables: [slots, max_pages_per_seq] int32 (inactive slots must
@@ -196,27 +244,33 @@ def _decode_logits(params, pages: dict, block_tables, tokens, pos,
     page_idx = write_page_idx
 
     def body(carry, xs):
-        x = carry
-        layer, kp, vp = xs                                 # [P, KH, page, D]
-        x2, kp, vp = decode_block(
-            x, layer, kp, vp, block_tables, pos, page_idx, c, page_size)
-        return x2, (kp, vp)
+        x, kf, vf = carry
+        layer, l = xs
+        x2, kf, vf = decode_block(
+            x, layer, kf, vf, l, block_tables, pos, page_idx, c, page_size,
+            paged=paged, live_pages=live_pages)
+        return (x2, kf, vf), None
 
-    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], pages["k"], pages["v"]))
+    (x, new_k, new_v), _ = lax.scan(
+        body, (x, pages["k"], pages["v"]),
+        (params["layers"], jnp.arange(c.n_layers)))
     hidden = rms_norm(x, params["final_norm"], eps=c.norm_eps)     # [slots, 1, E]
     logits = jnp.einsum("bse,ev->bsv", hidden, params["lm_head"])[:, 0]
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 
 
 decode_step = functools.partial(
-    jax.jit, static_argnames=("config", "page_size"), donate_argnames=("pages",)
+    jax.jit, static_argnames=("config", "page_size", "paged", "live_pages"),
+    donate_argnames=("pages",)
 )(_decode_logits)
 
 
-@functools.partial(jax.jit, static_argnames=("config", "page_size"),
-                   donate_argnames=("pages",))
+@functools.partial(
+    jax.jit, static_argnames=("config", "page_size", "paged", "live_pages"),
+    donate_argnames=("pages",))
 def decode_and_sample(params, pages: dict, block_tables, tokens, pos, temps, key,
-                      config: LlamaConfig, page_size: int):
+                      config: LlamaConfig, page_size: int, paged: bool = False,
+                      live_pages: int | None = None):
     """``decode_step`` + on-device sampling in ONE compiled program.
 
     The engine drives the chip through a (possibly remote) dispatch
@@ -227,7 +281,8 @@ def decode_and_sample(params, pages: dict, block_tables, tokens, pos, temps, key
     one dispatch, and only [slots] int32 tokens cross back.
     """
     logits, new_pages = _decode_logits(params, pages, block_tables, tokens, pos,
-                                       config, page_size)
+                                       config, page_size, paged=paged,
+                                       live_pages=live_pages)
     key, sub = jax.random.split(key)
     greedy = jnp.argmax(logits, axis=-1)
     sampled = jax.random.categorical(sub, logits / jnp.maximum(temps, 1e-6)[:, None])
@@ -261,10 +316,13 @@ def sample_first_batch(hiddens, lm_head, temps, key):
     return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32), key
 
 
-@functools.partial(jax.jit, static_argnames=("config", "page_size", "n_steps"),
-                   donate_argnames=("pages",))
+@functools.partial(
+    jax.jit,
+    static_argnames=("config", "page_size", "n_steps", "paged", "live_pages"),
+    donate_argnames=("pages",))
 def decode_loop(params, pages: dict, block_tables, tokens, pos, temps, eos_ids,
-                remaining, key, config: LlamaConfig, page_size: int, n_steps: int):
+                remaining, key, config: LlamaConfig, page_size: int, n_steps: int,
+                paged: bool = False, live_pages: int | None = None):
     """``n_steps`` decode+sample iterations in ONE dispatch (on-device
     ``lax.scan`` generate loop, JetStream-style).
 
@@ -280,6 +338,8 @@ def decode_loop(params, pages: dict, block_tables, tokens, pos, temps, eos_ids,
     eos_ids:   [slots] int32 (-1 = no EOS for that slot).
     remaining: [slots] int32 — tokens the slot may still emit (bounds
                both max_new_tokens and the page allocation).
+    live_pages: static bound covering ``max(pos) + n_steps - 1`` (the
+               last fused step's attend position) — see module docstring.
     Returns (tokens [n_steps, slots] int32, key, pages).
     """
     n = tokens.shape[0]
@@ -293,7 +353,8 @@ def decode_loop(params, pages: dict, block_tables, tokens, pos, temps, eos_ids,
             axis=1)[:, 0]
         write_idx = jnp.where(done, trash, real_page)
         logits, pages = _decode_logits(params, pages, block_tables, tokens, pos,
-                                       config, page_size, write_page_idx=write_idx)
+                                       config, page_size, write_page_idx=write_idx,
+                                       paged=paged, live_pages=live_pages)
         key, sub = jax.random.split(key)
         greedy = jnp.argmax(logits, axis=-1)
         sampled = jax.random.categorical(sub, logits / jnp.maximum(temps, 1e-6)[:, None])

@@ -77,14 +77,15 @@ def pp_prefill_chunk(params, pages, block_table, tokens, start_pos,
             live = t == stage                          # this stage holds the chunk
             x = jnp.where((stage == 0) & (t == 0), x0, act)
 
-            def body(xc, xs):
-                layer, kpl, vpl = xs                   # [P, KH, page, D]
+            def body(carry, xs):
+                xc, kp, vp = carry                     # pools [Ll, P, KH, page, D]
+                layer, l = xs
                 h = rms_norm(xc, layer["attn_norm"], eps=c.norm_eps)
                 q, k, v = _project_qkv(h, layer)       # [1, H|KH, C, D]
                 q = apply_rope(q, positions, theta=c.rope_theta)
                 k = apply_rope(k, positions, theta=c.rope_theta)
-                ck = _gather_ctx(kpl, block_table)     # [KH, ctx, D]
-                cv = _gather_ctx(vpl, block_table)
+                ck = _gather_ctx(kp, l, block_table)   # [KH, ctx, D]
+                cv = _gather_ctx(vp, l, block_table)
                 qg = q[0].reshape(kh, g, C, c.head_dim)
                 scale = c.head_dim ** -0.5
                 s_ctx = jnp.einsum("kgcd,ktd->kgct", qg, ck).astype(jnp.float32)
@@ -106,13 +107,15 @@ def pp_prefill_chunk(params, pages, block_table, tokens, start_pos,
                     k[0].reshape(kh, n_chunk_pages, page_size, c.head_dim), 0, 1)
                 v_new = jnp.swapaxes(
                     v[0].reshape(kh, n_chunk_pages, page_size, c.head_dim), 0, 1)
-                kpl = kpl.at[write_ids].set(
-                    jnp.where(live, k_new, kpl[write_ids]))
-                vpl = vpl.at[write_ids].set(
-                    jnp.where(live, v_new, vpl[write_ids]))
-                return x2, (kpl, vpl)
+                kp = kp.at[l, write_ids].set(
+                    jnp.where(live, k_new, kp[l, write_ids]))
+                vp = vp.at[l, write_ids].set(
+                    jnp.where(live, v_new, vp[l, write_ids]))
+                return (x2, kp, vp), None
 
-            x, (kp, vp) = lax.scan(body, x, (layers_local, kp, vp))
+            n_local = kp.shape[0]
+            (x, kp, vp), _ = lax.scan(
+                body, (x, kp, vp), (layers_local, jnp.arange(n_local)))
             h = rms_norm(x, final_norm, eps=c.norm_eps)[0]   # [C, E]
             hidden = jnp.where(live & (stage == pp - 1), h, hidden)
             act = lax.ppermute(x, "pp", perm=perm)
@@ -146,7 +149,13 @@ def pp_decode_loop(params, pages, block_tables, tokens, pos, temps, eos_ids,
     """Pipelined ``decode_loop``: same contract (tokens [n_steps, slots],
     key, pages). ``slots`` must divide into ``pp`` groups; group ``g``'s
     round ``r`` runs on stage ``s`` at tick ``t = g + r*pp + s``, so all
-    stages stay busy after a (pp-1)-tick warmup."""
+    stages stay busy after a (pp-1)-tick warmup.
+
+    Token parity with the unpipelined engine holds for GREEDY decoding
+    (temps == 0) only: this loop splits the PRNG key once per pipeline
+    tick (T = n_steps*pp + pp - 1 splits) while ``decode_loop`` splits
+    once per step, so sampled (temps > 0) outputs draw from the same
+    distribution but are not bit-identical."""
     c = config
     pp = mesh.shape["pp"]
     slots = tokens.shape[0]
@@ -187,13 +196,15 @@ def pp_decode_loop(params, pages, block_tables, tokens, pos, temps, eos_ids,
                 axis=1)[:, 0]
             write_idx = jnp.where(done_eff, trash_g[g], real_page)
 
-            def body(xc, xs):
-                layer, kpl, vpl = xs
-                x2, kpl, vpl = decode_block(
-                    xc, layer, kpl, vpl, bt, cpos, write_idx, c, page_size)
-                return x2, (kpl, vpl)
+            def body(carry, xs):
+                xc, kp, vp = carry
+                layer, l = xs
+                x2, kp, vp = decode_block(
+                    xc, layer, kp, vp, l, bt, cpos, write_idx, c, page_size)
+                return (x2, kp, vp), None
 
-            x, (kp, vp) = lax.scan(body, x, (layers_local, kp, vp))
+            (x, kp, vp), _ = lax.scan(
+                body, (x, kp, vp), (layers_local, jnp.arange(kp.shape[0])))
 
             # Last stage: logits + sample (computed on every stage for
             # SPMD uniformity; only the last stage's result is used).

@@ -1,0 +1,352 @@
+"""Paged-attention decode kernel (Pallas TPU).
+
+The framework's native answer to the decode kernel the reference buys
+from vLLM (``python/ray/llm/_internal/serve/deployments/llm/vllm/
+vllm_engine.py`` — the engine all of ``ray.llm`` delegates token
+generation to). One decode step reads, per sequence, ONLY the KV pages
+that hold live context: the sequence's block table is scalar-prefetched
+into SMEM, and the kernel's input index maps walk it so the pipelined
+HBM→VMEM copies fetch just the live pages, accumulating flash-style
+online softmax per page block. HBM traffic per step is
+``O(live_tokens)`` per slot — a dense gather pays the capacity (or the
+batch-max bucket) for EVERY slot.
+
+Layout contract (matches ``llm/model.py``):
+
+    k_pages / v_pages : [L, num_pages, KH, page_size, D]  (stacked pool;
+                        a single-layer [num_pages, ...] pool is promoted)
+    block_tables      : [slots, max_pages_per_seq] int32
+    pos               : [slots] int32 — attend over [0, pos] inclusive
+    q                 : [slots, KH, G, D]  (G = q heads per kv head)
+
+Kernel structure:
+  * grid = (slots, page_blocks), trailing axis sequential on-core so
+    the f32 online-softmax state (m / l / acc scratch) carries across
+    the page blocks of one sequence.
+  * A grid step covers ``ppb`` pages (~256 tokens). Discontiguous pages
+    can't ride one BlockSpec, so the pool is passed ``ppb`` times, each
+    input's index map selecting one page of the block —
+    auto-pipelining then double-buffers all of them. (Manual
+    ``make_async_copy`` from HBM needs 128-aligned minor dims, which
+    head_dim 64 models violate; pipelined copies don't.)
+  * Dead blocks — past the live page count — clamp their index maps to
+    the last live page. Pallas elides copies whose block index repeats,
+    and ``pl.when`` skips the compute, so dead blocks cost neither
+    bandwidth nor FLOPs.
+  * **The kernel owns the pool's token write.** The pool holds
+    positions [0, pos); the CURRENT token's K/V arrive as separate
+    small inputs, are folded into the softmax at the final block, and
+    are written into the pool through aliased outputs
+    (``input_output_aliases``) at (layer, write_idx, :, pos % page).
+    This is what keeps the donated pool IN PLACE across the layer scan:
+    any pool-mutating op outside the opaque custom call (a plain XLA
+    scatter before or after it) makes XLA materialize a pool-sized copy
+    per step — measured ~60 ms/step on a 1B model's 2 GB pool.
+  * GQA without K/V replication: per kv head, q is [G, D] against the
+    head's [T, D] page block (static loop over KH — decode is
+    bandwidth-bound; MXU utilization is irrelevant here).
+
+Off-TPU the kernel runs in interpreter mode (tests); the engine keeps
+the dense path as the CPU default since interpret-mode decode is slow.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    bt_ref,      # [slots, max_pages] int32 (SMEM, scalar-prefetched)
+    pos_ref,     # [slots] int32 (SMEM)
+    l_ref,       # [1] int32 layer index (SMEM; consumed by index maps)
+    wp_ref,      # [slots] int32 write page (trash-redirected; index maps)
+    q_ref,       # [1, KH, Gp, D] VMEM block
+    kc_ref,      # [1, KH, 1, D] current token's K (not yet in the pool)
+    vc_ref,      # [1, KH, 1, D] current token's V
+    *refs,       # [wpk, wpv (write-back only),] ppb k-page refs, ppb
+                 # v-page refs ([1, 1, KH, page, D]), then outputs
+                 # (o [, k_pool, v_pool]), then scratch m/l/acc
+    kh: int,
+    page_size: int,
+    ppb: int,
+    n_blocks: int,
+    scale: float,
+    write_back: bool,
+):
+    if write_back:
+        wpk_ref, wpv_ref = refs[:2]
+        refs = refs[2:]
+    k_refs = refs[:ppb]
+    v_refs = refs[ppb:2 * ppb]
+    if write_back:
+        o_ref, kp_out, vp_out, m_ref, lsum_ref, acc_ref = refs[2 * ppb:]
+    else:
+        o_ref, m_ref, lsum_ref, acc_ref = refs[2 * ppb:]
+    si = pl.program_id(0)
+    bi = pl.program_id(1)
+    pos = pos_ref[si]
+    # The pool holds positions [0, pos) — the CURRENT token's K/V arrive
+    # through kc/vc instead and are written back below.
+    n_live_pages = jax.lax.div(pos + page_size - 1, page_size)
+    needed = bi * ppb < n_live_pages
+
+    @pl.when(bi == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        lsum_ref[...] = jnp.zeros_like(lsum_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    if write_back:
+        # Token write as full-page read-modify-write through the aliased
+        # pool outputs (a 1-row output block violates TPU tiling): copy
+        # the write page, select-replace the token's row, flush. Pallas
+        # flushes when the output index (slot) changes — page ownership
+        # is exclusive per slot, so no cross-slot hazard.
+        off = jax.lax.rem(pos, page_size)
+        row = jax.lax.broadcasted_iota(
+            jnp.int32, (kh, page_size, q_ref.shape[3]), 1) == off
+        kp_out[0, 0] = jax.lax.select(
+            row, jnp.broadcast_to(kc_ref[0, :, 0][:, None], row.shape
+                                  ).astype(kp_out.dtype), wpk_ref[0, 0])
+        vp_out[0, 0] = jax.lax.select(
+            row, jnp.broadcast_to(vc_ref[0, :, 0][:, None], row.shape
+                                  ).astype(vp_out.dtype), wpv_ref[0, 0])
+
+    @pl.when(needed)
+    def _compute():
+        t = ppb * page_size
+        gp = q_ref.shape[2]
+        # Token liveness within the block: global position < pos (strict
+        # — position pos itself is the in-flight token, folded below).
+        t_pos = bi * t + jax.lax.broadcasted_iota(jnp.int32, (gp, t), 1)
+        live = t_pos < pos
+
+        for h in range(kh):
+            q = q_ref[0, h]                                   # [Gp, D]
+            kb = jnp.concatenate([r[0, 0, h] for r in k_refs])  # [T, D]
+            vb = jnp.concatenate([r[0, 0, h] for r in v_refs])
+            s = jax.lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                         # [Gp, T]
+            # lax.select, not jnp.where: jnp's scalar-broadcast wrapper
+            # lowers to a closed_call that trips a lowering-cache
+            # KeyError (jax 0.9.0) when this kernel sits in an outer scan.
+            s = jax.lax.select(live, s, jnp.full_like(s, NEG_INF))
+            m_prev = m_ref[h]                                 # [Gp, 128]
+            m_cur = jnp.max(s, axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+            p = jnp.exp(s - m_new[:, :1])
+            alpha = jnp.exp(m_prev - m_new)
+            lsum_ref[h] = lsum_ref[h] * alpha + jnp.broadcast_to(
+                jnp.sum(p, axis=1, keepdims=True), lsum_ref[h].shape)
+            acc_ref[h] = acc_ref[h] * alpha[:, :1] + jax.lax.dot(
+                p.astype(vb.dtype), vb, preferred_element_type=jnp.float32)
+            m_ref[h] = m_new
+
+    @pl.when(bi == n_blocks - 1)
+    def _final():
+        # Fold in the current token (always attended: position == pos),
+        # then normalize. Also covers pos == 0, where no pool block ran
+        # (m = -inf, l = 0) and the output is exactly v_cur.
+        for h in range(kh):
+            q = q_ref[0, h]                                   # [Gp, D]
+            kc = kc_ref[0, h]                                 # [1, D]
+            vc = vc_ref[0, h]
+            # Elementwise multiply-reduce, not an Nx1 dot: Mosaic's
+            # lowering of a [Gp, D] x [1, D] matmul with bf16 operands
+            # and f32 accumulation emits a type-mismatched broadcast.
+            s = jnp.sum(
+                q.astype(jnp.float32) * kc.astype(jnp.float32),
+                axis=1, keepdims=True,
+            ) * scale                                         # [Gp, 1]
+            m_prev = m_ref[h]
+            m_new = jnp.maximum(m_prev, jnp.broadcast_to(s, m_prev.shape))
+            p = jnp.exp(s - m_new[:, :1])                     # [Gp, 1]
+            alpha = jnp.exp(m_prev - m_new)
+            lsum = lsum_ref[h] * alpha + jnp.broadcast_to(p, lsum_ref[h].shape)
+            acc = acc_ref[h] * alpha[:, :1] + p * vc.astype(jnp.float32)
+            o_ref[0, h] = (acc / lsum[:, :1]).astype(o_ref.dtype)
+
+
+# NOTE: deliberately NOT @jax.jit-wrapped — a nested jit around a
+# pallas_call inside an outer scan trips a lowering-cache KeyError in
+# jax 0.9.0 ('closed_call' in cached_primitive_lowerings). Callers are
+# always under jit themselves (decode_loop / decode_step).
+def paged_decode_attention(
+    q,
+    k_pages,
+    v_pages,
+    block_tables,
+    pos,
+    k_cur=None,
+    v_cur=None,
+    *,
+    page_size: int,
+    pages_per_block: int | None = None,
+    live_pages: int | None = None,
+    layer=None,
+    write_idx=None,
+    interpret: bool | None = None,
+):
+    """One decode step of attention over a paged KV pool.
+
+    q:            [slots, KH, G, D] — current-token queries, grouped by
+                  kv head (``q.reshape(slots, KH, G, D)`` of the [H, D]
+                  layout, matching ``llm/model.py``'s GQA grouping).
+    k/v_pages:    [num_pages, KH, page_size, D] — one layer's pool — or
+                  the FULL stacked pool [L, num_pages, KH, page_size, D]
+                  with ``layer`` the (traced) layer index. Passing the
+                  stacked pool lets the layer scan keep the pool in its
+                  carry: the layer index rides the scalar prefetch into
+                  the page index maps, so no [num_pages, ...] slice is
+                  ever materialized.
+    k_cur/v_cur:  [slots, KH, D] — the CURRENT token's K/V, folded into
+                  the softmax at the final block. The pool must hold
+                  positions [0, pos) only. If omitted, the pool must
+                  instead already hold position ``pos`` (read-only mode;
+                  the wrapper pulls the token back out of the pool).
+    write_idx:    [slots] int32 — page each slot's token is written to
+                  (the caller's trash-redirected page). When given (with
+                  k_cur/v_cur), the kernel WRITES the token into the
+                  pool through aliased outputs and returns
+                  ``(out, k_pages, v_pages)``; the caller must not
+                  scatter separately. This in-kernel write is what keeps
+                  a donated, loop-carried pool in place — any XLA-side
+                  scatter next to the opaque custom call forces a
+                  pool-sized copy per step.
+    block_tables: [slots, max_pages_per_seq] int32.
+    pos:          [slots] int32 — attend over [0, pos] inclusive.
+    live_pages:   static upper bound on live pages of ANY slot (i.e.
+                  ``max(pos) // page_size + 1`` ≤ live_pages). Bounds the
+                  GRID, not just the copies: without it, dead blocks
+                  still pay per-step pipeline bookkeeping, so step count
+                  scales with pool capacity. Callers should bucket it
+                  (powers of two) to bound recompiles.
+
+    Returns [slots, KH, G, D] in q.dtype — plus the updated pool arrays
+    when ``write_idx`` is given.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    squeeze_layer = k_pages.ndim == 4
+    if squeeze_layer:
+        k_pages = k_pages[None]
+        v_pages = v_pages[None]
+    layer = (jnp.zeros((1,), jnp.int32) if layer is None
+             else jnp.asarray(layer, jnp.int32).reshape(1))
+    n, kh, g, d = q.shape
+    max_pages = block_tables.shape[1]
+    write_back = write_idx is not None
+    if k_cur is None:
+        if write_back:
+            raise ValueError("write_idx requires k_cur/v_cur")
+        # Pool already holds position ``pos``: pull the current token's
+        # K/V back out so the kernel's strict (< pos) pool mask plus the
+        # explicit current-token fold gives identical semantics.
+        wp = jnp.take_along_axis(
+            block_tables,
+            jnp.minimum(pos // page_size, max_pages - 1)[:, None], axis=1)[:, 0]
+        off = pos % page_size
+        k_cur = k_pages[layer[0], wp, :, off]              # [slots, KH, D]
+        v_cur = v_pages[layer[0], wp, :, off]
+    if write_idx is None:
+        write_idx = jnp.zeros((n,), jnp.int32)             # unused
+    covered = max_pages if live_pages is None else min(live_pages, max_pages)
+    # ~256 tokens of context per grid step: few enough steps that grid
+    # overhead stays small, few enough inputs that VMEM stays bounded.
+    if pages_per_block is None:
+        pages_per_block = max(1, min(covered, 256 // page_size, 8))
+    ppb = min(pages_per_block, covered)
+    n_blocks = -(-covered // ppb)
+
+    # Pad G to the f32 sublane tile (8) so scratch/compute rows are
+    # aligned; padded q rows are zeros and their outputs are sliced off.
+    gp = -(-g // 8) * 8
+    if gp != g:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+
+    def page_index_map(j):
+        # Page j of block bi for slot si; dead/overflow indices clamp to
+        # the last live page so consecutive steps repeat the block index
+        # and Pallas skips the copy. (Scalar-prefetch refs arrive as
+        # trailing index-map args; lax ops, not jnp — see closed_call
+        # note above.)
+        def index_map(si, bi, bt_ref, pos_ref, l_ref, wp_ref):
+            n_live = jax.lax.div(pos_ref[si] + page_size - 1, page_size)
+            logical = jax.lax.max(
+                jax.lax.min(bi * ppb + j,
+                            jax.lax.min(n_live, max_pages) - 1), 0)
+            return l_ref[0], bt_ref[si, logical], 0, 0, 0
+        return index_map
+
+    def wpage_map(si, bi, bt_ref, pos_ref, l_ref, wp_ref):
+        return l_ref[0], wp_ref[si], 0, 0, 0
+
+    page_block = (1, 1, kh, page_size, d)
+    kernel = functools.partial(
+        _decode_kernel,
+        kh=kh,
+        page_size=page_size,
+        ppb=ppb,
+        n_blocks=n_blocks,
+        scale=d ** -0.5,
+        write_back=write_back,
+    )
+    out_specs = [pl.BlockSpec((1, kh, gp, d), lambda si, bi, *_: (si, 0, 0, 0))]
+    out_shape = [jax.ShapeDtypeStruct((n, kh, gp, d), q.dtype)]
+    aliases = {}
+    wpage_inputs = []
+    wpage_specs = []
+    if write_back:
+        out_specs += [pl.BlockSpec(page_block, wpage_map)] * 2
+        out_shape += [jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+                      jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype)]
+        wpage_inputs = [k_pages, v_pages]
+        wpage_specs = [pl.BlockSpec(page_block, wpage_map)] * 2
+        # Flattened operand order: bt, pos, layer, wp, q, kc, vc, wpk,
+        # wpv, k_pages x ppb, v_pages x ppb. Alias the first ref of each
+        # pool to its output so the buffer passes through un-copied.
+        aliases = {9: 1, 9 + ppb: 2}
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(n, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, kh, gp, d), lambda si, bi, *_: (si, 0, 0, 0)),
+            pl.BlockSpec((1, kh, 1, d), lambda si, bi, *_: (si, 0, 0, 0)),
+            pl.BlockSpec((1, kh, 1, d), lambda si, bi, *_: (si, 0, 0, 0)),
+            *wpage_specs,
+            *[pl.BlockSpec(page_block, page_index_map(j)) for j in range(ppb)],
+            *[pl.BlockSpec(page_block, page_index_map(j)) for j in range(ppb)],
+        ],
+        out_specs=out_specs if write_back else out_specs[0],
+        scratch_shapes=[
+            pltpu.VMEM((kh, gp, 128), jnp.float32),
+            pltpu.VMEM((kh, gp, 128), jnp.float32),
+            pltpu.VMEM((kh, gp, d), jnp.float32),
+        ],
+    )
+    result = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape if write_back else out_shape[0],
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(block_tables, pos, layer, write_idx,
+      q, k_cur[:, :, None], v_cur[:, :, None], *wpage_inputs,
+      *([k_pages] * ppb), *([v_pages] * ppb))
+    if write_back:
+        out, new_k, new_v = result
+        out = out[:, :, :g] if gp != g else out
+        if squeeze_layer:
+            new_k, new_v = new_k[0], new_v[0]
+        return out, new_k, new_v
+    out = result
+    return out[:, :, :g] if gp != g else out

@@ -178,15 +178,20 @@ class ClientServer:
         return {}
 
     def _register_stream(self, p: dict, gen) -> str:
+        import asyncio
+
         sid = uuid.uuid4().hex
         self._client(p)
         with self._lock:
             # next: the index the client may request next; last: cached
             # reply for index next-1 so a RETRIED StreamNext (transport
             # drop after the server consumed the item) replays instead of
-            # silently skipping an item.
+            # silently skipping an item. serial: per-stream asyncio lock
+            # — a duplicate request racing the still-in-flight original
+            # must not pass the cursor check twice and double-consume.
             self._sessions[p["client_id"]]["streams"][sid] = {
-                "gen": gen, "next": 0, "last": None}
+                "gen": gen, "next": 0, "last": None,
+                "serial": asyncio.Lock()}
         return sid
 
     async def handle_ClientStreamNext(self, p: dict) -> dict:
@@ -201,6 +206,15 @@ class ClientServer:
         if state is None:
             return {"error": cloudpickle.dumps(
                 RayTpuError(f"unknown stream {p['stream']!r}"))}
+        # Serialize per stream: the cursor/replay check must re-run after
+        # any in-flight duplicate finishes, else both pass idx == next
+        # and the generator is consumed twice (one item silently lost).
+        async with state["serial"]:
+            return await self._stream_next_locked(p, state)
+
+    async def _stream_next_locked(self, p: dict, state: dict) -> dict:
+        import asyncio
+
         idx = p.get("index", state["next"])
         if idx == state["next"] - 1 and state["last"] is not None:
             return state["last"]  # retry replay

@@ -144,3 +144,50 @@ def test_tpu_fence_survives_pg_teardown(tpu_cluster):
     pid2, prev_alive = ray_tpu.get(next_lease.remote(pid1), timeout=120)
     assert pid2 != pid1
     assert not prev_alive, "PG teardown re-granted the chip before holder death"
+
+
+def test_tpu_grant_fence_waits_for_external_lock_holder(tmp_path, monkeypatch):
+    """GRANT-side fence: the libtpu device lock may be held by a process
+    the raylet never tracked (a benchmark phase, a stray trainer). The
+    first TPU lease after such a handoff must wait for the lock, not
+    start a worker that crash-loops on device init."""
+    import fcntl
+    import threading
+    import time as _time
+
+    lockfile = tmp_path / "libtpu_lockfile"
+    monkeypatch.setenv("RAY_TPU_LOCKFILE", str(lockfile))
+    # Simulate the external holder: take the flock in THIS process.
+    fd = os.open(lockfile, os.O_CREAT | os.O_RDWR, 0o666)
+    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(
+        initialize_head=True,
+        head_node_args={"num_cpus": 2, "resources": {"TPU": 1.0}},
+    )
+    ray_tpu.init(address=c.address, num_cpus=0)
+    try:
+        @ray_tpu.remote(resources={"TPU": 1.0}, num_cpus=0)
+        def probe():
+            return _time.time()
+
+        released_at = [None]
+
+        def release_later():
+            _time.sleep(3.0)
+            released_at[0] = _time.time()
+            fcntl.flock(fd, fcntl.LOCK_UN)
+
+        t = threading.Thread(target=release_later)
+        t.start()
+        ran_at = ray_tpu.get(probe.remote(), timeout=120)
+        t.join()
+        assert released_at[0] is not None
+        assert ran_at >= released_at[0], (
+            "TPU task ran while the external device lock was still held")
+    finally:
+        os.close(fd)
+        ray_tpu.shutdown()
+        c.shutdown()
