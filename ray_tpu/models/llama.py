@@ -251,6 +251,18 @@ def forward_hidden(params, tokens, config: LlamaConfig, *, mesh: Mesh | None = N
     positions = jnp.arange(s, dtype=jnp.int32)
     x = params["embed"][tokens].astype(c.dtype)
     if mesh is not None:
+        # Two-hop resharding. The gather's output inherits the table's
+        # embed=fsdp sharding; jumping straight to batch=(dcn,dp,fsdp)
+        # asks SPMD for a transition it can only do by replicating the
+        # whole tensor (the dryrun's "Involuntary full rematerialization"
+        # warning on dcn meshes). Hop 1 reshards batch/seq while KEEPING
+        # embed on fsdp; hop 2 moves fsdp from embed to batch — each a
+        # single-axis change XLA lowers to cheap collectives.
+        if mesh.shape.get("fsdp", 1) > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, _P(("dcn", "dp"), "sp", "fsdp")))
         x = shard_constraint(x, mesh, ("batch", "seq", "embed_act"))
 
     if mesh is not None and "pp" in mesh.shape and mesh.shape["pp"] > 1:
