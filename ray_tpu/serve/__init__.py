@@ -18,6 +18,7 @@ from .api import (
 )
 from .batching import batch
 from .config_api import build_app_from_spec, deploy_config, serve_status
+from .local_testing_mode import make_local_deployment_handle
 from .grpc_proxy import start_grpc
 from .deployment import Application, AutoscalingConfig, Deployment, deployment
 from .multiplex import get_multiplexed_model_id, multiplexed
@@ -43,6 +44,7 @@ __all__ = [
     "get_deployment_handle",
     "get_multiplexed_model_id",
     "http_address",
+    "make_local_deployment_handle",
     "multiplexed",
     "run",
     "shutdown",

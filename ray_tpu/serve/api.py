@@ -98,9 +98,19 @@ def _deployment_config(app: Application, app_name: str) -> dict:
 
 
 def run(app: Application, *, name: str = "default", route_prefix: str | None = "/",
-        _blocking: bool = True, timeout_s: float = 120.0) -> DeploymentHandle:
+        _blocking: bool = True, timeout_s: float = 120.0,
+        _local_testing_mode: bool = False) -> DeploymentHandle:
     """Deploy an application and wait for it to be healthy. Reference:
-    serve/api.py run()."""
+    serve/api.py run().
+
+    ``_local_testing_mode=True`` instantiates the deployments in THIS
+    process and returns a local handle — no controller, proxy, or actors
+    (reference ``serve/_private/local_testing_mode.py``). For unit
+    tests of handler logic."""
+    if _local_testing_mode:
+        from .local_testing_mode import make_local_deployment_handle
+
+        return make_local_deployment_handle(app, name)
     controller = start()
     nodes = app.walk()
     configs = [_deployment_config(node, name) for node in nodes]

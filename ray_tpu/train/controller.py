@@ -42,11 +42,15 @@ class ElasticScalingPolicy:
     whole-group restart from the latest checkpoint — never an in-place
     membership change (SPMD collectives can't survive one)."""
 
-    def __init__(self, scaling_config: ScalingConfig, *, check_interval_s: float = 2.0):
+    def __init__(self, scaling_config: ScalingConfig, *,
+                 check_interval_s: float = 2.0, clock=None):
         self._config = scaling_config
         self.min = max(1, scaling_config.min_workers or 1)
         self.max = scaling_config.num_workers
         self._check_interval = check_interval_s
+        # Injectable clock so the debounce is testable without wall-time
+        # sleeps (load-sensitive timing was a full-suite flake source).
+        self._clock = clock or time.monotonic
         self._next_check = 0.0
         self._pending_target: int | None = None
 
@@ -76,7 +80,7 @@ class ElasticScalingPolicy:
         the target must hold for two consecutive checks — node-death
         detection lags heartbeats, and a dying node's resources would
         otherwise read as phantom upscale capacity."""
-        now = time.monotonic()
+        now = self._clock()
         if now < self._next_check:
             return None
         self._next_check = now + self._check_interval

@@ -319,7 +319,20 @@ def test_elastic_scaling_downscale_on_node_death(tmp_path):
         box = {}
         t = threading.Thread(target=lambda: box.update(result=trainer.fit()))
         t.start()
-        time.sleep(4.0)  # 3-worker attempt underway
+        # Wait for EVIDENCE the 3-worker attempt is underway (its first
+        # checkpoint landing in storage) instead of a wall-clock sleep —
+        # under full-suite load on one core a fixed sleep races the
+        # worker-group start and flakes.
+        import glob as _glob
+
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if _glob.glob(str(tmp_path / "elastic_down" / "**" / "step.txt"),
+                          recursive=True):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("3-worker attempt never checkpointed")
         c.remove_node(n2)  # kill 2 of 3 workers' node
         t.join(timeout=240)
         assert not t.is_alive(), "fit() did not finish after node loss"
