@@ -15,6 +15,7 @@ protocol through Serve.
 from .batch import LLMProcessorConfig, Processor, build_llm_processor
 from .engine import InferenceEngine, PageAllocator, Request
 from .executor import LocalEngineExecutor
+from .lora import LoRAServingConfig, save_adapter
 from .model import decode_step, init_pages, prefill_chunk
 from .multihost import EngineShardWorker, ShardedEngineExecutor, create_sharded_executor
 from .serving import LLMDeployment, build_llm_app
@@ -32,6 +33,8 @@ __all__ = [
     "PageAllocator",
     "Request",
     "init_pages",
+    "LoRAServingConfig",
+    "save_adapter",
     "prefill_chunk",
     "decode_step",
     "LLMDeployment",

@@ -24,10 +24,14 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any
 
+import logging
+
 import numpy as np
 
 from ..models.llama import LlamaConfig, PRESETS
 from .executor import LocalEngineExecutor
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -38,6 +42,10 @@ class Request:
     temperature: float = 0.0
     eos_id: int | None = None
     stop_ids: list[int] = field(default_factory=list)
+    # LoRA adapter id (None/"" = base model); resolved to a device stack
+    # slot at admission (reference: per-request `model` routing through
+    # serve's multiplexed LoRA deployments)
+    model: str | None = None
     # runtime state
     generated: list[int] = field(default_factory=list)
     slot: int = -1
@@ -46,6 +54,7 @@ class Request:
     block_table: list[int] = field(default_factory=list)
     done: bool = False
     finish_reason: str = ""
+    lora_slot: int = 0
     arrived_at: float = field(default_factory=time.monotonic)
     first_token_at: float | None = None
     cached_prefix_tokens: int = 0
@@ -142,6 +151,7 @@ class InferenceEngine:
         executor=None,
         seed: int = 0,
         attention_impl: str = "auto",
+        lora_config=None,
     ):
         self.config = PRESETS[config] if isinstance(config, str) else config
         self.mesh = mesh
@@ -164,8 +174,16 @@ class InferenceEngine:
                 self.config, params, max_slots=max_slots,
                 num_pages=self.num_pages, page_size=page_size, mesh=mesh,
                 seed=seed, attention_impl=attention_impl,
+                lora_config=lora_config,
             )
         self.executor = executor
+        self.lora_manager = None
+        if lora_config is not None:
+            from .lora import LoRAManager
+
+            self.lora_manager = LoRAManager(
+                self.config, lora_config, executor.install_adapter)
+        self._lora_idx = np.zeros(max_slots, np.int32)
         self.allocator = PageAllocator(self.num_pages)
         # Trash pages 0..max_slots-1 are permanently owned by their slot.
         for s in range(max_slots):
@@ -249,6 +267,7 @@ class InferenceEngine:
             self._active.pop(r.slot, None)
             self._free_slots.append(r.slot)
             self._block_tables[r.slot, :] = r.slot  # back to trash page
+            self._lora_idx[r.slot] = 0
             # Reset the host pos mirror too: the executor's live_pages
             # bucket is max over ALL slots, and a stale 8k pos from a
             # retired request would inflate every later batch's
@@ -260,6 +279,9 @@ class InferenceEngine:
             self._free_slots.append(r.slot)
             self._block_tables[r.slot, :] = r.slot
             self._pos[r.slot] = 0
+        if r.lora_slot and self.lora_manager is not None:
+            self.lora_manager.release(r.lora_slot)
+            r.lora_slot = 0
         if r.block_table:
             if self.enable_prefix_cache and r.finish_reason != "admission_failed":
                 # Register only pages whose K/V was actually COMPUTED: a
@@ -267,6 +289,8 @@ class InferenceEngine:
                 # garbage — caching them would poison future prefix hits.
                 full_prompt_pages = min(len(r.prompt), r.prefill_pos) // self.page_size
                 h = hashlib.sha1()
+                # Adapter-specific K/V must never be shared across models
+                h.update((r.model or "").encode())
                 for i in range(full_prompt_pages):
                     h.update(bytes(np.asarray(
                         r.prompt[i * self.page_size:(i + 1) * self.page_size],
@@ -333,7 +357,27 @@ class InferenceEngine:
                 r.prefill_pos = len(hits) * self.page_size
                 r.cached_prefix_tokens = r.prefill_pos
                 self.metrics["prefix_hit_pages"] += len(hits)
+                if r.model and self.lora_manager is not None:
+                    try:
+                        # May read the adapter from storage + write the
+                        # device stack; engine-loop blocking is the
+                        # admission cost of a cold adapter (LRU-cached
+                        # after).
+                        r.lora_slot = self.lora_manager.acquire(r.model)
+                    except Exception as e:
+                        for pid in r.block_table:
+                            self.allocator.release(pid)
+                        r.block_table = []
+                        r.done, r.finish_reason = True, "admission_failed"
+                        logger.warning("adapter %r load failed: %s", r.model, e)
+                        continue
+                elif r.model and self.lora_manager is None:
+                    for pid in hits + fresh:
+                        self.allocator.release(pid)
+                    r.done, r.finish_reason = True, "admission_failed"
+                    continue
                 r.slot = self._free_slots.pop()
+                self._lora_idx[r.slot] = r.lora_slot
                 self._block_tables[r.slot, :len(r.block_table)] = r.block_table
                 self._prefilling.append(r)
 
@@ -344,6 +388,7 @@ class InferenceEngine:
         max_hit_pages = (len(r.prompt) - 1) // self.page_size
         hits: list[int] = []
         h = hashlib.sha1()
+        h.update((r.model or "").encode())  # adapter-scoped prefix space
         for i in range(max_hit_pages):
             h.update(bytes(np.asarray(
                 r.prompt[i * self.page_size:(i + 1) * self.page_size],
@@ -372,7 +417,8 @@ class InferenceEngine:
         bt[:len(r.block_table)] = r.block_table
         final = r.prefill_pos + take >= len(r.prompt)
         handle = next(self._handle_counter) if final else None
-        self.executor.prefill(bt, tokens, r.prefill_pos, handle, take)
+        self.executor.prefill(bt, tokens, r.prefill_pos, handle, take,
+                              lora_slot=r.lora_slot)
         self.metrics["prefill_chunks"] += 1
         r.prefill_pos += take
         if not final:
@@ -438,7 +484,7 @@ class InferenceEngine:
         K = self.decode_steps_per_dispatch
         tokens = self.executor.decode(
             self._block_tables, self._tokens, self._pos, temps, eos_ids,
-            remaining, K,
+            remaining, K, lora_idx=self._lora_idx,
         )  # [K, slots]
         self.metrics["decode_steps"] += K
         events = []

@@ -51,6 +51,7 @@ class LocalEngineExecutor:
         mesh=None,
         seed: int = 0,
         attention_impl: str = "auto",
+        lora_config=None,
     ):
         self.config = PRESETS[config] if isinstance(config, str) else config
         if params is None:
@@ -126,6 +127,16 @@ class LocalEngineExecutor:
             pages = jax.device_put(
                 pages, {"k": self._pages_sharding, "v": self._pages_sharding})
             self._replicated = NamedSharding(mesh, PartitionSpec())
+        self.lora_config = lora_config
+        self.lora_stack = None
+        if lora_config is not None:
+            if mesh is not None:
+                raise ValueError("lora serving is single-device for now "
+                                 "(stacks are not mesh-sharded)")
+            from .lora import init_lora_stack
+
+            self.lora_stack = init_lora_stack(
+                self.config, lora_config.max_loras, lora_config.max_rank)
         self.params = params
         self.pages = pages
         self._key = jax.random.PRNGKey(seed ^ 0x5EED)
@@ -190,15 +201,28 @@ class LocalEngineExecutor:
             b *= 2
         return min(b, max_pages)
 
+    def install_adapter(self, slot: int, arrays: dict) -> None:
+        """Write one adapter's padded A/B arrays into stack slot ``slot``
+        (the ``LoRAManager``'s device hook)."""
+        from .lora import _install
+
+        self.lora_stack = _install(
+            self.lora_stack, jnp.int32(slot),
+            {k: jnp.asarray(v) for k, v in arrays.items()})
+
     # ------------------------------------------------------------- operations
     def prefill(self, block_table: np.ndarray, tokens: np.ndarray,
-                start_pos: int, handle: int | None, take: int) -> None:
+                start_pos: int, handle: int | None, take: int,
+                lora_slot: int = 0) -> None:
         if self._pp > 1:
             kwargs = {}
         else:
             # Context gathered is [0, start_pos): cap the gather width.
             kwargs = {"live_pages": self._bucket_pages(
                 -(-int(start_pos) // self.page_size), block_table.shape[0])}
+            if self.lora_stack is not None:
+                kwargs["lora"] = self.lora_stack
+                kwargs["lora_slot"] = self._put(np.int32(lora_slot))
         self.pages, hidden = self._prefill(
             self.params, self.pages, self._put(block_table.astype(np.int32)),
             self._put(tokens.astype(np.int32)),
@@ -225,7 +249,8 @@ class LocalEngineExecutor:
 
     def decode(self, block_tables: np.ndarray, tokens: np.ndarray,
                pos: np.ndarray, temps: np.ndarray, eos_ids: np.ndarray,
-               remaining: np.ndarray, n_steps: int) -> np.ndarray:
+               remaining: np.ndarray, n_steps: int,
+               lora_idx: np.ndarray | None = None) -> np.ndarray:
         if self._pp > 1:
             kwargs = {}
         else:
@@ -236,6 +261,11 @@ class LocalEngineExecutor:
                 "paged": self.paged_attention,
                 "live_pages": self._bucket_pages(needed, block_tables.shape[1]),
             }
+            if self.lora_stack is not None:
+                kwargs["lora"] = self.lora_stack
+                kwargs["lora_idx"] = self._put(
+                    (lora_idx if lora_idx is not None
+                     else np.zeros(tokens.shape[0], np.int32)).astype(np.int32))
         toks, self._key, self.pages = self._decode_loop(
             self.params, self.pages, self._put(block_tables.astype(np.int32)),
             self._put(tokens.astype(np.int32)), self._put(pos.astype(np.int32)),

@@ -1,0 +1,229 @@
+"""Multi-LoRA serving: device-resident adapter stacks + per-slot routing.
+
+The reference ships dynamic LoRA adapter loading and multiplexed serving
+(``python/ray/llm/_internal/serve/deployments/llm/multiplex/
+lora_model_loader.py``; ``configs/server_models.py:141,236`` —
+``dynamic_lora_loading_path`` / ``lora_config``) and delegates the
+batched multi-adapter compute to vLLM's SGMV/BGMV CUDA kernels. TPU
+redesign: adapters live in a fixed device-resident STACK
+
+    A[proj]: [L, max_loras, E_in, r]     B[proj]: [L, max_loras, r, E_out]
+
+for the four attention projections (q/k/v/o). A decode batch carries a
+per-slot adapter index; the jitted step gathers each slot's A/B rows and
+adds ``(h @ A) @ B`` to the frozen base projection — one compiled
+program for every adapter mix, XLA tiling the gathered einsums onto the
+MXU (the property vLLM gets from custom CUDA). Index 0 is the identity
+adapter (zeros): requests for the base model ride the same program.
+
+Host side, ``LoRAManager`` is the dynamic loader: adapter_id -> stack
+slot with LRU eviction; loading an adapter writes its (zero-padded to
+``max_rank``) A/B into the stack via one ``jit`` scatter per projection.
+Adapters load from ``.npz`` files (``{wq|wk|wv|wo}.{A|B}`` arrays, rank
+<= max_rank) through ``pyarrow.fs`` so local paths and ``gs://``-style
+URIs both work — the reference's ``dynamic_lora_loading_path``.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PROJS = ("wq", "wk", "wv", "wo")
+
+
+@dataclass(frozen=True)
+class LoRAServingConfig:
+    """Engine-level knob (reference ``LLMConfig.lora_config``)."""
+
+    max_loras: int = 4          # stack slots (excluding the identity slot)
+    max_rank: int = 16
+    dynamic_lora_loading_path: str | None = None  # base URI for adapters
+
+
+def init_lora_stack(config, max_loras: int, max_rank: int) -> dict:
+    """All-zero adapter stacks (slot 0 = identity, never evicted)."""
+    c = config
+    n = max_loras + 1
+    L, E, H, KH, D = (c.n_layers, c.hidden, c.n_heads, c.n_kv_heads,
+                      c.head_dim)
+    dims = {"wq": (E, H * D), "wk": (E, KH * D), "wv": (E, KH * D),
+            "wo": (H * D, E)}
+    stack = {}
+    for p, (ein, eout) in dims.items():
+        stack[f"{p}.A"] = jnp.zeros((L, n, ein, max_rank), c.dtype)
+        stack[f"{p}.B"] = jnp.zeros((L, n, max_rank, eout), c.dtype)
+    return stack
+
+
+def load_adapter_arrays(path: str) -> dict[str, np.ndarray]:
+    """Read ``{proj}.{A|B}`` arrays from an ``.npz`` at a pyarrow.fs URI.
+
+    A[proj]: [L, E_in, r], B[proj]: [L, r, E_out] (r <= max_rank).
+    """
+    import io
+
+    from pyarrow import fs as pafs
+
+    filesystem, fspath = pafs.FileSystem.from_uri(path) if "://" in path \
+        else (pafs.LocalFileSystem(), path)
+    with filesystem.open_input_stream(fspath) as f:
+        data = f.read()
+    npz = np.load(io.BytesIO(data))
+    return {k: npz[k] for k in npz.files}
+
+
+@functools.partial(jax.jit, donate_argnames=("stack",))
+def _install(stack: dict, slot, arrays: dict) -> dict:
+    """Write one adapter's (rank-padded) A/B into stack slot ``slot``."""
+    out = dict(stack)
+    for k, v in arrays.items():
+        out[k] = out[k].at[:, slot].set(v.astype(out[k].dtype))
+    return out
+
+
+def lora_delta(h, A, B, l, idx):
+    """Batched per-slot LoRA delta for one projection at layer ``l``.
+
+    h:   [n, S, E_in] activations.
+    A:   [L, n_slots_stack, E_in, r]; B: [L, n_slots_stack, r, E_out].
+    idx: [n] int32 — each batch row's adapter slot (0 = identity/zeros).
+    Returns [n, S, E_out].
+    """
+    a = A[l, idx]                                  # [n, E_in, r]
+    b = B[l, idx]                                  # [n, r, E_out]
+    return jnp.einsum("nsr,nro->nso", jnp.einsum("nse,ner->nsr", h, a), b)
+
+
+def lora_delta_single(h, A, B, l, idx):
+    """Single-sequence (prefill) variant: h [1, C, E_in], scalar idx."""
+    a = A[l, idx]                                  # [E_in, r]
+    b = B[l, idx]
+    return jnp.einsum("bcr,ro->bco", jnp.einsum("bce,er->bcr", h, a), b)
+
+
+class LoRAManager:
+    """Host-side dynamic adapter registry: id -> stack slot, LRU evicted.
+
+    Slot 0 is the identity adapter (the base model). ``acquire`` returns
+    the slot for an adapter id, loading it into a free/evicted slot on
+    first use (reference ``LoraModelLoader.load_model``; disk->HBM here,
+    no remote download cache needed — pyarrow.fs reads the URI directly).
+    """
+
+    def __init__(self, config, serving: LoRAServingConfig, install_fn):
+        """``install_fn(slot, arrays_dict)`` writes into the device stack
+        (the executor owns the stack arrays; the manager owns naming)."""
+        self._config = config
+        self._serving = serving
+        self._install = install_fn
+        self._lock = threading.Lock()
+        self._slots: dict[str, int] = {}          # adapter_id -> slot
+        self._order: list[str] = []               # LRU, oldest first
+        self._free = list(range(1, serving.max_loras + 1))
+        self._pinned: dict[int, int] = {}         # slot -> active request count
+
+    def resolve_path(self, adapter_id: str) -> str:
+        base = self._serving.dynamic_lora_loading_path
+        if base is None:
+            raise ValueError(
+                "lora_config.dynamic_lora_loading_path is not set; cannot "
+                f"load adapter {adapter_id!r}")
+        return f"{base.rstrip('/')}/{adapter_id}.npz"
+
+    def acquire(self, adapter_id: str | None) -> int:
+        """Slot for this request's adapter (0 = base). Pins the slot for
+        the request's lifetime; pair with ``release``."""
+        if not adapter_id:
+            return 0
+        with self._lock:
+            slot = self._slots.get(adapter_id)
+            if slot is not None:
+                self._order.remove(adapter_id)
+                self._order.append(adapter_id)
+                self._pinned[slot] = self._pinned.get(slot, 0) + 1
+                return slot
+            slot = self._evict_or_free_locked()
+            self._slots[adapter_id] = slot
+            self._order.append(adapter_id)
+            self._pinned[slot] = self._pinned.get(slot, 0) + 1
+        # Load outside the lock (filesystem read + device write).
+        try:
+            arrays = self._pad(load_adapter_arrays(self.resolve_path(adapter_id)))
+            self._install(slot, arrays)
+        except Exception:
+            with self._lock:
+                self._slots.pop(adapter_id, None)
+                if adapter_id in self._order:
+                    self._order.remove(adapter_id)
+                n = self._pinned.get(slot, 1) - 1
+                if n:
+                    self._pinned[slot] = n
+                else:
+                    self._pinned.pop(slot, None)
+                self._free.append(slot)
+            raise
+        return slot
+
+    def release(self, slot: int) -> None:
+        if slot == 0:
+            return
+        with self._lock:
+            n = self._pinned.get(slot, 0) - 1
+            if n > 0:
+                self._pinned[slot] = n
+            else:
+                self._pinned.pop(slot, None)
+
+    def _evict_or_free_locked(self) -> int:
+        if self._free:
+            return self._free.pop()
+        for aid in self._order:                    # oldest first
+            s = self._slots[aid]
+            if s not in self._pinned:
+                self._order.remove(aid)
+                del self._slots[aid]
+                return s                           # stack row overwritten
+        raise RuntimeError(
+            f"all {self._serving.max_loras} LoRA slots pinned by active "
+            "requests; raise lora_config.max_loras")
+
+    def _pad(self, arrays: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+        """Zero-pad rank to max_rank and validate shapes."""
+        c, r_max = self._config, self._serving.max_rank
+        dims = {"wq": (c.hidden, c.n_heads * c.head_dim),
+                "wk": (c.hidden, c.n_kv_heads * c.head_dim),
+                "wv": (c.hidden, c.n_kv_heads * c.head_dim),
+                "wo": (c.n_heads * c.head_dim, c.hidden)}
+        out = {}
+        for p, (ein, eout) in dims.items():
+            a, b = arrays[f"{p}.A"], arrays[f"{p}.B"]
+            if a.shape[0] != c.n_layers or a.shape[1] != ein:
+                raise ValueError(f"{p}.A shape {a.shape} does not match model")
+            r = a.shape[2]
+            if r > r_max:
+                raise ValueError(f"adapter rank {r} > max_rank {r_max}")
+            if b.shape != (c.n_layers, r, eout):
+                raise ValueError(f"{p}.B shape {b.shape} does not match model")
+            out[f"{p}.A"] = np.pad(a, ((0, 0), (0, 0), (0, r_max - r)))
+            out[f"{p}.B"] = np.pad(b, ((0, 0), (0, r_max - r), (0, 0)))
+        return out
+
+
+def save_adapter(path: str, arrays: dict[str, np.ndarray]) -> None:
+    """Write an adapter ``.npz`` (test/tooling helper)."""
+    import io
+
+    from pyarrow import fs as pafs
+
+    filesystem, fspath = pafs.FileSystem.from_uri(path) if "://" in path \
+        else (pafs.LocalFileSystem(), path)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    with filesystem.open_output_stream(fspath) as f:
+        f.write(buf.getvalue())

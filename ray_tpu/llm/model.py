@@ -93,7 +93,7 @@ def _gather_ctx(pool, l, tables):
                    donate_argnames=("pages",))
 def prefill_chunk(params, pages: dict, block_table, tokens, start_pos,
                   config: LlamaConfig, page_size: int,
-                  live_pages: int | None = None):
+                  live_pages: int | None = None, lora=None, lora_slot=None):
     """Process one page-aligned prompt chunk.
 
     tokens:      [C] int32, C a multiple of ``page_size`` (static bucket).
@@ -130,6 +130,20 @@ def prefill_chunk(params, pages: dict, block_table, tokens, start_pos,
         layer, l = xs
         h = rms_norm(x, layer["attn_norm"], eps=c.norm_eps)
         q, k, v = _project_qkv(h, layer)                # [1, H|KH, C, D]
+        if lora is not None:
+            # Prompt K/V must carry the adapter too (one adapter per
+            # sequence — chunked prefill is single-sequence).
+            from .lora import lora_delta_single
+
+            def add(t, p, heads):
+                d = lora_delta_single(h, lora[f"{p}.A"], lora[f"{p}.B"],
+                                      l, lora_slot)
+                return t + jnp.swapaxes(
+                    d.reshape(1, C, heads, c.head_dim), 1, 2).astype(t.dtype)
+
+            q = add(q, "wq", c.n_heads)
+            k = add(k, "wk", c.n_kv_heads)
+            v = add(v, "wv", c.n_kv_heads)
         q = apply_rope(q, positions, theta=c.rope_theta)
         k = apply_rope(k, positions, theta=c.rope_theta)
         ck = _gather_ctx(kf, l, gather_table)           # [KH, ctx, D]
@@ -147,6 +161,12 @@ def prefill_chunk(params, pages: dict, block_table, tokens, start_pos,
             "kgct,ktd->kgcd", p_self, v[0])
         attn = attn.reshape(1, c.n_heads, C, c.head_dim)
         out = jnp.einsum("bhsd,hde->bse", attn, layer["wo"])
+        if lora is not None:
+            from .lora import lora_delta_single
+
+            flat = jnp.swapaxes(attn, 1, 2).reshape(1, C, -1)
+            out = out + lora_delta_single(
+                flat, lora["wo.A"], lora["wo.B"], l, lora_slot).astype(out.dtype)
         x2 = _mlp(x + out, layer, c)
         # Scatter the chunk's K/V into its pages: [KH, C, D] ->
         # [n_pages, KH, page, D] at distinct page ids (no conflicts).
@@ -167,7 +187,7 @@ def prefill_chunk(params, pages: dict, block_table, tokens, start_pos,
 
 def decode_block(x, layer, kf, vf, l, block_tables, pos, write_idx,
                  c: LlamaConfig, page_size: int, paged: bool = False,
-                 live_pages: int | None = None):
+                 live_pages: int | None = None, lora=None, lora_idx=None):
     """One decoder block for a [n, 1, E] single-token batch against the
     FULL page pool (kf/vf: [L, P, KH, page, D]; ``l`` is this layer's
     index into it — traced, so the pool is only touched at gather/scatter
@@ -185,6 +205,21 @@ def decode_block(x, layer, kf, vf, l, block_tables, pos, write_idx,
     offset = pos % page_size
     h = rms_norm(x, layer["attn_norm"], eps=c.norm_eps)
     q, k, v = _project_qkv(h, layer)                   # [n, H|KH, 1, D]
+    if lora is not None:
+        # Per-slot LoRA deltas on the attention projections (pre-rope):
+        # each batch row gathers its adapter's A/B from the device stack
+        # — batched multi-adapter decode in one compiled program (the
+        # capability the reference buys from vLLM's SGMV kernels).
+        from .lora import lora_delta
+
+        def add(t, p, heads):
+            d = lora_delta(h, lora[f"{p}.A"], lora[f"{p}.B"], l, lora_idx)
+            return t + jnp.swapaxes(
+                d.reshape(n, 1, heads, c.head_dim), 1, 2).astype(t.dtype)
+
+        q = add(q, "wq", c.n_heads)
+        k = add(k, "wk", c.n_kv_heads)
+        v = add(v, "wv", c.n_kv_heads)
     q = apply_rope(q, pos[:, None], theta=c.rope_theta)
     k = apply_rope(k, pos[:, None], theta=c.rope_theta)
     qg = q[:, :, 0].reshape(n, kh, g, c.head_dim)
@@ -219,12 +254,18 @@ def decode_block(x, layer, kf, vf, l, block_tables, pos, write_idx,
             n, 1, c.n_heads * c.head_dim)
     out = jnp.einsum("bsf,fe->bse", attn,
                      layer["wo"].reshape(c.n_heads * c.head_dim, c.hidden))
+    if lora is not None:
+        from .lora import lora_delta
+
+        out = out + lora_delta(attn, lora["wo.A"], lora["wo.B"],
+                               l, lora_idx).astype(out.dtype)
     return _mlp(x + out, layer, c), kf, vf
 
 
 def _decode_logits(params, pages: dict, block_tables, tokens, pos,
                    config: LlamaConfig, page_size: int, write_page_idx=None,
-                   paged: bool = False, live_pages: int | None = None):
+                   paged: bool = False, live_pages: int | None = None,
+                   lora=None, lora_idx=None):
     """One batched decode step over all slots.
 
     block_tables: [slots, max_pages_per_seq] int32 (inactive slots must
@@ -248,7 +289,7 @@ def _decode_logits(params, pages: dict, block_tables, tokens, pos,
         layer, l = xs
         x2, kf, vf = decode_block(
             x, layer, kf, vf, l, block_tables, pos, page_idx, c, page_size,
-            paged=paged, live_pages=live_pages)
+            paged=paged, live_pages=live_pages, lora=lora, lora_idx=lora_idx)
         return (x2, kf, vf), None
 
     (x, new_k, new_v), _ = lax.scan(
@@ -270,7 +311,7 @@ decode_step = functools.partial(
     donate_argnames=("pages",))
 def decode_and_sample(params, pages: dict, block_tables, tokens, pos, temps, key,
                       config: LlamaConfig, page_size: int, paged: bool = False,
-                      live_pages: int | None = None):
+                      live_pages: int | None = None, lora=None, lora_idx=None):
     """``decode_step`` + on-device sampling in ONE compiled program.
 
     The engine drives the chip through a (possibly remote) dispatch
@@ -282,7 +323,8 @@ def decode_and_sample(params, pages: dict, block_tables, tokens, pos, temps, key
     """
     logits, new_pages = _decode_logits(params, pages, block_tables, tokens, pos,
                                        config, page_size, paged=paged,
-                                       live_pages=live_pages)
+                                       live_pages=live_pages, lora=lora,
+                                       lora_idx=lora_idx)
     key, sub = jax.random.split(key)
     greedy = jnp.argmax(logits, axis=-1)
     sampled = jax.random.categorical(sub, logits / jnp.maximum(temps, 1e-6)[:, None])
@@ -322,7 +364,8 @@ def sample_first_batch(hiddens, lm_head, temps, key):
     donate_argnames=("pages",))
 def decode_loop(params, pages: dict, block_tables, tokens, pos, temps, eos_ids,
                 remaining, key, config: LlamaConfig, page_size: int, n_steps: int,
-                paged: bool = False, live_pages: int | None = None):
+                paged: bool = False, live_pages: int | None = None,
+                lora=None, lora_idx=None):
     """``n_steps`` decode+sample iterations in ONE dispatch (on-device
     ``lax.scan`` generate loop, JetStream-style).
 
@@ -354,7 +397,8 @@ def decode_loop(params, pages: dict, block_tables, tokens, pos, temps, eos_ids,
         write_idx = jnp.where(done, trash, real_page)
         logits, pages = _decode_logits(params, pages, block_tables, tokens, pos,
                                        config, page_size, write_page_idx=write_idx,
-                                       paged=paged, live_pages=live_pages)
+                                       paged=paged, live_pages=live_pages,
+                                       lora=lora, lora_idx=lora_idx)
         key, sub = jax.random.split(key)
         greedy = jnp.argmax(logits, axis=-1)
         sampled = jax.random.categorical(sub, logits / jnp.maximum(temps, 1e-6)[:, None])

@@ -45,6 +45,7 @@ class LLMDeployment:
         topology: str | None = None,
         seed: int = 0,
         request_timeout_s: float = 300.0,
+        lora_config: dict | None = None,
     ):
         mesh = None
         executor = None
@@ -81,11 +82,21 @@ class LLMDeployment:
             mesh = create_mesh(MeshConfig(
                 tp=tensor_parallel, pp=pipeline_parallel,
                 dp=max(1, n // (tensor_parallel * pipeline_parallel))))
+        lora = None
+        if lora_config is not None:
+            # Reference: LLMConfig.lora_config + dynamic_lora_loading_path
+            # (configs/server_models.py:141,236). Requests whose `model`
+            # differs from the base model_id load that adapter from
+            # `<dynamic_lora_loading_path>/<model>.npz` into the device
+            # stack and decode with it (multi-adapter batching).
+            from .lora import LoRAServingConfig
+
+            lora = LoRAServingConfig(**lora_config)
         self.engine = InferenceEngine(
             preset, max_slots=max_slots, max_len=max_len, page_size=page_size,
             prefill_chunk_size=prefill_chunk_size,
             decode_steps_per_dispatch=decode_steps_per_dispatch, mesh=mesh,
-            executor=executor, seed=seed,
+            executor=executor, seed=seed, lora_config=lora,
         )
         self.model_id = model_id or (preset if isinstance(preset, str) else "custom")
         self.tokenizer = ByteTokenizer()
@@ -140,15 +151,23 @@ class LLMDeployment:
             self._counter += 1
             return f"req-{self._counter}-{uuid.uuid4().hex[:8]}"
 
+    def _adapter_for(self, model: str | None) -> str | None:
+        """OpenAI `model` field -> adapter id (None = base model)."""
+        if not model or model == self.model_id:
+            return None
+        return model
+
     # ------------------------------------------------------ blocking path
     def generate(self, prompt: str, max_new_tokens: int = 16,
-                 temperature: float = 0.0) -> dict:
+                 temperature: float = 0.0, model: str | None = None) -> dict:
         """Blocking completion; many calls run concurrently on replica
-        threads and share the engine's decode batch."""
+        threads and share the engine's decode batch. ``model`` other than
+        the base model id selects a LoRA adapter."""
         ids = self.tokenizer.encode(prompt)
         rid = self._next_rid()
         req = Request(rid, ids, max_new_tokens, temperature,
-                      eos_id=self.tokenizer.eos_id)
+                      eos_id=self.tokenizer.eos_id,
+                      model=self._adapter_for(model))
         done = threading.Event()
         self._events[rid] = done  # before add: the engine may finish fast
         try:
@@ -212,7 +231,8 @@ class LLMDeployment:
         cid = f"cmpl-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
         if not body.get("stream"):
-            out = self.generate(prompt, max_tokens, temperature)
+            out = self.generate(prompt, max_tokens, temperature,
+                                model=body.get("model"))
             return {
                 "id": cid, "object": "text_completion", "created": created,
                 "model": body.get("model", self.model_id),
@@ -238,7 +258,8 @@ class LLMDeployment:
         if not body.get("stream"):
             out = self.generate(
                 prompt, int(body.get("max_tokens", 16)),
-                float(body.get("temperature", 0.0)))
+                float(body.get("temperature", 0.0)),
+                model=body.get("model"))
             return {
                 "id": cid, "object": "chat.completion", "created": created,
                 "model": body.get("model", self.model_id),
@@ -266,7 +287,9 @@ class LLMDeployment:
         obj = "chat.completion.chunk" if chat else "text_completion"
         ids = self.tokenizer.encode(prompt)
         rid = self._next_rid()
-        req = Request(rid, ids, max_tokens, temperature, eos_id=self.tokenizer.eos_id)
+        req = Request(rid, ids, max_tokens, temperature,
+                      eos_id=self.tokenizer.eos_id,
+                      model=self._adapter_for(body.get("model")))
 
         def gen():
             yield {"__serve_response__": True, "content_type": "text/event-stream"}

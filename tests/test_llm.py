@@ -15,6 +15,7 @@ import urllib.request
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from ray_tpu.llm.engine import InferenceEngine, Request
@@ -397,3 +398,133 @@ def test_paged_attention_engine_greedy_parity(small_model):
         return [r.generated for r in reqs]
 
     assert run("paged") == run("dense")
+
+
+# ------------------------------------------------------------------- LoRA
+
+def _make_adapter(cfg, rng, scale=0.5):
+    """Random rank-2 adapter arrays for every attention projection."""
+    L, E, H, KH, D = (cfg.n_layers, cfg.hidden, cfg.n_heads,
+                      cfg.n_kv_heads, cfg.head_dim)
+    r = 2
+    dims = {"wq": (E, H * D), "wk": (E, KH * D), "wv": (E, KH * D),
+            "wo": (H * D, E)}
+    out = {}
+    for p, (ein, eout) in dims.items():
+        out[f"{p}.A"] = (rng.standard_normal((L, ein, r)) * scale / ein ** 0.5
+                         ).astype(np.float32)
+        out[f"{p}.B"] = (rng.standard_normal((L, r, eout)) * scale
+                         ).astype(np.float32)
+    return out
+
+
+def _merge_adapter(cfg, params, arrays):
+    """Base params with the adapter folded in (ground truth)."""
+    import jax.numpy as jnp
+
+    L, E, H, KH, D = (cfg.n_layers, cfg.hidden, cfg.n_heads,
+                      cfg.n_kv_heads, cfg.head_dim)
+    layers = dict(params["layers"])
+    for p, heads in (("wq", H), ("wk", KH), ("wv", KH)):
+        delta = np.einsum("ler,lro->leo", arrays[f"{p}.A"], arrays[f"{p}.B"])
+        layers[p] = layers[p] + jnp.asarray(
+            delta.reshape(L, E, heads, D), layers[p].dtype)
+    delta_o = np.einsum("lfr,lre->lfe", arrays["wo.A"], arrays["wo.B"])
+    layers["wo"] = layers["wo"] + jnp.asarray(
+        delta_o.reshape(L, H, D, E), layers["wo"].dtype)
+    return {**params, "layers": layers}
+
+
+def test_lora_mixed_batch_matches_merged_weights(small_model, tmp_path):
+    """Multi-LoRA serving: a decode batch mixing the base model and two
+    adapters must produce, per request, exactly the tokens of an engine
+    whose weights have that adapter merged in (greedy). This is the
+    capability the reference gets from vLLM's multi-LoRA kernels
+    (lora_model_loader.py + per-request `model` routing)."""
+    from ray_tpu.llm.lora import LoRAServingConfig, save_adapter
+
+    cfg, params = small_model
+    rng = np.random.default_rng(7)
+    ad1 = _make_adapter(cfg, rng)
+    ad2 = _make_adapter(cfg, rng)
+    save_adapter(str(tmp_path / "ad1.npz"), ad1)
+    save_adapter(str(tmp_path / "ad2.npz"), ad2)
+
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+
+    def run_engine(params_, model=None, lora=None):
+        eng = InferenceEngine(cfg, params_, max_slots=4, max_len=64,
+                              lora_config=lora)
+        reqs = [Request(f"r{i}", prompt, max_new_tokens=6, model=m)
+                for i, m in enumerate([model] if lora is None
+                                      else [None, "ad1", "ad2"])]
+        for r in reqs:
+            eng.add_request(r)
+        while any(not r.done for r in reqs):
+            eng.step()
+        return [r.generated for r in reqs]
+
+    lora = LoRAServingConfig(max_loras=2, max_rank=4,
+                             dynamic_lora_loading_path=str(tmp_path))
+    base_toks, ad1_toks, ad2_toks = run_engine(params, lora=lora)
+
+    assert base_toks == run_engine(params)[0], "identity slot changed base"
+    assert ad1_toks == run_engine(_merge_adapter(cfg, params, ad1))[0]
+    assert ad2_toks == run_engine(_merge_adapter(cfg, params, ad2))[0]
+    assert ad1_toks != ad2_toks  # the adapters actually do something
+
+
+def test_lora_lru_eviction_and_prefix_isolation(small_model, tmp_path):
+    from ray_tpu.llm.lora import LoRAServingConfig, save_adapter
+
+    cfg, params = small_model
+    rng = np.random.default_rng(11)
+    save_adapter(str(tmp_path / "a.npz"), _make_adapter(cfg, rng))
+    save_adapter(str(tmp_path / "b.npz"), _make_adapter(cfg, rng))
+    eng = InferenceEngine(
+        cfg, params, max_slots=2, max_len=64,
+        lora_config=LoRAServingConfig(max_loras=1, max_rank=4,
+                                      dynamic_lora_loading_path=str(tmp_path)))
+    prompt = list(range(1, 9))
+
+    def run(model):
+        r = Request(f"r-{model}-{np.random.randint(1e9)}", prompt,
+                    max_new_tokens=4, model=model)
+        eng.add_request(r)
+        while not r.done:
+            eng.step()
+        return r.generated
+
+    a1 = run("a")
+    b1 = run("b")   # evicts a (max_loras=1)
+    a2 = run("a")   # reloads a
+    base = run(None)
+    assert a1 == a2, "adapter a changed across LRU reload"
+    assert a1 != b1 and a1 != base
+    # prefix cache must be adapter-scoped: same prompt, different model,
+    # yet outputs stayed adapter-faithful above (a2 == a1 after b ran
+    # with the identical prompt proves no cross-adapter KV reuse).
+    assert eng.metrics["prefix_hit_pages"] >= 0
+
+
+def test_lora_openai_route(small_model, tmp_path):
+    """`model` field on /v1/completions selects the adapter (reference
+    LLMRouter + multiplex routing), no cluster needed."""
+    from ray_tpu.llm.lora import save_adapter
+    from ray_tpu.llm.serving import LLMDeployment
+
+    cfg, params = small_model
+    rng = np.random.default_rng(3)
+    save_adapter(str(tmp_path / "tone.npz"), _make_adapter(cfg, rng))
+    dep = LLMDeployment(
+        "debug-128", max_slots=2, max_len=64,
+        lora_config={"max_loras": 2, "max_rank": 4,
+                     "dynamic_lora_loading_path": str(tmp_path)})
+    try:
+        base = dep.completions({"prompt": "hi", "max_tokens": 4})
+        assert base["choices"][0]["finish_reason"] in ("length", "stop")
+        tuned = dep.completions({"prompt": "hi", "max_tokens": 4,
+                                 "model": "tone"})
+        assert tuned["model"] == "tone"
+    finally:
+        dep.close()
