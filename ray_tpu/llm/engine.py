@@ -407,20 +407,43 @@ class InferenceEngine:
 
     def _prefill_chunk_one(self, r: Request) -> list[dict]:
         remaining = len(r.prompt) - r.prefill_pos
-        # Bucket, clamped so the chunk's pages never run past the table
-        # (both operands are page-aligned).
-        chunk = min(self._chunk_bucket(remaining), self.max_len - r.prefill_pos)
-        tokens = np.zeros(chunk, np.int32)
-        take = min(remaining, chunk)
-        tokens[:take] = r.prompt[r.prefill_pos:r.prefill_pos + take]
         bt = np.full(self.max_pages_per_seq, r.slot, np.int32)  # trash-pad
         bt[:len(r.block_table)] = r.block_table
-        final = r.prefill_pos + take >= len(r.prompt)
-        handle = next(self._handle_counter) if final else None
-        self.executor.prefill(bt, tokens, r.prefill_pos, handle, take,
-                              lora_slot=r.lora_slot)
-        self.metrics["prefill_chunks"] += 1
-        r.prefill_pos += take
+        # Chunk-pipelined prefill: an executor that can pipeline (pp
+        # stages) takes up to `depth` consecutive FULL-size chunks of
+        # this prompt in ONE dispatch — the single-chunk schedule leaves
+        # (pp-1)/pp of prefill compute idle.
+        depth = getattr(self.executor, "pipelined_prefill_depth", 1)
+        full = self.prefill_chunk_size
+        m = min(depth, remaining // full,
+                (self.max_len - r.prefill_pos) // full)
+        # power-of-two wavefront lengths: O(log depth) compiled variants
+        while m & (m - 1):
+            m &= m - 1
+        if m >= 2 and not r.lora_slot:
+            take = m * full
+            tokens_m = np.asarray(
+                r.prompt[r.prefill_pos:r.prefill_pos + take],
+                np.int32).reshape(m, full)
+            final = r.prefill_pos + take >= len(r.prompt)
+            handle = next(self._handle_counter) if final else None
+            self.executor.prefill_many(bt, tokens_m, r.prefill_pos, handle, full)
+            self.metrics["prefill_chunks"] += m
+            r.prefill_pos += take
+        else:
+            # Bucket, clamped so the chunk's pages never run past the
+            # table (both operands are page-aligned).
+            chunk = min(self._chunk_bucket(remaining),
+                        self.max_len - r.prefill_pos)
+            tokens = np.zeros(chunk, np.int32)
+            take = min(remaining, chunk)
+            tokens[:take] = r.prompt[r.prefill_pos:r.prefill_pos + take]
+            final = r.prefill_pos + take >= len(r.prompt)
+            handle = next(self._handle_counter) if final else None
+            self.executor.prefill(bt, tokens, r.prefill_pos, handle, take,
+                                  lora_slot=r.lora_slot)
+            self.metrics["prefill_chunks"] += 1
+            r.prefill_pos += take
         if not final:
             return []  # more chunks to go
         # Prompt complete: queue the last real position's hidden state

@@ -54,9 +54,10 @@ class EngineShardWorker:
 
         n = len(jax.devices())
         pp = pp or 1
-        # pure PP requires tp=1 (executor constraint); extra devices go to
-        # dp. Pure TP (pp=1) defaults to tp over every device.
-        tp = tp or (1 if pp > 1 else n)
+        # tp composes inside pp stages (partial-manual shard_map in
+        # pp_model.py); with pp given, default tp fills the remaining
+        # devices. Pure TP (pp=1) defaults to tp over every device.
+        tp = tp or max(1, n // pp)
         mesh = create_mesh(MeshConfig(tp=tp, pp=pp, dp=max(1, n // (tp * pp))))
         self.executor = LocalEngineExecutor(
             config, max_slots=max_slots, num_pages=num_pages,
@@ -112,7 +113,10 @@ class ShardedEngineExecutor:
         refs = [getattr(s, method).remote(*args) for s in self.shards]
         return ray.get(refs, timeout=timeout)
 
-    def prefill(self, block_table, tokens, start_pos, handle, take) -> None:
+    def prefill(self, block_table, tokens, start_pos, handle, take,
+                lora_slot: int = 0) -> None:
+        # lora is single-device-executor only; the engine never routes
+        # adapter requests here (admission fails them without a manager)
         self._dispatch("prefill", block_table, tokens, start_pos, handle, take)
 
     def drop_handle(self, handle) -> None:
@@ -122,7 +126,7 @@ class ShardedEngineExecutor:
         return self._all("sample_first", list(handles), temps)[0]
 
     def decode(self, block_tables, tokens, pos, temps, eos_ids, remaining,
-               n_steps) -> np.ndarray:
+               n_steps, lora_idx=None) -> np.ndarray:
         return self._all(
             "decode", block_tables, tokens, pos, temps, eos_ids, remaining,
             n_steps)[0]

@@ -28,8 +28,10 @@ activation, so every stage sees the group's current round state without
 host synchronization, and finished slots redirect their KV writes to
 their private trash page exactly as the unpipelined ``decode_loop`` does.
 
-Constraint this round: ``tp`` must be 1 when ``pp > 1`` (pure pipeline;
-composing tp inside pp stages needs shard_map's partial-auto mode).
+TP composes inside the stages: the shard_map is manual over ``pp``
+only (``axis_names={"pp"}``) — ``tp`` remains an auto axis that XLA
+partitions from the params'/pool's shardings, inserting the ICI
+collectives per stage.
 """
 
 from __future__ import annotations
@@ -130,10 +132,14 @@ def pp_prefill_chunk(params, pages, block_table, tokens, start_pos,
         return {"k": kp, "v": vp}, hidden
 
     layer_spec = jax.tree.map(lambda _: P("pp"), params["layers"])
+    # Manual over pp ONLY: tp stays an auto axis, so XLA partitions the
+    # per-stage math from the params' tp shardings (TP inside PP stages
+    # — the composition the reference gets from vLLM, vllm_models.py:117).
     fn = jax.shard_map(
         per_device, mesh=mesh,
         in_specs=(layer_spec, P("pp"), P("pp"), P(), P(), P(), P(), P()),
         out_specs=({"k": P("pp"), "v": P("pp")}, P()),
+        axis_names=frozenset({"pp"}),
         check_vma=False,
     )
     return fn(params["layers"], pages["k"], pages["v"], params["embed"],
@@ -256,8 +262,118 @@ def pp_decode_loop(params, pages, block_tables, tokens, pos, temps, eos_ids,
         in_specs=(layer_spec, P("pp"), P("pp"), P(), P(), P(),
                   P(), P(), P(), P(), P(), P(), P()),
         out_specs=(P(), P(), {"k": P("pp"), "v": P("pp")}),
+        axis_names=frozenset({"pp"}),
         check_vma=False,
     )
     return fn(params["layers"], pages["k"], pages["v"], params["embed"],
               params["final_norm"], params["lm_head"],
               bt_g, tok_g, pos_g, temp_g, eos_g, rem_g, key)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "page_size", "mesh"),
+                   donate_argnames=("pages",))
+def pp_prefill_chunks(params, pages, block_table, tokens_m, start_pos0,
+                      config: LlamaConfig, page_size: int, mesh):
+    """CHUNK-PIPELINED prefill: ``m`` consecutive same-size chunks of ONE
+    sequence flow through the stages like a wavefront — chunk ``j`` runs
+    on stage ``s`` at tick ``t = j + s``, so after a (pp-1)-tick warmup
+    every stage computes every tick. The single-chunk schedule
+    (``pp_prefill_chunk``) keeps (pp-1)/pp of prefill idle; this one
+    approaches full utilization for long prompts (m >= pp). Chunk j+1's
+    attention at stage s needs chunk j's stage-s K/V, which stage s wrote
+    one tick earlier — the dependency is satisfied by construction.
+
+    tokens_m:   [m, C] int32 — consecutive chunks (C a page multiple).
+    start_pos0: scalar int32 — chunk j starts at ``start_pos0 + j*C``.
+    Returns (pages, hidden [m, C, E]).
+    """
+    c = config
+    pp = mesh.shape["pp"]
+    m, C = tokens_m.shape
+    n_chunk_pages = C // page_size
+    max_ctx = block_table.shape[0] * page_size
+    kh, g = c.n_kv_heads, c.n_heads // c.n_kv_heads
+    causal = jnp.arange(C)[:, None] >= jnp.arange(C)[None, :]
+    T = m + pp - 1
+
+    def per_device(layers_local, kp, vp, embed, final_norm,
+                   block_table, tokens_m, start_pos0):
+        stage = lax.axis_index("pp")
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def tick(carry, t):
+            act, hiddens, kp, vp = carry
+            j = t - stage
+            valid = (j >= 0) & (j < m)
+            jc = jnp.clip(j, 0, m - 1)
+            start_j = start_pos0 + jc * C
+            positions = start_j + jnp.arange(C, dtype=jnp.int32)
+            ctx_live = jnp.arange(max_ctx, dtype=jnp.int32) < start_j
+            first = start_j // page_size
+            write_ids = lax.dynamic_slice(block_table, (first,), (n_chunk_pages,))
+            # stage 0 injects chunk t's embedding at its entry tick
+            x0 = embed[tokens_m[jnp.clip(t, 0, m - 1)]][None].astype(c.dtype)
+            x = jnp.where((stage == 0) & (t < m), x0, act)
+
+            def body(carry, xs):
+                xc, kp, vp = carry
+                layer, l = xs
+                h = rms_norm(xc, layer["attn_norm"], eps=c.norm_eps)
+                q, k, v = _project_qkv(h, layer)
+                q = apply_rope(q, positions, theta=c.rope_theta)
+                k = apply_rope(k, positions, theta=c.rope_theta)
+                ck = _gather_ctx(kp, l, block_table)
+                cv = _gather_ctx(vp, l, block_table)
+                qg = q[0].reshape(kh, g, C, c.head_dim)
+                scale = c.head_dim ** -0.5
+                s_ctx = jnp.einsum("kgcd,ktd->kgct", qg, ck).astype(jnp.float32)
+                s_self = jnp.einsum("kgcd,ktd->kgct", qg, k[0]).astype(jnp.float32)
+                s_ctx = jnp.where(ctx_live[None, None, None], s_ctx * scale, -jnp.inf)
+                s_self = jnp.where(causal[None, None], s_self * scale, -jnp.inf)
+                probs = jax.nn.softmax(
+                    jnp.concatenate([s_ctx, s_self], axis=-1), axis=-1)
+                p_ctx = probs[..., :max_ctx].astype(c.dtype)
+                p_self = probs[..., max_ctx:].astype(c.dtype)
+                attn = jnp.einsum("kgct,ktd->kgcd", p_ctx, cv) + jnp.einsum(
+                    "kgct,ktd->kgcd", p_self, v[0])
+                attn = attn.reshape(1, c.n_heads, C, c.head_dim)
+                out = jnp.einsum("bhsd,hde->bse", attn, layer["wo"])
+                x2 = _mlp(xc + out, layer, c)
+                k_new = jnp.swapaxes(
+                    k[0].reshape(kh, n_chunk_pages, page_size, c.head_dim), 0, 1)
+                v_new = jnp.swapaxes(
+                    v[0].reshape(kh, n_chunk_pages, page_size, c.head_dim), 0, 1)
+                kp = kp.at[l, write_ids].set(
+                    jnp.where(valid, k_new, kp[l, write_ids]))
+                vp = vp.at[l, write_ids].set(
+                    jnp.where(valid, v_new, vp[l, write_ids]))
+                return (x2, kp, vp), None
+
+            (x, kp, vp), _ = lax.scan(
+                body, (x, kp, vp), (layers_local, jnp.arange(kp.shape[0])))
+            h = rms_norm(x, final_norm, eps=c.norm_eps)[0]   # [C, E]
+            hiddens = jnp.where(
+                valid & (stage == pp - 1),
+                hiddens.at[jc].set(h), hiddens)
+            act = lax.ppermute(x, "pp", perm=perm)
+            return (act, hiddens, kp, vp), None
+
+        hiddens0 = jnp.zeros((m, C, c.hidden), c.dtype)
+        act0 = jnp.zeros((1, C, c.hidden), c.dtype)
+        (_, hiddens, kp, vp), _ = lax.scan(
+            tick, (act0, hiddens0, kp, vp), jnp.arange(T))
+        hiddens = lax.psum(
+            jnp.where(stage == pp - 1, hiddens, jnp.zeros_like(hiddens)), "pp")
+        return {"k": kp, "v": vp}, hiddens
+
+    layer_spec = jax.tree.map(lambda _: P("pp"), params["layers"])
+    fn = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(layer_spec, P("pp"), P("pp"), P(), P(), P(), P(), P()),
+        out_specs=({"k": P("pp"), "v": P("pp")}, P()),
+        axis_names=frozenset({"pp"}),
+        check_vma=False,
+    )
+    return fn(params["layers"], pages["k"], pages["v"], params["embed"],
+              params["final_norm"], block_table, tokens_m, start_pos0)
