@@ -465,25 +465,35 @@ class CoreWorker:
         with self._counter_lock:
             self._put_counter += 1
             oid = ObjectID.for_put(self.current_task_id, self._put_counter)
-        metadata, blob, contained = serialization.serialize(value)
-        self._store_owned_value(oid, metadata, blob, contained)
+        s = serialization.serialize_value(value)
+        self._store_owned_value(oid, s.metadata, s, s.contained)
         return ObjectRef(oid, self.address)
 
-    def _store_owned_value(self, oid: ObjectID, metadata: bytes, blob: bytes, contained: list) -> None:
+    def _store_owned_value(self, oid: ObjectID, metadata: bytes, blob, contained: list) -> None:
         cfg = get_config()
         contained_ids = [r.id() for r in contained]
         self.refcounter.add_owned_object(oid, contained_ids)
-        if len(blob) <= cfg.max_inline_object_size:
+        nbytes = blob.nbytes if isinstance(blob, serialization.Serialized) else len(blob)
+        if nbytes <= cfg.max_inline_object_size:
+            if isinstance(blob, serialization.Serialized):
+                blob = blob.to_blob()
             self.memory_store.put(oid, metadata, blob)
         else:
             self._plasma_put(oid, metadata, blob)
             self.memory_store.put_plasma_marker(oid, self.node_id.encode())
             self.refcounter.add_location(oid, self.node_id)
 
-    def _plasma_put(self, oid: ObjectID, metadata: bytes, blob: bytes) -> None:
+    def _plasma_put(self, oid: ObjectID, metadata: bytes, blob) -> None:
+        """``blob`` may be bytes OR a ``serialization.Serialized`` — the
+        latter frames its buffers DIRECTLY into the mmapped arena (the
+        plasma-client zero-copy create path, reference ``plasma/store.h``
+        client mmap + ``fling.cc`` fd passing): one copy end to end
+        instead of pickle-concat + frame + mmap write."""
+        parts = isinstance(blob, serialization.Serialized)
+        data_size = blob.nbytes if parts else len(blob)
         reply = self._raylet_call(
             "PlasmaCreate",
-            {"id": oid.binary(), "data_size": len(blob), "meta_size": len(metadata),
+            {"id": oid.binary(), "data_size": data_size, "meta_size": len(metadata),
              "creator": self.worker_id},
         )
         if reply.get("exists"):
@@ -493,9 +503,12 @@ class CoreWorker:
 
             raise ObjectStoreFullError(reply.get("detail", "object store full"))
         offset = reply["offset"]
-        self.shm.write(offset, blob)
+        if parts:
+            blob.write_into(self.shm.read(offset, data_size))
+        else:
+            self.shm.write(offset, blob)
         if metadata:
-            self.shm.write(offset + len(blob), metadata)
+            self.shm.write(offset + data_size, metadata)
         self._raylet_call("PlasmaSeal", {"id": oid.binary()})
 
     # ------------------------------------------------------------------- get
@@ -1879,13 +1892,14 @@ class CoreWorker:
         """Serialize one task return: inline entry for small values, shm
         store + plasma marker for large ones."""
         cfg = get_config()
-        metadata, blob, contained = serialization.serialize(value)
-        wire_contained = self._hold_returned_refs(contained)
-        if len(blob) <= cfg.max_inline_object_size:
-            entry = {"t": "v", "meta": metadata, "blob": blob}
+        s = serialization.serialize_value(value)
+        metadata = s.metadata
+        wire_contained = self._hold_returned_refs(s.contained)
+        if s.nbytes <= cfg.max_inline_object_size:
+            entry = {"t": "v", "meta": metadata, "blob": s.to_blob()}
         else:
             rid = ObjectID.for_task_return(task_id, index + 1)
-            self._plasma_put(rid, metadata, blob)
+            self._plasma_put(rid, metadata, s)
             entry = {"t": "p", "node_id": self.node_id}
         if wire_contained:
             entry["contained"] = wire_contained

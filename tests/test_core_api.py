@@ -215,8 +215,9 @@ def test_cancel_queued_running_and_force(ray_cluster):
 
     # hold EVERY cpu; wait until all blockers are confirmed running
     blockers = [blocker.remote(gate) for _ in range(n_cpus)]
-    deadline = time.time() + 60
-    while ray_tpu.get(gate.count.remote(), timeout=60) < n_cpus:
+    # generous: worker cold-start under full-suite load on 1 core
+    deadline = time.time() + 120
+    while ray_tpu.get(gate.count.remote(), timeout=120) < n_cpus:
         assert time.time() < deadline, "blockers never started"
         time.sleep(0.05)
     queued = never.remote()   # no CPU free: must queue
