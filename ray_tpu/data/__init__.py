@@ -13,12 +13,14 @@ from .dataset import (
     GroupedData,
     MaterializedDataset,
     from_arrow,
+    from_huggingface,
     from_items,
     from_numpy,
     from_pandas,
     range,  # noqa: A004
     read_binary_files,
     read_images,
+    read_tfrecords,
     read_csv,
     read_json,
     read_numpy,
@@ -37,12 +39,14 @@ __all__ = [
     "GroupedData",
     "MaterializedDataset",
     "from_arrow",
+    "from_huggingface",
     "from_items",
     "from_numpy",
     "from_pandas",
     "range",
     "read_binary_files",
     "read_images",
+    "read_tfrecords",
     "read_csv",
     "read_json",
     "read_numpy",

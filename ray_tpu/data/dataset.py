@@ -134,6 +134,9 @@ class Dataset:
     # ------------------------------------------------------------ execution
     def iter_internal_ref_bundles(self) -> Iterator:
         executor = StreamingExecutor(plan(self._last_op))
+        # retained so stats() can report the LAST execution's per-op
+        # breakdown (reference data/_internal/stats.py)
+        self._last_exec_ops = executor._ops
         return executor.run()
 
     def _iter_blocks(self) -> Iterator:
@@ -182,8 +185,27 @@ class Dataset:
         return concat_blocks(list(self._iter_blocks())).to_pandas()
 
     def stats(self) -> str:
-        mat = self.materialize()
-        return f"Dataset: {len(mat._refs)} blocks"
+        """Per-operator execution summary of the most recent run
+        (reference ``data/_internal/stats.py`` — surfaced on the dataset
+        after iteration). Executes the pipeline if it never ran."""
+        if getattr(self, "_last_exec_ops", None) is None:
+            n = len(self.materialize()._refs)
+        else:
+            n = None
+        lines = ["Dataset execution stats:"]
+        total = 0.0
+        for op in self._last_exec_ops:
+            s = op.stats
+            total += s["wall_s"]
+            avg = s["wall_s"] / s["tasks"] * 1000 if s["tasks"] else 0.0
+            lines.append(
+                f"  {op.name}: {s['tasks']} tasks, "
+                f"{s['blocks_out']} blocks, "
+                f"wall {s['wall_s']:.3f}s (avg {avg:.1f}ms/task)")
+        lines.append(f"  total task wall: {total:.3f}s")
+        if n is not None:
+            lines.append(f"  output blocks: {n}")
+        return "\n".join(lines)
 
     # --------------------------------------------------------- train feeding
     def streaming_split(self, n: int, *, equal: bool = False) -> list[DataIterator]:
@@ -231,6 +253,15 @@ class Dataset:
         for i, block in enumerate(self._iter_blocks()):
             with ds.open_output(path, f"part-{i:05d}.csv") as f:
                 pcsv.write_csv(block, f)
+
+    def write_tfrecords(self, path: str) -> None:
+        """tf.train.Example shards (native codec, tfrecords.py)."""
+        from .tfrecords import encode_example, write_record
+
+        for i, block in enumerate(self._iter_blocks()):
+            with ds.open_output(path, f"part-{i:05d}.tfrecords") as f:
+                for row in BlockAccessor.for_block(block).iter_rows():
+                    write_record(f, encode_example(row))
 
     def __repr__(self):
         return f"Dataset(ops={[o.name for o in self._last_op.chain()]})"
@@ -370,6 +401,45 @@ def read_text(paths) -> Dataset:
 def read_binary_files(paths) -> Dataset:
     """One row per file: columns ``path`` and ``bytes``."""
     return Dataset(L.Read("read_binary", read_tasks=ds.binary_tasks(paths)))
+
+
+def read_tfrecords(paths) -> Dataset:
+    """TFRecord shards of tf.train.Example records, parsed natively (no
+    TensorFlow import) — reference
+    ``datasource/tfrecords_datasource.py``. One read task per shard."""
+    from .tfrecords import tfrecords_tasks
+
+    return Dataset(L.Read("read_tfrecords", read_tasks=tfrecords_tasks(paths)))
+
+
+def from_huggingface(hf_dataset, *, parallelism: int = 8) -> Dataset:
+    """A HuggingFace ``datasets.Dataset`` by its underlying Arrow table
+    (zero-copy slicing — reference ``datasource/huggingface_datasource``).
+    Also accepts any object exposing ``.data`` as an Arrow table, or a
+    plain iterable of row dicts."""
+    import pyarrow as pa
+
+    table = None
+    data = getattr(hf_dataset, "data", None)
+    if data is not None:
+        table = getattr(data, "table", data)  # datasets wraps in ConcatenationTable
+    if isinstance(hf_dataset, pa.Table):
+        table = hf_dataset
+    if table is None:
+        return from_items(list(hf_dataset), parallelism=parallelism)
+    if hasattr(table, "combine_chunks"):
+        table = table.combine_chunks()
+    n = table.num_rows
+    parallelism = max(1, min(parallelism, n or 1))
+    bounds = [round(i * n / parallelism) for i in builtins.range(parallelism + 1)]
+    slices = [table.slice(bounds[i], bounds[i + 1] - bounds[i])
+              for i in builtins.range(parallelism)]
+
+    def make(s):
+        return lambda: s
+
+    return Dataset(L.Read("from_huggingface",
+                          read_tasks=[make(s) for s in slices]))
 
 
 def read_images(paths, *, size: tuple[int, int] | None = None,

@@ -292,6 +292,10 @@ class PhysicalOp:
         self._next_seq = 0
         self._emit_seq = 0
         self._completed: dict[int, Any] = {}
+        # per-op execution stats (reference data/_internal/stats.py):
+        # wall = task submit->complete (includes queue + remote exec)
+        self.stats = {"tasks": 0, "blocks_out": 0, "wall_s": 0.0}
+        self._launched_at: dict = {}
 
     def done(self) -> bool:
         return self.upstream_done and not self.input_queue and not self.in_flight
@@ -303,12 +307,23 @@ class PhysicalOp:
         raise NotImplementedError
 
     def _track(self, refs: list) -> list:
+        import time as _time
+
+        now = _time.monotonic()
         for r in refs:
             self.in_flight[r] = self._next_seq
             self._next_seq += 1
+            self._launched_at[r] = now
         return refs
 
     def on_complete(self, ref) -> None:
+        import time as _time
+
+        t0 = self._launched_at.pop(ref, None)
+        if t0 is not None:
+            self.stats["wall_s"] += _time.monotonic() - t0
+            self.stats["tasks"] += 1
+            self.stats["blocks_out"] += 1
         seq = self.in_flight.pop(ref)
         self._completed[seq] = ref
         while self._emit_seq in self._completed:

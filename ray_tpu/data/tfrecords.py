@@ -1,0 +1,278 @@
+"""TFRecord datasource: read/write without a TensorFlow dependency.
+
+Reference: ``python/ray/data/_internal/datasource/tfrecords_datasource.py``
+(which parses via ``tf.train.Example``). TPU ingest commonly arrives as
+TFRecord shards; this module implements the container format and a
+minimal ``tf.train.Example`` protobuf codec natively:
+
+  * TFRecord framing: ``uint64 length | uint32 masked_crc(length) |
+    payload | uint32 masked_crc(payload)`` with CRC32C (Castagnoli)
+    masked per the TF spec (rot15 + 0xa282ead8).
+  * Example wire format: ``Example{features: Features{feature:
+    map<string, Feature>}}``; ``Feature`` is a oneof of bytes_list /
+    float_list / int64_list. Scalars flatten on read (list length 1 ->
+    value), arrays stay lists.
+
+Readers accept pyarrow.fs URIs like every other datasource.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, Iterator
+
+# ------------------------------------------------------------------ crc32c
+
+_CRC_TABLE: list[int] | None = None
+
+
+def _crc32c_table() -> list[int]:
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        poly = 0x82F63B78  # Castagnoli, reflected
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            table.append(c)
+        _CRC_TABLE = table
+    return _CRC_TABLE
+
+
+def crc32c(data: bytes) -> int:
+    table = _crc32c_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ----------------------------------------------------------- record framing
+
+
+def read_records(stream) -> Iterator[bytes]:
+    """Yield raw record payloads; validates lengths (CRC checked on the
+    header so corrupt shards fail fast, payload CRC skipped for speed —
+    the reference's tf.io behavior with check_integrity off)."""
+    while True:
+        header = stream.read(12)
+        if not header:
+            return
+        if len(header) < 12:
+            raise ValueError("truncated TFRecord header")
+        (length,) = struct.unpack("<Q", header[:8])
+        (len_crc,) = struct.unpack("<I", header[8:])
+        if len_crc != _masked_crc(header[:8]):
+            raise ValueError("TFRecord length CRC mismatch (corrupt shard?)")
+        payload = stream.read(length)
+        if len(payload) < length:
+            raise ValueError("truncated TFRecord payload")
+        stream.read(4)  # payload crc (unchecked)
+        yield payload
+
+
+def write_record(stream, payload: bytes) -> None:
+    header = struct.pack("<Q", len(payload))
+    stream.write(header)
+    stream.write(struct.pack("<I", _masked_crc(header)))
+    stream.write(payload)
+    stream.write(struct.pack("<I", _masked_crc(payload)))
+
+
+# ------------------------------------------------- tf.train.Example codec
+
+_WIRE_VARINT, _WIRE_I64, _WIRE_LEN, _WIRE_I32 = 0, 1, 2, 5
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _write_varint(out: bytearray, value: int) -> None:
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _skip_field(buf: bytes, pos: int, wire: int) -> int:
+    if wire == _WIRE_VARINT:
+        return _read_varint(buf, pos)[1]
+    if wire == _WIRE_I64:
+        return pos + 8
+    if wire == _WIRE_LEN:
+        n, pos = _read_varint(buf, pos)
+        return pos + n
+    if wire == _WIRE_I32:
+        return pos + 4
+    raise ValueError(f"unknown wire type {wire}")
+
+
+def _iter_fields(buf: bytes) -> Iterator[tuple[int, int, bytes]]:
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == _WIRE_LEN:
+            n, pos = _read_varint(buf, pos)
+            yield field, wire, buf[pos:pos + n]
+            pos += n
+        else:
+            start = pos
+            pos = _skip_field(buf, pos, wire)
+            yield field, wire, buf[start:pos]
+
+
+def _parse_feature(buf: bytes):
+    # Feature: oneof { bytes_list=1, float_list=2, int64_list=3 }
+    for field, _, payload in _iter_fields(buf):
+        if field == 1:    # BytesList{value: repeated bytes = 1}
+            return [v for f, _, v in _iter_fields(payload) if f == 1]
+        if field == 2:    # FloatList{value: repeated float = 1, packed}
+            out: list[float] = []
+            for f, wire, v in _iter_fields(payload):
+                if f != 1:
+                    continue
+                if wire == _WIRE_LEN:  # packed
+                    out.extend(struct.unpack(f"<{len(v) // 4}f", v))
+                else:
+                    out.extend(struct.unpack("<f", v))
+            return out
+        if field == 3:    # Int64List{value: repeated int64 = 1, varint}
+            out = []
+            for f, wire, v in _iter_fields(payload):
+                if f != 1:
+                    continue
+                if wire == _WIRE_LEN:  # packed varints
+                    p = 0
+                    while p < len(v):
+                        n, p = _read_varint(v, p)
+                        out.append(n - (1 << 64) if n >= 1 << 63 else n)
+                else:
+                    n = _read_varint(v, 0)[0]
+                    out.append(n - (1 << 64) if n >= 1 << 63 else n)
+            return out
+    return []
+
+
+def parse_example(payload: bytes) -> dict:
+    """tf.train.Example bytes -> {name: scalar or list} row."""
+    row: dict = {}
+    for field, _, features in _iter_fields(payload):
+        if field != 1:  # Example{features=1}
+            continue
+        for f2, _, entry in _iter_fields(features):
+            if f2 != 1:  # Features{feature map entry=1}
+                continue
+            name = b""
+            value = []
+            for mf, _, mv in _iter_fields(entry):
+                if mf == 1:
+                    name = mv
+                elif mf == 2:
+                    value = _parse_feature(mv)
+            if len(value) == 1:
+                value = value[0]
+            row[name.decode()] = value
+    return row
+
+
+def _encode_feature(values) -> bytes:
+    inner = bytearray()
+    if values and isinstance(values[0], bytes):
+        body = bytearray()
+        for v in values:
+            body.append((1 << 3) | _WIRE_LEN)
+            _write_varint(body, len(v))
+            body += v
+        field = 1
+    elif values and isinstance(values[0], float):
+        body = bytearray([(1 << 3) | _WIRE_LEN])
+        packed = struct.pack(f"<{len(values)}f", *values)
+        _write_varint(body, len(packed))
+        body += packed
+        field = 2
+    else:
+        body = bytearray([(1 << 3) | _WIRE_LEN])
+        packed = bytearray()
+        for v in values:
+            _write_varint(packed, int(v) & ((1 << 64) - 1))
+        _write_varint(body, len(packed))
+        body += packed
+        field = 3
+    inner.append((field << 3) | _WIRE_LEN)
+    _write_varint(inner, len(body))
+    inner += body
+    return bytes(inner)
+
+
+def encode_example(row: dict) -> bytes:
+    """{name: value} row -> tf.train.Example bytes."""
+    features = bytearray()
+    for name, value in row.items():
+        if hasattr(value, "tolist"):
+            value = value.tolist()
+        values = value if isinstance(value, list) else [value]
+        if values and isinstance(values[0], str):
+            values = [v.encode() for v in values]
+        key = name.encode()
+        feat = _encode_feature(values)
+        entry = bytearray([(1 << 3) | _WIRE_LEN])
+        _write_varint(entry, len(key))
+        entry += key
+        entry.append((2 << 3) | _WIRE_LEN)
+        _write_varint(entry, len(feat))
+        entry += feat
+        m = bytearray([(1 << 3) | _WIRE_LEN])
+        _write_varint(m, len(entry))
+        m += entry
+        features += m
+    out = bytearray([(1 << 3) | _WIRE_LEN])
+    _write_varint(out, len(features))
+    out += features
+    return bytes(out)
+
+
+# ---------------------------------------------------------------- read tasks
+
+
+def tfrecords_tasks(paths) -> list[Callable]:
+    """One read task per shard file (the reference's file-parallel split)."""
+    from . import datasource as ds
+
+    def make(fs, path):
+        def task():
+            import pyarrow as pa
+
+            rows: list[dict] = []
+            with fs.open_input_stream(path) as f:
+                for payload in read_records(f):
+                    rows.append(parse_example(payload))
+            cols: dict[str, list] = {}
+            for r in rows:
+                for k in r:
+                    cols.setdefault(k, [])
+            for r in rows:
+                for k, col in cols.items():
+                    col.append(r.get(k))
+            return pa.table(cols) if cols else pa.table({})
+        return task
+
+    return [make(fs, path) for fs, path in ds._expand_paths(paths)]
