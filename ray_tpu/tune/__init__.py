@@ -18,10 +18,13 @@ from .schedulers import (
     FIFOScheduler,
     HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
 )
 from .search import (
     BasicVariantGenerator,
+    OptunaSearch,
+    Searcher,
     TPESearcher,
     choice,
     grid_search,
@@ -44,7 +47,10 @@ __all__ = [
     "FIFOScheduler",
     "HyperBandScheduler",
     "MedianStoppingRule",
+    "PB2",
     "PopulationBasedTraining",
+    "OptunaSearch",
+    "Searcher",
     "TPESearcher",
     "ResultGrid",
     "TuneConfig",

@@ -200,3 +200,110 @@ class PopulationBasedTraining:
                 new_config[key] = base * self._rng.choice([0.8, 1.2])
         new_config["_pbt_exploit_from"] = donor.trial_id
         return new_config
+
+
+class PB2(PopulationBasedTraining):
+    """Population-Based Bandits (reference ``tune/schedulers/pb2.py``,
+    Parker-Holder et al. 2020): PBT's exploit step, but EXPLORE selects
+    the new hyperparameters by GP-UCB over observed (config -> reward
+    improvement) data instead of random multiplicative perturbation —
+    markedly more sample-efficient for small populations.
+
+    ``hyperparam_bounds``: {name: (low, high)} continuous ranges the GP
+    models (categorical mutations are not supported — PBT handles those).
+    """
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: dict | None = None,
+                 quantile_fraction: float = 0.25,
+                 seed: int | None = None,
+                 ucb_beta: float = 1.5,
+                 n_candidates: int = 128):
+        super().__init__(metric=metric, mode=mode, time_attr=time_attr,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction, seed=seed)
+        self._bounds = hyperparam_bounds or {}
+        self._beta = ucb_beta
+        self._n_candidates = n_candidates
+        self._prev_score: dict[Any, float] = {}
+        # GP dataset: (normalized config vector, reward improvement)
+        self._X: list[list[float]] = []
+        self._y: list[float] = []
+
+    # -------------------------------------------------------------- data
+    def _vec(self, config: dict) -> list[float]:
+        out = []
+        for k, (lo, hi) in self._bounds.items():
+            v = float(config.get(k, lo))
+            out.append((v - lo) / (hi - lo) if hi > lo else 0.0)
+        return out
+
+    def on_result(self, trial, metrics: dict) -> str:
+        score = self._sign * float(metrics.get(self._metric, float("-inf")))
+        prev = self._prev_score.get(trial)
+        if prev is not None and prev != float("-inf") and score != float("-inf"):
+            self._X.append(self._vec(trial.config))
+            self._y.append(score - prev)
+        self._prev_score[trial] = score
+        return super().on_result(trial, metrics)
+
+    # ---------------------------------------------------------------- GP
+    def _gp_ucb(self, donor_config: dict) -> dict:
+        import numpy as np
+
+        keys = list(self._bounds)
+        cand = np.asarray(
+            [[self._rng.random() for _ in keys]
+             for _ in range(self._n_candidates)])
+        if len(self._y) >= 3:
+            X = np.asarray(self._X)
+            y = np.asarray(self._y)
+            y = (y - y.mean()) / (y.std() + 1e-9)
+            ls = 0.3
+            def k(a, b):
+                d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+                return np.exp(-d2 / (2 * ls * ls))
+            K = k(X, X) + 1e-3 * np.eye(len(X))
+            Kinv_y = np.linalg.solve(K, y)
+            Ks = k(cand, X)                        # [n_cand, n_obs]
+            mu = Ks @ Kinv_y
+            # diag of posterior cov
+            v = np.linalg.solve(K, Ks.T)
+            var = np.clip(1.0 - (Ks * v.T).sum(1), 1e-9, None)
+            scores = mu + self._beta * np.sqrt(var)
+            best = cand[int(np.argmax(scores))]
+        else:
+            best = cand[0]                         # no data yet: random
+        out = dict(donor_config)
+        for i, kname in enumerate(keys):
+            lo, hi = self._bounds[kname]
+            val = lo + float(best[i]) * (hi - lo)
+            if isinstance(donor_config.get(kname), int):
+                val = int(round(val))
+            out[kname] = val
+        return out
+
+    def maybe_exploit(self, trial, metrics: dict, population: list) -> dict | None:
+        t = metrics.get(self._time_attr, 0)
+        if t - self._last_perturb.get(trial, 0) < self._interval:
+            return None
+        self._last_perturb[trial] = t
+        if len(self._scores) < 2:
+            return None
+        ranked = sorted(population,
+                        key=lambda tr: self._scores.get(tr, float("-inf")))
+        k = max(1, int(len(ranked) * self._quantile))
+        bottom, top = ranked[:k], ranked[-k:]
+        if trial not in bottom:
+            return None
+        donor = self._rng.choice(top)
+        if donor is trial:
+            return None
+        new_config = self._gp_ucb(donor.config)
+        new_config["_pbt_exploit_from"] = donor.trial_id
+        # the exploited trial restarts: its next improvement baseline resets
+        self._prev_score.pop(trial, None)
+        return new_config

@@ -252,3 +252,81 @@ class BasicVariantGenerator:
                         cfg[k] = v
                 configs.append(cfg)
         return configs
+
+
+class Searcher:
+    """Sequential-searcher protocol the controller drives (reference
+    ``tune/search/searcher.py``): ``set_space`` once, then alternate
+    ``suggest`` / ``on_trial_complete``. ``TPESearcher`` is the native
+    implementation; ``OptunaSearch`` adapts an external library through
+    the same three methods — write an adapter with this surface to plug
+    in any external optimizer (HyperOpt/Ax/BOHB equivalents)."""
+
+    def set_space(self, param_space: dict) -> None:
+        raise NotImplementedError
+
+    def suggest(self) -> dict:
+        raise NotImplementedError
+
+    def on_trial_complete(self, config: dict, metrics: dict) -> None:
+        raise NotImplementedError
+
+
+class OptunaSearch(Searcher):
+    """Adapter over Optuna's ask/tell interface (reference
+    ``tune/search/optuna/optuna_search.py``): Domain objects map to
+    Optuna distributions; each ``suggest`` asks a trial, each completion
+    tells its objective value. Requires ``optuna`` (not bundled in this
+    image — the import is deferred and raises a clear error)."""
+
+    def __init__(self, metric: str, mode: str = "max", *, seed: int | None = None,
+                 sampler=None):
+        try:
+            import optuna  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearch requires the `optuna` package; install it or "
+                "use the native TPESearcher (same protocol)") from e
+        self._optuna = optuna
+        self.metric = metric
+        self._direction = "maximize" if mode == "max" else "minimize"
+        self._sampler = sampler or optuna.samplers.TPESampler(seed=seed)
+        self._study = None
+        self._space: dict = {}
+        self._live: dict[int, Any] = {}  # config-id -> optuna trial
+
+    def set_space(self, param_space: dict) -> None:
+        optuna = self._optuna
+        self._study = optuna.create_study(
+            direction=self._direction, sampler=self._sampler)
+        dist = optuna.distributions
+        self._space = {}
+        for k, v in param_space.items():
+            if isinstance(v, Uniform):
+                self._space[k] = dist.FloatDistribution(v.low, v.high)
+            elif isinstance(v, LogUniform):
+                self._space[k] = dist.FloatDistribution(v.low, v.high, log=True)
+            elif isinstance(v, RandInt):
+                self._space[k] = dist.IntDistribution(v.low, v.high - 1)
+            elif isinstance(v, Choice):
+                self._space[k] = dist.CategoricalDistribution(list(v.categories))
+            elif isinstance(v, GridSearch):
+                self._space[k] = dist.CategoricalDistribution(list(v.values))
+            else:
+                self._space[k] = dist.CategoricalDistribution([v])
+
+    @staticmethod
+    def _key(config: dict):
+        return tuple(sorted((k, repr(v)) for k, v in config.items()))
+
+    def suggest(self) -> dict:
+        trial = self._study.ask(self._space)
+        config = dict(trial.params)
+        # identical configs may be suggested twice: a list per key
+        self._live.setdefault(self._key(config), []).append(trial)
+        return config
+
+    def on_trial_complete(self, config: dict, metrics: dict) -> None:
+        trials = self._live.get(self._key(config))
+        if trials and metrics and self.metric in metrics:
+            self._study.tell(trials.pop(0), float(metrics[self.metric]))
