@@ -12,6 +12,14 @@ accelerator.
 """
 
 from .algorithm import Algorithm, AlgorithmConfig
+from .connectors import (
+    ClipRewards,
+    ConnectorPipelineV2,
+    ConnectorV2,
+    LambdaConnector,
+    NormalizeObservations,
+    ScaleObservations,
+)
 from .env import CartPole, GridWorld, Pendulum
 from .env_runner import EnvRunner, EnvRunnerGroup
 from .impala import APPO, APPOConfig, IMPALA, IMPALAConfig
@@ -27,6 +35,12 @@ from .replay import ReplayBuffer
 from .sac import SAC, SACConfig
 
 __all__ = [
+    "ClipRewards",
+    "ConnectorPipelineV2",
+    "ConnectorV2",
+    "LambdaConnector",
+    "NormalizeObservations",
+    "ScaleObservations",
     "Algorithm",
     "AlgorithmConfig",
     "CartPole",

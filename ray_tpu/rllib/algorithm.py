@@ -11,6 +11,8 @@ from __future__ import annotations
 import os
 import pickle
 import time
+
+import numpy as np
 from typing import Any, Type
 
 
@@ -25,6 +27,12 @@ class AlgorithmConfig:
         self.max_grad_norm = 0.5
         self.seed = 0
         self.train_kwargs: dict = {}
+        # ConnectorV2 factories (called per runner so state is per-runner)
+        self.env_to_module_connector = None
+        self.learner_connector = None
+        # evaluation harness settings
+        self.evaluation_num_episodes = 10
+        self.evaluation_num_envs = 8
 
     # ----------------------------------------------------- fluent builders
     def environment(self, env_cls) -> "AlgorithmConfig":
@@ -55,6 +63,25 @@ class AlgorithmConfig:
         self.seed = seed
         return self
 
+    def connectors(self, *, env_to_module=None, learner=None) -> "AlgorithmConfig":
+        """ConnectorV2 pipelines (reference connectors/connector_v2.py):
+        ``env_to_module`` preprocesses observations before the policy
+        (and the rollout records the TRANSFORMED obs); ``learner``
+        preprocesses each sampled batch before the update. Pass a
+        factory (zero-arg callable) so every env-runner gets its own
+        stateful copy."""
+        if env_to_module is not None:
+            self.env_to_module_connector = env_to_module
+        if learner is not None:
+            self.learner_connector = learner
+        return self
+
+    def evaluation(self, *, num_episodes: int = 10,
+                   num_envs: int = 8) -> "AlgorithmConfig":
+        self.evaluation_num_episodes = num_episodes
+        self.evaluation_num_envs = num_envs
+        return self
+
     def build(self) -> "Algorithm":
         return self.algo_cls(self)  # set by subclass
 
@@ -81,6 +108,61 @@ class Algorithm:
         metrics["training_iteration"] = self.iteration
         metrics["time_this_iter_s"] = time.monotonic() - start
         return metrics
+
+    def get_weights(self):
+        """Current policy weights for inference (eval, export). Default:
+        the learner group's weights; algorithms without one override."""
+        group = getattr(self, "learner_group", None)
+        if group is None:
+            raise NotImplementedError(f"{type(self).__name__}.get_weights")
+        return group.get_weights()
+
+    def evaluate(self) -> dict:
+        """Run evaluation episodes on a DEDICATED env-runner with the
+        current weights (reference ``Algorithm.evaluate``,
+        ``algorithms/algorithm.py:199``): the eval runner never feeds
+        training, its connector state is cloned from training (frozen
+        stats — evaluating under a different normalization than the
+        policy was trained with would skew returns)."""
+        from .env_runner import EnvRunner
+
+        cfg = self.config
+        if getattr(self, "_eval_runner", None) is None:
+            conn = None
+            if cfg.env_to_module_connector is not None:
+                from .connectors import make_pipeline
+
+                conn = make_pipeline(cfg.env_to_module_connector)
+            self._eval_runner = EnvRunner(
+                cfg.env_cls, cfg.evaluation_num_envs, cfg.rollout_len,
+                seed=cfg.seed ^ 0xE7A1, env_to_module=conn)
+        runner = self._eval_runner
+        if runner.env_to_module is not None:
+            # freeze + sync normalizer stats from a training runner
+            group = getattr(self, "env_runner_group", None)
+            state = group.connector_states()[0] if group is not None else None
+            if state:
+                runner.env_to_module.set_state(state)
+            for p in runner.env_to_module.pieces:
+                if hasattr(p, "update"):
+                    p.update = False
+        weights = self.get_weights()
+        returns: list[float] = []
+        lengths = 0
+        while len(returns) < cfg.evaluation_num_episodes:
+            batch = runner.sample(weights)
+            returns.extend(batch["episode_returns"].tolist())
+            lengths += batch["rewards"].size
+        returns = returns[: cfg.evaluation_num_episodes]
+        return {
+            "evaluation": {
+                "episode_return_mean": float(np.mean(returns)),
+                "episode_return_min": float(np.min(returns)),
+                "episode_return_max": float(np.max(returns)),
+                "num_episodes": len(returns),
+                "env_steps": int(lengths),
+            }
+        }
 
     # --------------------------------------------------------- checkpointing
     def get_state(self) -> dict:  # pragma: no cover - overridden

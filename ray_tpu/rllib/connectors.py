@@ -1,0 +1,159 @@
+"""ConnectorV2: composable data-transform pipelines for RL.
+
+Reference: ``rllib/connectors/connector_v2.py`` — the new-API-stack
+abstraction for everything that happens to data BETWEEN the env, the
+module, and the learner: observation preprocessing before action
+computation (env-to-module), and batch preprocessing before an update
+(learner). Instead of hand-rolling normalization inside every
+algorithm, a pipeline of small pieces is configured once and applied at
+the two seams:
+
+  * ``EnvRunner`` applies the env-to-module pipeline to every
+    observation it feeds the policy AND records the TRANSFORMED
+    observation in the rollout, so the learner trains on exactly what
+    the policy saw (the invariant the reference's connector design
+    exists to guarantee).
+  * Algorithms apply the learner pipeline to each sampled batch before
+    the update.
+
+Pieces are stateful (e.g. running mean/std) and checkpointable via
+``get_state``/``set_state``; each env-runner owns its own instance, as
+in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+class ConnectorV2:
+    """One transform piece: ``batch`` is a dict of arrays; return the
+    (possibly mutated) dict."""
+
+    def __call__(self, batch: dict, **kwargs) -> dict:
+        raise NotImplementedError
+
+    def get_state(self) -> dict:
+        return {}
+
+    def set_state(self, state: dict) -> None:
+        pass
+
+
+class ConnectorPipelineV2(ConnectorV2):
+    """Ordered composition of pieces (reference ConnectorPipelineV2)."""
+
+    def __init__(self, pieces: list[ConnectorV2] | None = None):
+        self.pieces = list(pieces or [])
+
+    def __call__(self, batch: dict, **kwargs) -> dict:
+        for p in self.pieces:
+            batch = p(batch, **kwargs)
+        return batch
+
+    def append(self, piece: ConnectorV2) -> "ConnectorPipelineV2":
+        self.pieces.append(piece)
+        return self
+
+    def get_state(self) -> dict:
+        return {i: p.get_state() for i, p in enumerate(self.pieces)}
+
+    def set_state(self, state: dict) -> None:
+        for i, p in enumerate(self.pieces):
+            if i in state:
+                p.set_state(state[i])
+
+
+class NormalizeObservations(ConnectorV2):
+    """Running mean/std observation normalizer (Welford accumulation),
+    the standard MuJoCo-style preprocessing (reference
+    ``connectors/env_to_module/mean_std_filter.py``)."""
+
+    def __init__(self, clip: float | None = 10.0, update: bool = True):
+        self.clip = clip
+        self.update = update
+        self._count = 0.0
+        self._mean: np.ndarray | None = None
+        self._m2: np.ndarray | None = None
+
+    def __call__(self, batch: dict, **kwargs) -> dict:
+        obs = np.asarray(batch["obs"], np.float32)
+        flat = obs.reshape(-1, obs.shape[-1])
+        if self._mean is None:
+            self._mean = np.zeros(obs.shape[-1], np.float64)
+            self._m2 = np.ones(obs.shape[-1], np.float64)
+        if self.update:
+            for row in flat:
+                self._count += 1.0
+                d = row - self._mean
+                self._mean += d / self._count
+                self._m2 += d * (row - self._mean)
+        std = np.sqrt(self._m2 / max(self._count, 1.0)) + 1e-8
+        out = (obs - self._mean.astype(np.float32)) / std.astype(np.float32)
+        if self.clip is not None:
+            out = np.clip(out, -self.clip, self.clip)
+        batch = dict(batch)
+        batch["obs"] = out.astype(np.float32)
+        return batch
+
+    def get_state(self) -> dict:
+        return {"count": self._count,
+                "mean": None if self._mean is None else self._mean.copy(),
+                "m2": None if self._m2 is None else self._m2.copy()}
+
+    def set_state(self, state: dict) -> None:
+        self._count = state["count"]
+        self._mean = state["mean"]
+        self._m2 = state["m2"]
+
+
+class ClipRewards(ConnectorV2):
+    """Learner-side reward clipping (reference Atari-style preprocessing)."""
+
+    def __init__(self, limit: float = 1.0):
+        self.limit = limit
+
+    def __call__(self, batch: dict, **kwargs) -> dict:
+        if "rewards" in batch:
+            batch = dict(batch)
+            batch["rewards"] = np.clip(batch["rewards"], -self.limit, self.limit)
+        return batch
+
+
+class ScaleObservations(ConnectorV2):
+    """Fixed affine observation scaling (e.g. pixel / 255)."""
+
+    def __init__(self, scale: float, offset: float = 0.0):
+        self.scale = scale
+        self.offset = offset
+
+    def __call__(self, batch: dict, **kwargs) -> dict:
+        batch = dict(batch)
+        batch["obs"] = (np.asarray(batch["obs"], np.float32) - self.offset) * self.scale
+        return batch
+
+
+class LambdaConnector(ConnectorV2):
+    """Wrap a plain function as a piece."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, batch: dict, **kwargs) -> dict:
+        return self._fn(batch)
+
+
+def make_pipeline(spec: Any) -> ConnectorPipelineV2 | None:
+    """None | piece | list | factory -> pipeline instance (a factory is
+    called with no args so each env-runner gets its OWN stateful copy)."""
+    if spec is None:
+        return None
+    if callable(spec) and not isinstance(spec, ConnectorV2):
+        spec = spec()
+    if isinstance(spec, ConnectorPipelineV2):
+        return spec
+    if isinstance(spec, ConnectorV2):
+        return ConnectorPipelineV2([spec])
+    return ConnectorPipelineV2(list(spec))

@@ -33,14 +33,28 @@ class EnvRunner:
     """Collects fragments of ``rollout_len`` steps from ``num_envs``
     parallel env copies. Returns flat arrays plus episode-return stats."""
 
-    def __init__(self, env_cls, num_envs: int = 8, rollout_len: int = 64, seed: int = 0):
+    def __init__(self, env_cls, num_envs: int = 8, rollout_len: int = 64, seed: int = 0,
+                 env_to_module=None):
+        from .connectors import make_pipeline
+
         self.env = env_cls(num_envs=num_envs, seed=seed)
         self.num_envs = num_envs
         self.rollout_len = rollout_len
         self.rng = np.random.default_rng(seed ^ 0xA5)
-        self.obs = self.env.reset()
+        # ConnectorV2 pipeline between env observations and the module
+        # (each runner owns its stateful copy — reference connector_v2.py)
+        self.env_to_module = make_pipeline(env_to_module)
+        self.obs = self._connect(self.env.reset())
         self._ep_return = np.zeros(num_envs, np.float32)
         self._completed: list[float] = []
+
+    def _connect(self, obs: np.ndarray) -> np.ndarray:
+        if self.env_to_module is None:
+            return obs
+        return self.env_to_module({"obs": obs})["obs"]
+
+    def connector_state(self) -> dict:
+        return self.env_to_module.get_state() if self.env_to_module else {}
 
     def sample(self, weights) -> dict:
         T, N = self.rollout_len, self.num_envs
@@ -62,11 +76,12 @@ class EnvRunner:
             logp = np.log(probs[np.arange(N), actions] + 1e-10)
             obs_buf[t], act_buf[t] = self.obs, actions
             logp_buf[t], val_buf[t] = logp, value
-            self.obs, rewards, dones, info = self.env.step(actions)
+            raw_obs, rewards, dones, info = self.env.step(actions)
+            self.obs = self._connect(raw_obs)
             rew_buf[t], done_buf[t] = rewards, dones
             truncated = info["truncated"]
             if truncated.any():
-                _, v_term = _np_forward(weights, info["terminal_obs"])
+                _, v_term = _np_forward(weights, self._connect(info["terminal_obs"]))
                 trunc_val_buf[t, truncated] = v_term[truncated]
             self._ep_return += rewards
             for i in np.nonzero(dones)[0]:
@@ -117,6 +132,22 @@ class EnvRunnerGroup:
                            seed + 1000 * i, **kw)
                 for i in range(num_env_runners)
             ]
+
+    def connector_states(self) -> list[dict]:
+        """Per-runner env-to-module connector states (stats sync for
+        evaluation / checkpointing)."""
+        if self._local is not None:
+            c = self._local.env_to_module
+            return [c.get_state() if c is not None else {}]
+        from ..core import api as ray
+
+        def _state(r):
+            return r.connector_state.remote()
+
+        try:
+            return ray.get([_state(a) for a in self._actors], timeout=60)
+        except Exception:
+            return [{} for _ in self._actors]
 
     def sample(self, weights, **kwargs) -> list[dict]:
         if self._local is not None:

@@ -119,6 +119,9 @@ class PPO(Algorithm):
             num_envs_per_runner=c.num_envs_per_runner,
             rollout_len=c.rollout_len,
             seed=c.seed,
+            runner_kwargs=(
+                {"env_to_module": c.env_to_module_connector}
+                if c.env_to_module_connector is not None else None),
         )
         self.rng = np.random.default_rng(c.seed)
         self._recent_returns: list[float] = []
@@ -127,6 +130,12 @@ class PPO(Algorithm):
         c: PPOConfig = self.config  # type: ignore[assignment]
         weights = self.learner_group.get_weights()
         samples = self.env_runner_group.sample(weights)
+        if c.learner_connector is not None:
+            from .connectors import make_pipeline
+
+            if not hasattr(self, "_learner_conn"):
+                self._learner_conn = make_pipeline(c.learner_connector)
+            samples = [self._learner_conn(s) for s in samples]
 
         flat = {"obs": [], "actions": [], "logp_old": [], "advantages": [], "returns": []}
         for s in samples:
