@@ -260,26 +260,30 @@ class ActorMethod:
     """Reference: actor.py:116."""
 
     def __init__(self, handle: "ActorHandle", name: str, num_returns: int | str = 1,
-                 generator_backpressure: int = 0):
+                 generator_backpressure: int = 0, concurrency_group: str = ""):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
         self._generator_backpressure = generator_backpressure
+        self._concurrency_group = concurrency_group
 
     def remote(self, *args, **kwargs):
         refs = global_worker().submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs,
             num_returns=self._num_returns,
             generator_backpressure=self._generator_backpressure,
+            concurrency_group=self._concurrency_group,
         )
         if self._num_returns == "streaming":
             return refs  # ObjectRefGenerator
         return refs[0] if self._num_returns == 1 else refs
 
     def options(self, num_returns: int | str = 1,
-                _generator_backpressure_num_objects: int = 0) -> "ActorMethod":
+                _generator_backpressure_num_objects: int = 0,
+                concurrency_group: str = "") -> "ActorMethod":
         return ActorMethod(self._handle, self._name, num_returns,
-                           _generator_backpressure_num_objects)
+                           _generator_backpressure_num_objects,
+                           concurrency_group or self._concurrency_group)
 
     def bind(self, *args, **kwargs):
         """Build a compiled-graph node instead of submitting now
@@ -351,6 +355,7 @@ class ActorClass:
             resources=resources,
             max_restarts=opts.get("max_restarts", 0),
             max_concurrency=opts.get("max_concurrency", 1),
+            concurrency_groups=opts.get("concurrency_groups"),
             detached=opts.get("lifetime") == "detached",
             scheduling_strategy=strategy,
             placement_group_id=pg_id,
@@ -395,9 +400,17 @@ def remote(*args, **options):
     return wrap
 
 
-def method(num_returns: int = 1):
+def method(num_returns: int = 1, concurrency_group: str = ""):
+    """Per-method defaults on actor classes (reference actor.py
+    ``@ray.method``): ``concurrency_group`` names the pool declared in
+    ``@remote(concurrency_groups={...})`` this method runs in —
+    resolved executor-side from the class definition, so handles need
+    not know the class."""
+
     def decorator(fn):
         fn.__ray_num_returns__ = num_returns
+        if concurrency_group:
+            fn.__ray_concurrency_group__ = concurrency_group
         return fn
 
     return decorator

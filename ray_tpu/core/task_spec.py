@@ -44,6 +44,11 @@ class TaskSpec:
     seq_no: int = -1
     max_restarts: int = 0
     max_concurrency: int = 1
+    # Named per-method concurrency pools (reference
+    # concurrency_group_manager.cc): creation carries the group table,
+    # each actor task the group it runs in ("" = default pool).
+    concurrency_groups: dict = field(default_factory=dict)
+    concurrency_group: str = ""
     # Scheduling.
     scheduling_strategy: dict = field(default_factory=dict)
     placement_group_id: bytes = b""
@@ -71,6 +76,8 @@ class TaskSpec:
             "seq_no": self.seq_no,
             "max_restarts": self.max_restarts,
             "max_concurrency": self.max_concurrency,
+            "concurrency_groups": self.concurrency_groups,
+            "concurrency_group": self.concurrency_group,
             "scheduling_strategy": self.scheduling_strategy,
             "placement_group_id": self.placement_group_id,
             "placement_group_bundle_index": self.placement_group_bundle_index,

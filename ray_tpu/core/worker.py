@@ -280,6 +280,7 @@ class CoreWorker:
         self._actor_ooo_buffer: dict[tuple[str, int], Any] = {}
         self._actor_sem: threading.Semaphore | None = None
         self._actor_max_concurrency = 1
+        self._actor_group_sems: dict[str, threading.Semaphore] = {}
         self._exec_local = threading.local()
 
         # Task execution threads: the loop's default executor caps at
@@ -1251,6 +1252,7 @@ class CoreWorker:
         resources: dict | None = None,
         max_restarts: int = 0,
         max_concurrency: int = 1,
+        concurrency_groups: dict | None = None,
         detached: bool = False,
         scheduling_strategy: dict | None = None,
         placement_group_id: bytes = b"",
@@ -1279,6 +1281,7 @@ class CoreWorker:
             actor_id=actor_id.binary(),
             max_restarts=max_restarts,
             max_concurrency=max_concurrency,
+            concurrency_groups=dict(concurrency_groups or {}),
             scheduling_strategy=scheduling_strategy or {},
             placement_group_id=placement_group_id,
             placement_group_bundle_index=placement_group_bundle_index,
@@ -1308,6 +1311,7 @@ class CoreWorker:
         *,
         num_returns: int | str = 1,
         generator_backpressure: int = 0,
+        concurrency_group: str = "",
     ) -> list[ObjectRef] | ObjectRefGenerator:
         state = self._actor_state(actor_id)
         streaming = num_returns == "streaming"
@@ -1332,6 +1336,7 @@ class CoreWorker:
             actor_id=actor_id,
             actor_method=method_name,
             seq_no=seq_no,
+            concurrency_group=concurrency_group,
         )
         spec._incarnation = incarnation
         if streaming:
@@ -1740,9 +1745,11 @@ class CoreWorker:
             fut = loop.create_future()
             self._actor_ooo_buffer[(caller, spec.seq_no)] = fut
             await fut
-        if self._actor_max_concurrency <= 1:
+        if self._actor_max_concurrency <= 1 and not self._actor_group_sems:
             # Serialized actor: strict execution order — complete before
-            # releasing the next sequence number.
+            # releasing the next sequence number. (An actor WITH
+            # concurrency groups is inherently concurrent: grouped calls
+            # must not serialize behind the default pool.)
             result = await loop.run_in_executor(None, self._execute_task, spec)
             self._release_next_actor_seq(caller, spec.seq_no)
             return result
@@ -1796,6 +1803,13 @@ class CoreWorker:
                 # max_concurrency (default 1 = serialized actor).
                 self._actor_max_concurrency = max(1, spec.max_concurrency)
                 self._actor_sem = threading.Semaphore(self._actor_max_concurrency)
+                # Named per-method pools (reference
+                # concurrency_group_manager.cc): each group gets its own
+                # semaphore; grouped calls never contend with the default
+                # pool or with other groups.
+                self._actor_group_sems = {
+                    g: threading.Semaphore(max(1, int(n)))
+                    for g, n in (spec.concurrency_groups or {}).items()}
                 return {"returns": []}
             if spec.kind == TASK_KIND_ACTOR_TASK:
                 if self.actor_instance is None:
@@ -1808,7 +1822,14 @@ class CoreWorker:
                     method = functools.partial(fn, self.actor_instance)
                 else:
                     method = getattr(self.actor_instance, spec.actor_method)
-                sem = self._actor_sem
+                group = spec.concurrency_group
+                if not group:
+                    # per-method default declared with @method(
+                    # concurrency_group=...) — resolved here, executor
+                    # side, where the class definition lives
+                    fn = getattr(method, "__func__", method)
+                    group = getattr(fn, "__ray_concurrency_group__", "")
+                sem = self._actor_group_sems.get(group) or self._actor_sem
                 if sem is not None:
                     with sem:
                         # run-to-completion INSIDE the semaphore: an async

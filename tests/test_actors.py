@@ -104,3 +104,67 @@ def test_kill_actor():
         for _ in range(50):
             ray_tpu.get(c.incr.remote(), timeout=30)
             time.sleep(0.1)
+
+
+def test_concurrency_groups(ray_cluster):
+    """Named per-method concurrency pools (reference
+    concurrency_group_manager.cc): an "io" group with 2 permits runs two
+    io calls concurrently while the default pool (max_concurrency=1)
+    stays serialized, and groups never contend with each other."""
+    import time
+
+    import ray_tpu
+
+    @ray_tpu.remote(concurrency_groups={"io": 2, "compute": 1})
+    class Worker:
+        def __init__(self):
+            self.active = {"io": 0, "default": 0}
+            self.peak = {"io": 0, "default": 0}
+            import threading
+
+            self.lock = threading.Lock()
+
+        def _enter(self, group):
+            with self.lock:
+                self.active[group] += 1
+                self.peak[group] = max(self.peak[group], self.active[group])
+
+        def _exit(self, group):
+            with self.lock:
+                self.active[group] -= 1
+
+        @ray_tpu.method(concurrency_group="io")
+        def io_call(self):
+            self._enter("io")
+            time.sleep(0.4)
+            self._exit("io")
+            return "io"
+
+        def default_call(self):
+            self._enter("default")
+            time.sleep(0.2)
+            self._exit("default")
+            return "d"
+
+        def peaks(self):
+            return dict(self.peak)
+
+    w = Worker.remote()
+    t0 = time.monotonic()
+    refs = [w.io_call.remote() for _ in range(4)]
+    refs += [w.default_call.remote() for _ in range(2)]
+    out = ray_tpu.get(refs, timeout=120)
+    wall = time.monotonic() - t0
+    assert out == ["io"] * 4 + ["d"] * 2
+    peaks = ray_tpu.get(w.peaks.remote(), timeout=60)
+    # The peak counters are the precise check: the io pool reached
+    # exactly its 2 permits while the default pool stayed serialized.
+    # (No wall-clock assertion: dispatch overhead on the 1-core CI host
+    # dwarfs the 0.4s sleeps.)
+    assert peaks["io"] == 2, peaks
+    assert peaks["default"] == 1, peaks
+    del wall
+
+    # call-time group override routes into the io pool
+    r = w.default_call.options(concurrency_group="io").remote()
+    assert ray_tpu.get(r, timeout=60) == "d"
