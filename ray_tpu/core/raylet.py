@@ -749,9 +749,16 @@ class Raylet:
             # error is visible, a leaked reservation is not.
             logger.warning("runtime_env working_dir %s does not exist; ignoring", working_dir)
             working_dir = None
-        proc = subprocess.Popen(
+        from .runtime_env import resolve_python_executable, wrap_worker_command
+
+        # Interpreter-level plugins: py_executable / conda pick the
+        # worker's python; container wraps the whole command in
+        # podman/docker. Failures raise BEFORE the Popen so the lease
+        # reply carries the plugin's error, not a crash-looping worker.
+        py = resolve_python_executable(runtime_env) or sys.executable
+        cmd = wrap_worker_command(
             [
-                sys.executable,
+                py,
                 "-m",
                 "ray_tpu.core.worker_main",
                 "--raylet-address",
@@ -767,6 +774,10 @@ class Raylet:
                 "--store-capacity",
                 str(self.object_store_capacity),
             ],
+            runtime_env,
+        )
+        proc = subprocess.Popen(
+            cmd,
             env=env,
             cwd=working_dir,
             stdout=open(log_path, "wb"),

@@ -182,3 +182,119 @@ def apply_runtime_env(env: dict, runtime_env: dict | None) -> str | None:
             paths.append(env["PYTHONPATH"])
         env["PYTHONPATH"] = os.pathsep.join(paths)
     return working_dir
+
+
+# ------------------------------------------------- interpreter-level plugins
+
+
+class RuntimeEnvSetupError(RuntimeError):
+    """A runtime_env plugin could not be satisfied on this node
+    (reference ``_private/runtime_env``'s setup failure surface)."""
+
+
+def _conda_base() -> str | None:
+    import shutil
+
+    exe = os.environ.get("CONDA_EXE") or shutil.which("conda") \
+        or shutil.which("micromamba") or shutil.which("mamba")
+    if exe is None:
+        return None
+    try:
+        out = subprocess.run([exe, "info", "--base"], capture_output=True,
+                             text=True, timeout=30)
+        base = out.stdout.strip().splitlines()[-1].strip() if out.returncode == 0 else ""
+    except Exception:
+        base = ""
+    if not base:
+        # micromamba: root prefix env var
+        base = os.environ.get("MAMBA_ROOT_PREFIX", "")
+    return base or None
+
+
+def _conda_env_python(spec) -> str:
+    """Python interpreter of the requested conda env (reference
+    ``runtime_env/conda.py``): a string names an EXISTING env; a dict is
+    an environment.yml-style spec created once and cached by hash."""
+    base = _conda_base()
+    if base is None:
+        raise RuntimeEnvSetupError(
+            "runtime_env 'conda' requires a conda/micromamba installation "
+            "on the node; none found on PATH (and CONDA_EXE unset)")
+    if isinstance(spec, str):
+        candidates = [os.path.join(base, "envs", spec, "bin", "python")]
+        if spec in ("base", ""):
+            candidates.insert(0, os.path.join(base, "bin", "python"))
+        for c in candidates:
+            if os.path.exists(c):
+                return c
+        raise RuntimeEnvSetupError(
+            f"conda env {spec!r} not found under {base}/envs")
+    # dict spec: create under the URI cache, keyed by content hash
+    import hashlib
+    import json
+    import shutil as _shutil
+
+    blob = json.dumps(spec, sort_keys=True).encode()
+    uri = hashlib.sha1(blob).hexdigest()[:16]
+
+    def build(target: str) -> None:
+        yml = os.path.join(target, "environment.yml")
+        os.makedirs(target, exist_ok=True)
+        with open(yml, "w") as f:
+            json.dump(spec, f)
+        exe = os.environ.get("CONDA_EXE") or _shutil.which("conda") \
+            or _shutil.which("micromamba")
+        r = subprocess.run(
+            [exe, "env", "create", "--prefix", os.path.join(target, "env"),
+             "--file", yml, "--yes"],
+            capture_output=True, text=True, timeout=1800)
+        if r.returncode != 0:
+            raise RuntimeEnvSetupError(
+                f"conda env create failed:\n{r.stderr[-2000:]}")
+
+    target = _prepare_cached("conda", uri, build)
+    return os.path.join(target, "env", "bin", "python")
+
+
+def resolve_python_executable(runtime_env: dict | None) -> str | None:
+    """Interpreter override for worker processes: ``py_executable``
+    (reference ``runtime_env/py_executable.py``) or ``conda`` (reference
+    ``runtime_env/conda.py`` — hermetic env, its python). None = the
+    raylet's own interpreter."""
+    renv = runtime_env or {}
+    if renv.get("py_executable"):
+        py = renv["py_executable"]
+        if not os.path.exists(py):
+            raise RuntimeEnvSetupError(f"py_executable {py!r} does not exist")
+        return py
+    if renv.get("conda"):
+        return _conda_env_python(renv["conda"])
+    return None
+
+
+def wrap_worker_command(cmd: list[str], runtime_env: dict | None) -> list[str]:
+    """``container``/``image_uri`` plugin (reference
+    ``runtime_env/image_uri.py``): run the worker inside a container via
+    podman/docker when a runtime exists — host network (the worker must
+    reach the raylet/GCS sockets) and /tmp + the repo mounted so the shm
+    store arena and source tree resolve. Raises a clear setup error when
+    no container runtime is installed."""
+    import shutil
+
+    renv = runtime_env or {}
+    spec = renv.get("container") or (
+        {"image": renv["image_uri"]} if renv.get("image_uri") else None)
+    if not spec:
+        return cmd
+    image = spec.get("image") if isinstance(spec, dict) else spec
+    engine = shutil.which("podman") or shutil.which("docker")
+    if engine is None:
+        raise RuntimeEnvSetupError(
+            "runtime_env 'container'/'image_uri' requires podman or docker "
+            "on the node; neither found on PATH")
+    run_opts = list(spec.get("run_options") or []) if isinstance(spec, dict) else []
+    repo = package_root()
+    return [engine, "run", "--rm", "--network=host",
+            "-v", "/tmp:/tmp", "-v", "/dev/shm:/dev/shm",
+            "-v", f"{repo}:{repo}",
+            *run_opts, image, *cmd]

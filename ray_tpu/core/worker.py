@@ -945,8 +945,11 @@ class CoreWorker:
                     with self._queue_lock:
                         queue = self._task_queues.get(key) or []
                         specs, self._task_queues[key] = list(queue), []
+                    reason = getattr(self, "_last_lease_denial", "") or \
+                        "cluster infeasible or timeout"
                     for spec in specs:
-                        self._fail_task(spec, RayTpuError("Failed to lease a worker (cluster infeasible or timeout)"))
+                        self._fail_task(spec, RayTpuError(
+                            f"Failed to lease a worker ({reason})"))
                     return
                 worker_addr, worker_id, raylet_client = lease
                 worker = RpcClient(worker_addr)
@@ -1055,6 +1058,7 @@ class CoreWorker:
 
         deadline = time.monotonic() + get_config().worker_register_timeout_s * 2
         raylet = self.raylet
+        self._last_lease_denial = ""  # never report a stale reason
         try:
             while time.monotonic() < deadline:
                 for _hop in range(4):
@@ -1079,7 +1083,12 @@ class CoreWorker:
                             await raylet.close()
                         raylet = RetryableRpcClient(reply["node_address"])
                         continue
-                    return None  # definitive denial (infeasible / timeout)
+                    # definitive denial (infeasible / timeout / worker
+                    # start failure): keep the raylet's reason so the
+                    # task error names the actual cause (e.g. a
+                    # runtime_env plugin setup failure)
+                    self._last_lease_denial = reply.get("reason", "")
+                    return None
                 if raylet is not self.raylet:
                     await raylet.close()
                     raylet = self.raylet

@@ -115,3 +115,72 @@ def test_mismatched_envs_never_share_a_worker(tmp_path):
         timeout=120) is True
     # plain-env task right after: must NOT land on the py_modules worker
     assert ray_tpu.get(has_module.remote("renv_only_a"), timeout=120) is False
+
+
+def test_py_executable_plugin(ray_cluster):
+    """runtime_env py_executable picks the worker's interpreter
+    (reference runtime_env/py_executable.py) — here the same python via
+    its real path, proving the plumb reaches the spawn."""
+    import sys
+
+    import ray_tpu
+
+    @ray_tpu.remote(runtime_env={"py_executable": sys.executable})
+    def which_python():
+        import sys as s
+
+        return s.executable
+
+    out = ray_tpu.get(which_python.remote(), timeout=120)
+    assert out == sys.executable
+
+
+def test_conda_and_container_gated_errors(ray_cluster):
+    """conda/container plugins fail the LEASE with a clear setup error
+    when the node lacks the tooling (this image has neither), instead of
+    crash-looping a worker (reference runtime_env setup-error surface)."""
+    import pytest as _pytest
+
+    import ray_tpu
+    from ray_tpu.core.runtime_env import (
+        RuntimeEnvSetupError, resolve_python_executable, wrap_worker_command)
+
+    import shutil
+
+    if shutil.which("conda") or shutil.which("micromamba"):
+        _pytest.skip("conda present on this host")
+    with _pytest.raises(RuntimeEnvSetupError, match="conda"):
+        resolve_python_executable({"conda": "myenv"})
+    if shutil.which("docker") or shutil.which("podman"):
+        _pytest.skip("container runtime present on this host")
+    with _pytest.raises(RuntimeEnvSetupError, match="podman or docker"):
+        wrap_worker_command(["python"], {"image_uri": "img:latest"})
+
+    @ray_tpu.remote(runtime_env={"conda": "myenv"})
+    def f():
+        return 1
+
+    with _pytest.raises(Exception, match="conda"):
+        ray_tpu.get(f.remote(), timeout=120)
+
+
+def test_conda_plugin_resolves_existing_env(tmp_path, monkeypatch):
+    """With a (stubbed) conda on PATH, a string spec resolves to the
+    named env's interpreter."""
+    import stat
+    import sys
+
+    from ray_tpu.core.runtime_env import resolve_python_executable
+
+    base = tmp_path / "conda_base"
+    envpy = base / "envs" / "myenv" / "bin"
+    envpy.mkdir(parents=True)
+    (envpy / "python").write_text("#!/bin/sh\n")
+    stub = tmp_path / "bin" / "conda"
+    stub.parent.mkdir()
+    stub.write_text(f"#!/bin/sh\necho {base}\n")
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{stub.parent}:{os.environ['PATH']}")
+    monkeypatch.delenv("CONDA_EXE", raising=False)
+    py = resolve_python_executable({"conda": "myenv"})
+    assert py == str(envpy / "python")
