@@ -26,6 +26,7 @@ from .impala import APPO, APPOConfig, IMPALA, IMPALAConfig
 from .learner import Learner
 from .learner_group import LearnerGroup
 from .dqn import DQN, DQNConfig
+from .dreamerv3 import DreamerV3, DreamerV3Config
 from .offline import (BC, BCConfig, CQL, CQLConfig, MARWIL, MARWILConfig,
                       collect_offline_data)
 from .multi_agent import (MultiAgentCartPole, MultiAgentEnvRunner,
@@ -62,6 +63,8 @@ __all__ = [
     "CQL",
     "CQLConfig",
     "collect_offline_data",
+    "DreamerV3",
+    "DreamerV3Config",
     "MARWIL",
     "MARWILConfig",
     "MultiAgentCartPole",

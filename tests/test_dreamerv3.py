@@ -1,0 +1,109 @@
+"""DreamerV3: world-model learning + imagination actor-critic.
+
+Mirrors the reference's DreamerV3 test strategy
+(``rllib/algorithms/dreamerv3/``): unit checks on the distribution
+utilities, a world-model-loss learning curve, and an end-to-end
+learning assertion on a vector-obs control task.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from ray_tpu.rllib import CartPole, DreamerV3Config
+from ray_tpu.rllib.dreamerv3 import (
+    symlog, symexp, twohot, twohot_decode, _lambda_returns)
+
+
+def _tiny_config(**overrides):
+    kw = dict(deter=64, stoch_groups=4, stoch_classes=8, hidden=64,
+              seq_len=16, batch_size=8, imag_horizon=8,
+              rollout_len=32, updates_per_iteration=4,
+              learning_starts=128, buffer_size=1024,
+              entropy_scale=3e-3)
+    kw.update(overrides)
+    return (DreamerV3Config()
+            .environment(CartPole)
+            .env_runners(num_envs_per_runner=8)
+            .seeding(1)
+            .training(**kw))
+
+
+def test_symlog_twohot_roundtrip():
+    x = jnp.array([-50.0, -1.0, 0.0, 0.5, 3.0, 200.0])
+    np.testing.assert_allclose(symexp(symlog(x)), x, rtol=1e-5, atol=1e-5)
+    # twohot encode -> expected-value decode is the identity on the
+    # support (x enters/leaves in raw space, bins live in symlog space)
+    y = symlog(x)
+    dec = symexp(twohot(y) @ jnp.linspace(-15.0, 15.0, 63))
+    np.testing.assert_allclose(dec, x, rtol=1e-3, atol=1e-3)
+    probs = twohot(y)
+    assert probs.shape == (6, 63)
+    np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-6)
+
+
+def test_lambda_returns_hand_computed():
+    # H=2, N=1; conts are per-transition. gamma=1, lam=1 -> pure
+    # Monte Carlo + bootstrap
+    rews = jnp.array([[1.0], [2.0]])
+    conts = jnp.array([[1.0], [1.0]])
+    values = jnp.array([[10.0], [20.0], [30.0]])
+    rets = _lambda_returns(rews, conts, values, gamma=1.0, lam=1.0)
+    np.testing.assert_allclose(rets[:, 0], [1.0 + 2.0 + 30.0, 2.0 + 30.0])
+    # lam=0 -> one-step TD targets
+    rets0 = _lambda_returns(rews, conts, values, gamma=0.5, lam=0.0)
+    np.testing.assert_allclose(rets0[:, 0], [1.0 + 0.5 * 20.0,
+                                             2.0 + 0.5 * 30.0])
+    # a terminating first transition masks everything after step 0
+    conts_t = jnp.array([[0.0], [1.0]])
+    rets_t = _lambda_returns(rews, conts_t, values, gamma=0.9, lam=1.0)
+    np.testing.assert_allclose(rets_t[0, 0], 1.0)
+    np.testing.assert_allclose(rets_t[1, 0], 2.0 + 0.9 * 30.0)
+
+
+def test_world_model_loss_decreases():
+    algo = _tiny_config().build()
+    first = last = None
+    for _ in range(12):
+        m = algo.training_step()
+        if "wm_loss" in m:
+            first = m["wm_loss"] if first is None else first
+            last = m["wm_loss"]
+    assert first is not None, "updates never started"
+    assert last < first, (first, last)
+    assert np.isfinite(last)
+
+
+def test_dreamerv3_cartpole_learns():
+    # Seed-1 curve on a 1-core CPU host: random ~17 at iter 0, crosses
+    # 60 around iter 55-60, 100+ by iter 80 (~25 s wall after compile).
+    algo = _tiny_config(updates_per_iteration=8).build()
+    first = None
+    result = {}
+    for i in range(80):
+        result = algo.training_step()
+        r = result.get("episode_return_mean")
+        if r is not None and first is None:
+            first = r
+        if r is not None and r > 60.0 and i > 5:
+            break
+    assert first is not None
+    assert result["episode_return_mean"] > max(45.0, 1.5 * first), result
+
+
+def test_dreamerv3_checkpoint_roundtrip(tmp_path):
+    algo = _tiny_config().build()
+    for _ in range(5):
+        algo.training_step()
+    path = str(tmp_path / "ckpt")
+    algo.save(path)
+    it = algo.iteration
+    algo2 = _tiny_config().build()
+    algo2.restore(path)
+    assert algo2.iteration == it
+    a = algo.state["wm"]["prior"][0]["w"]
+    b = algo2.state["wm"]["prior"][0]["w"]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    # evaluation harness runs with restored weights
+    ev = algo2.evaluate()
+    assert ev["evaluation"]["num_episodes"] >= 1
