@@ -38,8 +38,26 @@ def init(
     with _init_lock:
         if is_initialized():
             if ignore_reinit_error:
-                return {"address": _node.gcs_address if _node else address}
-            raise RayTpuError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
+                # Re-entrant init is only a no-op if the cluster this
+                # process started is actually still ALIVE. A locally
+                # hosted raylet can die underneath us (OOM-killed store,
+                # crashed node harness): without this probe every later
+                # init() no-ops against the corpse and each new worker
+                # fails booting on the vanished shm store file.
+                if _node is None or _local_cluster_alive(_node):
+                    return {"address": _node.gcs_address if _node else address}
+                if _node.gcs is None:
+                    # Attached to a REMOTE cluster: rebooting would
+                    # silently swap the user onto an isolated local
+                    # cluster. Surface the death instead.
+                    raise RayTpuError(
+                        "local raylet attached to %s has died; call "
+                        "ray_tpu.shutdown() then init(address=...) to "
+                        "reattach" % _node.gcs_address)
+                _shutdown_locked(tolerant=True)
+            else:
+                raise RayTpuError(
+                    "ray_tpu.init() called twice; pass ignore_reinit_error=True")
         if _system_config:
             from .config import get_config
 
@@ -88,18 +106,44 @@ def is_initialized() -> bool:
         return False
 
 
-def shutdown() -> None:
+def _local_cluster_alive(node) -> bool:
+    """Cheap liveness probe for the in-process cluster: the raylet's shm
+    store segment must still exist (it vanishes when the store process
+    dies or the node harness was torn down behind our back)."""
+    try:
+        return os.path.exists(node.raylet.store_path)
+    except Exception:
+        return False
+
+
+def _shutdown_locked(tolerant: bool = False) -> None:
+    """Shutdown body; caller holds ``_init_lock``. ``tolerant`` is for
+    tearing down an already-dead cluster (the init liveness probe),
+    where teardown steps are expected to fail; a user-called shutdown
+    of a healthy cluster keeps errors loud."""
     global _node
-    with _init_lock:
+    try:
+        worker = global_worker()
+        worker.shutdown()
+    except RayTpuError:
+        pass
+    except Exception:
+        if not tolerant:
+            raise
+    set_global_worker(None)
+    if _node is not None:
         try:
-            worker = global_worker()
-            worker.shutdown()
-        except RayTpuError:
-            pass
-        set_global_worker(None)
-        if _node is not None:
             _node.shutdown()
-            _node = None
+        except Exception:
+            if not tolerant:
+                _node = None
+                raise
+        _node = None
+
+
+def shutdown() -> None:
+    with _init_lock:
+        _shutdown_locked()
 
 
 def put(value: Any) -> ObjectRef:

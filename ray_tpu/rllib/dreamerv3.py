@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import optax
 
 from .algorithm import Algorithm, AlgorithmConfig
+from .models import _dense_stack
 
 # ------------------------------------------------------------------ symlog
 
@@ -88,14 +89,12 @@ def _ce(logits, target):
 # ------------------------------------------------------------------ layers
 
 
-def _dense(key, i, o):
-    return {"w": jax.random.normal(key, (i, o), jnp.float32) * (2.0 / i) ** 0.5,
-            "b": jnp.zeros((o,), jnp.float32)}
-
-
 def _mlp(key, sizes):
-    keys = jax.random.split(key, len(sizes) - 1)
-    return [_dense(k, i, o) for k, i, o in zip(keys, sizes[:-1], sizes[1:])]
+    return _dense_stack(key, tuple(sizes))
+
+
+def _dense(key, i, o):
+    return _dense_stack(key, (i, o))[0]
 
 
 def _mlp_fwd(layers, x, out_linear=True):
@@ -523,6 +522,7 @@ class DreamerV3(Algorithm):
         # (sampling needs a full seq_len window in every stream).
         if (self._steps_sampled >= cfg.learning_starts
                 and self._buf_size >= cfg.seq_len):
+            m: dict = {}
             for _ in range(cfg.updates_per_iteration):
                 self._key, sub = jax.random.split(self._key)
                 self.state, m = self._update(
