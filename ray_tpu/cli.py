@@ -41,12 +41,16 @@ def main(argv: list[str] | None = None) -> int:
 
     list_p = sub.add_parser("list", help="list cluster entities")
     list_p.add_argument("what", choices=["nodes", "actors", "tasks", "workers",
-                                         "objects", "placement-groups"])
+                                         "objects", "placement-groups", "errors"])
     sub.add_parser("summary", help="task counts by name and state")
     tl = sub.add_parser("timeline", help="dump a chrome://tracing file")
     tl.add_argument("-o", "--output", default="timeline.json")
     sub.add_parser("metrics", help="aggregated metrics (Prometheus text format)")
     sub.add_parser("status", help="cluster resource overview")
+    doctor_p = sub.add_parser(
+        "doctor", help="aggregate per-node debug state + recent error events")
+    doctor_p.add_argument("--errors", type=int, default=10,
+                          help="recent error events to show")
 
     args = parser.parse_args(argv)
     _connect(args.address)
@@ -65,6 +69,8 @@ def main(argv: list[str] | None = None) -> int:
             rows, cols = st.list_workers(), ["worker_id", "state", "pid", "node_id"]
         elif what == "objects":
             rows, cols = st.list_objects(), ["object_id", "size", "state", "node_id"]
+        elif what == "errors":
+            rows, cols = st.list_errors(), ["type", "source", "node_id", "message"]
         else:
             rows, cols = st.list_placement_groups(), ["pg_id", "state", "strategy"]
         print(json.dumps(rows, indent=2, default=str) if args.as_json else "", end="")
@@ -86,6 +92,39 @@ def main(argv: list[str] | None = None) -> int:
         print(f"nodes: {sum(1 for n in nodes if n['state'] == 'ALIVE')} alive / {len(nodes)}")
         for k in sorted(total):
             print(f"  {k}: {avail.get(k, 0.0):g} / {total[k]:g} available")
+    elif args.cmd == "doctor":
+        diag = st.cluster_diagnostics(error_limit=args.errors)
+        if args.as_json:
+            print(json.dumps(diag, indent=2, default=str))
+            return 0
+        gcs = diag["gcs"]
+        print("GCS: nodes=%s actors=%s placement_groups=%s errors_buffered=%s" % (
+            gcs.get("nodes_by_state", {}), gcs.get("actors_by_state", {}),
+            gcs.get("placement_groups_by_state", {}), gcs.get("errors_buffered", 0)))
+        rows = []
+        for snap in diag["nodes"]:
+            queue = snap.get("lease_queue") or []
+            store = snap.get("store") or {}
+            rows.append({
+                "node_id": snap.get("node_id", ""),
+                "lease_queue": snap.get("lease_queue_depth", "?"),
+                "oldest_wait_s": max((e["age_s"] for e in queue), default=0.0),
+                "workers": snap.get("num_workers", "?"),
+                "idle": snap.get("idle_workers", "?"),
+                "store_used": store.get("used", "?"),
+                "wedges": snap.get("wedge_events_total", 0),
+                "oom_kills": snap.get("oom_kills_total", 0),
+            })
+        print("per-node lease queues / worker pools:")
+        _print_table(rows, ["node_id", "lease_queue", "oldest_wait_s", "workers",
+                            "idle", "store_used", "wedges", "oom_kills"])
+        errors = diag["errors"]
+        print(f"recent errors ({len(errors)}):")
+        for e in errors:
+            print("  [%s/%s] node=%s %s" % (
+                e.get("source", "?"), e.get("type", "?"),
+                (e.get("node_id") or "")[:8],
+                str(e.get("message", "")).splitlines()[0][:120] if e.get("message") else ""))
     return 0
 
 

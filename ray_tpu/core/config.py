@@ -131,6 +131,18 @@ class RayTpuConfig:
     task_events_flush_interval_ms: int = 1000
     enable_timeline: bool = True
 
+    # --- diagnostics ---------------------------------------------------------
+    # Retained ErrorEvents in the GCS error-info buffer (list_errors()).
+    error_info_buffer_size: int = 1000
+    # Raylet/GCS debug_state_*.txt dump cadence; 0 disables periodic dumps
+    # (the GetDebugState RPC always works).
+    debug_state_dump_interval_s: float = 10.0
+    # Lease-wedge watchdog: fire an ErrorEvent when an admission-queue
+    # entry has waited this long while its resources could be granted
+    # (head-of-line blocking / missed wake). 0 disables the watchdog.
+    lease_wedge_threshold_s: float = 10.0
+    lease_wedge_check_interval_s: float = 1.0
+
     # --- workers / executor --------------------------------------------------
     # Thread pool depth per worker (long-poll actor methods park threads).
     worker_executor_threads: int = 64

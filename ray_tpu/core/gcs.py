@@ -83,10 +83,12 @@ class Publisher:
 
 
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, storage=None):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, storage=None,
+                 session_dir: str | None = None):
         self._server = RpcServer(host, port)
         self._server.register_service(self)
         self.publisher = Publisher()
+        self._session_dir = session_dir
         # Fault tolerance (redis_store_client.h equivalent): durable tables
         # snapshot through `storage`; a restarted GCS restores them and
         # raylets re-register on their next heartbeat.
@@ -119,6 +121,10 @@ class GcsServer:
             max_tasks=get_config().task_events_buffer_size
         )
         self._metrics: dict[str, tuple[float, list[dict]]] = {}  # worker -> (ts, snapshot)
+        # Error-info table: retained ErrorEvents behind the pub/sub channel
+        # (reference ErrorInfoHandler / RAY_ERROR_INFO_CHANNEL).
+        self._errors: list[dict] = []
+        self._debug_dump_task: asyncio.Task | None = None
 
     # ------------------------------------------------------------------ util
     def _spawn(self, coro) -> asyncio.Task:
@@ -132,6 +138,8 @@ class GcsServer:
             self._health_task.cancel()
         if self._persist_task:
             self._persist_task.cancel()
+        if self._debug_dump_task:
+            self._debug_dump_task.cancel()
         for task in list(self._bg_tasks):
             task.cancel()
 
@@ -140,6 +148,8 @@ class GcsServer:
         await self._server.start()
         self._health_task = spawn(self._health_check_loop())
         self._persist_task = spawn(self._persist_loop())
+        if self._session_dir:
+            self._debug_dump_task = spawn(self._debug_dump_loop())
 
     async def stop(self) -> None:
         self._cancel_bg()
@@ -383,6 +393,88 @@ class GcsServer:
 
     async def handle_Timeline(self, p: dict) -> dict:
         return {"trace": self.task_events.chrome_trace()}
+
+    # ----------------------------------------------------------- error info
+    async def handle_PublishError(self, p: dict) -> dict:
+        """Record + broadcast an ErrorEvent (reference
+        ``publish_error_to_driver`` → RAY_ERROR_INFO_CHANNEL). The event is
+        retained in a bounded table for ``ListErrors`` AND published on the
+        long-poll channel for live driver subscribers."""
+        from ..diagnostics.errors import ERROR_INFO_CHANNEL
+
+        event = dict(p.get("event") or {})
+        event.setdefault("timestamp", time.time())
+        self._errors.append(event)
+        max_events = get_config().error_info_buffer_size
+        if len(self._errors) > max_events:
+            del self._errors[: len(self._errors) - max_events]
+        await self.publisher.publish(ERROR_INFO_CHANNEL, event)
+        return {}
+
+    async def handle_ListErrors(self, p: dict) -> dict:
+        """Filtered view of retained ErrorEvents. ``limit=0`` returns no
+        events — used by drivers to fetch just the channel cursor before
+        subscribing (no history replay)."""
+        from ..diagnostics.errors import ERROR_INFO_CHANNEL
+
+        source, etype = p.get("source"), p.get("type")
+        limit = p.get("limit", 100)
+        out = [
+            e for e in self._errors
+            if (not source or e.get("source") == source)
+            and (not etype or e.get("type") == etype)
+        ]
+        return {
+            "errors": out[-limit:] if limit else [],
+            "cursor": self.publisher.current_seq(ERROR_INFO_CHANNEL),
+        }
+
+    def _debug_state_snapshot(self) -> dict:
+        """Control-plane FSM counts (the GCS half of debug_state.txt)."""
+        def by_state(records, key: str = "state") -> dict[str, int]:
+            out: dict[str, int] = {}
+            for r in records:
+                s = r.get(key, "?")
+                out[s] = out.get(s, 0) + 1
+            return out
+
+        return {
+            "num_nodes": len(self._nodes),
+            "nodes_by_state": by_state(self._nodes.values()),
+            "actors_by_state": by_state(self._actors.values()),
+            "named_actors": len(self._named_actors),
+            "placement_groups_by_state": by_state(self._placement_groups.values()),
+            "jobs_by_state": by_state(self._jobs.values()),
+            "kv_keys": len(self._kv),
+            "tasks_by_state": self.task_events.count_by_state(),
+            "errors_buffered": len(self._errors),
+        }
+
+    async def handle_GetDebugState(self, p: dict) -> dict:
+        return {"debug_state": self._debug_state_snapshot()}
+
+    async def _debug_dump_loop(self) -> None:
+        """Periodic ``debug_state_gcs.txt`` in the session dir (reference:
+        every component dumps its DebugString on an interval)."""
+        import os
+
+        from ..diagnostics.debug_state import write_debug_state
+
+        last = 0.0
+        while True:
+            await asyncio.sleep(0.5)
+            interval = get_config().debug_state_dump_interval_s
+            now = time.monotonic()
+            if interval <= 0 or now - last < interval:
+                continue
+            last = now
+            try:
+                path = os.path.join(self._session_dir, "debug_state_gcs.txt")
+                snapshot = self._debug_state_snapshot()
+                await asyncio.get_running_loop().run_in_executor(
+                    None, write_debug_state, path, "GCS", snapshot)
+            except Exception:
+                logger.exception("GCS debug-state dump failed")
 
     async def handle_ListPlacementGroups(self, p: dict) -> dict:
         return {

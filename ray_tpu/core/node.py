@@ -41,7 +41,8 @@ class Node:
         if head:
             from .gcs_storage import storage_from_config
 
-            self.gcs = GcsServer(storage=storage_from_config(self.session_dir))
+            self.gcs = GcsServer(storage=storage_from_config(self.session_dir),
+                                 session_dir=self.session_dir)
             self.services_loop.run_sync(self.gcs.start())
             gcs_address = self.gcs.address
         assert gcs_address is not None

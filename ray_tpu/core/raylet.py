@@ -229,6 +229,10 @@ class Raylet:
         # Transfer counters (observability + the broadcast fan-out test).
         self.transfer_stats = {"chunks_served": 0, "pushes_served": 0,
                                "pulls_started": 0}
+        # Diagnostics counters (debug_state + the lease-wedge watchdog).
+        self._wedge_events_total = 0
+        self._oom_kills_total = 0
+        self._started_at = time.monotonic()
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> None:
@@ -248,6 +252,8 @@ class Raylet:
         self._tasks.append(spawn(self._heartbeat_loop()))
         self._tasks.append(spawn(self._worker_monitor_loop()))
         self._tasks.append(spawn(self._memory_monitor_loop()))
+        self._tasks.append(spawn(self._debug_dump_loop()))
+        self._tasks.append(spawn(self._lease_watchdog_loop()))
         if get_config().log_to_driver:
             self._tasks.append(spawn(self._log_monitor_loop()))
         cfg = get_config()
@@ -595,7 +601,15 @@ class Raylet:
         working_dir = renv.get("working_dir") or ""
         py_modules = renv.get("py_modules") or []
         pip = renv.get("pip") or renv.get("uv") or []
-        if not env_vars and not working_dir and not py_modules and not pip:
+        # Interpreter-level plugins key the hash too: a conda/py_executable/
+        # container task must NEVER match an idle default-interpreter worker
+        # — that silently ran it on the wrong interpreter (and skipped the
+        # plugin's setup-error surface entirely).
+        interp = {k: renv.get(k)
+                  for k in ("py_executable", "conda", "container", "image_uri")
+                  if renv.get(k)}
+        if (not env_vars and not working_dir and not py_modules and not pip
+                and not interp):
             return ""
         import hashlib
         import json
@@ -609,7 +623,8 @@ class Raylet:
 
             modules_digest = _hash_paths(list(py_modules))
         blob = json.dumps({"env_vars": env_vars, "working_dir": working_dir,
-                           "py_modules": modules_digest, "pip": pip},
+                           "py_modules": modules_digest, "pip": pip,
+                           "interp": interp},
                           sort_keys=True, default=str)
         return hashlib.sha1(blob.encode()).hexdigest()[:16]
 
@@ -881,6 +896,7 @@ class Raylet:
             "seq": self._admission_seq,
             "request": request,
             "fut": asyncio.get_running_loop().create_future(),
+            "enqueued_at": time.monotonic(),  # lease-wedge watchdog input
         }
         # Insert in (priority, seq) order: earlier same-priority requests
         # stay ahead; higher-priority (lower number) requests go first.
@@ -1380,6 +1396,16 @@ class Raylet:
             victim.proc.kill()
         except Exception:
             pass
+        self._oom_kills_total += 1
+        from ..diagnostics.errors import make_event
+
+        spawn(self._publish_error_event(make_event(
+            "oom_kill",
+            f"node memory usage {usage * 100:.0f}% above threshold: killed "
+            f"newest retriable lease (worker {victim.worker_id[:12]}, "
+            f"pid {victim.pid})",
+            source="raylet", node_id=self.node_id.hex(),
+            worker_id=victim.worker_id, actor_id=victim.actor_id)))
         return True
 
     # ------------------------------------------------------- plasma service
@@ -1908,6 +1934,150 @@ class Raylet:
             "spilled_bytes_total": self._spilled_bytes_total,
             "restored_bytes_total": self._restored_bytes_total,
         }
+
+    # ----------------------------------------------------------- diagnostics
+    def _debug_state_snapshot(self) -> dict:
+        """Full raylet internals for debug_state.txt / GetDebugState /
+        wedge reports: the lease admission queue with per-entry ages (the
+        round-5 cascade was invisible precisely because this view did not
+        exist), worker-pool states, bundle ledger, store/spill/OOM
+        counters (reference node_manager.cc DebugString)."""
+        now = time.monotonic()
+        lease_queue = [
+            {
+                "shape": e["request"].to_dict(),
+                "priority": e["prio"],
+                "seq": e["seq"],
+                "age_s": round(now - e.get("enqueued_at", now), 3),
+                "granted": e["fut"].done(),
+            }
+            for e in self._admission_queue
+        ]
+        workers_by_state: dict[str, int] = {}
+        for w in self._workers.values():
+            workers_by_state[w.state] = workers_by_state.get(w.state, 0) + 1
+        return {
+            "node_id": self.node_id.hex(),
+            "address": self.address,
+            "uptime_s": round(now - self._started_at, 1),
+            "resources": self.resources.to_dict(),
+            "lease_queue_depth": len(self._admission_queue),
+            "lease_queue": lease_queue,
+            "lease_waiters": len(self._lease_waiters),
+            "pending_demand": [
+                {"shape": dict(shape), "count": count}
+                for shape, count in self._pending_lease_demand.items()
+            ],
+            "workers_by_state": workers_by_state,
+            "num_workers": len(self._workers),
+            "idle_workers": len(self._idle),
+            "pg_bundles": [
+                {"pg_id": key[0], "bundle_index": key[1],
+                 "committed": b.get("committed", False),
+                 "resources": b["resources"].to_dict(),
+                 "used": b["used"].to_dict()}
+                for key, b in self._pg_bundles.items()
+            ],
+            "fence_pending": {str(k): v for k, v in self._fence_pending.items()},
+            "store": {
+                "used": self.store.used(),
+                "capacity": self.object_store_capacity,
+                "objects": self.store.num_objects(),
+                "spilled_objects": len(self._spilled),
+                "spilled_bytes_total": self._spilled_bytes_total,
+                "restored_bytes_total": self._restored_bytes_total,
+                "receiving": len(self._receiving),
+                "pull_inflight": self._pull_inflight,
+                "pull_waiters": len(self._pull_waiters),
+            },
+            "transfer_stats": dict(self.transfer_stats),
+            "oom_kills_total": self._oom_kills_total,
+            "wedge_events_total": self._wedge_events_total,
+        }
+
+    async def handle_GetDebugState(self, p: dict) -> dict:
+        return {"debug_state": self._debug_state_snapshot()}
+
+    async def _publish_error_event(self, event: dict) -> None:
+        """Best-effort ErrorEvent publish to the GCS error-info channel."""
+        try:
+            await self._gcs.call("PublishError", {"event": event}, timeout=5.0)
+        except Exception:
+            pass
+
+    async def _debug_dump_loop(self) -> None:
+        """Write ``debug_state_<node>.txt`` into the session dir on an
+        interval (reference: raylet debug_state.txt dumps). Polls the
+        config each tick so tests (and live operators) can retune the
+        cadence without restarting the raylet."""
+        from ..diagnostics.debug_state import write_debug_state
+
+        last = 0.0
+        while True:
+            await asyncio.sleep(0.5)
+            interval = get_config().debug_state_dump_interval_s
+            now = time.monotonic()
+            if interval <= 0 or now - last < interval:
+                continue
+            last = now
+            try:
+                path = os.path.join(
+                    self._session_dir,
+                    f"debug_state_{self.node_id.hex()[:12]}.txt")
+                snapshot = self._debug_state_snapshot()
+                await asyncio.get_running_loop().run_in_executor(
+                    None, write_debug_state, path, "raylet", snapshot)
+            except Exception:
+                logger.exception("debug-state dump failed")
+
+    async def _lease_watchdog_loop(self) -> None:
+        """Lease-wedge watchdog: a queued admission entry older than the
+        threshold whose request WOULD fit the free pool means the queue is
+        wedged — head-of-line blocked behind an unsatisfiable entry, or a
+        missed wake. Fire an ErrorEvent carrying the full queue snapshot
+        (the exact instrumentation the round-5 mid-suite lease-timeout
+        cascade lacked), then nudge the dispatcher as a self-heal."""
+        from ..diagnostics.errors import make_event
+
+        while True:
+            cfg = get_config()
+            await asyncio.sleep(max(0.1, cfg.lease_wedge_check_interval_s))
+            threshold = cfg.lease_wedge_threshold_s
+            if threshold <= 0 or not self._admission_queue:
+                continue
+            try:
+                now = time.monotonic()
+                fired = False
+                for entry in list(self._admission_queue):
+                    age = now - entry.get("enqueued_at", now)
+                    if (age < threshold or entry.get("wedge_reported")
+                            or entry["fut"].done()):
+                        continue
+                    if not self.resources.can_fit(entry["request"]):
+                        continue  # genuinely waiting for capacity: not a wedge
+                    entry["wedge_reported"] = True
+                    self._wedge_events_total += 1
+                    fired = True
+                    shape = entry["request"].to_dict()
+                    logger.error(
+                        "lease-wedge watchdog: lease %s (prio %d) pending %.1fs "
+                        "while matching resources are free; queue depth %d",
+                        shape, entry["prio"], age, len(self._admission_queue))
+                    spawn(self._publish_error_event(make_event(
+                        "lease_wedge",
+                        f"lease {shape} pending {age:.1f}s on node "
+                        f"{self.node_id.hex()[:8]} while matching resources are "
+                        f"free (queue depth {len(self._admission_queue)})",
+                        source="raylet", node_id=self.node_id.hex(),
+                        extra={"debug_state": self._debug_state_snapshot()})))
+                if fired:
+                    # Self-heal a missed wake; a truly blocked head keeps the
+                    # queue intact and the report stands.
+                    self._dispatch_admission()
+            except Exception:
+                # The watchdog must outlive any one bad scan (e.g. the
+                # store closing mid-snapshot during teardown).
+                logger.exception("lease-wedge watchdog scan failed")
 
 
 def _node_memory_usage_fraction() -> float:

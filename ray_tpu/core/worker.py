@@ -270,6 +270,11 @@ class CoreWorker:
         # Owner-side streaming-generator state, keyed by task id
         # (reference task_manager.h:212 ObjectRefStream map).
         self._streams: dict[bytes, StreamState] = {}
+        # Driver-side view of the GCS error-info channel (diagnostics):
+        # most recent ErrorEvents seen by the auto-subscriber.
+        from collections import deque
+
+        self._recent_errors: deque = deque(maxlen=256)
 
         # Executor-side state (worker mode).
         self.actor_instance: Any = None
@@ -329,11 +334,60 @@ class CoreWorker:
         )
         if self.mode == MODE_DRIVER and get_config().log_to_driver:
             self.io.run_coro(self._stream_logs_to_driver())
+        if self.mode == MODE_DRIVER:
+            # Auto-subscribe to the error-info channel: worker/raylet/serve
+            # failures surface in the driver's log, not just worker files
+            # (reference: listen_error_messages in worker.py).
+            self.io.run_coro(self._error_info_poller())
+
+    async def _error_info_poller(self) -> None:
+        """Driver-side error-info subscriber: long-poll the GCS channel,
+        cache events for inspection, and log each one — a replica or
+        remote-worker failure becomes visible at the driver without
+        grepping per-worker log files."""
+        import asyncio
+
+        from ..diagnostics.errors import ERROR_INFO_CHANNEL
+
+        cursor = None  # start at the current end: no history replay
+        while True:
+            try:
+                if cursor is None:
+                    reply = await self.gcs.call("ListErrors", {"limit": 0}, timeout=10.0)
+                    cursor = reply.get("cursor", 0)
+                reply = await self.gcs.call(
+                    "SubscribePoll",
+                    {"cursors": {ERROR_INFO_CHANNEL: cursor}, "timeout": 30.0},
+                    timeout=45.0,
+                )
+            except Exception:
+                await asyncio.sleep(1.0)
+                continue
+            msgs = (reply.get("messages") or {}).get(ERROR_INFO_CHANNEL, [])
+            if not msgs:
+                # Empty long-poll: re-check the channel cursor — a restarted
+                # GCS resets Publisher sequences, and a cursor PAST the new
+                # end would filter every future event forever (same clamp as
+                # PollGlobalGc).
+                try:
+                    base = await self.gcs.call("ListErrors", {"limit": 0}, timeout=10.0)
+                    cursor = min(cursor, base.get("cursor", cursor))
+                except Exception:
+                    pass
+                continue
+            for seq, event in msgs:
+                cursor = max(cursor, seq)
+                self._recent_errors.append(event)
+                logger.warning(
+                    "ErrorEvent [%s/%s] node=%s: %s",
+                    event.get("source", "?"), event.get("type", "?"),
+                    (event.get("node_id") or "")[:8], event.get("message", ""))
 
     async def _stream_logs_to_driver(self) -> None:
         """Long-poll the GCS log channel and echo worker output with a
         ``(worker=..., node=...)`` prefix (reference: driver-side
         print_logs over the log pubsub)."""
+        import asyncio
         import sys
 
         cursor = None  # None = "start at the current end" (no history replay)
@@ -1867,6 +1921,7 @@ class CoreWorker:
             tb = traceback.format_exc()
             self.task_events.record(spec.task_id, spec.name, "FAILED", kind=spec.kind,
                                     extra={"error": f"{type(e).__name__}: {e}"})
+            self._publish_task_error(spec, e, tb)
             if spec.kind == TASK_KIND_ACTOR_CREATION:
                 return {"error": f"{type(e).__name__}: {e}\n{tb}"}
             metadata, blob, _ = serialization.serialize_error(RayTaskError(spec.name, tb, e))
@@ -1890,6 +1945,33 @@ class CoreWorker:
             with self._exec_lock:
                 self._exec_threads.pop(spec.task_id, None)
             self.current_task_id = prev_task_id
+
+    def _publish_task_error(self, spec: TaskSpec, error: Exception, tb: str) -> None:
+        """Executor-side publish_error_to_driver: a raising task's full
+        traceback reaches the GCS error-info channel (→ the driver's log
+        and ``state.list_errors()``), not just the serialized return value.
+        Fire-and-forget — diagnostics never blocks or fails execution."""
+        if isinstance(error, TaskCancelledError):
+            return  # a requested cancel is not an error condition
+        try:
+            from ..diagnostics.errors import make_event
+
+            etype = ("actor_creation_failure"
+                     if spec.kind == TASK_KIND_ACTOR_CREATION else "task_failure")
+            actor_id = spec.actor_id or b""
+            event = make_event(
+                etype,
+                f"{spec.name}: {type(error).__name__}: {error}",
+                source="worker",
+                traceback=tb,
+                node_id=self.node_id,
+                worker_id=self.worker_id,
+                actor_id=actor_id.hex() if isinstance(actor_id, bytes) else actor_id,
+                job_id=str(self.job_id.int_value()),
+            )
+            self.io.run_coro(self.gcs.call("PublishError", {"event": event}, 10.0))
+        except Exception:
+            pass
 
     def _deserialize_args(self, spec: TaskSpec) -> tuple[tuple, dict]:
         args: list = []

@@ -30,6 +30,7 @@ _ENDPOINTS = [
     "nodes", "actors", "tasks", "objects", "workers",
     "placement_groups", "jobs", "metrics", "cluster_resources",
     "available_resources", "timeline", "grafana_dashboard",
+    "errors", "diagnostics",
 ]
 
 
@@ -47,6 +48,10 @@ def _collect(endpoint: str):
         return state.list_objects()
     if endpoint == "workers":
         return state.list_workers()
+    if endpoint == "errors":
+        return state.list_errors()
+    if endpoint == "diagnostics":
+        return state.cluster_diagnostics()
     if endpoint == "placement_groups":
         return state.list_placement_groups()
     if endpoint == "jobs":

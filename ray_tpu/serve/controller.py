@@ -62,6 +62,10 @@ class _DeploymentState:
         # exponentially (a broken constructor must not spin replica churn)
         self.consecutive_start_failures = 0
         self.next_start_allowed = 0.0
+        # The most recent replica-start failure's exception text — surfaced
+        # in the controller log, get_app_status(), and the error-info
+        # channel so "failed to start" is never cause-less.
+        self.last_start_failure: str | None = None
 
     @property
     def name(self) -> str:
@@ -150,6 +154,7 @@ class ServeController:
                     "version": state.version,
                     "healthy": len(running) >= state.target_replicas,
                     "deleted": bool(state.config.get("deleted")),
+                    "last_start_failure": state.last_start_failure,
                 }
             return out
 
@@ -242,8 +247,12 @@ class ServeController:
                     if done:
                         ray.get(done[0], timeout=5)
                         p["ready"] = True
-                except Exception:
+                except Exception as e:
                     p["failed"] = True
+                    # Keep the replica's ACTUAL exception (an ActorDiedError
+                    # here embeds the creation task's traceback): the
+                    # "failed to start" log line must name the cause.
+                    p["failure"] = f"{type(e).__name__}: {e}"
             elif r.state == RUNNING:
                 p["alive"] = self._replica_alive(r)
                 try:
@@ -279,15 +288,29 @@ class ServeController:
                         r.applied_user_config = user_config
                         state.consecutive_start_failures = 0
                         state.next_start_allowed = 0.0
+                        state.last_start_failure = None
                         dirty = True
                     elif p.get("failed"):
+                        cause = p.get("failure") or "unknown cause"
                         state.consecutive_start_failures += 1
+                        state.last_start_failure = cause
                         delay = min(30.0, 0.5 * 2 ** min(state.consecutive_start_failures, 6))
                         state.next_start_allowed = time.time() + delay
                         logger.warning(
                             "replica %s failed to start; replacing in %.1fs "
-                            "(%d consecutive failures)",
-                            r.replica_id, delay, state.consecutive_start_failures)
+                            "(%d consecutive failures): %s",
+                            r.replica_id, delay,
+                            state.consecutive_start_failures, cause)
+                        from ..diagnostics.errors import publish_error_to_driver
+
+                        publish_error_to_driver(
+                            "replica_start_failure",
+                            f"replica {r.replica_id} failed to start: "
+                            + cause.splitlines()[0],
+                            source="serve_controller", traceback=cause,
+                            extra={"app": state.app_name,
+                                   "deployment": state.name,
+                                   "replica_id": r.replica_id})
                         state.replicas.remove(r)
                         to_kill.append(r)
                         dirty = True

@@ -48,15 +48,32 @@ class ReplicaActor:
         self._total = 0
         self._deployment_name = deployment_name
         self._app_name = app_name
-        func_or_class = cloudpickle.loads(serialized_callable)
-        init_args = resolve_handle_markers(init_args)
-        init_kwargs = resolve_handle_markers(init_kwargs)
-        if isinstance(func_or_class, type):
-            self._callable = func_or_class(*init_args, **init_kwargs)
-        else:
-            self._callable = func_or_class  # plain function deployment
-        if user_config is not None:
-            self.reconfigure(user_config)
+        try:
+            func_or_class = cloudpickle.loads(serialized_callable)
+            init_args = resolve_handle_markers(init_args)
+            init_kwargs = resolve_handle_markers(init_kwargs)
+            if isinstance(func_or_class, type):
+                self._callable = func_or_class(*init_args, **init_kwargs)
+            else:
+                self._callable = func_or_class  # plain function deployment
+            if user_config is not None:
+                self.reconfigure(user_config)
+        except Exception as e:
+            # Publish the constructor's full traceback on the error-info
+            # channel from INSIDE the replica process, then re-raise so the
+            # actor-creation failure path still runs — the controller's
+            # "failed to start" must never be cause-less again.
+            import traceback
+
+            from ..diagnostics.errors import publish_error_to_driver
+
+            publish_error_to_driver(
+                "replica_start_failure",
+                f"replica of {app_name}#{deployment_name} failed in "
+                f"__init__: {type(e).__name__}: {e}",
+                source="serve_replica", traceback=traceback.format_exc(),
+                extra={"app": app_name, "deployment": deployment_name})
+            raise
 
     def ready(self) -> bool:
         return True

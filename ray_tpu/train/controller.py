@@ -128,13 +128,17 @@ class TrainController:
         resume_from_checkpoint: Checkpoint | None = None,
         poll_interval_s: float = 0.2,
         datasets: dict | None = None,
+        scaling_policy=None,
     ):
         self._train_fn = train_fn
         self._config = train_loop_config or {}
         self._datasets = datasets or {}
         self._scaling = scaling_config
         self._run_config = run_config
-        self._scaling_policy = (
+        # scaling_policy overrides the config-derived default — tests
+        # inject an ElasticScalingPolicy with a fake clock so the resize
+        # debounce is call-count-driven, not wall-clock-sensitive.
+        self._scaling_policy = scaling_policy or (
             ElasticScalingPolicy(scaling_config)
             if scaling_config.min_workers is not None
             else FixedScalingPolicy(scaling_config)

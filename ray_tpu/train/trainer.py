@@ -27,6 +27,7 @@ class DataParallelTrainer:
         run_config: RunConfig | None = None,
         resume_from_checkpoint: Checkpoint | None = None,
         datasets: dict | None = None,
+        scaling_policy=None,
     ):
         self._train_fn = train_loop_per_worker
         self._train_loop_config = train_loop_config
@@ -34,6 +35,7 @@ class DataParallelTrainer:
         self._run_config = run_config or RunConfig()
         self._resume = resume_from_checkpoint
         self._datasets = datasets or {}
+        self._scaling_policy = scaling_policy
 
     def fit(self) -> Result:
         controller = TrainController(
@@ -43,6 +45,7 @@ class DataParallelTrainer:
             run_config=self._run_config,
             resume_from_checkpoint=self._resume,
             datasets=self._datasets,
+            scaling_policy=self._scaling_policy,
         )
         return controller.run()
 

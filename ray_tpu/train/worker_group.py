@@ -157,7 +157,19 @@ class WorkerGroup:
         return ray.get(refs, timeout=timeout)
 
     def poll(self, timeout: float = 60.0) -> list[dict]:
-        return self.run_on_all("poll", timeout=timeout)
+        """Per-worker harvest: a dead worker yields an ``error`` entry
+        instead of discarding the whole batch — reports already produced
+        by surviving workers (rank-0 metrics + checkpoint registrations)
+        must still reach the controller's ingest before the group failure
+        is raised, or the attempt's progress is silently lost."""
+        refs = [w.poll.remote() for w in self.workers]
+        out = []
+        for i, ref in enumerate(refs):
+            try:
+                out.append(ray.get(ref, timeout=timeout))
+            except Exception as e:
+                out.append({"error": f"worker {i} poll failed: {e}"})
+        return out
 
     def shutdown(self) -> None:
         try:

@@ -346,7 +346,8 @@ class ClientServer:
             None, lambda: self._worker.submit_actor_task(
                 bytes.fromhex(p["actor_id"]), p["method"], args, kwargs,
                 num_returns=p.get("num_returns", 1),
-                generator_backpressure=p.get("generator_backpressure", 0)))
+                generator_backpressure=p.get("generator_backpressure", 0),
+                concurrency_group=p.get("concurrency_group", "")))
         if not isinstance(refs, list):  # ObjectRefGenerator (streaming)
             return {"stream": self._register_stream(p, refs)}
         return {"refs": [self._track(p, r) for r in refs]}
@@ -568,11 +569,13 @@ class ClientWorker:
         return bytes.fromhex(reply["actor_id"])
 
     def submit_actor_task(self, actor_id: bytes, method: str, args, kwargs,
-                          *, num_returns=1, generator_backpressure: int = 0):
+                          *, num_returns=1, generator_backpressure: int = 0,
+                          concurrency_group: str = ""):
         reply = self._call("ClientActorCall", {
             "actor_id": actor_id.hex(), "method": method,
             "args": self._wire_args(args, kwargs), "num_returns": num_returns,
             "generator_backpressure": generator_backpressure,
+            "concurrency_group": concurrency_group,
         })
         if "stream" in reply:
             return ClientObjectRefGenerator(self, reply["stream"])

@@ -79,3 +79,48 @@ def summarize_tasks() -> dict:
         entry = summary.setdefault(t["name"], {})
         entry[t["state"]] = entry.get(t["state"], 0) + 1
     return summary
+
+
+# ------------------------------------------------------------- diagnostics
+def list_errors(source: str | None = None, error_type: str | None = None,
+                limit: int = 100) -> list[dict]:
+    """Structured ErrorEvents retained by the GCS error-info channel:
+    raising tasks, failed actor/replica starts, OOM kills, lease-wedge
+    watchdog reports (reference: the driver's error-message listener over
+    RAY_ERROR_INFO_CHANNEL, surfaced as a state API)."""
+    return _gcs("ListErrors", {
+        "source": source, "type": error_type, "limit": limit,
+    })["errors"]
+
+
+def cluster_diagnostics(error_limit: int = 50) -> dict:
+    """One aggregated doctor view: the GCS control-plane snapshot, every
+    alive raylet's debug state (lease queue with ages, worker pool,
+    store/spill/OOM counters), and the most recent ErrorEvents."""
+    import asyncio
+
+    from ..core.rpc import RpcClient
+
+    nodes = [n for n in list_nodes() if n["state"] == "ALIVE"]
+    worker = global_worker()
+
+    async def _one(node):
+        client = RpcClient(node["address"])
+        try:
+            reply = await client.call("GetDebugState", {}, timeout=10.0)
+            snap = reply.get("debug_state") or {}
+            snap.setdefault("node_id", node["node_id"])
+            return snap
+        except Exception as e:
+            return {"node_id": node["node_id"], "unreachable": str(e)}
+        finally:
+            await client.close()
+
+    async def _all():
+        return await asyncio.gather(*(_one(n) for n in nodes))
+
+    return {
+        "gcs": _gcs("GetDebugState").get("debug_state", {}),
+        "nodes": list(worker.io.run_sync(_all())),
+        "errors": list_errors(limit=error_limit),
+    }

@@ -147,6 +147,41 @@ def test_client_streaming_generator():
         server.stop()
 
 
+def test_client_actor_method_concurrency_group():
+    """Regression (round-5 breakage): ``ActorMethod.remote`` always passes
+    ``concurrency_group=`` to ``submit_actor_task`` — the client worker
+    must accept AND forward it, including an explicit group selected via
+    ``.options(concurrency_group=...)``."""
+    from ray_tpu.util.client import ClientServer
+
+    server = ClientServer(host="127.0.0.1", port=0)
+    try:
+        code = textwrap.dedent(f"""
+            import ray_tpu
+            ray_tpu.init(address="ray://{server.address}")
+
+            @ray_tpu.remote(concurrency_groups={{"io": 2}})
+            class Grouped:
+                def plain(self):
+                    return "ok"
+                def fetch(self):
+                    return "io-ok"
+
+            g = Grouped.remote()
+            assert ray_tpu.get(g.plain.remote(), timeout=120) == "ok"
+            assert ray_tpu.get(
+                g.fetch.options(concurrency_group="io").remote(),
+                timeout=60) == "io-ok"
+            ray_tpu.shutdown()
+            print("CG_OK")
+        """)
+        proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                              text=True, timeout=300, cwd="/root/repo")
+        assert "CG_OK" in proc.stdout, proc.stderr[-2000:]
+    finally:
+        server.stop()
+
+
 def test_client_crash_reaps_session():
     """A client that dies WITHOUT disconnecting stops pinging; the proxy
     reaps the session: its actors are killed and its job finishes

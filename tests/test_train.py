@@ -198,6 +198,19 @@ def test_failure_policy_exhausted(ray_cluster, tmp_path):
     assert "always fails" in str(result.error)
 
 
+class _CallCountClock:
+    """Fake clock for ElasticScalingPolicy: advances one "second" per
+    call, so the resize debounce is driven by monitor() call counts
+    instead of wall time — full-suite load cannot flake it."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        self.t += 1.0
+        return self.t
+
+
 def test_elastic_scaling_upscale(tmp_path):
     import time
     """Elastic policy (min_workers set): the run starts at the feasible
@@ -206,6 +219,7 @@ def test_elastic_scaling_upscale(tmp_path):
     (reference v2 scaling_policy ResizeDecision)."""
     import ray_tpu
     from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train import ElasticScalingPolicy
 
     if ray_tpu.is_initialized():
         ray_tpu.shutdown()
@@ -233,11 +247,14 @@ def test_elastic_scaling_upscale(tmp_path):
                 )
                 time.sleep(0.25)
 
+        scaling = ScalingConfig(num_workers=3, min_workers=1,
+                                resources_per_worker={"CPU": 1})
         trainer = DataParallelTrainer(
             train_fn,
-            scaling_config=ScalingConfig(num_workers=3, min_workers=1,
-                                         resources_per_worker={"CPU": 1}),
+            scaling_config=scaling,
             run_config=RunConfig(name="elastic", storage_path=str(tmp_path)),
+            scaling_policy=ElasticScalingPolicy(
+                scaling, check_interval_s=2.0, clock=_CallCountClock()),
         )
 
         import threading
@@ -274,6 +291,7 @@ def test_elastic_scaling_downscale_on_node_death(tmp_path):
 
     import ray_tpu
     from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train import ElasticScalingPolicy
 
     if ray_tpu.is_initialized():
         ray_tpu.shutdown()
@@ -306,12 +324,15 @@ def test_elastic_scaling_downscale_on_node_death(tmp_path):
                 )
                 _t.sleep(0.25)
 
+        scaling = ScalingConfig(num_workers=3, min_workers=1,
+                                resources_per_worker={"CPU": 1})
         trainer = DataParallelTrainer(
             train_fn,
-            scaling_config=ScalingConfig(num_workers=3, min_workers=1,
-                                         resources_per_worker={"CPU": 1}),
+            scaling_config=scaling,
             run_config=RunConfig(name="elastic_down", storage_path=str(tmp_path),
                                  failure_config=FailureConfig(max_failures=2)),
+            scaling_policy=ElasticScalingPolicy(
+                scaling, check_interval_s=2.0, clock=_CallCountClock()),
         )
 
         import threading
