@@ -45,6 +45,11 @@ def main(argv: list[str] | None = None) -> int:
     sub.add_parser("summary", help="task counts by name and state")
     tl = sub.add_parser("timeline", help="dump a chrome://tracing file")
     tl.add_argument("-o", "--output", default="timeline.json")
+    tr = sub.add_parser(
+        "trace", help="list recent traces, or show one trace's span tree")
+    tr.add_argument("trace_id", nargs="?",
+                    help="trace id (omit to list recent traces)")
+    tr.add_argument("--limit", type=int, default=20)
     sub.add_parser("metrics", help="aggregated metrics (Prometheus text format)")
     sub.add_parser("status", help="cluster resource overview")
     doctor_p = sub.add_parser(
@@ -81,6 +86,21 @@ def main(argv: list[str] | None = None) -> int:
     elif args.cmd == "timeline":
         path = ray_tpu.timeline(args.output)
         print(f"wrote {path}")
+    elif args.cmd == "trace":
+        from ray_tpu.observability import format_trace_tree
+
+        if args.trace_id:
+            spans = st.list_spans(trace_id=args.trace_id)
+            if args.as_json:
+                print(json.dumps(spans, indent=2, default=str))
+            else:
+                print(format_trace_tree(spans))
+        else:
+            rows = st.list_traces(limit=args.limit)
+            if args.as_json:
+                print(json.dumps(rows, indent=2, default=str))
+            else:
+                _print_table(rows, ["trace_id", "root", "spans", "duration_ms"])
     elif args.cmd == "metrics":
         from ray_tpu.util.metrics import get_metrics, prometheus_text
 

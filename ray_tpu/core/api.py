@@ -151,9 +151,20 @@ def put(value: Any) -> ObjectRef:
 
 
 def get(refs, timeout: float | None = None):
-    if isinstance(refs, ObjectRef):
-        return global_worker().get([refs], timeout)[0]
-    return global_worker().get(list(refs), timeout)
+    from ..observability import tracing
+
+    single = isinstance(refs, ObjectRef)
+    refs = [refs] if single else list(refs)
+    ctx = tracing.current()
+    if ctx is not None:
+        # Inside an active trace: the get is a hop worth seeing (it is
+        # where submit→lease→run latency surfaces to the caller).
+        with tracing.span(f"get x{len(refs)}", kind="task",
+                          attrs={"num_refs": len(refs)}):
+            out = global_worker().get(refs, timeout)
+    else:
+        out = global_worker().get(refs, timeout)
+    return out[0] if single else out
 
 
 def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1, timeout: float | None = None):

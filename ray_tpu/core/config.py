@@ -130,6 +130,12 @@ class RayTpuConfig:
     task_events_buffer_size: int = 10000
     task_events_flush_interval_ms: int = 1000
     enable_timeline: bool = True
+    # Distributed tracing: trace-context propagation through TaskSpec /
+    # serve requests + span recording (observability/tracing.py).
+    enable_tracing: bool = True
+    # Spans retained by the GCS span store (whole traces are evicted
+    # oldest-first past this cap).
+    span_events_buffer_size: int = 20000
 
     # --- diagnostics ---------------------------------------------------------
     # Retained ErrorEvents in the GCS error-info buffer (list_errors()).

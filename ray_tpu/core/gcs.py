@@ -116,10 +116,27 @@ class GcsServer:
         # Observability: task-event ring (gcs_task_manager.h) + per-worker
         # metric snapshots (stats/metric.h aggregation point).
         from .task_events import GcsTaskEventStore
+        from ..observability.spans import GcsSpanStore
+        from ..util.metrics import Histogram
 
+        # Lease-stage latency histograms, fed at event ingest (submit→lease,
+        # queue wait, worker spawn, lease→run). Private (register=False):
+        # the GCS often shares a process with a driver whose metrics flusher
+        # would otherwise re-report this registry back to us — these are
+        # merged into GetMetrics directly via _framework_metrics.
+        self._lease_stage_hist = Histogram(
+            "ray_tpu_lease_stage_ms",
+            "Task lease pipeline stage durations (submit to lease, lease "
+            "queue wait, worker spawn/setup, lease to run)",
+            tag_keys=("stage", "node_id"), register=False)
         self.task_events = GcsTaskEventStore(
-            max_tasks=get_config().task_events_buffer_size
+            max_tasks=get_config().task_events_buffer_size,
+            on_stage=lambda stage, ms, node: self._lease_stage_hist.observe(
+                ms, {"stage": stage, "node_id": (node or "")[:12]}),
         )
+        # Trace spans flushed on the task-event path (status SPAN).
+        self.span_store = GcsSpanStore(
+            max_spans=get_config().span_events_buffer_size)
         self._metrics: dict[str, tuple[float, list[dict]]] = {}  # worker -> (ts, snapshot)
         # Error-info table: retained ErrorEvents behind the pub/sub channel
         # (reference ErrorInfoHandler / RAY_ERROR_INFO_CHANNEL).
@@ -385,14 +402,39 @@ class GcsServer:
 
     # --------------------------------------------------------- observability
     async def handle_AddTaskEvents(self, p: dict) -> dict:
-        self.task_events.add_events(p.get("events") or [], p.get("dropped", 0))
+        from .task_events import SPAN
+
+        events = p.get("events") or []
+        spans = [e for e in events if e.get("status") == SPAN]
+        if spans:
+            # Stamp recorder identity onto the span at ingest so the
+            # chrome trace can group tracks per recording worker.
+            records = []
+            for e in spans:
+                s = dict(e.get("span") or {})
+                s.setdefault("worker_id", e.get("worker_id", ""))
+                s.setdefault("node_id", e.get("node_id", ""))
+                records.append(s)
+            self.span_store.add(records)
+            events = [e for e in events if e.get("status") != SPAN]
+        self.task_events.add_events(events, p.get("dropped", 0))
         return {}
 
     async def handle_ListTaskEvents(self, p: dict) -> dict:
         return {"tasks": self.task_events.list_tasks(p.get("limit", 1000))}
 
+    async def handle_ListSpans(self, p: dict) -> dict:
+        return {"spans": self.span_store.list_spans(
+            p.get("trace_id"), p.get("limit", 1000))}
+
+    async def handle_ListTraces(self, p: dict) -> dict:
+        return {"traces": self.span_store.list_traces(p.get("limit", 100))}
+
     async def handle_Timeline(self, p: dict) -> dict:
-        return {"trace": self.task_events.chrome_trace()}
+        # Task slices + trace spans in one chrome trace: spans appear as
+        # nested per-trace flows alongside the per-node task tracks.
+        return {"trace": self.task_events.chrome_trace()
+                + self.span_store.chrome_trace()}
 
     # ----------------------------------------------------------- error info
     async def handle_PublishError(self, p: dict) -> dict:
@@ -448,6 +490,7 @@ class GcsServer:
             "kv_keys": len(self._kv),
             "tasks_by_state": self.task_events.count_by_state(),
             "errors_buffered": len(self._errors),
+            "spans_buffered": self.span_store.size(),
         }
 
     async def handle_GetDebugState(self, p: dict) -> dict:
@@ -581,6 +624,7 @@ class GcsServer:
             by_state[r.get("state", "?")] = by_state.get(r.get("state", "?"), 0) + 1
         for state, count in by_state.items():
             gauge("ray_tpu_placement_groups", count, state=state)
+        out.extend(self._lease_stage_hist.snapshot())
         return out
 
     # --------------------------------------------------------------- pub/sub

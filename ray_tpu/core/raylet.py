@@ -233,6 +233,12 @@ class Raylet:
         self._wedge_events_total = 0
         self._oom_kills_total = 0
         self._started_at = time.monotonic()
+        # Lease-stage task events + spans (LEASED at grant, queue-wait and
+        # spawn timings), flushed to the GCS on the worker flush cadence.
+        from .task_events import TaskEventBuffer
+
+        self._task_events = TaskEventBuffer(
+            f"raylet-{self.node_id.hex()[:8]}", self.node_id.hex())
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> None:
@@ -254,6 +260,7 @@ class Raylet:
         self._tasks.append(spawn(self._memory_monitor_loop()))
         self._tasks.append(spawn(self._debug_dump_loop()))
         self._tasks.append(spawn(self._lease_watchdog_loop()))
+        self._tasks.append(spawn(self._task_event_flush_loop()))
         if get_config().log_to_driver:
             self._tasks.append(spawn(self._log_monitor_loop()))
         cfg = get_config()
@@ -941,11 +948,60 @@ class Raylet:
                 self._pending_lease_demand.pop(shape, None)
 
     # ---------------------------------------------------------- lease service
+    async def _task_event_flush_loop(self) -> None:
+        """Flush raylet-recorded task events/spans (LEASED, lease/spawn
+        spans) to the GCS — the raylet's half of the worker flusher."""
+        interval = get_config().task_events_flush_interval_ms / 1000.0
+        while True:
+            await asyncio.sleep(interval)
+            events, dropped = self._task_events.drain()
+            if not events and not dropped:
+                continue
+            try:
+                await self._gcs.call(
+                    "AddTaskEvents", {"events": events, "dropped": dropped},
+                    timeout=10.0)
+            except Exception:
+                pass
+
+    def _record_lease_grant(self, spec: dict, t_arrive: float,
+                            queue_wait_ms: float, spawn_ms: float) -> None:
+        """Record the LEASED transition (with the raylet-measured stage
+        timings) and, when the spec is traced, the lease + worker-spawn
+        spans — all fire-and-forget into the local event buffer."""
+        task_id = spec.get("task_id") or b""
+        if not task_id:
+            return
+        self._task_events.record(
+            task_id, spec.get("name", ""), "LEASED", kind=spec.get("kind", 0),
+            extra={"queue_wait_ms": round(queue_wait_ms, 3),
+                   "spawn_ms": round(spawn_ms, 3),
+                   "trace_id": spec.get("trace_id", "")})
+        trace_id = spec.get("trace_id") or ""
+        if not trace_id:
+            return
+        from ..observability import tracing
+
+        now = time.time()
+        start = now - (time.monotonic() - t_arrive)
+        lease_span = tracing.make_span(
+            f"lease {spec.get('name', '')}", "lease", start, now, trace_id,
+            spec.get("span_id", ""),
+            attrs={"queue_wait_ms": round(queue_wait_ms, 3),
+                   "node_id": self.node_id.hex()})
+        self._task_events.record_span(lease_span)
+        if spawn_ms > 1.0:
+            self._task_events.record_span(tracing.make_span(
+                "worker spawn/setup", "lease", now - spawn_ms / 1000.0, now,
+                trace_id, lease_span["span_id"],
+                attrs={"node_id": self.node_id.hex()}))
+
     async def handle_RequestWorkerLease(self, p: dict) -> dict:
         """ClusterTaskManager::QueueAndScheduleTask equivalent
         (cluster_task_manager.cc:48): grant locally, or spill to a better
         node, or queue until resources free up."""
         spec = p["spec"]
+        t_arrive = time.monotonic()
         request = ResourceSet(self._lease_resources(spec))
         grant_only_local = bool(p.get("grant_only_local") or p.get("dedicated"))
 
@@ -1017,8 +1073,10 @@ class Raylet:
         priority = 0 if (p.get("dedicated") or spec.get("kind", 0) == 1) else 1
         if not await self._acquire_resources_queued(request, priority, deadline):
             return {"granted": False, "reason": "timed out waiting for resources"}
+        queue_wait_ms = (time.monotonic() - t_arrive) * 1000.0
 
         inflight = False
+        t_spawn = time.monotonic()
         try:
             await self._await_tpu_grant_fence(request)
             if request.to_dict().get("TPU", 0.0) > 0:
@@ -1043,6 +1101,8 @@ class Raylet:
         if p.get("dedicated"):
             actor_id = spec.get("actor_id", b"")
             worker.actor_id = actor_id.hex() if isinstance(actor_id, bytes) else actor_id
+        self._record_lease_grant(spec, t_arrive, queue_wait_ms,
+                                 (time.monotonic() - t_spawn) * 1000.0)
         self._wake_lease_waiters()
         return {
             "granted": True,
@@ -1058,6 +1118,7 @@ class Raylet:
         if not res:
             res = {"CPU": 1.0}
         request = ResourceSet(res)
+        t_arrive = time.monotonic()
         deadline = time.monotonic() + get_config().worker_register_timeout_s
         key = None
         while True:
@@ -1074,7 +1135,9 @@ class Raylet:
                 await asyncio.wait_for(fut, 0.5)
             except asyncio.TimeoutError:
                 pass
+        queue_wait_ms = (time.monotonic() - t_arrive) * 1000.0
         inflight = False
+        t_spawn = time.monotonic()
         try:
             await self._await_tpu_grant_fence(request)
             if request.to_dict().get("TPU", 0.0) > 0:
@@ -1096,6 +1159,8 @@ class Raylet:
             if b is not None:
                 b["used"] = b["used"].subtract(request, allow_negative=True)
             return {"granted": False, "reason": reason}
+        self._record_lease_grant(spec, t_arrive, queue_wait_ms,
+                                 (time.monotonic() - t_spawn) * 1000.0)
         worker.lease_resources = request
         worker.bundle_key = key
         worker.state = "dedicated" if p.get("dedicated") else "leased"

@@ -18,9 +18,26 @@ from typing import Any
 
 # Status transition names (reference rpc::TaskStatus).
 SUBMITTED = "SUBMITTED"
+LEASED = "LEASED"
 RUNNING = "RUNNING"
 FINISHED = "FINISHED"
 FAILED = "FAILED"
+# Pseudo-status carrying a finished trace span (observability/tracing.py)
+# through the same buffered flush path; the GCS routes these to its span
+# store instead of the task table.
+SPAN = "SPAN"
+
+
+def _resolve_state(events: dict) -> str:
+    if FAILED in events:
+        return FAILED
+    if FINISHED in events:
+        return FINISHED
+    if RUNNING in events:
+        return RUNNING
+    if LEASED in events:
+        return LEASED
+    return SUBMITTED
 
 
 class TaskEventBuffer:
@@ -53,6 +70,25 @@ class TaskEventBuffer:
                 return
             self._events.append(ev)
 
+    def record_span(self, span: dict) -> None:
+        """Buffer one finished trace span; it rides the same drain/flush
+        batch as status events (status ``SPAN``)."""
+        ev = {
+            "task_id": span.get("trace_id", ""),
+            "name": span.get("name", ""),
+            "status": SPAN,
+            "ts": span.get("end", time.time()),
+            "worker_id": self._worker_id,
+            "node_id": self._node_id,
+            "kind": 0,
+            "span": span,
+        }
+        with self._lock:
+            if len(self._events) >= self._max:
+                self._dropped += 1
+                return
+            self._events.append(ev)
+
     def drain(self) -> tuple[list[dict], int]:
         with self._lock:
             events, self._events = self._events, []
@@ -64,19 +100,25 @@ class GcsTaskEventStore:
     """GCS-side bounded event log + per-task aggregation
     (reference ``gcs_task_manager.h``)."""
 
-    def __init__(self, max_tasks: int = 100_000):
+    def __init__(self, max_tasks: int = 100_000, on_stage=None):
         self._lock = threading.Lock()
         # dict insertion order IS the ring order: eviction pops the oldest
         # key in O(1) instead of shifting a list under the lock
         self._tasks: dict[str, dict] = {}
         self._max = max_tasks
         self.num_dropped = 0
+        # Optional (stage, duration_ms, node_id) observer fed at ingest:
+        # backs the per-raylet lease-stage histograms without a second
+        # pass over the event log.
+        self._on_stage = on_stage
 
     def add_events(self, events: list[dict], dropped: int = 0) -> None:
         with self._lock:
             self.num_dropped += dropped
             for ev in events:
                 tid = ev["task_id"]
+                status = ev["status"]
+                ts = ev["ts"]
                 rec = self._tasks.get(tid)
                 if rec is None:
                     if len(self._tasks) >= self._max:
@@ -87,11 +129,33 @@ class GcsTaskEventStore:
                         "kind": ev.get("kind", 0),
                         "events": {},
                     }
-                rec["events"][ev["status"]] = ev["ts"]
+                self._observe_stages(rec, ev, status, ts)
+                if status == LEASED:
+                    # Both the raylet (at grant) and the owner (at
+                    # dispatch) report LEASED: keep the earliest — the
+                    # actual grant time.
+                    rec["events"].setdefault(status, ts)
+                else:
+                    rec["events"][status] = ts
                 rec["name"] = ev.get("name") or rec["name"]
-                for key in ("worker_id", "node_id", "error"):
+                for key in ("worker_id", "node_id", "error", "trace_id"):
                     if ev.get(key):
                         rec[key] = ev[key]
+
+    def _observe_stages(self, rec: dict, ev: dict, status: str, ts: float) -> None:
+        if self._on_stage is None:
+            return
+        node = ev.get("node_id", "")
+        # Raylet-measured sub-stages ride the LEASED event itself.
+        for key, stage in (("queue_wait_ms", "lease_queue_wait"),
+                           ("spawn_ms", "worker_spawn")):
+            if ev.get(key) is not None:
+                self._on_stage(stage, float(ev[key]), node)
+        events = rec["events"]
+        if status == LEASED and LEASED not in events and SUBMITTED in events:
+            self._on_stage("submit_to_lease", (ts - events[SUBMITTED]) * 1000.0, node)
+        elif status == RUNNING and RUNNING not in events and LEASED in events:
+            self._on_stage("lease_to_run", (ts - events[LEASED]) * 1000.0, node)
 
     def list_tasks(self, limit: int = 1000) -> list[dict]:
         with self._lock:
@@ -99,21 +163,14 @@ class GcsTaskEventStore:
             for tid in list(self._tasks)[-limit:]:
                 rec = self._tasks[tid]
                 events = rec["events"]
-                if FAILED in events:
-                    state = FAILED
-                elif FINISHED in events:
-                    state = FINISHED
-                elif RUNNING in events:
-                    state = RUNNING
-                else:
-                    state = SUBMITTED
                 out.append({
                     "task_id": tid,
                     "name": rec["name"],
-                    "state": state,
+                    "state": _resolve_state(events),
                     "worker_id": rec.get("worker_id", ""),
                     "node_id": rec.get("node_id", ""),
                     "error": rec.get("error", ""),
+                    "trace_id": rec.get("trace_id", ""),
                     "events": dict(events),
                 })
             return out
@@ -124,15 +181,7 @@ class GcsTaskEventStore:
         out: dict[str, int] = {}
         with self._lock:
             for rec in self._tasks.values():
-                events = rec["events"]
-                if FAILED in events:
-                    state = FAILED
-                elif FINISHED in events:
-                    state = FINISHED
-                elif RUNNING in events:
-                    state = RUNNING
-                else:
-                    state = SUBMITTED
+                state = _resolve_state(rec["events"])
                 out[state] = out.get(state, 0) + 1
         return out
 
@@ -155,7 +204,8 @@ class GcsTaskEventStore:
                 "dur": dur_us,
                 "pid": f"node:{rec.get('node_id', '?')[:8]}",
                 "tid": f"worker:{rec.get('worker_id', '?')[:8]}",
-                "args": {"task_id": rec["task_id"], "state": rec["state"]},
+                "args": {"task_id": rec["task_id"], "state": rec["state"],
+                         "trace_id": rec.get("trace_id", "")},
             })
         return trace
 

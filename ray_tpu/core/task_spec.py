@@ -55,6 +55,12 @@ class TaskSpec:
     placement_group_bundle_index: int = -1
     label_selector: dict = field(default_factory=dict)
     runtime_env: dict = field(default_factory=dict)
+    # Distributed-trace context (observability/tracing.py): the task's
+    # own span id plus its parent, propagated owner → raylet → executor
+    # so every hop records into one connected span tree.
+    trace_id: str = ""
+    span_id: str = ""
+    parent_span_id: str = ""
 
     def to_wire(self) -> dict[str, Any]:
         return {
@@ -83,6 +89,9 @@ class TaskSpec:
             "placement_group_bundle_index": self.placement_group_bundle_index,
             "label_selector": self.label_selector,
             "runtime_env": self.runtime_env,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_span_id,
         }
 
     @classmethod

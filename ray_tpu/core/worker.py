@@ -129,6 +129,7 @@ class TaskManager:
                 "spec": spec,
                 "retries_left": spec.max_retries,
                 "return_ids": return_ids,
+                "submitted_at": time.time(),  # owner-side task span start
             }
 
     def get_pending(self, task_id: bytes) -> dict | None:
@@ -775,6 +776,40 @@ class CoreWorker:
             self._task_counter += 1
             return TaskID.for_normal_task(self.job_id, self.current_task_id, self._task_counter)
 
+    def _attach_trace(self, spec: TaskSpec) -> None:
+        """Give the spec a trace context: continue the submitting thread's
+        active trace (the task's span becomes a child of it) or root a
+        fresh one, so every task is traceable end to end."""
+        from ..observability import tracing
+
+        if not get_config().enable_tracing:
+            return
+        ctx = tracing.current()
+        if ctx is None:
+            spec.trace_id = tracing.new_trace_id()
+        else:
+            spec.trace_id = ctx.trace_id
+            spec.parent_span_id = ctx.span_id
+        spec.span_id = tracing.new_span_id()
+
+    def _record_submit(self, spec: TaskSpec) -> None:
+        extra = {"trace_id": spec.trace_id} if spec.trace_id else None
+        self.task_events.record(spec.task_id, spec.name, "SUBMITTED",
+                                kind=spec.kind, extra=extra)
+
+    def _record_task_span(self, spec: TaskSpec, status: str) -> None:
+        """Owner-side umbrella span for one task: submit → settled."""
+        if not spec.trace_id:
+            return
+        from ..observability import tracing
+
+        entry = self.task_manager.get_pending(spec.task_id)
+        start = (entry or {}).get("submitted_at") or time.time()
+        tracing.record_span(tracing.make_span(
+            f"task {spec.name}", "task", start, time.time(), spec.trace_id,
+            spec.parent_span_id, spec.span_id,
+            attrs={"task_id": spec.task_id.hex(), "status": status}))
+
     @staticmethod
     def _accelerator_runtime_env(resources: dict | None, runtime_env: dict | None) -> dict:
         """Workers are pinned to JAX_PLATFORMS=cpu by the raylet unless the
@@ -829,13 +864,14 @@ class CoreWorker:
             placement_group_bundle_index=placement_group_bundle_index,
             runtime_env=self._accelerator_runtime_env(resources, runtime_env),
         )
+        self._attach_trace(spec)
         if streaming:
             return self._submit_streaming(spec)
         return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(num_returns)]
         for rid in return_ids:
             self.refcounter.add_owned_object(rid)
         self.task_manager.add_pending(spec, return_ids)
-        self.task_events.record(spec.task_id, spec.name, "SUBMITTED", kind=spec.kind)
+        self._record_submit(spec)
         self._enqueue_task(spec)
         return [ObjectRef(rid, self.address) for rid in return_ids]
 
@@ -843,7 +879,7 @@ class CoreWorker:
         stream = StreamState(spec.task_id)
         self._streams[spec.task_id] = stream
         self.task_manager.add_pending(spec, [])
-        self.task_events.record(spec.task_id, spec.name, "SUBMITTED", kind=spec.kind)
+        self._record_submit(spec)
         if spec.kind == TASK_KIND_ACTOR_TASK:
             self.io.run_coro(self._submit_actor_task_async(spec))
         else:
@@ -1154,6 +1190,11 @@ class CoreWorker:
 
     async def _push_and_complete(self, spec: TaskSpec, worker: RpcClient, worker_id: str) -> bool:
         """Returns False when the worker died (the caller must drop the lease)."""
+        # LEASED at dispatch: tasks pushed onto a reused lease never pass
+        # through the raylet's grant path, so the owner stamps the lease
+        # stage here (the GCS keeps the earliest LEASED ts per task).
+        self.task_events.record(spec.task_id, spec.name, "LEASED",
+                                kind=spec.kind, extra={"worker_id": worker_id})
         self._dispatched_to[spec.task_id] = worker.address
         try:
             reply = await worker.call("PushTask", {"spec": spec.to_wire()}, timeout=None)
@@ -1185,6 +1226,8 @@ class CoreWorker:
         if len(specs) == 1:
             return await self._push_and_complete(specs[0], worker, worker_id)
         for spec in specs:
+            self.task_events.record(spec.task_id, spec.name, "LEASED",
+                                    kind=spec.kind, extra={"worker_id": worker_id})
             self._dispatched_to[spec.task_id] = worker.address
         try:
             reply = await worker.call(
@@ -1257,6 +1300,7 @@ class CoreWorker:
 
     def _handle_task_reply(self, spec: TaskSpec, reply: dict) -> None:
         self._cancelled_tasks.discard(spec.task_id)
+        self._record_task_span(spec, "ok")
         task_id = TaskID(spec.task_id)
         if spec.num_returns == -1:
             # Streaming task finished: items arrived via ReportGeneratorItem;
@@ -1284,6 +1328,7 @@ class CoreWorker:
 
     def _fail_task(self, spec: TaskSpec, error: Exception) -> None:
         self._cancelled_tasks.discard(spec.task_id)
+        self._record_task_span(spec, "error")
         self.task_events.record(spec.task_id, spec.name, "FAILED", kind=spec.kind,
                                 extra={"error": str(error)[:200]})
         task_id = TaskID(spec.task_id)
@@ -1350,6 +1395,7 @@ class CoreWorker:
             placement_group_bundle_index=placement_group_bundle_index,
             runtime_env=self._accelerator_runtime_env(res, runtime_env),
         )
+        self._attach_trace(spec)
         reply = self._gcs_call(
             "RegisterActor",
             {"spec": spec.to_wire(), "name": name, "detached": detached},
@@ -1402,13 +1448,14 @@ class CoreWorker:
             concurrency_group=concurrency_group,
         )
         spec._incarnation = incarnation
+        self._attach_trace(spec)
         if streaming:
             return self._submit_streaming(spec)
         return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(num_returns)]
         for rid in return_ids:
             self.refcounter.add_owned_object(rid)
         self.task_manager.add_pending(spec, return_ids)
-        self.task_events.record(spec.task_id, spec.name, "SUBMITTED", kind=spec.kind)
+        self._record_submit(spec)
         self.io.run_coro(self._submit_actor_task_async(spec))
         return [ObjectRef(rid, self.address) for rid in return_ids]
 
@@ -1854,6 +1901,17 @@ class CoreWorker:
                     {"t": "v", "meta": metadata, "blob": blob, "contained": []}
                     for _ in range(max(spec.num_returns, 1))]}
             self._exec_threads[spec.task_id] = threading.get_ident()
+        # Install the spec's trace context for the duration of execution:
+        # spans recorded by user code (and nested submits, engine requests,
+        # serve batches) chain under this task's execute span.
+        from ..observability import tracing
+
+        _exec_ctx = _trace_prev = None
+        _exec_start = time.time()
+        if spec.trace_id:
+            _exec_ctx = tracing.TraceContext(
+                spec.trace_id, tracing.new_span_id(), spec.span_id)
+            _trace_prev = tracing.set_current(_exec_ctx)
         try:
             args, kwargs = self._deserialize_args(spec)
             if spec.kind == TASK_KIND_ACTOR_CREATION:
@@ -1942,6 +2000,13 @@ class CoreWorker:
                         "stream_error": {"meta": metadata, "blob": blob}}
             return {"returns": [{"t": "v", "meta": metadata, "blob": blob} for _ in range(spec.num_returns)]}
         finally:
+            if _exec_ctx is not None:
+                tracing.record_span(tracing.make_span(
+                    f"execute {spec.name}", "task", _exec_start, time.time(),
+                    spec.trace_id, spec.span_id, _exec_ctx.span_id,
+                    attrs={"task_id": spec.task_id.hex(),
+                           "worker_id": self.worker_id}))
+                tracing.set_current(_trace_prev)
             with self._exec_lock:
                 self._exec_threads.pop(spec.task_id, None)
             self.current_task_id = prev_task_id

@@ -30,7 +30,7 @@ _ENDPOINTS = [
     "nodes", "actors", "tasks", "objects", "workers",
     "placement_groups", "jobs", "metrics", "cluster_resources",
     "available_resources", "timeline", "grafana_dashboard",
-    "errors", "diagnostics",
+    "errors", "diagnostics", "traces",
 ]
 
 
@@ -52,6 +52,8 @@ def _collect(endpoint: str):
         return state.list_errors()
     if endpoint == "diagnostics":
         return state.cluster_diagnostics()
+    if endpoint == "traces":
+        return state.list_traces()
     if endpoint == "placement_groups":
         return state.list_placement_groups()
     if endpoint == "jobs":

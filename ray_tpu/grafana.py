@@ -136,6 +136,23 @@ def generate_dashboard(title: str = "ray_tpu cluster") -> dict:
             {"expr": "histogram_quantile(0.5, rate(serve_request_latency_ms_bucket[5m]))",
              "legend": "{{deployment}}"},
         ], grid={"x": 2 * W, "y": 4 + 2 * H, "w": W, "h": H}, unit="ms"),
+        # Row 5: request-path observability (tracing PR): engine TTFT,
+        # router queue wait, and the raylet lease pipeline stages.
+        _panel(40, "Serve TTFT p50 / p95", [
+            {"expr": "histogram_quantile(0.5, rate(serve_ttft_ms_bucket[5m]))",
+             "legend": "p50 {{deployment}}"},
+            {"expr": "histogram_quantile(0.95, rate(serve_ttft_ms_bucket[5m]))",
+             "legend": "p95 {{deployment}}"},
+        ], grid={"x": 0, "y": 4 + 3 * H, "w": W, "h": H}, unit="ms"),
+        _panel(41, "Serve router queue wait p95", [
+            {"expr": "histogram_quantile(0.95, rate(serve_queue_wait_ms_bucket[5m]))",
+             "legend": "{{deployment}}"},
+        ], grid={"x": W, "y": 4 + 3 * H, "w": W, "h": H}, unit="ms"),
+        _panel(42, "Lease pipeline stage p95", [
+            {"expr": "histogram_quantile(0.95, sum by (le, stage) "
+                     "(rate(ray_tpu_lease_stage_ms_bucket[5m])))",
+             "legend": "{{stage}}"},
+        ], grid={"x": 2 * W, "y": 4 + 3 * H, "w": W, "h": H}, unit="ms"),
     ]
     return {
         "title": title,

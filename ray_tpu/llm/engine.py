@@ -56,8 +56,14 @@ class Request:
     finish_reason: str = ""
     lora_slot: int = 0
     arrived_at: float = field(default_factory=time.monotonic)
+    arrived_wall: float = field(default_factory=time.time)
     first_token_at: float | None = None
+    first_token_wall: float | None = None
     cached_prefix_tokens: int = 0
+    # Trace context ({"trace_id", "span_id"}) captured from the submitting
+    # thread at add_request: the engine loop runs detached, so prefill/
+    # decode spans parent onto this instead of any thread-local state.
+    trace: dict | None = None
 
 
 class PageAllocator:
@@ -225,6 +231,10 @@ class InferenceEngine:
             )
         if not request.prompt:
             raise ValueError("empty prompt")
+        if request.trace is None:
+            from ..observability import tracing
+
+            request.trace = tracing.current_wire()
         with self._lock:
             self._waiting.append(request)
 
@@ -474,6 +484,7 @@ class InferenceEngine:
         tokens = self.executor.sample_first([h for _, h in live], temps)
         events = []
         now = time.monotonic()
+        now_wall = time.time()
         for i, (r, _) in enumerate(live):
             with self._lock:
                 if r.done:  # cancelled while sampling
@@ -481,8 +492,36 @@ class InferenceEngine:
                 self._active[r.slot] = r
             r.pos = len(r.prompt)
             r.first_token_at = now
+            r.first_token_wall = now_wall
+            self._record_prefill_span(r)
             events.append(self._emit(r, int(tokens[i])))
         return events
+
+    def _record_prefill_span(self, r: Request) -> None:
+        """Span from request arrival to its first sampled token: the
+        engine-side TTFT (queue wait + chunked prefill + first sample)."""
+        if not r.trace:
+            return
+        from ..observability import tracing
+
+        tracing.record_span(tracing.make_span(
+            "llm.prefill", "llm", r.arrived_wall, r.first_token_wall or time.time(),
+            r.trace.get("trace_id", ""), r.trace.get("span_id", ""),
+            attrs={"request_id": r.request_id,
+                   "prompt_tokens": len(r.prompt),
+                   "cached_prefix_tokens": r.cached_prefix_tokens}))
+
+    def _record_decode_span(self, r: Request) -> None:
+        if not r.trace:
+            return
+        from ..observability import tracing
+
+        tracing.record_span(tracing.make_span(
+            "llm.decode", "llm", r.first_token_wall or time.time(), time.time(),
+            r.trace.get("trace_id", ""), r.trace.get("span_id", ""),
+            attrs={"request_id": r.request_id,
+                   "generated_tokens": len(r.generated),
+                   "finish_reason": r.finish_reason}))
 
     def _decode_all(self) -> list[dict]:
         with self._lock:
@@ -518,6 +557,7 @@ class InferenceEngine:
                 r.pos += 1
                 if r.first_token_at is None:
                     r.first_token_at = time.monotonic()
+                    r.first_token_wall = time.time()
                 events.append(self._emit(r, int(tokens[k, slot])))
         return events
 
@@ -532,6 +572,7 @@ class InferenceEngine:
         if r.done:
             with self._lock:
                 self._retire_locked(r)  # idempotent if cancel() beat us
+            self._record_decode_span(r)
         return {
             "request_id": r.request_id,
             "token": token,

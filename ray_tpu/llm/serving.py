@@ -21,6 +21,46 @@ import uuid
 from .engine import InferenceEngine, Request
 from .tokenizer import ByteTokenizer
 
+# Request-level serving metrics (lazily created so importing llm doesn't
+# start the metrics flusher). serve_ttft_ms is the measurement ROADMAP
+# item 2 was missing: arrival → first sampled token, tagged with the
+# serve deployment hosting the engine (falls back to the model id when
+# the engine runs outside serve).
+_metrics_lock = threading.Lock()
+_metrics: dict = {}
+
+
+def _llm_metrics() -> dict:
+    with _metrics_lock:
+        if not _metrics:
+            from ..util.metrics import Histogram
+
+            _metrics["ttft"] = Histogram(
+                "serve_ttft_ms",
+                "Time from request arrival to first generated token",
+                tag_keys=("deployment",))
+        return _metrics
+
+
+def _deployment_tag(fallback: str) -> str:
+    try:
+        from ..serve.replica import get_replica_context
+
+        rc = get_replica_context()
+        if rc and rc.get("deployment"):
+            return rc["deployment"]
+    except Exception:
+        pass
+    return fallback
+
+
+def _observe_ttft(req: Request, deployment: str) -> None:
+    if req.first_token_at is None:
+        return
+    _llm_metrics()["ttft"].observe(
+        1000.0 * (req.first_token_at - req.arrived_at),
+        tags={"deployment": deployment})
+
 
 class LLMDeployment:
     """User-facing deployment class: wrap with ``serve.deployment`` (see
@@ -181,6 +221,7 @@ class LLMDeployment:
             finish = "timeout"
         else:
             finish = req.finish_reason
+        _observe_ttft(req, _deployment_tag(self.model_id))
         return {
             "request_id": rid,
             "text": self.tokenizer.decode(req.generated),
@@ -202,6 +243,7 @@ class LLMDeployment:
             self._token_queues.pop(req.request_id, None)
             raise
         deadline = time.monotonic() + self.request_timeout_s
+        first = True
         try:
             while True:
                 try:
@@ -211,6 +253,9 @@ class LLMDeployment:
                         self.engine.cancel(req.request_id)
                         return
                     continue
+                if first:
+                    first = False
+                    _observe_ttft(req, _deployment_tag(self.model_id))
                 yield event
                 if event["done"]:
                     return

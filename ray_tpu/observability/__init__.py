@@ -1,0 +1,48 @@
+"""Observability: end-to-end distributed tracing.
+
+Dapper-style span propagation (Sigelman et al., 2010) over this
+framework's task-event architecture: a ``TraceContext`` (trace id +
+parent span id) rides ``TaskSpec`` and serve request metadata across
+every hop — task submit → raylet lease grant → worker spawn/setup →
+execute → get, and serve HTTP proxy → router queue → replica batch →
+LLM engine prefill (first token) → decode. Spans are buffered in the
+existing ``TaskEventBuffer`` and reach the GCS on the same flush path
+as task status events; they merge into ``ray_tpu.timeline()``'s chrome
+trace and are queryable via ``state.list_spans()`` / ``cli trace``.
+"""
+
+from .tracing import (
+    TraceContext,
+    bind,
+    context_from_headers,
+    current,
+    current_wire,
+    local_spans,
+    make_span,
+    new_span_id,
+    new_trace_id,
+    record_span,
+    set_current,
+    span,
+    use_context,
+)
+from .spans import GcsSpanStore, format_trace_tree, spans_to_chrome
+
+__all__ = [
+    "TraceContext",
+    "GcsSpanStore",
+    "bind",
+    "context_from_headers",
+    "current",
+    "current_wire",
+    "format_trace_tree",
+    "local_spans",
+    "make_span",
+    "new_span_id",
+    "new_trace_id",
+    "record_span",
+    "set_current",
+    "span",
+    "spans_to_chrome",
+    "use_context",
+]

@@ -22,7 +22,7 @@ from typing import Any, Callable
 
 
 class _Item:
-    __slots__ = ("args", "kwargs", "result", "error", "done")
+    __slots__ = ("args", "kwargs", "result", "error", "done", "trace_ctx")
 
     def __init__(self, args, kwargs):
         self.args = args
@@ -30,6 +30,11 @@ class _Item:
         self.result = None
         self.error: BaseException | None = None
         self.done = threading.Event()
+        # Captured at submit: the batcher thread has no caller context, so
+        # the batch span parents onto the first traced item of the batch.
+        from ..observability import tracing
+
+        self.trace_ctx = tracing.current()
 
 
 class _Batcher:
@@ -76,8 +81,13 @@ class _Batcher:
             self._run_batch(batch)
 
     def _run_batch(self, batch: list[_Item]) -> None:
+        from ..observability import tracing
+
         self.num_batches += 1
         inputs = [it.args[0] if it.args else None for it in batch]
+        ctx = next((it.trace_ctx for it in batch if it.trace_ctx is not None), None)
+        t0 = time.time()
+        prev = tracing.set_current(ctx) if ctx is not None else None
         try:
             if self._instance is not None:
                 outputs = self._fn(self._instance, inputs)
@@ -100,6 +110,13 @@ class _Batcher:
             for it in batch:
                 it.error = e
                 it.done.set()
+        finally:
+            if ctx is not None:
+                tracing.record_span(tracing.make_span(
+                    f"serve.batch {getattr(self._fn, '__name__', 'fn')}",
+                    "serve", t0, time.time(), ctx.trace_id, ctx.span_id,
+                    attrs={"batch_size": len(batch)}))
+                tracing.set_current(prev)
 
 
 # Deployment classes are cloudpickled to replicas, so decorator closures

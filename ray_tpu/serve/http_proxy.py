@@ -12,10 +12,12 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
 import urllib.parse
 from typing import Any
 
 from ..core import api as ray
+from ..observability import tracing
 from .long_poll import LongPollClient
 from .replica import Request
 from .router import CONTROLLER_NAME, DeploymentHandle
@@ -105,10 +107,12 @@ class ProxyActor:
                 pass
 
     @staticmethod
-    def _write_full(writer, status: str, body: bytes, content_type: str = "application/json"):
+    def _write_full(writer, status: str, body: bytes, content_type: str = "application/json",
+                    trace_id: str = ""):
+        extra = f"x-raytpu-trace-id: {trace_id}\r\n" if trace_id else ""
         writer.write((
             f"HTTP/1.1 {status}\r\nContent-Type: {content_type}\r\n"
-            f"Content-Length: {len(body)}\r\nConnection: keep-alive\r\n\r\n"
+            f"Content-Length: {len(body)}\r\n{extra}Connection: keep-alive\r\n\r\n"
         ).encode() + body)
 
     async def _read_request(self, reader: asyncio.StreamReader) -> Request | None:
@@ -160,51 +164,81 @@ class ProxyActor:
         model_id = request.headers.get("serve_multiplexed_model_id", "")
         if model_id:
             handle = handle.options(multiplexed_model_id=model_id)
-        loop = asyncio.get_running_loop()
-        stream = None
+        # Root span for the request (or a continuation of the client's
+        # trace via the x-raytpu-trace header); everything downstream —
+        # router queue, replica task, engine prefill/decode — chains
+        # under this context. The trace id is echoed back in a response
+        # header so clients can pull the tree with `cli trace <id>`.
+        ctx = tracing.context_from_headers(request.headers)
+        t0 = time.time()
+        status = "200"
         try:
-            # assign + submit off-loop (the router may block on backpressure)
-            stream = await loop.run_in_executor(None, handle.remote_streaming, request)
-            head = await stream.__anext__()
-        except StopAsyncIteration:
-            self._write_full(writer, "500 Internal Server Error",
-                             json.dumps({"error": "empty response stream"}).encode())
-            await writer.drain()
-            return True
-        except TimeoutError as e:
-            if stream is not None:
-                stream.close()  # release the router slot, cancel the replica
-            self._write_full(writer, "503 Service Unavailable",
-                             json.dumps({"error": str(e)}).encode())
-            await writer.drain()
-            return True
-        except Exception as e:
-            if stream is not None:
-                stream.close()
-            self._write_full(writer, "500 Internal Server Error",
-                             json.dumps({"error": f"{type(e).__name__}: {e}"}).encode())
-            await writer.drain()
-            return True
+            loop = asyncio.get_running_loop()
+            stream = None
+            try:
+                # assign + submit off-loop (the router may block on
+                # backpressure); bind the trace context across the hop.
+                stream = await loop.run_in_executor(
+                    None, tracing.bind(ctx, handle.remote_streaming, request))
+                head = await stream.__anext__()
+            except StopAsyncIteration:
+                status = "500"
+                self._write_full(writer, "500 Internal Server Error",
+                                 json.dumps({"error": "empty response stream"}).encode(),
+                                 trace_id=ctx.trace_id)
+                await writer.drain()
+                return True
+            except TimeoutError as e:
+                status = "503"
+                if stream is not None:
+                    stream.close()  # release the router slot, cancel the replica
+                self._write_full(writer, "503 Service Unavailable",
+                                 json.dumps({"error": str(e)}).encode(),
+                                 trace_id=ctx.trace_id)
+                await writer.drain()
+                return True
+            except Exception as e:
+                status = "500"
+                if stream is not None:
+                    stream.close()
+                self._write_full(writer, "500 Internal Server Error",
+                                 json.dumps({"error": f"{type(e).__name__}: {e}"}).encode(),
+                                 trace_id=ctx.trace_id)
+                await writer.drain()
+                return True
 
-        if head.get("kind") == "error":
-            stream.close()  # settle the router slot
-            self._write_full(writer, "500 Internal Server Error",
-                             json.dumps({"error": head["error"]}).encode())
-            await writer.drain()
-            return True
-        if head.get("kind") == "full":
-            stream.close()  # single-message stream: release the slot now
-            result = head.get("data")
-            body = result if isinstance(result, bytes) else json.dumps(result).encode()
-            self._write_full(writer, "200 OK", body)
-            await writer.drain()
-            return True
+            if head.get("kind") == "error":
+                status = "500"
+                stream.close()  # settle the router slot
+                self._write_full(writer, "500 Internal Server Error",
+                                 json.dumps({"error": head["error"]}).encode(),
+                                 trace_id=ctx.trace_id)
+                await writer.drain()
+                return True
+            if head.get("kind") == "full":
+                stream.close()  # single-message stream: release the slot now
+                result = head.get("data")
+                body = result if isinstance(result, bytes) else json.dumps(result).encode()
+                self._write_full(writer, "200 OK", body, trace_id=ctx.trace_id)
+                await writer.drain()
+                return True
 
+            return await self._stream_body(request, writer, stream, head, ctx)
+        finally:
+            tracing.record_span(tracing.make_span(
+                f"http {request.method} {request.path}", "serve", t0, time.time(),
+                ctx.trace_id, ctx.parent_id, ctx.span_id,
+                attrs={"app": route["app"], "deployment": route["deployment"],
+                       "status": status}))
+
+    async def _stream_body(self, request: Request, writer, stream, head,
+                           ctx) -> bool:
         # Streaming body: chunked transfer encoding, flushed per chunk
         # (SSE works over this: content_type text/event-stream).
         writer.write((
             f"HTTP/1.1 {head.get('status', '200 OK')}\r\n"
             f"Content-Type: {head.get('content_type', 'application/octet-stream')}\r\n"
+            f"x-raytpu-trace-id: {ctx.trace_id}\r\n"
             "Transfer-Encoding: chunked\r\nConnection: keep-alive\r\n"
             "Cache-Control: no-cache\r\n\r\n"
         ).encode())

@@ -35,6 +35,18 @@ class Request:
         return (Request, (self.method, self.path, self.query_params, self.headers, self.body))
 
 
+# Identity of the replica hosted by THIS worker process (one replica actor
+# per worker), set before the user callable is constructed so deployment
+# code — e.g. the LLM deployment's TTFT histogram — can tag its metrics
+# with the serve deployment it runs in (reference
+# serve.get_replica_context()).
+_REPLICA_CONTEXT: dict | None = None
+
+
+def get_replica_context() -> dict | None:
+    return _REPLICA_CONTEXT
+
+
 class ReplicaActor:
     """One deployment replica. Created by the controller with the pickled
     user class so replicas never re-import application modules."""
@@ -43,6 +55,8 @@ class ReplicaActor:
                  user_config: Any = None, deployment_name: str = "", app_name: str = ""):
         from .router import resolve_handle_markers
 
+        global _REPLICA_CONTEXT
+        _REPLICA_CONTEXT = {"app": app_name, "deployment": deployment_name}
         self._lock = threading.Lock()
         self._ongoing = 0
         self._total = 0

@@ -46,7 +46,34 @@ def _serve_metrics():
                 "End-to-end handle latency",
                 boundaries=(1, 5, 25, 100, 250, 500, 1000, 5000, 30000),
                 tag_keys=("deployment",))
+            _metrics["queue_wait"] = Histogram(
+                "serve_queue_wait_ms",
+                "Time a request waits in the router for a replica slot",
+                tag_keys=("deployment",))
         return _metrics
+
+
+def _assign_traced(router: "Router", metrics: dict, deployment: str,
+                   model_id: str) -> tuple[str, Any]:
+    """Assign a replica, recording the router queue wait as both a
+    histogram observation and (inside an active trace) a span."""
+    import time as _time
+
+    from ..observability import tracing
+
+    t0w, t0m = _time.time(), _time.monotonic()
+    try:
+        replica_id, actor = router.assign_replica(model_id=model_id)
+    finally:
+        wait_ms = 1000 * (_time.monotonic() - t0m)
+        metrics["queue_wait"].observe(wait_ms, tags={"deployment": deployment})
+        ctx = tracing.current()
+        if ctx is not None:
+            tracing.record_span(tracing.make_span(
+                f"router.queue {deployment}", "serve", t0w, _time.time(),
+                ctx.trace_id, ctx.span_id,
+                attrs={"deployment": deployment}))
+    return replica_id, actor
 
 
 def resolve_handle_markers(obj):
@@ -335,7 +362,8 @@ class DeploymentHandle:
         metrics = _serve_metrics()
         metrics["requests"].inc(tags={"deployment": self.deployment_name})
         t0 = _time.monotonic()
-        replica_id, actor = router.assign_replica(model_id=self._multiplexed_model_id)
+        replica_id, actor = _assign_traced(
+            router, metrics, self.deployment_name, self._multiplexed_model_id)
         if self._multiplexed_model_id:
             kwargs[MULTIPLEXED_KWARG] = self._multiplexed_model_id
         try:
@@ -367,7 +395,8 @@ class DeploymentHandle:
         metrics = _serve_metrics()
         metrics["requests"].inc(tags={"deployment": self.deployment_name})
         t0 = _time.monotonic()
-        replica_id, actor = router.assign_replica(model_id=self._multiplexed_model_id)
+        replica_id, actor = _assign_traced(
+            router, metrics, self.deployment_name, self._multiplexed_model_id)
         if self._multiplexed_model_id:
             kwargs[MULTIPLEXED_KWARG] = self._multiplexed_model_id
         try:

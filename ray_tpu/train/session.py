@@ -12,10 +12,36 @@ import dataclasses
 import os
 import shutil
 import threading
+import time
 import uuid
 from typing import Any
 
 from .checkpoint import Checkpoint
+
+# Per-step training gauges pushed through the metrics pipeline from each
+# worker's report() (reference: ray.train step metrics on the dashboard).
+_metrics_lock = threading.Lock()
+_metrics: dict = {}
+
+# report() keys mapped onto the exported tokens/s gauge, first match wins.
+_TOKENS_KEYS = ("tokens_per_s", "tokens_per_sec", "tokens_per_sec_per_chip")
+
+
+def _train_metrics() -> dict:
+    with _metrics_lock:
+        if not _metrics:
+            from ..util.metrics import Gauge
+
+            tags = ("experiment", "rank")
+            _metrics["step_time"] = Gauge(
+                "train_step_time_s", "Wall time between report() calls",
+                tag_keys=tags)
+            _metrics["tokens_per_s"] = Gauge(
+                "train_tokens_per_s", "Reported training token throughput",
+                tag_keys=tags)
+            _metrics["mfu"] = Gauge(
+                "train_mfu", "Reported model FLOPs utilization", tag_keys=tags)
+        return _metrics
 
 
 @dataclasses.dataclass
@@ -56,9 +82,32 @@ class _Session:
         self._lock = threading.Lock()
         self._reports: list[dict] = []
         self._step = 0
+        self._last_report_t: float | None = None
+
+    def _export_step_metrics(self, metrics: dict) -> None:
+        """Per-step gauges (step_time_s / tokens_per_s / mfu) so training
+        progress is visible on the metrics/Grafana path, not only in the
+        controller's result log. Never raises into the train loop."""
+        try:
+            tags = {"experiment": self.context.experiment_name,
+                    "rank": str(self.context.world_rank)}
+            m = _train_metrics()
+            now = time.monotonic()
+            if self._last_report_t is not None:
+                m["step_time"].set(now - self._last_report_t, tags)
+            self._last_report_t = now
+            for key in _TOKENS_KEYS:
+                if key in metrics:
+                    m["tokens_per_s"].set(float(metrics[key]), tags)
+                    break
+            if "mfu" in metrics:
+                m["mfu"].set(float(metrics["mfu"]), tags)
+        except Exception:
+            pass
 
     def report(self, metrics: dict, checkpoint: Checkpoint | None = None) -> None:
         entry: dict[str, Any] = {"metrics": dict(metrics or {}), "rank": self.context.world_rank}
+        self._export_step_metrics(entry["metrics"])
         if checkpoint is not None:
             # persist into run storage so it outlives the worker's tmpdir
             dest = os.path.join(

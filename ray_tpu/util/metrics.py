@@ -14,14 +14,28 @@ import time
 from typing import Sequence
 
 
+# Default histogram boundaries, millisecond-scale: suitable for the
+# latency/TTFT metrics this framework emits (serve_ttft_ms,
+# serve_queue_wait_ms, ray_tpu_lease_stage_ms, ...).
+LATENCY_MS_BOUNDARIES = (
+    1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
+)
+
+
 class _Metric:
-    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
+    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = (),
+                 register: bool = True):
         self.name = name
         self.description = description
         self.tag_keys = tuple(tag_keys)
         self._lock = threading.Lock()
         self._values: dict[tuple, float] = {}
-        _registry_add(self)
+        # register=False keeps the metric out of the global registry (no
+        # flusher push) — used by GCS-internal aggregations that are
+        # merged into GetMetrics directly.
+        if register:
+            _registry_add(self)
 
     def _key(self, tags: dict | None) -> tuple:
         tags = tags or {}
@@ -30,7 +44,7 @@ class _Metric:
     def snapshot(self) -> list[dict]:
         with self._lock:
             return [
-                {"name": self.name, "type": self.kind,
+                {"name": self.name, "type": self.kind, "desc": self.description,
                  "tags": dict(zip(self.tag_keys, key)), "value": value}
                 for key, value in self._values.items()
             ]
@@ -59,13 +73,13 @@ class Histogram(_Metric):
     kind = "histogram"
 
     def __init__(self, name: str, description: str = "", boundaries: Sequence[float] = (),
-                 tag_keys: Sequence[str] = ()):
-        self.boundaries = tuple(boundaries) or (0.001, 0.01, 0.1, 1.0, 10.0, 100.0)
+                 tag_keys: Sequence[str] = (), register: bool = True):
+        self.boundaries = tuple(boundaries) or LATENCY_MS_BOUNDARIES
         # set BEFORE super().__init__: registration makes this metric
         # visible to the flusher thread, which may snapshot immediately
         self._buckets: dict[tuple, list[int]] = {}
         self._counts: dict[tuple, int] = {}
-        super().__init__(name, description, tag_keys)
+        super().__init__(name, description, tag_keys, register)
 
     def observe(self, value: float, tags: dict | None = None) -> None:
         with self._lock:
@@ -82,6 +96,7 @@ class Histogram(_Metric):
             for key, total in self._values.items():
                 out.append({
                     "name": self.name, "type": "histogram",
+                    "desc": self.description,
                     "tags": dict(zip(self.tag_keys, key)),
                     "value": total,
                     "count": self._counts.get(key, 0),
@@ -151,30 +166,67 @@ def get_metrics() -> list[dict]:
 
 
 def prometheus_text(metrics: list[dict] | None = None) -> str:
-    """Render metrics in the Prometheus exposition format. Histograms emit
-    the full ``_bucket``/``_sum``/``_count`` family (cumulative ``le``
-    buckets) so ``histogram_quantile`` works in Grafana."""
+    """Render metrics in the Prometheus exposition format: a ``# HELP`` /
+    ``# TYPE`` header per metric family (Prometheus drops metadata — and
+    Grafana shows no descriptions — without them), then the samples.
+    Histograms emit the full ``_bucket``/``_sum``/``_count`` family
+    (cumulative ``le`` buckets) so ``histogram_quantile`` works in
+    Grafana."""
     def _esc(v) -> str:
         # Label-value escaping per the exposition format: one bad user tag
         # must not invalidate the whole scrape.
         return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
-    lines = []
+    # Group rows by family so HELP/TYPE precede every sample of a name.
+    families: dict[str, list[dict]] = {}
     for m in metrics if metrics is not None else get_metrics():
-        tags = sorted((m.get("tags") or {}).items())
-        base = ",".join(f'{k}="{_esc(v)}"' for k, v in tags)
-        if m.get("type") == "histogram" and m.get("buckets"):
-            cum = 0
-            for bound, count in zip(
-                    list(m.get("boundaries", [])) + ["+Inf"], m["buckets"]):
-                cum += count
-                le = f'le="{bound}"'
-                label = "{" + (base + "," if base else "") + le + "}"
-                lines.append(f"{m['name']}_bucket{label} {cum}")
+        families.setdefault(m["name"], []).append(m)
+
+    lines = []
+    for name, rows in families.items():
+        kind = rows[0].get("type") or "gauge"
+        kind = kind if kind in ("counter", "gauge", "histogram") else "untyped"
+        desc = next((r.get("desc") for r in rows if r.get("desc")), "")
+        if desc:
+            lines.append(f"# HELP {name} {_esc(desc)}")
+        lines.append(f"# TYPE {name} {kind}")
+        for m in rows:
+            tags = sorted((m.get("tags") or {}).items())
+            base = ",".join(f'{k}="{_esc(v)}"' for k, v in tags)
+            if m.get("type") == "histogram" and m.get("buckets"):
+                cum = 0
+                for bound, count in zip(
+                        list(m.get("boundaries", [])) + ["+Inf"], m["buckets"]):
+                    cum += count
+                    le = f'le="{bound}"'
+                    label = "{" + (base + "," if base else "") + le + "}"
+                    lines.append(f"{name}_bucket{label} {cum}")
+                label = f"{{{base}}}" if base else ""
+                lines.append(f"{name}_sum{label} {m['value']}")
+                lines.append(f"{name}_count{label} {m.get('count', cum)}")
+                continue
             label = f"{{{base}}}" if base else ""
-            lines.append(f"{m['name']}_sum{label} {m['value']}")
-            lines.append(f"{m['name']}_count{label} {m.get('count', cum)}")
-            continue
-        label = f"{{{base}}}" if base else ""
-        lines.append(f"{m['name']}{label} {m['value']}")
+            lines.append(f"{name}{label} {m['value']}")
     return "\n".join(lines) + "\n"
+
+
+def histogram_quantile(snapshot: dict, q: float) -> float | None:
+    """Approximate quantile from one histogram snapshot row (linear
+    interpolation within the bucket, the Prometheus convention). Returns
+    None for an empty histogram."""
+    buckets = snapshot.get("buckets") or []
+    boundaries = list(snapshot.get("boundaries") or [])
+    total = sum(buckets)
+    if not total or not boundaries:
+        return None
+    target = q * total
+    cum = 0
+    lo = 0.0
+    for i, count in enumerate(buckets):
+        hi = boundaries[i] if i < len(boundaries) else boundaries[-1]
+        if cum + count >= target and count > 0:
+            frac = (target - cum) / count
+            return lo + (hi - lo) * frac
+        cum += count
+        lo = hi
+    return boundaries[-1]

@@ -33,6 +33,19 @@ def list_placement_groups() -> list[dict]:
     return _gcs("ListPlacementGroups")["placement_groups"]
 
 
+def list_spans(trace_id: str | None = None, limit: int = 1000) -> list[dict]:
+    """Trace spans retained by the GCS span store (observability/):
+    task submit/lease/spawn/execute hops plus the serve request path
+    (http → router → replica batch → llm prefill/decode), connected by
+    ``trace_id``/``parent_id``."""
+    return _gcs("ListSpans", {"trace_id": trace_id, "limit": limit})["spans"]
+
+
+def list_traces(limit: int = 100) -> list[dict]:
+    """Per-trace summaries (root span, span count, duration)."""
+    return _gcs("ListTraces", {"limit": limit})["traces"]
+
+
 def _fanout_raylets(method: str, payload: dict, result_key: str) -> list[dict]:
     """Call a raylet RPC on every alive node concurrently; tag each row
     with its node_id. Nodes that fail to answer are skipped."""
