@@ -140,8 +140,31 @@ def run_framework() -> dict:
     if result.error is not None:
         raise result.error
     out = dict(result.metrics)
+    out.update(collect_memory_peaks())
     ray_tpu.shutdown()
     return out
+
+
+def collect_memory_peaks() -> dict:
+    """Peak HBM and object-store bytes from the cluster's memory gauges
+    (must run while still connected): lets the perf trajectory correlate
+    throughput regressions with memory pressure."""
+    try:
+        from ray_tpu.util.metrics import get_metrics
+
+        rows = get_metrics()
+
+        def peak(name: str) -> int:
+            return int(max((m["value"] for m in rows if m["name"] == name),
+                           default=0))
+
+        return {
+            "peak_hbm_used_bytes": peak("ray_tpu_hbm_peak_bytes"),
+            "peak_object_store_bytes": peak("ray_tpu_object_store_used_peak_bytes"),
+        }
+    except Exception as e:
+        print(f"memory peak collection failed: {e}", file=sys.stderr)
+        return {}
 
 
 def _run_chip_subprocess(code: str, what: str, timeout: float = 900) -> dict:
@@ -463,6 +486,8 @@ def main() -> None:
         "vs_baseline": round(value / baseline, 4) if baseline else 1.0,
         "mfu": round(fw["mfu"], 4),
         "loss": round(fw["loss"], 4),
+        "peak_hbm_used_bytes": fw.get("peak_hbm_used_bytes"),
+        "peak_object_store_bytes": fw.get("peak_object_store_bytes"),
         "raw_tokens_per_sec": round(raw, 2) if raw else None,
         "framework_overhead_pct": round(100 * (1 - value / raw), 2) if raw else None,
         **serve_metrics,

@@ -56,6 +56,20 @@ def main(argv: list[str] | None = None) -> int:
         "doctor", help="aggregate per-node debug state + recent error events")
     doctor_p.add_argument("--errors", type=int, default=10,
                           help="recent error events to show")
+    mem_p = sub.add_parser(
+        "memory", help="`ray memory`-style cluster view: per-worker object "
+                       "refs with size, ref type, and creation callsite")
+    mem_p.add_argument("--group-by-callsite", action="store_true",
+                       help="aggregate holders per creation callsite")
+    prof_p = sub.add_parser(
+        "profile", help="capture an on-demand jax.profiler trace on a worker")
+    prof_p.add_argument("--node", default=None,
+                        help="node id prefix (default: the driver's node)")
+    prof_p.add_argument("--worker", default=None, help="specific worker id")
+    prof_p.add_argument("--duration", type=float, default=5.0,
+                        help="capture length in seconds")
+    prof_p.add_argument("--list", action="store_true", dest="list_profiles",
+                        help="list previously captured artifacts instead")
 
     args = parser.parse_args(argv)
     _connect(args.address)
@@ -73,7 +87,8 @@ def main(argv: list[str] | None = None) -> int:
         elif what == "workers":
             rows, cols = st.list_workers(), ["worker_id", "state", "pid", "node_id"]
         elif what == "objects":
-            rows, cols = st.list_objects(), ["object_id", "size", "state", "node_id"]
+            rows, cols = st.list_objects(), ["object_id", "size", "state",
+                                             "ref_type", "callsite", "node_id"]
         elif what == "errors":
             rows, cols = st.list_errors(), ["type", "source", "node_id", "message"]
         else:
@@ -145,6 +160,41 @@ def main(argv: list[str] | None = None) -> int:
                 e.get("source", "?"), e.get("type", "?"),
                 (e.get("node_id") or "")[:8],
                 str(e.get("message", "")).splitlines()[0][:120] if e.get("message") else ""))
+    elif args.cmd == "memory":
+        summary = st.memory_summary()
+        if args.as_json:
+            print(json.dumps(summary, indent=2, default=str))
+            return 0
+        if args.group_by_callsite:
+            from ray_tpu.observability.memory import _top_holders
+
+            entries = [e for w in summary.get("workers", [])
+                       for e in w.get("entries", [])]
+            print("%-52s %8s %12s  %s" % ("CALLSITE", "REFS", "BYTES", "REF_TYPES"))
+            for h in _top_holders(entries, top_k=50):
+                print("%-52s %8d %12d  %s" % (
+                    h["callsite"][:52], h["count"], h["bytes"],
+                    ",".join(h["ref_types"])))
+            return 0
+        from ray_tpu.observability import format_memory_summary
+
+        print(format_memory_summary(summary, st.list_nodes()))
+    elif args.cmd == "profile":
+        if args.list_profiles:
+            rows = st.list_profiles()
+            if args.as_json:
+                print(json.dumps(rows, indent=2, default=str))
+            else:
+                _print_table(rows, ["path", "node_id", "worker_id", "duration"])
+            return 0
+        reply = st.capture_profile(node_id=args.node, duration=args.duration,
+                                   worker_id=args.worker)
+        if reply.get("error"):
+            print(f"error: {reply['error']}", file=sys.stderr)
+            return 1
+        print(json.dumps(reply, indent=2, default=str) if args.as_json
+              else f"wrote {reply['path']} (worker {reply.get('worker_id', '')[:12]}, "
+                   f"{reply.get('duration')}s) — open with XProf/TensorBoard")
     return 0
 
 

@@ -149,6 +149,24 @@ class RayTpuConfig:
     lease_wedge_threshold_s: float = 10.0
     lease_wedge_check_interval_s: float = 1.0
 
+    # --- memory observability ------------------------------------------------
+    # Record a Python creation callsite on every user-facing ObjectRef
+    # (reference record_ref_creation_sites; powers `cli memory` attribution).
+    record_ref_creation_sites: bool = True
+    # Cadence of per-worker memory summaries on the task-event flush path.
+    memory_report_interval_ms: int = 2000
+    # Rows per worker summary (totals stay exact; only the table is capped).
+    memory_summary_max_entries: int = 200
+    # GCS leak watcher: flag a worker/raylet whose refcount table or pinned
+    # bytes grew monotonically across this many consecutive reports by at
+    # least the byte/ref thresholds. 0 intervals disables the watcher.
+    memory_leak_check_interval_s: float = 5.0
+    memory_leak_intervals: int = 4
+    memory_leak_min_growth_bytes: int = 1 << 20
+    memory_leak_min_growth_refs: int = 50
+    # On-demand jax.profiler capture (cli profile): hard cap per request.
+    profile_max_duration_s: float = 60.0
+
     # --- workers / executor --------------------------------------------------
     # Thread pool depth per worker (long-poll actor methods park threads).
     worker_executor_threads: int = 64

@@ -137,6 +137,14 @@ class GcsServer:
         # Trace spans flushed on the task-event path (status SPAN).
         self.span_store = GcsSpanStore(
             max_spans=get_config().span_events_buffer_size)
+        # Per-worker memory summaries flushed on the same path (status
+        # MEMORY) + the trend histories the leak watcher scans.
+        from ..observability.memory import GcsMemoryStore
+
+        self.memory_store = GcsMemoryStore()
+        self._memory_watch_task: asyncio.Task | None = None
+        # On-demand profiler artifacts registered by raylets (cli profile).
+        self._profiles: list[dict] = []
         self._metrics: dict[str, tuple[float, list[dict]]] = {}  # worker -> (ts, snapshot)
         # Error-info table: retained ErrorEvents behind the pub/sub channel
         # (reference ErrorInfoHandler / RAY_ERROR_INFO_CHANNEL).
@@ -157,6 +165,8 @@ class GcsServer:
             self._persist_task.cancel()
         if self._debug_dump_task:
             self._debug_dump_task.cancel()
+        if self._memory_watch_task:
+            self._memory_watch_task.cancel()
         for task in list(self._bg_tasks):
             task.cancel()
 
@@ -165,6 +175,7 @@ class GcsServer:
         await self._server.start()
         self._health_task = spawn(self._health_check_loop())
         self._persist_task = spawn(self._persist_loop())
+        self._memory_watch_task = spawn(self._memory_watch_loop())
         if self._session_dir:
             self._debug_dump_task = spawn(self._debug_dump_loop())
 
@@ -290,6 +301,13 @@ class GcsServer:
         node["pending_demand"] = p.get("pending_demand", [])
         if "store" in p:
             node["store"] = p["store"]
+            # Feed the leak watcher's per-node pinned-bytes trend history.
+            self.memory_store.report_node(
+                p["node_id"], p["store"].get("pinned_bytes", 0))
+        if "hbm" in p:
+            node["hbm"] = p["hbm"]
+        if "worker_rss_bytes" in p:
+            node["worker_rss_bytes"] = p["worker_rss_bytes"]
         # Bundle reconciliation (reference: GCS-restart bundle cleanup):
         # the raylet cancels reservations whose group no longer exists —
         # half-committed 2PC bundles from before a GCS crash would
@@ -402,9 +420,16 @@ class GcsServer:
 
     # --------------------------------------------------------- observability
     async def handle_AddTaskEvents(self, p: dict) -> dict:
-        from .task_events import SPAN
+        from .task_events import MEMORY, SPAN
 
         events = p.get("events") or []
+        memories = [e for e in events if e.get("status") == MEMORY]
+        if memories:
+            for e in memories:
+                summary = e.get("memory")
+                if summary:
+                    self.memory_store.report(summary)
+            events = [e for e in events if e.get("status") != MEMORY]
         spans = [e for e in events if e.get("status") == SPAN]
         if spans:
             # Stamp recorder identity onto the span at ingest so the
@@ -422,6 +447,51 @@ class GcsServer:
 
     async def handle_ListTaskEvents(self, p: dict) -> dict:
         return {"tasks": self.task_events.list_tasks(p.get("limit", 1000))}
+
+    async def handle_MemorySummary(self, p: dict) -> dict:
+        """Merged per-worker memory summaries (state.memory_summary /
+        cli memory / dashboard /api/memory)."""
+        return {"summary": self.memory_store.summary()}
+
+    async def handle_RegisterProfile(self, p: dict) -> dict:
+        """A raylet registers a finished jax.profiler capture artifact."""
+        entry = dict(p.get("profile") or {})
+        entry.setdefault("ts", time.time())
+        self._profiles.append(entry)
+        del self._profiles[: max(0, len(self._profiles) - 100)]
+        return {}
+
+    async def handle_ListProfiles(self, p: dict) -> dict:
+        return {"profiles": list(self._profiles)}
+
+    async def _memory_watch_loop(self) -> None:
+        """Leak watcher: scan the memory store's trend histories and turn
+        monotonic growth (a worker's refcount table, a raylet's pinned
+        bytes) into a diagnostics ErrorEvent naming the top holders by
+        callsite (ROADMAP 1c). Re-reads the config each tick so tests and
+        live operators can retune thresholds without a restart."""
+        from ..observability.memory import leak_event_message
+        from ..diagnostics.errors import make_event
+
+        while True:
+            cfg = get_config()
+            await asyncio.sleep(max(0.1, cfg.memory_leak_check_interval_s))
+            if cfg.memory_leak_intervals <= 0:
+                continue
+            try:
+                suspects = self.memory_store.detect_leaks(
+                    intervals=cfg.memory_leak_intervals,
+                    min_growth_bytes=cfg.memory_leak_min_growth_bytes,
+                    min_growth_refs=cfg.memory_leak_min_growth_refs)
+                for s in suspects:
+                    logger.warning("memory leak watcher: %s", leak_event_message(s))
+                    await self.handle_PublishError({"event": make_event(
+                        "memory_leak", leak_event_message(s), source="gcs",
+                        node_id=s.get("node_id", ""),
+                        worker_id=s.get("worker_id", ""),
+                        extra={"suspect": s})})
+            except Exception:
+                logger.exception("memory leak watcher scan failed")
 
     async def handle_ListSpans(self, p: dict) -> dict:
         return {"spans": self.span_store.list_spans(
@@ -491,6 +561,9 @@ class GcsServer:
             "tasks_by_state": self.task_events.count_by_state(),
             "errors_buffered": len(self._errors),
             "spans_buffered": self.span_store.size(),
+            "memory_reports": self.memory_store.size(),
+            "memory_leaks_flagged_total": self.memory_store.leaks_flagged_total,
+            "profiles_registered": len(self._profiles),
         }
 
     async def handle_GetDebugState(self, p: dict) -> dict:
@@ -600,15 +673,41 @@ class GcsServer:
         for shape, count in demand.items():
             gauge("ray_tpu_pending_demand", count, shape=shape)
 
+        worker_hbm = self.memory_store.hbm_by_node()
         for node_id, n in self._nodes.items():
-            store = n.get("store")
-            if n.get("state") != "ALIVE" or not store:
+            if n.get("state") != "ALIVE":
                 continue
             nid = node_id[:12]
+            store = n.get("store") or {}
             gauge("ray_tpu_object_store_used_bytes", store.get("used", 0), node_id=nid)
-            gauge("ray_tpu_object_store_capacity_bytes", store.get("capacity", 0), node_id=nid)
+            gauge("ray_tpu_object_store_capacity_bytes",
+                  store.get("capacity", n.get("object_store_capacity", 0)), node_id=nid)
+            gauge("ray_tpu_object_store_pinned_bytes", store.get("pinned_bytes", 0), node_id=nid)
+            gauge("ray_tpu_object_store_used_peak_bytes",
+                  store.get("used_peak", store.get("used", 0)), node_id=nid)
+            gauge("ray_tpu_object_store_fallback_allocations_total",
+                  store.get("fallback_allocations_total", 0), node_id=nid)
+            # Spill/restore counters: bytes AND object counts (canonical
+            # ray_tpu_spill_* names; the legacy *_bytes_total spellings from
+            # the first metrics PR stay for existing dashboards).
+            gauge("ray_tpu_spill_bytes_total", store.get("spilled_bytes_total", 0), node_id=nid)
+            gauge("ray_tpu_restore_bytes_total", store.get("restored_bytes_total", 0), node_id=nid)
+            gauge("ray_tpu_spill_objects_total", store.get("spilled_objects_total", 0), node_id=nid)
+            gauge("ray_tpu_restore_objects_total", store.get("restored_objects_total", 0), node_id=nid)
             gauge("ray_tpu_spilled_bytes_total", store.get("spilled_bytes_total", 0), node_id=nid)
             gauge("ray_tpu_restored_bytes_total", store.get("restored_bytes_total", 0), node_id=nid)
+            # HBM accounting: the raylet's own heartbeat view merged (max)
+            # with what the node's workers report in memory summaries — the
+            # device lock is exclusive per process, and max never double
+            # counts a driver sharing the raylet's process.
+            hbm = dict(n.get("hbm") or {})
+            whbm = worker_hbm.get(node_id) or {}
+            for k in ("used", "limit", "peak"):
+                hbm[k] = max(int(hbm.get(k, 0)), int(whbm.get(k, 0)))
+            gauge("ray_tpu_hbm_used_bytes", hbm.get("used", 0), node_id=nid)
+            gauge("ray_tpu_hbm_limit_bytes", hbm.get("limit", 0), node_id=nid)
+            gauge("ray_tpu_hbm_peak_bytes", hbm.get("peak", 0), node_id=nid)
+            gauge("ray_tpu_worker_rss_bytes", n.get("worker_rss_bytes", 0), node_id=nid)
 
         by_state = {}
         for a in self._actors.values():

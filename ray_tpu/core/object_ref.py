@@ -14,14 +14,26 @@ from .ids import ObjectID
 
 
 class ObjectRef:
-    __slots__ = ("_id", "_owner_address", "_skip_refcount", "__weakref__")
+    __slots__ = ("_id", "_owner_address", "_skip_refcount", "_callsite", "__weakref__")
 
     def __init__(self, object_id: ObjectID, owner_address: str = "", *, _add_local_ref: bool = True):
         self._id = object_id
         self._owner_address = owner_address
         self._skip_refcount = not _add_local_ref
         if _add_local_ref:
+            # Creation callsite for `ray memory`-style reference debugging
+            # (observability/memory.py; reference record_ref_creation_sites):
+            # the first user frame above the ray_tpu call that made the ref.
+            from ..observability.memory import capture_callsite
+
+            self._callsite = capture_callsite()
             _refcounter_hook("add_local", self)
+        else:
+            self._callsite = ""
+
+    @property
+    def callsite(self) -> str:
+        return self._callsite
 
     def id(self) -> ObjectID:
         return self._id

@@ -193,6 +193,13 @@ class Raylet:
         self._last_oom_kill = 0.0
         self._spilled_bytes_total = 0
         self._restored_bytes_total = 0
+        # Memory observability: spill/restore object counts, creations that
+        # only succeeded after a synchronous spill (the reference's
+        # "fallback allocation" analogue), and the high-water store mark.
+        self._spilled_objects_total = 0
+        self._restored_objects_total = 0
+        self._fallback_allocations_total = 0
+        self._store_used_peak = 0
         # Overridable for tests: returns fraction of node memory in use.
         self._memory_usage_fn = _node_memory_usage_fraction
         # Outstanding pin_read store refs per reader (worker_id), released
@@ -318,7 +325,41 @@ class Raylet:
         ``cluster_utils.py`` remove_node non-graceful path)."""
         await self.stop(graceful=False)
 
+    def _store_stats(self) -> dict:
+        """Store/spill accounting shared by heartbeats and debug_state
+        (the node half of the memory observability layer)."""
+        used = self.store.used()
+        self._store_used_peak = max(self._store_used_peak, used)
+        return {
+            "used": used,
+            "used_peak": self._store_used_peak,
+            "capacity": self.object_store_capacity,
+            "objects": self.store.num_objects(),
+            "pinned_objects": len(self._pinned),
+            "pinned_bytes": sum(self._pinned.values()),
+            "spilled_objects": len(self._spilled),
+            "spilled_bytes_total": self._spilled_bytes_total,
+            "restored_bytes_total": self._restored_bytes_total,
+            "spilled_objects_total": self._spilled_objects_total,
+            "restored_objects_total": self._restored_objects_total,
+            "fallback_allocations_total": self._fallback_allocations_total,
+        }
+
+    def _worker_rss(self) -> dict[str, int]:
+        """RSS per tracked worker/driver process on this node."""
+        from ..observability.memory import process_rss_bytes
+
+        out: dict[str, int] = {}
+        for w in self._workers.values():
+            if w.pid and w.state != "dead":
+                rss = process_rss_bytes(w.pid)
+                if rss:
+                    out[w.worker_id] = rss
+        return out
+
     async def _heartbeat_loop(self) -> None:
+        from ..observability.memory import hbm_stats
+
         cfg = get_config()
         while True:
             await asyncio.sleep(cfg.health_check_period_ms / 1000.0)
@@ -332,14 +373,11 @@ class Raylet:
                             {"shape": dict(shape), "count": count}
                             for shape, count in self._pending_lease_demand.items()
                         ],
-                        # Store/spill gauges for the metrics pipeline
-                        # (ray_tpu_object_store_used_bytes etc.).
-                        "store": {
-                            "used": self.store.used(),
-                            "capacity": self.object_store_capacity,
-                            "spilled_bytes_total": self._spilled_bytes_total,
-                            "restored_bytes_total": self._restored_bytes_total,
-                        },
+                        # Store/spill/HBM/RSS gauges for the metrics
+                        # pipeline (ray_tpu_object_store_* / ray_tpu_hbm_*).
+                        "store": self._store_stats(),
+                        "hbm": hbm_stats(),
+                        "worker_rss_bytes": sum(self._worker_rss().values()),
                     },
                     timeout=5.0,
                 )
@@ -1272,10 +1310,13 @@ class Raylet:
         secondary copies wasn't enough (local_object_manager.cc
         SpillObjectsOfSize)."""
         try:
-            return self.store.create(oid, data_size, meta_size)
+            offset = self.store.create(oid, data_size, meta_size)
         except StoreFullError:
             self._spill_objects(data_size + meta_size)
-            return self.store.create(oid, data_size, meta_size)
+            offset = self.store.create(oid, data_size, meta_size)
+            self._fallback_allocations_total += 1
+        self._store_used_peak = max(self._store_used_peak, self.store.used())
+        return offset
 
     def _spill_objects(self, nbytes: int) -> int:
         """Move the oldest unreferenced pinned objects out of shm until
@@ -1307,6 +1348,7 @@ class Raylet:
                 self._write_file(self._spill_path(oid), blob)
                 self._spill_pending.pop(oid, None)
             self._spilled_bytes_total += data_size + meta_size
+            self._spilled_objects_total += 1
             meta = self._object_meta.get(oid)
             if meta is not None:
                 meta["spilled"] = True
@@ -1361,6 +1403,7 @@ class Raylet:
         self._spilled.pop(oid, None)
         self._spill_pending.pop(oid, None)
         self._restored_bytes_total += data_size + meta_size
+        self._restored_objects_total += 1
         meta = self._object_meta.get(oid)
         if meta is not None:
             meta["spilled"] = False
@@ -1978,14 +2021,64 @@ class Raylet:
     async def handle_ListObjects(self, p: dict) -> dict:
         limit = p.get("limit", 1000)
         out = []
+        total = len(self._object_meta)
         for oid, meta in list(self._object_meta.items())[:limit]:
             if oid in self._spilled:
                 state_name = "SPILLED"
             else:
                 state = self.store.contains(oid)
                 state_name = {0: "ABSENT", 1: "CREATED", 2: "SEALED"}.get(state, "?")
-            out.append({"object_id": oid.hex(), "size": meta["size"], "state": state_name})
-        return {"objects": out}
+            out.append({"object_id": oid.hex(), "size": meta["size"],
+                        "state": state_name, "pinned": oid in self._pinned})
+        # Truncation is reported, never silent: the state API warns when a
+        # listing hit its limit.
+        return {"objects": out, "total": total, "truncated": total > limit}
+
+    async def handle_CaptureProfile(self, p: dict) -> dict:
+        """Trigger an on-demand jax.profiler capture on one of this node's
+        workers (cli profile --node ...). Prefers a busy (leased/dedicated)
+        worker — the one actually touching the accelerator — then idle,
+        then the driver. The finished artifact is registered with the GCS
+        so it shows up under /api/profiles."""
+        target_id = p.get("worker_id") or ""
+        candidates = [w for w in self._workers.values()
+                      if w.address and w.state not in ("dead", "starting")]
+        if target_id:
+            candidates = [w for w in candidates if w.worker_id == target_id]
+        rank = {"dedicated": 0, "leased": 1, "idle": 2, "driver": 3}
+        candidates.sort(key=lambda w: rank.get(w.state, 4))
+        if not candidates:
+            return {"error": "no reachable worker on node "
+                             f"{self.node_id.hex()[:8]}"
+                             + (f" matching worker_id {target_id}" if target_id else "")}
+        target = candidates[0]
+        duration = float(p.get("duration", 2.0))
+        outdir = os.path.join(self._session_dir, "profiles")
+        client = RpcClient(target.address)
+        try:
+            reply = await client.call(
+                "CaptureProfile",
+                {"duration": duration, "output_dir": outdir},
+                timeout=duration + 120.0)
+        except Exception as e:
+            return {"error": f"worker {target.worker_id[:12]} capture failed: {e}"}
+        finally:
+            await client.close()
+        if reply.get("path"):
+            profile = {
+                "path": reply["path"],
+                "node_id": self.node_id.hex(),
+                "worker_id": target.worker_id,
+                "worker_state": target.state,
+                "duration": reply.get("duration", duration),
+            }
+            try:
+                await self._gcs.call("RegisterProfile", {"profile": profile},
+                                     timeout=5.0)
+            except Exception:
+                pass
+            reply.setdefault("node_id", self.node_id.hex())
+        return reply
 
     async def handle_DebugState(self, p: dict) -> dict:
         return {
@@ -2045,16 +2138,14 @@ class Raylet:
             ],
             "fence_pending": {str(k): v for k, v in self._fence_pending.items()},
             "store": {
-                "used": self.store.used(),
-                "capacity": self.object_store_capacity,
-                "objects": self.store.num_objects(),
-                "spilled_objects": len(self._spilled),
-                "spilled_bytes_total": self._spilled_bytes_total,
-                "restored_bytes_total": self._restored_bytes_total,
+                **self._store_stats(),
                 "receiving": len(self._receiving),
                 "pull_inflight": self._pull_inflight,
                 "pull_waiters": len(self._pull_waiters),
             },
+            "hbm": _hbm_snapshot(),
+            "worker_rss_bytes": {
+                wid[:12]: rss for wid, rss in self._worker_rss().items()},
             "transfer_stats": dict(self.transfer_stats),
             "oom_kills_total": self._oom_kills_total,
             "wedge_events_total": self._wedge_events_total,
@@ -2143,6 +2234,12 @@ class Raylet:
                 # The watchdog must outlive any one bad scan (e.g. the
                 # store closing mid-snapshot during teardown).
                 logger.exception("lease-wedge watchdog scan failed")
+
+
+def _hbm_snapshot() -> dict:
+    from ..observability.memory import hbm_stats
+
+    return hbm_stats()
 
 
 def _node_memory_usage_fraction() -> float:

@@ -19,6 +19,7 @@ this via ``WaitForRefRemoved`` pub/sub).
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -42,6 +43,11 @@ class _Ref:
     owner_address: str = ""
     # Borrow registration with the owner has been initiated.
     borrow_registered: bool = False
+    # Memory observability (observability/memory.py): Python creation
+    # callsite, serialized size, and entry age for memory_summary().
+    callsite: str = ""
+    size: int = 0
+    created_at: float = 0.0
 
     def total(self) -> int:
         return self.local + self.submitted + self.borrowers + self.contained_in
@@ -56,8 +62,57 @@ class ReferenceCounter:
     def _entry(self, oid: ObjectID) -> _Ref:
         ref = self._refs.get(oid)
         if ref is None:
-            ref = self._refs[oid] = _Ref()
+            ref = self._refs[oid] = _Ref(created_at=time.time())
         return ref
+
+    # -- memory observability ------------------------------------------------
+    def set_callsite(self, oid: ObjectID, callsite: str) -> None:
+        """First recorded callsite wins: it names the creation line, not
+        later touches."""
+        if not callsite:
+            return
+        with self._lock:
+            ref = self._refs.get(oid)
+            if ref is not None and not ref.callsite:
+                ref.callsite = callsite
+
+    def set_size(self, oid: ObjectID, nbytes: int) -> None:
+        with self._lock:
+            ref = self._refs.get(oid)
+            if ref is not None:
+                ref.size = int(nbytes)
+
+    def summary(self, limit: int = 200) -> tuple[list[dict], int, int]:
+        """(entries, num_refs, total_bytes) for memory_summary(): every
+        live entry classified per observability.memory.classify_ref,
+        biggest first, capped at ``limit`` rows (totals are uncapped)."""
+        from ..observability.memory import classify_ref
+
+        now = time.time()
+        entries: list[dict] = []
+        total_bytes = 0
+        with self._lock:
+            num_refs = len(self._refs)
+            for oid, ref in self._refs.items():
+                total_bytes += ref.size
+                entries.append({
+                    "object_id": oid.hex(),
+                    "size": ref.size,
+                    "ref_type": classify_ref(
+                        local=ref.local, submitted=ref.submitted,
+                        contained_in=ref.contained_in,
+                        borrowers=ref.borrowers,
+                        pinned=bool(ref.locations)),
+                    "callsite": ref.callsite,
+                    "age_s": max(0.0, now - ref.created_at) if ref.created_at else 0.0,
+                    "local": ref.local,
+                    "submitted": ref.submitted,
+                    "borrowers": ref.borrowers,
+                    "contained_in": ref.contained_in,
+                    "owned": ref.owned,
+                })
+        entries.sort(key=lambda e: e["size"], reverse=True)
+        return entries[:limit], num_refs, total_bytes
 
     # -- ownership -----------------------------------------------------------
     def add_owned_object(self, oid: ObjectID, contained: list[ObjectID] | None = None) -> None:

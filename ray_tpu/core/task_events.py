@@ -26,6 +26,9 @@ FAILED = "FAILED"
 # through the same buffered flush path; the GCS routes these to its span
 # store instead of the task table.
 SPAN = "SPAN"
+# Pseudo-status carrying a worker memory summary (observability/memory.py)
+# on the same flush path; the GCS routes these to its memory store.
+MEMORY = "MEMORY"
 
 
 def _resolve_state(events: dict) -> str:
@@ -82,6 +85,25 @@ class TaskEventBuffer:
             "node_id": self._node_id,
             "kind": 0,
             "span": span,
+        }
+        with self._lock:
+            if len(self._events) >= self._max:
+                self._dropped += 1
+                return
+            self._events.append(ev)
+
+    def record_memory(self, summary: dict) -> None:
+        """Buffer one per-worker memory summary; rides the same drain/flush
+        batch as status events (status ``MEMORY``)."""
+        ev = {
+            "task_id": "",
+            "name": "memory_summary",
+            "status": MEMORY,
+            "ts": summary.get("ts", time.time()),
+            "worker_id": self._worker_id,
+            "node_id": self._node_id,
+            "kind": 0,
+            "memory": summary,
         }
         with self._lock:
             if len(self._events) >= self._max:

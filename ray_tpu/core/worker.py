@@ -453,6 +453,7 @@ class CoreWorker:
     def _hook_add_local(self, ref: ObjectRef) -> None:
         oid = ref.id()
         self.refcounter.add_local_ref(oid)
+        self.refcounter.set_callsite(oid, ref.callsite)
         owner = ref.owner_address
         if owner and owner != self.address and self.refcounter.note_borrowed(oid, owner):
             # First local ref to a borrowed object: register with its owner
@@ -530,6 +531,7 @@ class CoreWorker:
         contained_ids = [r.id() for r in contained]
         self.refcounter.add_owned_object(oid, contained_ids)
         nbytes = blob.nbytes if isinstance(blob, serialization.Serialized) else len(blob)
+        self.refcounter.set_size(oid, nbytes)
         if nbytes <= cfg.max_inline_object_size:
             if isinstance(blob, serialization.Serialized):
                 blob = blob.to_blob()
@@ -1268,10 +1270,12 @@ class CoreWorker:
             self.refcounter.add_containment(rid, child_ids)
         if ret["t"] == "v":
             self.memory_store.put(rid, ret["meta"], ret["blob"])
+            self.refcounter.set_size(rid, len(ret["blob"]))
         else:  # in plasma on executor's node
             node_id = ret["node_id"]
             self.refcounter.add_location(rid, node_id)
             self.memory_store.put_plasma_marker(rid, node_id.encode() if isinstance(node_id, str) else node_id)
+            self.refcounter.set_size(rid, ret.get("size", 0))
 
     async def _maybe_reexport(self, spec: TaskSpec, reply: dict) -> bool:
         """Handle a worker's "function not in GCS" reply: the GCS lost the
@@ -1717,12 +1721,98 @@ class CoreWorker:
         with stream.cond:
             return {"consumed": stream.consumed, "cancel": stream.error is not None}
 
+    # -------------------------------------------------- memory observability
+    def memory_summary(self, limit: int | None = None) -> dict:
+        """This process's reference table, `ray memory`-style: every live
+        entry with size, classified ref type, creation callsite, and age,
+        plus actor handles and local JAX HBM stats (observability/memory)."""
+        from ..observability.memory import ACTOR_HANDLE, hbm_stats, process_rss_bytes
+
+        cfg = get_config()
+        entries, num_refs, total_bytes = self.refcounter.summary(
+            limit if limit is not None else cfg.memory_summary_max_entries)
+        with self._counter_lock:
+            handles = {aid: n for aid, n in self._actor_handle_counts.items() if n > 0}
+        for aid, count in handles.items():
+            entries.append({
+                "object_id": aid.hex(), "size": 0, "ref_type": ACTOR_HANDLE,
+                "callsite": "", "age_s": 0.0, "local": count,
+                "submitted": 0, "borrowers": 0, "contained_in": 0,
+                "owned": aid in self._owned_actors,
+            })
+        return {
+            "worker_id": self.worker_id,
+            "node_id": self.node_id,
+            "mode": self.mode,
+            "pid": os.getpid(),
+            "ts": time.time(),
+            "num_refs": num_refs,
+            "actor_handles": len(handles),
+            "total_bytes": total_bytes,
+            "rss_bytes": process_rss_bytes(),
+            "hbm": hbm_stats(),
+            "entries": entries,
+        }
+
+    async def handle_MemorySummary(self, p: dict) -> dict:
+        """Live (un-buffered) summary for direct fan-out queries."""
+        return {"summary": self.memory_summary(p.get("limit"))}
+
+    async def handle_CaptureProfile(self, p: dict) -> dict:
+        """On-demand ``jax.profiler`` trace capture (reference: `ray timeline`
+        + the dashboard profiler button): runs start_trace/stop_trace around
+        a sleep in an executor thread and returns the artifact directory
+        (xplane.pb + trace.json.gz, loadable in XProf/Perfetto)."""
+        import asyncio
+        import tempfile
+
+        cfg = get_config()
+        duration = min(float(p.get("duration", 2.0)), cfg.profile_max_duration_s)
+        outdir = p.get("output_dir") or tempfile.gettempdir()
+        path = os.path.join(
+            outdir, f"raytpu_profile_{self.worker_id[:8]}_{int(time.time())}")
+        with self._exec_lock:
+            if getattr(self, "_profiling", False):
+                return {"error": "a profile capture is already in progress"}
+            self._profiling = True
+
+        def _capture() -> None:
+            import jax
+
+            os.makedirs(path, exist_ok=True)
+            jax.profiler.start_trace(path)
+            try:
+                time.sleep(duration)
+            finally:
+                jax.profiler.stop_trace()
+
+        try:
+            await asyncio.get_running_loop().run_in_executor(None, _capture)
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            with self._exec_lock:
+                self._profiling = False
+        return {"path": path, "worker_id": self.worker_id,
+                "node_id": self.node_id, "duration": duration}
+
     async def _task_event_flusher(self) -> None:
         import asyncio
 
         interval = get_config().task_events_flush_interval_ms / 1000.0
+        last_memory_report = 0.0
         while True:
             await asyncio.sleep(interval)
+            # Piggyback the periodic memory summary on the flush cadence
+            # (re-reads the config so tests can retune it live).
+            mem_interval = get_config().memory_report_interval_ms / 1000.0
+            now = time.monotonic()
+            if mem_interval > 0 and now - last_memory_report >= mem_interval:
+                last_memory_report = now
+                try:
+                    self.task_events.record_memory(self.memory_summary())
+                except Exception:
+                    pass
             events, dropped = self.task_events.drain()
             if not events and not dropped:
                 continue
@@ -2077,7 +2167,7 @@ class CoreWorker:
         else:
             rid = ObjectID.for_task_return(task_id, index + 1)
             self._plasma_put(rid, metadata, s)
-            entry = {"t": "p", "node_id": self.node_id}
+            entry = {"t": "p", "node_id": self.node_id, "size": s.nbytes}
         if wire_contained:
             entry["contained"] = wire_contained
         return entry

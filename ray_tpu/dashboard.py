@@ -30,7 +30,7 @@ _ENDPOINTS = [
     "nodes", "actors", "tasks", "objects", "workers",
     "placement_groups", "jobs", "metrics", "cluster_resources",
     "available_resources", "timeline", "grafana_dashboard",
-    "errors", "diagnostics", "traces",
+    "errors", "diagnostics", "traces", "memory", "profiles",
 ]
 
 
@@ -54,6 +54,10 @@ def _collect(endpoint: str):
         return state.cluster_diagnostics()
     if endpoint == "traces":
         return state.list_traces()
+    if endpoint == "memory":
+        return state.memory_summary()
+    if endpoint == "profiles":
+        return state.list_profiles()
     if endpoint == "placement_groups":
         return state.list_placement_groups()
     if endpoint == "jobs":

@@ -126,8 +126,8 @@ def generate_dashboard(title: str = "ray_tpu cluster") -> dict:
         ], grid={"x": 2 * W, "y": 4 + H, "w": W, "h": H}),
         # Row 4: spill + serve
         _panel(30, "Spill / restore throughput", [
-            {"expr": "rate(ray_tpu_spilled_bytes_total[5m])", "legend": "spilled"},
-            {"expr": "rate(ray_tpu_restored_bytes_total[5m])", "legend": "restored"},
+            {"expr": "rate(ray_tpu_spill_bytes_total[5m])", "legend": "spilled"},
+            {"expr": "rate(ray_tpu_restore_bytes_total[5m])", "legend": "restored"},
         ], grid={"x": 0, "y": 4 + 2 * H, "w": W, "h": H}, unit="Bps"),
         _panel(31, "Serve requests", [
             {"expr": "rate(serve_num_requests_total[1m])", "legend": "{{deployment}}"},
@@ -153,6 +153,24 @@ def generate_dashboard(title: str = "ray_tpu cluster") -> dict:
                      "(rate(ray_tpu_lease_stage_ms_bucket[5m])))",
              "legend": "{{stage}}"},
         ], grid={"x": 2 * W, "y": 4 + 3 * H, "w": W, "h": H}, unit="ms"),
+        # Row 6: memory observability (memory PR): per-node object-store
+        # usage vs capacity/pinned, HBM used vs limit, worker RSS, and the
+        # spill-rate-by-node view that pairs with the leak watcher.
+        _panel(50, "Object store used / pinned / capacity", [
+            {"expr": "ray_tpu_object_store_used_bytes", "legend": "{{node_id}} used"},
+            {"expr": "ray_tpu_object_store_pinned_bytes", "legend": "{{node_id}} pinned"},
+            {"expr": "ray_tpu_object_store_capacity_bytes", "legend": "{{node_id}} capacity"},
+        ], grid={"x": 0, "y": 4 + 4 * H, "w": W, "h": H}, unit="bytes"),
+        _panel(51, "HBM used / limit by node", [
+            {"expr": "ray_tpu_hbm_used_bytes", "legend": "{{node_id}} used"},
+            {"expr": "ray_tpu_hbm_peak_bytes", "legend": "{{node_id}} peak"},
+            {"expr": "ray_tpu_hbm_limit_bytes", "legend": "{{node_id}} limit"},
+        ], grid={"x": W, "y": 4 + 4 * H, "w": W, "h": H}, unit="bytes"),
+        _panel(52, "Worker RSS / spill rate by node", [
+            {"expr": "ray_tpu_worker_rss_bytes", "legend": "{{node_id}} rss"},
+            {"expr": "rate(ray_tpu_spill_bytes_total[5m])",
+             "legend": "{{node_id}} spill Bps"},
+        ], grid={"x": 2 * W, "y": 4 + 4 * H, "w": W, "h": H}, unit="bytes"),
     ]
     return {
         "title": title,

@@ -1,4 +1,4 @@
-"""Observability: end-to-end distributed tracing.
+"""Observability: end-to-end distributed tracing + cluster memory.
 
 Dapper-style span propagation (Sigelman et al., 2010) over this
 framework's task-event architecture: a ``TraceContext`` (trace id +
@@ -27,10 +27,24 @@ from .tracing import (
     use_context,
 )
 from .spans import GcsSpanStore, format_trace_tree, spans_to_chrome
+from .memory import (
+    GcsMemoryStore,
+    capture_callsite,
+    classify_ref,
+    format_memory_summary,
+    hbm_stats,
+    process_rss_bytes,
+)
 
 __all__ = [
     "TraceContext",
     "GcsSpanStore",
+    "GcsMemoryStore",
+    "capture_callsite",
+    "classify_ref",
+    "format_memory_summary",
+    "hbm_stats",
+    "process_rss_bytes",
     "bind",
     "context_from_headers",
     "current",

@@ -81,8 +81,107 @@ def list_workers() -> list[dict]:
 
 
 def list_objects(limit: int = 1000) -> list[dict]:
-    """Objects in each node's plasma store (store-level view)."""
-    return _fanout_raylets("ListObjects", {"limit": limit}, "objects")
+    """Objects in each node's plasma store, enriched with the owner-side
+    reference view (ref type + creation callsite + age from the workers'
+    memory summaries). Warns — never silently truncates — when any node's
+    listing hit ``limit``."""
+    import asyncio
+    import warnings
+
+    from ..core.rpc import RpcClient
+
+    nodes = [n for n in list_nodes() if n["state"] == "ALIVE"]
+    worker = global_worker()
+
+    async def _one(node):
+        client = RpcClient(node["address"])
+        try:
+            reply = await client.call("ListObjects", {"limit": limit}, timeout=10.0)
+            for r in reply.get("objects", []):
+                r["node_id"] = node["node_id"]
+            return reply
+        except Exception:
+            return {"objects": []}
+        finally:
+            await client.close()
+
+    async def _all():
+        return await asyncio.gather(*(_one(n) for n in nodes))
+
+    replies = worker.io.run_sync(_all())
+    rows = [row for reply in replies for row in reply.get("objects", [])]
+    truncated = [r for r in replies if r.get("truncated")]
+    if truncated:
+        warnings.warn(
+            f"list_objects(limit={limit}) truncated: "
+            f"{sum(r.get('total', 0) for r in truncated)} objects exist on "
+            f"{len(truncated)} node(s); raise limit for the full view",
+            stacklevel=2)
+    # Merge in the reference-debugging fields reported by owners.
+    by_oid: dict[str, dict] = {}
+    try:
+        for w in memory_summary().get("workers", []):
+            for e in w.get("entries", []):
+                by_oid.setdefault(e.get("object_id", ""), e)
+    except Exception:
+        pass
+    for row in rows:
+        ref = by_oid.get(row.get("object_id", ""))
+        if ref:
+            row.setdefault("size", ref.get("size", 0))
+            row["ref_type"] = ref.get("ref_type", "")
+            row["callsite"] = ref.get("callsite", "")
+            row["age_s"] = round(ref.get("age_s", 0.0), 1)
+    return rows
+
+
+def memory_summary() -> dict:
+    """Cluster memory view (reference ``ray memory`` /
+    ``memory_summary()``): per-worker reference tables with object sizes,
+    ref types (LOCAL_REFERENCE / USED_BY_PENDING_TASK / ...), creation
+    callsites, and ages, aggregated by the GCS from the workers' periodic
+    reports on the task-event flush path."""
+    return _gcs("MemorySummary")["summary"]
+
+
+def capture_profile(node_id: str | None = None, duration: float = 2.0,
+                    worker_id: str | None = None) -> dict:
+    """Trigger an on-demand ``jax.profiler`` trace capture on a worker of
+    ``node_id`` (prefix match; default: this node) and return the artifact
+    info (``{"path", "worker_id", "node_id", "duration"}`` or
+    ``{"error"}``). The artifact is also registered under
+    ``list_profiles()`` / dashboard ``/api/profiles``."""
+    import asyncio
+
+    from ..core.rpc import RpcClient
+
+    worker = global_worker()
+    nodes = [n for n in list_nodes() if n["state"] == "ALIVE"]
+    if node_id:
+        nodes = [n for n in nodes if n["node_id"].startswith(node_id)]
+        if not nodes:
+            return {"error": f"no alive node matching {node_id!r}"}
+    else:
+        nodes = [n for n in nodes if n["node_id"] == worker.node_id] or nodes
+    node = nodes[0]
+
+    async def _call():
+        client = RpcClient(node["address"])
+        try:
+            return await client.call(
+                "CaptureProfile",
+                {"duration": duration, "worker_id": worker_id or ""},
+                timeout=duration + 150.0)
+        finally:
+            await client.close()
+
+    return worker.io.run_sync(_call())
+
+
+def list_profiles() -> list[dict]:
+    """Profiler artifacts captured via ``capture_profile`` / ``cli
+    profile``, most recent last."""
+    return _gcs("ListProfiles")["profiles"]
 
 
 def summarize_tasks() -> dict:
