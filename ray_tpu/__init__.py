@@ -44,3 +44,13 @@ from .core.status import (
 )
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # Lazy subsystems: `ray_tpu.chaos.run_plan(...)` works right after
+    # `import ray_tpu` without paying the import on every startup.
+    if name == "chaos":
+        import importlib
+
+        return importlib.import_module(".chaos", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

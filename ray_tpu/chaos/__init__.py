@@ -1,0 +1,56 @@
+"""Deterministic chaos subsystem.
+
+Three pieces (see ``chaos/plan.py``, ``chaos/clock.py``,
+``chaos/verifier.py``):
+
+  * **FaultPlan** — a seeded, declarative schedule of faults (RPC
+    drop/delay/fail per method, node-pair partitions, GCS blackouts,
+    worker kill-on-Nth-lease, spill-disk write errors) compiled into a
+    byte-identical :class:`FaultSchedule` and installed as the process's
+    chaos engine (driving ``core.rpc.RpcChaos`` plus injection points in
+    the raylet, object store, and serve proxy).
+  * **VirtualClock** — cluster-wide virtual time for the timeout-driven
+    control loops, so wedge watchdogs / leak watchers / backoffs replay
+    deterministically and fast.
+  * **RecoveryVerifier** — asserts the cluster heals after every plan:
+    all tasks terminal, lease queues drained, refcounts at baseline, no
+    orphaned ErrorEvents.
+
+Entry points: :func:`run_plan` (also ``cli chaos run <plan.yaml> --seed
+N``), :func:`install`/:func:`uninstall` for manual control, and
+``BUILTIN_PLANS`` for the bundled scenarios.
+"""
+
+from .clock import Clock, VirtualClock, WallClock, get_clock, set_clock
+from .plan import (
+    BUILTIN_PLANS,
+    FaultPlan,
+    FaultPlanError,
+    FaultSchedule,
+    PlanChaos,
+    load_plan,
+)
+from .runner import active_plan, default_workload, install, run_plan, uninstall
+from .verifier import ChaosVerificationError, RecoveryVerifier, VerifyResult
+
+__all__ = [
+    "BUILTIN_PLANS",
+    "ChaosVerificationError",
+    "Clock",
+    "FaultPlan",
+    "FaultPlanError",
+    "FaultSchedule",
+    "PlanChaos",
+    "RecoveryVerifier",
+    "VerifyResult",
+    "VirtualClock",
+    "WallClock",
+    "active_plan",
+    "default_workload",
+    "get_clock",
+    "install",
+    "load_plan",
+    "run_plan",
+    "set_clock",
+    "uninstall",
+]

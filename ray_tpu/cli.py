@@ -33,6 +33,37 @@ def _print_table(rows: list[dict], columns: list[str]) -> None:
         print("  ".join(str(r.get(c, ""))[:48].ljust(widths[c]) for c in columns))
 
 
+def _cmd_chaos(args) -> int:
+    from ray_tpu import chaos as chaos_mod
+
+    if args.chaos_cmd == "plans":
+        rows = [{"name": name, "description": p.get("description", "")}
+                for name, p in chaos_mod.BUILTIN_PLANS.items()]
+        if args.as_json:
+            print(json.dumps(rows, indent=2))
+        else:
+            _print_table(rows, ["name", "description"])
+        return 0
+    # chaos run
+    plan = chaos_mod.load_plan(args.plan)
+    schedule = plan.compile(args.seed)
+    if args.dry_run:
+        # Canonical bytes: two runs with the same plan + seed must print
+        # identical output (the reproducibility contract).
+        sys.stdout.write(schedule.canonical_bytes().decode() + "\n")
+        return 0
+    _connect(args.address)
+    try:
+        report = chaos_mod.run_plan(
+            plan, seed=args.seed, verify=not args.no_verify,
+            verify_timeout_s=args.verify_timeout)
+    except chaos_mod.ChaosVerificationError as e:
+        print(f"RECOVERY VERIFICATION FAILED: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(report, indent=2, default=str))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
     parser.add_argument("--address", help="GCS address of a running cluster")
@@ -70,8 +101,28 @@ def main(argv: list[str] | None = None) -> int:
                         help="capture length in seconds")
     prof_p.add_argument("--list", action="store_true", dest="list_profiles",
                         help="list previously captured artifacts instead")
+    chaos_p = sub.add_parser(
+        "chaos", help="deterministic fault injection (seeded FaultPlans)")
+    chaos_sub = chaos_p.add_subparsers(dest="chaos_cmd", required=True)
+    crun = chaos_sub.add_parser(
+        "run", help="run a fault plan against the cluster, then verify "
+                    "recovery (tasks terminal, lease queues drained, "
+                    "refcounts at baseline)")
+    crun.add_argument("plan", help="plan YAML path or a bundled plan name "
+                                   "(see `chaos plans`)")
+    crun.add_argument("--seed", type=int, default=0,
+                      help="schedule seed — same plan+seed compiles to a "
+                           "byte-identical fault schedule")
+    crun.add_argument("--dry-run", action="store_true",
+                      help="print the compiled fault schedule (canonical "
+                           "JSON) without touching a cluster")
+    crun.add_argument("--no-verify", action="store_true")
+    crun.add_argument("--verify-timeout", type=float, default=60.0)
+    chaos_sub.add_parser("plans", help="list bundled fault plans")
 
     args = parser.parse_args(argv)
+    if args.cmd == "chaos":
+        return _cmd_chaos(args)
     _connect(args.address)
     import ray_tpu
     from ray_tpu.util import state as st
@@ -136,6 +187,11 @@ def main(argv: list[str] | None = None) -> int:
         print("GCS: nodes=%s actors=%s placement_groups=%s errors_buffered=%s" % (
             gcs.get("nodes_by_state", {}), gcs.get("actors_by_state", {}),
             gcs.get("placement_groups_by_state", {}), gcs.get("errors_buffered", 0)))
+        plan = diag.get("active_fault_plan")
+        if plan:
+            print("ACTIVE FAULT PLAN: %s (seed=%s, digest=%s) — failures "
+                  "below may be chaos-injected" % (
+                      plan.get("name"), plan.get("seed"), plan.get("digest")))
         rows = []
         for snap in diag["nodes"]:
             queue = snap.get("lease_queue") or []
@@ -148,11 +204,13 @@ def main(argv: list[str] | None = None) -> int:
                 "idle": snap.get("idle_workers", "?"),
                 "store_used": store.get("used", "?"),
                 "wedges": snap.get("wedge_events_total", 0),
+                "orphans": snap.get("orphan_leases_total", 0),
                 "oom_kills": snap.get("oom_kills_total", 0),
             })
         print("per-node lease queues / worker pools:")
         _print_table(rows, ["node_id", "lease_queue", "oldest_wait_s", "workers",
-                            "idle", "store_used", "wedges", "oom_kills"])
+                            "idle", "store_used", "wedges", "orphans",
+                            "oom_kills"])
         errors = diag["errors"]
         print(f"recent errors ({len(errors)}):")
         for e in errors:

@@ -118,9 +118,30 @@ class RayTpuConfig:
     rpc_retry_base_delay_ms: int = 100
     rpc_retry_max_delay_ms: int = 5000
     rpc_max_retries: int = 5
+    # Full-jitter exponential backoff (AWS style: sleep ~ U(0, base*2^n)).
+    # Bare doubling synchronizes retry storms when many clients fail at
+    # once (mass failure under chaos / a GCS blackout); jitter decorrelates
+    # them. Off = the legacy deterministic delay*2 schedule.
+    rpc_retry_jitter: bool = True
     # Fault-injection spec, format "Service.Method=req_prob,resp_prob"
     # (reference ``rpc_chaos.cc:34``, env RAY_testing_rpc_failure).
+    # Extended clauses (chaos subsystem): "Method=nth:3,delay:50" fails
+    # every 3rd call deterministically and delays the rest by 50 ms.
     testing_rpc_failure: str = ""
+    # Seed for the probabilistic chaos modes (env-spec and FaultPlans).
+    testing_rpc_failure_seed: int = 0xC0FFEE
+
+    # --- chaos ---------------------------------------------------------------
+    # Process clock for timeout-driven control loops (chaos/clock.py):
+    # "" | "wall" | "virtual" | "virtual:RATE". Workers inherit the env
+    # override, so RAY_TPU_chaos_clock=virtual:50 puts the whole cluster
+    # on accelerated virtual time.
+    chaos_clock: str = ""
+    # Reclaim a granted-but-never-acknowledged worker lease after this
+    # long (the owner acks right after the grant reply arrives; a grant
+    # whose reply was lost strands the reservation forever otherwise —
+    # the ROADMAP-1c lease-timeout cascade). 0 disables reclaim.
+    lease_orphan_timeout_s: float = 10.0
 
     # --- GCS -----------------------------------------------------------------
     gcs_pubsub_poll_timeout_s: float = 30.0

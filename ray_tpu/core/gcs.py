@@ -28,6 +28,7 @@ from typing import Any
 from .config import get_config
 from .ids import ActorID, NodeID
 from .rpc import RetryableRpcClient, RpcClient, RpcServer, spawn
+from ..chaos import clock as chaos_clock
 
 logger = logging.getLogger(__name__)
 
@@ -85,7 +86,7 @@ class Publisher:
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0, storage=None,
                  session_dir: str | None = None):
-        self._server = RpcServer(host, port)
+        self._server = RpcServer(host, port, tag="gcs")
         self._server.register_service(self)
         self.publisher = Publisher()
         self._session_dir = session_dir
@@ -347,7 +348,7 @@ class GcsServer:
         period = cfg.health_check_period_ms / 1000.0
         failures: dict[str, int] = {}
         while True:
-            await asyncio.sleep(period)
+            await chaos_clock.sleep(period)
             for node_id, node in list(self._nodes.items()):
                 if node["state"] != "ALIVE":
                     continue
@@ -475,7 +476,7 @@ class GcsServer:
 
         while True:
             cfg = get_config()
-            await asyncio.sleep(max(0.1, cfg.memory_leak_check_interval_s))
+            await chaos_clock.sleep(max(0.1, cfg.memory_leak_check_interval_s))
             if cfg.memory_leak_intervals <= 0:
                 continue
             try:
@@ -773,8 +774,23 @@ class GcsServer:
         spec = record["spec"]
         resources = spec.get("resources") or {"CPU": 1.0}
         strategy = spec.get("scheduling_strategy") or {}
+
+        def _stamp_creation(status: str, worker_id: str = "",
+                            node_id: str = "") -> None:
+            # Submitter-side terminal status for the creation task: the
+            # executor records one too, but its buffer dies unflushed if
+            # the worker is killed right after (or during) creation —
+            # every settled creation must look settled in list_tasks().
+            self.task_events.add_events([{
+                "task_id": spec["task_id"], "status": status,
+                "ts": time.time(), "name": spec.get("name", ""),
+                "kind": spec.get("kind", 1),
+                "worker_id": worker_id, "node_id": node_id,
+            }])
+
         for attempt in range(60):
             if record["state"] == DEAD:  # killed while pending
+                _stamp_creation("FAILED")
                 return
             pg_id = spec.get("placement_group_id") or b""
             if pg_id:
@@ -819,6 +835,13 @@ class GcsServer:
                 continue
             worker_addr = lease["worker_address"]
             worker_id = lease.get("worker_id", "")
+            try:
+                # Confirm the grant reply arrived (AckLease): un-acked
+                # leases are reclaimed by the raylet's orphan watchdog.
+                await client.call("AckLease", {"worker_id": worker_id},
+                                  timeout=10.0)
+            except Exception:
+                pass
 
             async def _return_lease(kill: bool) -> None:
                 try:
@@ -834,6 +857,8 @@ class GcsServer:
                 )
                 await worker.close()
                 logger.info("Actor %s: creation reply %s", record["actor_id"][:8], "err" if reply.get("error") else "ok")
+                _stamp_creation("FAILED" if reply.get("error") else "FINISHED",
+                                worker_id, node_id)
                 if reply.get("error"):
                     await _return_lease(kill=True)
                     record["state"] = DEAD
@@ -849,7 +874,7 @@ class GcsServer:
                 continue
             if record["state"] == DEAD:  # ray.kill raced with creation
                 await _return_lease(kill=True)
-                return
+                return  # (terminal status already stamped above)
             record["state"] = ALIVE
             record["address"] = worker_addr
             record["node_id"] = node_id
@@ -858,6 +883,7 @@ class GcsServer:
             return
         record["state"] = DEAD
         record["death_cause"] = record.get("death_cause") or "no node could schedule the actor"
+        _stamp_creation("FAILED")
         await self._publish_actor(record)
 
     def _select_node(self, resources: dict, strategy: dict | None = None) -> str | None:

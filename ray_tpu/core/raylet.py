@@ -34,7 +34,8 @@ from dataclasses import dataclass, field
 from .config import get_config
 from .ids import NodeID, WorkerID
 from .resources import NodeResources, ResourceSet
-from .rpc import RetryableRpcClient, RpcClient, RpcServer, spawn
+from .rpc import RetryableRpcClient, RpcClient, RpcServer, get_chaos, spawn
+from ..chaos import clock as chaos_clock
 from ..native.store import ShmStore, StoreFullError
 
 logger = logging.getLogger(__name__)
@@ -123,6 +124,16 @@ class WorkerHandle:
     # (reference worker_killing_policy.cc retriable-LIFO).
     lease_time: float = 0.0
     retriable: bool = False
+    # Lease-grant acknowledgement: the owner acks right after it receives
+    # the grant reply. A lease still un-acked past lease_orphan_timeout_s
+    # means the reply was lost (the owner will retry elsewhere) and the
+    # reservation would strand forever — the watchdog reclaims it.
+    # Granted-at runs on the chaos clock so virtual time replays it.
+    lease_acked: bool = True
+    lease_granted_at: float = 0.0
+    # pushes_total sampled at the watchdog's first orphan probe (a second
+    # unchanged sample confirms the owner really never used the lease).
+    orphan_probe: int | None = None
 
 
 class Raylet:
@@ -139,7 +150,7 @@ class Raylet:
     ):
         self.node_id = NodeID.from_random()
         self.gcs_address = gcs_address
-        self._server = RpcServer(host, port)
+        self._server = RpcServer(host, port, tag="raylet")
         self._server.register_service(self)
         self._gcs = RetryableRpcClient(gcs_address)
 
@@ -239,6 +250,7 @@ class Raylet:
         # Diagnostics counters (debug_state + the lease-wedge watchdog).
         self._wedge_events_total = 0
         self._oom_kills_total = 0
+        self._orphan_leases_total = 0
         self._started_at = time.monotonic()
         # Lease-stage task events + spans (LEASED at grant, queue-wait and
         # spawn timings), flushed to the GCS on the worker flush cadence.
@@ -941,7 +953,9 @@ class Raylet:
             "seq": self._admission_seq,
             "request": request,
             "fut": asyncio.get_running_loop().create_future(),
-            "enqueued_at": time.monotonic(),  # lease-wedge watchdog input
+            # Lease-wedge watchdog input — on the chaos clock so virtual
+            # time replays the wedge thresholds deterministically.
+            "enqueued_at": chaos_clock.now(),
         }
         # Insert in (priority, seq) order: earlier same-priority requests
         # stay ahead; higher-priority (lower number) requests go first.
@@ -1136,11 +1150,15 @@ class Raylet:
         worker.state = "dedicated" if p.get("dedicated") else "leased"
         worker.lease_time = time.monotonic()
         worker.retriable = bool(spec.get("max_retries", 0)) and not p.get("dedicated")
+        worker.lease_acked = False
+        worker.lease_granted_at = chaos_clock.now()
+        worker.orphan_probe = None
         if p.get("dedicated"):
             actor_id = spec.get("actor_id", b"")
             worker.actor_id = actor_id.hex() if isinstance(actor_id, bytes) else actor_id
         self._record_lease_grant(spec, t_arrive, queue_wait_ms,
                                  (time.monotonic() - t_spawn) * 1000.0)
+        self._maybe_chaos_kill_lease(worker)
         self._wake_lease_waiters()
         return {
             "granted": True,
@@ -1148,6 +1166,21 @@ class Raylet:
             "worker_address": worker.address,
             "node_id": self.node_id.hex(),
         }
+
+    def _maybe_chaos_kill_lease(self, worker: WorkerHandle) -> None:
+        """Chaos injection point: SIGKILL the worker of the lease just
+        granted (kill-on-Nth-lease FaultPlan rule) — the owner's task push
+        fails and the retry / actor-restart machinery takes over."""
+        if worker.proc is None:
+            return
+        if not get_chaos().take_kill_on_lease(self.node_id.hex()):
+            return
+        logger.warning("chaos: killing worker %s (pid %d) of the lease just "
+                       "granted", worker.worker_id[:12], worker.pid)
+        try:
+            worker.proc.kill()
+        except Exception:
+            pass
 
     async def _grant_in_bundle(self, p: dict, spec: dict, pg_hex: str, idx: int) -> dict:
         """Lease a worker whose resources are charged against a committed
@@ -1204,9 +1237,13 @@ class Raylet:
         worker.state = "dedicated" if p.get("dedicated") else "leased"
         worker.lease_time = time.monotonic()
         worker.retriable = bool(spec.get("max_retries", 0)) and not p.get("dedicated")
+        worker.lease_acked = False
+        worker.lease_granted_at = chaos_clock.now()
+        worker.orphan_probe = None
         if p.get("dedicated"):
             actor_id = spec.get("actor_id", b"")
             worker.actor_id = actor_id.hex() if isinstance(actor_id, bytes) else actor_id
+        self._maybe_chaos_kill_lease(worker)
         self._wake_lease_waiters()
         return {
             "granted": True,
@@ -1274,6 +1311,17 @@ class Raylet:
             if best is None or nr.utilization() < best[1]:
                 best = (node, nr.utilization())
         return best[0] if best else None
+
+    async def handle_AckLease(self, p: dict) -> dict:
+        """Owner (or the GCS, for dedicated leases) confirms it received
+        the grant reply. Un-acked leases past ``lease_orphan_timeout_s``
+        are reclaimed by the watchdog — a grant whose reply was lost in
+        transit otherwise strands its reservation forever (the ROADMAP-1c
+        lease-timeout cascade)."""
+        w = self._workers.get(p.get("worker_id", ""))
+        if w is not None:
+            w.lease_acked = True
+        return {}
 
     async def handle_ReturnWorker(self, p: dict) -> dict:
         w = self._workers.get(p["worker_id"])
@@ -1345,8 +1393,15 @@ class Raylet:
             if _in_loop():
                 spawn(self._write_spill_file(oid, blob))
             else:
-                self._write_file(self._spill_path(oid), blob)
-                self._spill_pending.pop(oid, None)
+                try:
+                    self._write_file(self._spill_path(oid), blob)
+                    self._spill_pending.pop(oid, None)
+                except OSError as e:
+                    # Disk write failed (full disk / chaos injection): the
+                    # blob stays in _spill_pending, so the object remains
+                    # restorable from memory — degraded, never lost.
+                    logger.warning("spill write of %s failed: %s "
+                                   "(kept in memory)", oid.hex()[:12], e)
             self._spilled_bytes_total += data_size + meta_size
             self._spilled_objects_total += 1
             meta = self._object_meta.get(oid)
@@ -1359,13 +1414,23 @@ class Raylet:
         return os.path.join(self._spill_dir, oid.hex())
 
     def _write_file(self, path: str, blob: bytes) -> None:
+        if get_chaos().maybe_fail_spill():
+            raise OSError("chaos-injected spill write failure")
         os.makedirs(self._spill_dir, exist_ok=True)
         with open(path, "wb") as f:
             f.write(blob)
 
     async def _write_spill_file(self, oid: bytes, blob: bytes) -> None:
         path = self._spill_path(oid)
-        await asyncio.get_running_loop().run_in_executor(None, self._write_file, path, blob)
+        try:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._write_file, path, blob)
+        except OSError as e:
+            # Failed disk write: keep the blob in _spill_pending — restore
+            # serves it from memory, and a later spill pass may re-spill it.
+            logger.warning("spill write of %s failed: %s (kept in memory)",
+                           oid.hex()[:12], e)
+            return
         # Identity check: a restore + re-spill while we were writing installs
         # a new pending blob (and its own write task) — leave those alone.
         if self._spill_pending.get(oid) is blob:
@@ -1470,7 +1535,7 @@ class Raylet:
             return
         period = cfg.memory_monitor_refresh_ms / 1000.0
         while True:
-            await asyncio.sleep(period)
+            await chaos_clock.sleep(period)
             try:
                 threshold = int(self.object_store_capacity * cfg.object_spilling_threshold)
                 if self.store.used() > threshold:
@@ -2101,12 +2166,13 @@ class Raylet:
         exist), worker-pool states, bundle ledger, store/spill/OOM
         counters (reference node_manager.cc DebugString)."""
         now = time.monotonic()
+        qnow = chaos_clock.now()
         lease_queue = [
             {
                 "shape": e["request"].to_dict(),
                 "priority": e["prio"],
                 "seq": e["seq"],
-                "age_s": round(now - e.get("enqueued_at", now), 3),
+                "age_s": round(qnow - e.get("enqueued_at", qnow), 3),
                 "granted": e["fut"].done(),
             }
             for e in self._admission_queue
@@ -2149,6 +2215,7 @@ class Raylet:
             "transfer_stats": dict(self.transfer_stats),
             "oom_kills_total": self._oom_kills_total,
             "wedge_events_total": self._wedge_events_total,
+            "orphan_leases_total": self._orphan_leases_total,
         }
 
     async def handle_GetDebugState(self, p: dict) -> dict:
@@ -2197,12 +2264,16 @@ class Raylet:
 
         while True:
             cfg = get_config()
-            await asyncio.sleep(max(0.1, cfg.lease_wedge_check_interval_s))
+            await chaos_clock.sleep(max(0.1, cfg.lease_wedge_check_interval_s))
+            try:
+                await self._scan_orphan_leases(cfg)
+            except Exception:
+                logger.exception("orphan-lease scan failed")
             threshold = cfg.lease_wedge_threshold_s
             if threshold <= 0 or not self._admission_queue:
                 continue
             try:
-                now = time.monotonic()
+                now = chaos_clock.now()
                 fired = False
                 for entry in list(self._admission_queue):
                     age = now - entry.get("enqueued_at", now)
@@ -2234,6 +2305,93 @@ class Raylet:
                 # The watchdog must outlive any one bad scan (e.g. the
                 # store closing mid-snapshot during teardown).
                 logger.exception("lease-wedge watchdog scan failed")
+
+    async def _scan_orphan_leases(self, cfg) -> None:
+        """Reclaim granted leases whose owner never acknowledged them.
+
+        The grant reply can be lost in transit (chaos, or a real network
+        fault): the owner times out and retries elsewhere while this
+        raylet keeps the reservation and the leased worker forever. That
+        strand was the root cause of the ROADMAP-1c mid-suite
+        lease-timeout cascade — each lost reply shrank the node's usable
+        CPU pool until every later lease timed out. Before reclaiming,
+        the worker itself is probed: a worker that is executing (or whose
+        push count moves between two probes) proves the owner DID receive
+        the grant — only its AckLease was lost — and the lease is kept.
+        """
+        timeout = cfg.lease_orphan_timeout_s
+        if timeout <= 0:
+            return
+        now = chaos_clock.now()
+        for w in list(self._workers.values()):
+            if w.state not in ("leased", "dedicated") or w.lease_acked:
+                continue
+            if not w.lease_granted_at or now - w.lease_granted_at < timeout:
+                continue
+            probe = None
+            if w.address:
+                try:
+                    client = RpcClient(w.address)
+                    probe = await client.call("LeaseProbe", {}, timeout=5.0)
+                    await client.close()
+                except Exception:
+                    probe = None  # unreachable/dead: reclaim below
+            if probe is not None:
+                if probe.get("executing"):
+                    w.lease_acked = True  # grant reached the owner after all
+                    continue
+                if w.orphan_probe is None:
+                    # First look: sample the push counter; confirm on the
+                    # next scan so a push in flight right now isn't raced.
+                    w.orphan_probe = probe.get("pushes_total", 0)
+                    continue
+                if probe.get("pushes_total", 0) != w.orphan_probe:
+                    w.lease_acked = True
+                    continue
+            self._reclaim_orphan_lease(w, now - w.lease_granted_at, cfg)
+
+    def _reclaim_orphan_lease(self, w: WorkerHandle, age: float, cfg) -> None:
+        from ..diagnostics.errors import make_event
+
+        self._orphan_leases_total += 1
+        logger.error(
+            "orphan-lease reclaim: worker %s lease un-acked for %.1fs (grant "
+            "reply lost?); releasing %s",
+            w.worker_id[:12], age, w.lease_resources.to_dict())
+        # Leases starving in the queue behind this strand ARE the wedge —
+        # report it with the queue snapshot before freeing the resources.
+        if self._admission_queue and cfg.lease_wedge_threshold_s > 0:
+            head = self._admission_queue[0]
+            head_age = chaos_clock.now() - head.get("enqueued_at", 0.0)
+            if (head_age >= cfg.lease_wedge_threshold_s
+                    and not head.get("wedge_reported")):
+                head["wedge_reported"] = True
+                self._wedge_events_total += 1
+                spawn(self._publish_error_event(make_event(
+                    "lease_wedge",
+                    f"lease {head['request'].to_dict()} pending "
+                    f"{head_age:.1f}s on node {self.node_id.hex()[:8]} "
+                    f"blocked behind an orphaned lease grant (worker "
+                    f"{w.worker_id[:12]}, queue depth "
+                    f"{len(self._admission_queue)})",
+                    source="raylet", node_id=self.node_id.hex(),
+                    extra={"debug_state": self._debug_state_snapshot()})))
+        spawn(self._publish_error_event(make_event(
+            "lease_orphan",
+            f"reclaimed un-acked lease on worker {w.worker_id[:12]} after "
+            f"{age:.1f}s — the grant reply likely never reached the owner",
+            source="raylet", node_id=self.node_id.hex(),
+            worker_id=w.worker_id, actor_id=w.actor_id)))
+        if self._release_lease(w):
+            self._on_worker_dead(w)  # TPU device fence: worker being killed
+        else:
+            w.state = "idle"
+            w.actor_id = ""
+            w.lease_acked = True
+            w.orphan_probe = None
+            w.last_idle_time = time.monotonic()
+            self._idle.append(w.worker_id)
+        self._wake_lease_waiters()
 
 
 def _hbm_snapshot() -> dict:

@@ -66,25 +66,165 @@ async def _read_frame(reader: asyncio.StreamReader) -> Any:
     return msgpack.unpackb(body, raw=False)
 
 
+_injections_counter = None
+
+
+def record_chaos_injection(kind: str, method: str) -> None:
+    """Count one injected fault in ``ray_tpu_chaos_injections_total``
+    (lazily created so chaos-free processes never start the metrics
+    flusher). Never raises: chaos accounting must not become a fault."""
+    global _injections_counter
+    try:
+        if _injections_counter is None:
+            from ..util.metrics import Counter
+
+            _injections_counter = Counter(
+                "ray_tpu_chaos_injections_total",
+                "Injected chaos faults by kind and RPC method",
+                tag_keys=("kind", "method"))
+        _injections_counter.inc(tags={"kind": kind, "method": method or ""})
+    except Exception:
+        pass
+
+
 class RpcChaos:
-    """Deterministic request/response failure injection (rpc_chaos.cc:34)."""
+    """Request/response fault injection (rpc_chaos.h:23-37), extended with
+    delay injection, a deterministic every-Nth mode, and seeded
+    probabilistic modes.
 
-    def __init__(self, spec: str = ""):
-        # spec: "Method=req_prob,resp_prob;Method2=..."
-        self._probs: dict[str, tuple[float, float]] = {}
-        for item in filter(None, spec.split(";")):
-            method, probs = item.split("=")
-            req, resp = probs.split(",")
-            self._probs[method] = (float(req), float(resp))
-        self._rng = random.Random(0xC0FFEE)
+    Spec grammar (``testing_rpc_failure`` config / env var), one rule per
+    ``;``-separated item::
 
-    def should_fail_request(self, method: str) -> bool:
-        p = self._probs.get(method)
-        return bool(p) and self._rng.random() < p[0]
+        Method=req_prob,resp_prob              # legacy positional form
+        Method=req_prob,resp_prob,delay_ms     # legacy + delay
+        Method=req:0.2,resp:0.1,client:0.3,nth:3,delay:50,max:10
 
-    def should_fail_response(self, method: str) -> bool:
-        p = self._probs.get(method)
-        return bool(p) and self._rng.random() < p[1]
+    ``nth`` makes matched injections deterministic (every Nth call of
+    that side, no RNG); ``max`` caps total injections for the rule;
+    ``delay`` (ms) is applied to every matched request. ``Method`` may be
+    ``*`` to match all methods. Subclasses (``chaos.plan.PlanChaos``)
+    override the decision hooks to drive pre-compiled fault schedules and
+    the non-RPC fault kinds (worker kills, spill errors, partitions).
+    """
+
+    def __init__(self, spec: str = "", seed: int | None = None):
+        self._rules = self._parse_spec(spec)
+        if seed is None:
+            seed = get_config().testing_rpc_failure_seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._calls: dict[tuple[str, str], int] = {}
+        # (kind, method) -> injections fired (mirrors the metric; used by
+        # `cli doctor` / the chaos report without a GCS round trip).
+        self.injections_total: dict[tuple[str, str], int] = {}
+
+    @staticmethod
+    def _parse_spec(spec: str) -> dict[str, dict]:
+        rules: dict[str, dict] = {}
+        for item in filter(None, (spec or "").split(";")):
+            method, _, clauses = item.partition("=")
+            rule = {"request": 0.0, "response": 0.0, "client": 0.0,
+                    "nth": 0, "delay_ms": 0.0, "max": 0, "injected": 0}
+            parts = [c.strip() for c in clauses.split(",") if c.strip()]
+            if parts and ":" not in parts[0]:
+                # Legacy positional: req_prob, resp_prob [, delay_ms]
+                rule["request"] = float(parts[0])
+                if len(parts) > 1:
+                    rule["response"] = float(parts[1])
+                if len(parts) > 2:
+                    rule["delay_ms"] = float(parts[2])
+            else:
+                for clause in parts:
+                    key, _, value = clause.partition(":")
+                    if key == "req":
+                        rule["request"] = float(value)
+                    elif key == "resp":
+                        rule["response"] = float(value)
+                    elif key == "client":
+                        rule["client"] = float(value)
+                    elif key == "nth":
+                        rule["nth"] = int(value)
+                    elif key in ("delay", "delay_ms"):
+                        rule["delay_ms"] = float(value)
+                    elif key in ("max", "count"):
+                        rule["max"] = int(value)
+                    else:
+                        raise ValueError(f"Unknown chaos clause {clause!r}")
+            rules[method.strip()] = rule
+        return rules
+
+    def _rule_for(self, method: str) -> dict | None:
+        return self._rules.get(method) or self._rules.get("*")
+
+    def _decide(self, method: str, where: str) -> bool:
+        rule = self._rule_for(method)
+        if rule is None:
+            return False
+        prob = rule[where]
+        sided = rule["request"] or rule["response"] or rule["client"]
+        with self._lock:
+            if rule["max"] and rule["injected"] >= rule["max"]:
+                return False
+            if rule["nth"]:
+                # Deterministic mode: fire on every Nth call of this side.
+                # With no side probabilities given, nth applies to requests.
+                if sided and not prob:
+                    return False
+                if not sided and where != "request":
+                    return False
+                key = (method, where)
+                n = self._calls.get(key, 0) + 1
+                self._calls[key] = n
+                hit = n % rule["nth"] == 0
+            else:
+                if not prob:
+                    return False
+                hit = self._rng.random() < prob
+            if hit:
+                rule["injected"] += 1
+        if hit:
+            self.record_injection(f"rpc_{where}_drop", method)
+        return hit
+
+    def record_injection(self, kind: str, method: str = "") -> None:
+        with self._lock:
+            key = (kind, method)
+            self.injections_total[key] = self.injections_total.get(key, 0) + 1
+        record_chaos_injection(kind, method)
+
+    # -- decision hooks (all consulted from hot paths: fast no-op when no
+    # matching rule exists) ------------------------------------------------
+    def should_fail_request(self, method: str, tag: str = "") -> bool:
+        return self._decide(method, "request")
+
+    def should_fail_response(self, method: str, tag: str = "") -> bool:
+        return self._decide(method, "response")
+
+    def should_drop_client_send(self, method: str) -> bool:
+        return self._decide(method, "client")
+
+    def request_delay_s(self, method: str, tag: str = "") -> float:
+        rule = self._rule_for(method)
+        if rule is None or not rule["delay_ms"]:
+            return 0.0
+        self.record_injection("rpc_delay", method)
+        return rule["delay_ms"] / 1000.0
+
+    def peer_blocked(self, address: str) -> bool:
+        """Node-pair partition / endpoint blackout probe (plan-driven)."""
+        return False
+
+    def take_kill_on_lease(self, node_id: str = "") -> bool:
+        """Raylet asks: kill the worker of the lease just granted?"""
+        return False
+
+    def maybe_fail_spill(self) -> bool:
+        """Raylet asks: fail this spill-file disk write?"""
+        return False
+
+    def maybe_fail_store_create(self) -> bool:
+        """Object store asks: fail this arena allocation (as store-full)?"""
+        return False
 
 
 _chaos: RpcChaos | None = None
@@ -109,9 +249,12 @@ Handler = Callable[[dict], Awaitable[dict]]
 class RpcServer:
     """Asyncio TCP server dispatching named methods (grpc_server.h equiv)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, tag: str = ""):
         self.host = host
         self.port = port
+        # Chaos tag naming the service this server fronts ("gcs",
+        # "raylet", ...) so plans can target a component, not a method.
+        self.tag = tag
         self._handlers: dict[str, Handler] = {}
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[asyncio.StreamWriter] = set()
@@ -173,8 +316,11 @@ class RpcServer:
     async def _dispatch(self, msg: dict, writer: asyncio.StreamWriter) -> None:
         method = msg.get("method", "")
         chaos = get_chaos()
-        if chaos.should_fail_request(method):
+        if chaos.should_fail_request(method, tag=self.tag):
             return  # drop request silently
+        delay = chaos.request_delay_s(method, tag=self.tag)
+        if delay > 0:
+            await asyncio.sleep(delay)
         handler = self._handlers.get(method)
         if handler is None:
             reply = {"id": msg["id"], "ok": False, "error": f"No such method: {method}"}
@@ -185,7 +331,7 @@ class RpcServer:
             except Exception as e:
                 logger.debug("RPC handler %s raised", method, exc_info=True)
                 reply = {"id": msg["id"], "ok": False, "error": f"{type(e).__name__}: {e}"}
-        if chaos.should_fail_response(method):
+        if chaos.should_fail_response(method, tag=self.tag):
             return  # drop response
         try:
             writer.write(_pack(reply))
@@ -252,6 +398,20 @@ class RpcClient:
                 fut.set_exception(error)
 
     async def call(self, method: str, payload: dict | None = None, timeout: float | None = None) -> dict:
+        chaos = get_chaos()
+        if chaos.peer_blocked(self.address):
+            # Partition / endpoint blackout: behaves exactly like an
+            # unreachable host, so retry & failover paths see the real
+            # failure mode (RetryableRpcClient retries these).
+            err = RpcError(f"Connection to {self.address} failed: "
+                           "chaos-injected partition")
+            err.undelivered = True
+            raise err
+        if chaos.should_drop_client_send(method):
+            err = RpcError(f"Connection to {self.address} failed: "
+                           f"chaos-injected client drop of {method}")
+            err.undelivered = True
+            raise err
         await self._ensure_connected()
         self._next_id += 1
         req_id = self._next_id
@@ -284,7 +444,9 @@ class RetryableRpcClient(RpcClient):
 
     async def call(self, method: str, payload: dict | None = None, timeout: float | None = None) -> dict:
         cfg = get_config()
-        delay = cfg.rpc_retry_base_delay_ms / 1000.0
+        base = cfg.rpc_retry_base_delay_ms / 1000.0
+        cap = cfg.rpc_retry_max_delay_ms / 1000.0
+        delay = base
         last: Exception | None = None
         for attempt in range(cfg.rpc_max_retries + 1):
             try:
@@ -300,8 +462,16 @@ class RetryableRpcClient(RpcClient):
                 last = e
                 if attempt == cfg.rpc_max_retries:
                     break
-                await asyncio.sleep(delay)
-                delay = min(delay * 2, cfg.rpc_retry_max_delay_ms / 1000.0)
+                if cfg.rpc_retry_jitter:
+                    # Full jitter: U(0, min(cap, base*2^attempt)). Bare
+                    # doubling synchronizes every client that failed at the
+                    # same instant into retry waves (mass failure under
+                    # chaos); sampling the whole window decorrelates them.
+                    await asyncio.sleep(random.uniform(
+                        0.0, min(cap, base * (2 ** attempt))))
+                else:
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, cap)
         raise RpcError(f"RPC {method} to {self.address} failed after retries: {last}")
 
 

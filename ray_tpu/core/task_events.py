@@ -139,6 +139,11 @@ class GcsTaskEventStore:
             self.num_dropped += dropped
             for ev in events:
                 tid = ev["task_id"]
+                if isinstance(tid, bytes):
+                    # Normalize at ingest: every reporter (worker buffer,
+                    # raylet, GCS-side stamps) must land on ONE record per
+                    # task, whatever id form it sends.
+                    tid = tid.hex()
                 status = ev["status"]
                 ts = ev["ts"]
                 rec = self._tasks.get(tid)
@@ -189,6 +194,7 @@ class GcsTaskEventStore:
                     "task_id": tid,
                     "name": rec["name"],
                     "state": _resolve_state(events),
+                    "kind": rec.get("kind", 0),
                     "worker_id": rec.get("worker_id", ""),
                     "node_id": rec.get("node_id", ""),
                     "error": rec.get("error", ""),

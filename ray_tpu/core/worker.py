@@ -280,6 +280,10 @@ class CoreWorker:
         # Executor-side state (worker mode).
         self.actor_instance: Any = None
         self.actor_id: bytes = b""
+        # Task pushes received over this worker's lifetime: the raylet's
+        # orphan-lease watchdog probes it (LeaseProbe) before reclaiming a
+        # lease whose AckLease never arrived.
+        self._pushes_total = 0
         # Per-caller sequencing (reference: per-handle sequence numbers,
         # actor_task_submitter.cc; callers are identified by owner address).
         self._actor_next_seq: dict[str, int] = {}
@@ -625,31 +629,48 @@ class CoreWorker:
 
             raise OwnerDiedError(ref.id(), f"owner {ref.owner_address} unreachable: {e}")
 
+    # Per-attempt PlasmaGetInfo wait: a lost object must surface as
+    # not-found well before the caller's deadline, or lineage
+    # reconstruction never gets time to run (the raylet used to long-poll
+    # the ENTIRE get() budget before admitting the object was gone).
+    _PLASMA_PROBE_S = 5.0
+
     def _get_from_plasma(self, ref: ObjectRef, deadline: float | None,
                          pull_class: str = "get"):
         oid = ref.id()
-        remaining = self._remaining(deadline)
-        reply = self._raylet_call(
-            "PlasmaGetInfo",
-            {
-                "id": oid.binary(),
-                "owner_address": ref.owner_address or self.address,
-                "timeout": 3600.0 if remaining is None else remaining,
-                # The raylet holds a store ref for us until we release, so the
-                # object can't be spilled/evicted while views are alive.
-                "pin_read": True,
-                "reader": self.worker_id,
-                # Pull admission class (raylet orders get > wait > task_arg).
-                "pull_class": pull_class,
-            },
-            timeout=None if remaining is None else remaining + 10.0,
-        )
-        if not reply.get("found"):
-            # Lost from every node: try lineage reconstruction
-            # (object_recovery_manager.h:90,106).
+        t0 = time.monotonic()  # no-deadline gets still give up after 1 h
+        while True:
+            remaining = self._remaining(deadline)
+            probe = (self._PLASMA_PROBE_S if remaining is None
+                     else max(0.0, min(remaining, self._PLASMA_PROBE_S)))
+            reply = self._raylet_call(
+                "PlasmaGetInfo",
+                {
+                    "id": oid.binary(),
+                    "owner_address": ref.owner_address or self.address,
+                    "timeout": probe,
+                    # The raylet holds a store ref for us until we release, so
+                    # the object can't be spilled/evicted while views are alive.
+                    "pin_read": True,
+                    "reader": self.worker_id,
+                    # Pull admission class (raylet orders get > wait > task_arg).
+                    "pull_class": pull_class,
+                },
+                timeout=probe + 10.0,
+            )
+            if reply.get("found"):
+                break
+            # Lost from every reachable node: try lineage reconstruction
+            # (object_recovery_manager.h:90,106), then keep probing — a
+            # copy may still appear (in-flight push, restarting holder)
+            # until the caller's deadline truly expires.
             if self._try_reconstruct(oid, deadline):
-                return self._get_from_plasma(ref, deadline, pull_class)
-            raise ObjectLostError(oid, "not found on any node and not reconstructable")
+                continue
+            remaining = self._remaining(deadline)
+            if (remaining is not None and remaining <= 0) or (
+                    remaining is None and time.monotonic() - t0 > 3600.0):
+                raise ObjectLostError(
+                    oid, "not found on any node and not reconstructable")
         data = self.shm.read(reply["offset"], reply["data_size"])
         meta = bytes(self.shm.read(reply["offset"] + reply["data_size"], reply["meta_size"]))
         # Zero-copy deserialization aliases the arena; release the read ref
@@ -1148,7 +1169,18 @@ class CoreWorker:
         until an overall deadline expires."""
         import asyncio
 
-        deadline = time.monotonic() + get_config().worker_register_timeout_s * 2
+        cfg = get_config()
+        deadline = time.monotonic() + cfg.worker_register_timeout_s * 2
+        # Lost-reply budget: a lease RPC that times out (dropped request
+        # or reply — chaos or a real transient) is retried with a fresh
+        # deadline window instead of failing every queued task; the
+        # stranded grant, if any, is reclaimed raylet-side as an un-acked
+        # orphan lease (ROADMAP 1c).
+        timeout_retries = 3
+        # Bounds waiting on a LOST reply; a slow-but-alive raylet keeps
+        # streaming toward this cap legitimately (worker cold start).
+        lease_rpc_timeout = (cfg.worker_register_timeout_s
+                             + min(10.0, cfg.worker_register_timeout_s))
         raylet = self.raylet
         self._last_lease_denial = ""  # never report a stale reason
         try:
@@ -1160,13 +1192,30 @@ class CoreWorker:
                             # `spilled` marks follow-up hops so policies that
                             # redirect (spread) don't ping-pong the lease
                             {"spec": spec.to_wire(), "spilled": _hop > 0},
-                            timeout=get_config().worker_register_timeout_s + 10.0,
+                            timeout=lease_rpc_timeout,
                         )
-                    except RpcError:
+                    except RpcError as e:
                         if raylet is self.raylet:
+                            if "timed out" in str(e) and timeout_retries > 0:
+                                timeout_retries -= 1
+                                deadline = max(
+                                    deadline,
+                                    time.monotonic()
+                                    + cfg.worker_register_timeout_s)
+                                break
                             return None  # our own raylet is gone
                         break  # spill target died: restart from local
                     if reply.get("granted"):
+                        try:
+                            # Confirm receipt of the grant: the raylet
+                            # reclaims leases that are never acked (the
+                            # reply may die on the wire — ROADMAP 1c).
+                            await raylet.call(
+                                "AckLease",
+                                {"worker_id": reply["worker_id"]},
+                                timeout=10.0)
+                        except RpcError:
+                            pass  # raylet reclaims; the lease still works
                         lease = reply["worker_address"], reply["worker_id"], raylet
                         raylet = self.raylet  # returned client kept by caller
                         return lease
@@ -1323,12 +1372,24 @@ class CoreWorker:
                     stream.finish(reply.get("streamed", 0))
             self.task_manager.complete(spec.task_id)
             self._release_submitted_refs(spec)
+            self._record_terminal(spec, reply)
             return
         for i, ret in enumerate(reply.get("returns", [])):
             rid = ObjectID.for_task_return(task_id, i + 1)
             self._store_return_item(rid, ret)
         self.task_manager.complete(spec.task_id)
         self._release_submitted_refs(spec)
+        self._record_terminal(spec, reply)
+
+    def _record_terminal(self, spec: TaskSpec, reply: dict) -> None:
+        """Owner-side terminal status: the executor records FINISHED too,
+        but its buffer dies unflushed when the worker is killed right
+        after executing (chaos kill-on-lease, OOM kill) — the owner has
+        the reply in hand, so the GCS must never show a settled task as
+        non-terminal."""
+        status = "FAILED" if reply.get("stream_error") else "FINISHED"
+        self.task_events.record(spec.task_id, spec.name, status,
+                                kind=spec.kind)
 
     def _fail_task(self, spec: TaskSpec, error: Exception) -> None:
         self._cancelled_tasks.discard(spec.task_id)
@@ -1907,9 +1968,21 @@ class CoreWorker:
                 ctypes.c_ulong(ident), ctypes.py_object(TaskCancelledError))
         return {"found": True}
 
+    async def handle_LeaseProbe(self, p: dict) -> dict:
+        """Raylet probe before an orphan-lease reclaim: is this worker
+        actually serving its lease (executing, hosting an actor, or still
+        receiving pushes)?"""
+        with self._exec_lock:
+            executing = bool(self._exec_threads)
+        return {
+            "executing": executing or self.actor_instance is not None,
+            "pushes_total": self._pushes_total,
+        }
+
     async def handle_PushTask(self, p: dict) -> dict:
         import asyncio
 
+        self._pushes_total += 1
         spec = TaskSpec.from_wire(p["spec"])
         logger.debug("PushTask recv: %s kind=%s seq=%s", spec.name, spec.kind, spec.seq_no)
         loop = asyncio.get_running_loop()
@@ -1924,6 +1997,7 @@ class CoreWorker:
         by per-hop RPC + thread-handoff overhead, not execution."""
         import asyncio
 
+        self._pushes_total += 1
         specs = [TaskSpec.from_wire(w) for w in p["specs"]]
         loop = asyncio.get_running_loop()
 
@@ -2021,6 +2095,11 @@ class CoreWorker:
                 self._actor_group_sems = {
                     g: threading.Semaphore(max(1, int(n)))
                     for g, n in (spec.concurrency_groups or {}).items()}
+                # Terminal status for the creation task: without this every
+                # successful actor creation stays RUNNING in list_tasks()
+                # forever (and trips any "all tasks settled" invariant).
+                self.task_events.record(spec.task_id, spec.name, "FINISHED",
+                                        kind=spec.kind)
                 return {"returns": []}
             if spec.kind == TASK_KIND_ACTOR_TASK:
                 if self.actor_instance is None:

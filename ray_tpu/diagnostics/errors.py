@@ -15,6 +15,18 @@ from typing import Any
 
 ERROR_INFO_CHANNEL = "error_info"
 
+# Chaos convention: every fault the chaos subsystem injects publishes an
+# ErrorEvent with ``source="chaos"`` and ``extra={"chaos": True, ...}``
+# so list_errors()/doctor/traces can separate injected pain from organic
+# failures (chaos/runner.py tags them; RecoveryVerifier relies on it).
+CHAOS_SOURCE = "chaos"
+
+
+def is_chaos_event(event: dict) -> bool:
+    """True when the event was published by an injected fault."""
+    return bool(event.get("source") == CHAOS_SOURCE
+                or (event.get("extra") or {}).get("chaos"))
+
 
 @dataclass
 class ErrorEvent:

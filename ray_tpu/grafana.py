@@ -153,6 +153,18 @@ def generate_dashboard(title: str = "ray_tpu cluster") -> dict:
                      "(rate(ray_tpu_lease_stage_ms_bucket[5m])))",
              "legend": "{{stage}}"},
         ], grid={"x": 2 * W, "y": 4 + 3 * H, "w": W, "h": H}, unit="ms"),
+        # Chaos injections live NEXT TO the lease-stage / leak panels: a
+        # spike here explains spikes there (injected pain vs real pain).
+        _panel(43, "Chaos injections by kind", [
+            {"expr": "sum by (kind) "
+                     "(rate(ray_tpu_chaos_injections_total[5m]))",
+             "legend": "{{kind}}"},
+        ], grid={"x": 0, "y": 4 + 5 * H, "w": W, "h": H}, unit="ops"),
+        _panel(44, "Chaos injections by RPC method", [
+            {"expr": "sum by (method) "
+                     "(rate(ray_tpu_chaos_injections_total[5m]))",
+             "legend": "{{method}}"},
+        ], grid={"x": W, "y": 4 + 5 * H, "w": W, "h": H}, unit="ops"),
         # Row 6: memory observability (memory PR): per-node object-store
         # usage vs capacity/pinned, HBM used vs limit, worker RSS, and the
         # spill-rate-by-node view that pairs with the leak watcher.

@@ -100,6 +100,14 @@ class ShmStore:
 
     def create(self, object_id: bytes, data_size: int, meta_size: int = 0) -> int:
         """Allocate space; returns byte offset into the arena."""
+        from ..core.rpc import get_chaos
+
+        if get_chaos().maybe_fail_store_create():
+            # Chaos injection point (store_full FaultPlan rule): surface
+            # as the real allocation failure so callers exercise their
+            # spill / fallback-allocation paths.
+            raise StoreFullError(
+                f"chaos-injected store-full creating {object_id.hex()}")
         offset = ctypes.c_uint64()
         with self._lock:
             rc = self._lib.store_create_object(

@@ -21,6 +21,7 @@ from typing import Any
 import cloudpickle
 
 from ..core import api as ray
+from ..chaos import clock as chaos_clock
 from .long_poll import LongPollHost
 
 logger = logging.getLogger(__name__)
@@ -295,7 +296,9 @@ class ServeController:
                         state.consecutive_start_failures += 1
                         state.last_start_failure = cause
                         delay = min(30.0, 0.5 * 2 ** min(state.consecutive_start_failures, 6))
-                        state.next_start_allowed = time.time() + delay
+                        # Chaos clock: restart backoff replays deterministically
+                        # under time=virtual (chaos/clock.py).
+                        state.next_start_allowed = chaos_clock.now() + delay
                         logger.warning(
                             "replica %s failed to start; replacing in %.1fs "
                             "(%d consecutive failures): %s",
@@ -320,7 +323,8 @@ class ServeController:
                     to_kill.append(r)
                     dirty = True
                 elif r.state == STOPPING and (
-                    p.get("queue", 0) == 0 or time.time() - r.draining_since > 15.0
+                    p.get("queue", 0) == 0
+                    or chaos_clock.now() - r.draining_since > 15.0
                 ):
                     state.replicas.remove(r)
                     to_kill.append(r)
@@ -352,7 +356,7 @@ class ServeController:
                 ray.kill(r.actor)
             except Exception:
                 pass
-        if n_to_start and time.time() < state.next_start_allowed:
+        if n_to_start and chaos_clock.now() < state.next_start_allowed:
             n_to_start = 0  # crash-loop backoff window
         for _ in range(n_to_start):
             self._start_replica(state)
@@ -399,7 +403,7 @@ class ServeController:
         requests complete (graceful_shutdown_wait_loop in the reference)."""
         if r.state != STOPPING:
             r.state = STOPPING
-            r.draining_since = time.time()
+            r.draining_since = chaos_clock.now()
 
     # ----------------------------------------------------------- autoscaling
     def _autoscale_from_probes(self, state: _DeploymentState, probes: dict) -> None:

@@ -149,6 +149,26 @@ class ProxyActor:
             self._write_full(writer, "200 OK", b'"ok"')
             await writer.drain()
             return True
+        # Chaos injection point: ingress drops/delays (http_ingress
+        # FaultPlan rules, or "http.ingress=..." in the env spec) — lets
+        # fault tests exercise client retry behavior at the front door.
+        from ..core.rpc import get_chaos
+
+        chaos = get_chaos()
+        drop, delay = False, 0.0
+        if hasattr(chaos, "http_ingress_fault"):
+            drop, delay = chaos.http_ingress_fault()
+        else:
+            drop = chaos.should_fail_request("http.ingress", tag="serve")
+            delay = chaos.request_delay_s("http.ingress", tag="serve")
+        if delay > 0:
+            await asyncio.sleep(delay)
+        if drop:
+            self._write_full(
+                writer, "503 Service Unavailable",
+                json.dumps({"error": "chaos-injected ingress fault"}).encode())
+            await writer.drain()
+            return True
         route = next((r for r in self._routes if request.path.startswith(r["prefix"])), None)
         if route is None:
             self._write_full(writer, "404 Not Found",

@@ -185,6 +185,15 @@ class Router:
                 self._inflight[replica_id] = max(0, self._inflight[replica_id] - 1)
             self._cond.notify_all()
 
+    def remove_replica(self, replica_id: str) -> None:
+        """Drop a replica observed dead from the local view immediately —
+        the controller's long-poll update confirms it later, but a retry
+        assigned in the meantime must not land on the same corpse."""
+        with self._cond:
+            self._replicas.pop(replica_id, None)
+            self._inflight.pop(replica_id, None)
+            self._cond.notify_all()
+
     def shutdown(self) -> None:
         self._long_poll.stop()
 
@@ -192,10 +201,14 @@ class Router:
 class DeploymentResponse:
     """Future-like result of handle.remote() (reference DeploymentResponse)."""
 
-    def __init__(self, ref, on_done, on_error=None):
+    def __init__(self, ref, on_done, on_error=None, retry=None):
         self._ref = ref
         self._on_done = on_done
         self._on_error = on_error
+        # Optional resubmit hook: result() invokes it when the replica
+        # died mid-request (ActorDiedError) — the request is re-routed to
+        # a live replica instead of surfacing the infrastructure failure.
+        self._retry = retry
         self._settle_lock = threading.Lock()
         self._settled = False
         worker = global_worker()
@@ -237,7 +250,18 @@ class DeploymentResponse:
             pass
 
     def result(self, timeout: float | None = 60.0):
-        value = ray.get(self._ref, timeout=timeout)
+        from ..core.status import ActorDiedError
+
+        try:
+            value = ray.get(self._ref, timeout=timeout)
+        except ActorDiedError:
+            self._settle()
+            if self._retry is not None:
+                # Replica died under the request: re-route once to a live
+                # replica (the dead one is already dropped from the local
+                # router view by the retry hook).
+                return self._retry().result(timeout)
+            raise
         self._settle()
         return value
 
@@ -353,7 +377,8 @@ class DeploymentHandle:
             raise AttributeError(item)
         return self.options(method_name=item)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, _replica_death_retries: int = 1,
+               **kwargs) -> DeploymentResponse:
         import time as _time
 
         from .multiplex import MULTIPLEXED_KWARG
@@ -379,10 +404,19 @@ class DeploymentHandle:
                 1000 * (_time.monotonic() - t0),
                 tags={"deployment": self.deployment_name})
 
+        def _retry():
+            # The assigned replica died mid-request: purge it from the
+            # local view and re-route (the controller replaces it async).
+            router.remove_replica(replica_id)
+            return self.remote(
+                *args, _replica_death_retries=_replica_death_retries - 1,
+                **kwargs)
+
         return DeploymentResponse(
             ref, on_done=_done,
             on_error=lambda: metrics["errors"].inc(
-                tags={"deployment": self.deployment_name}))
+                tags={"deployment": self.deployment_name}),
+            retry=_retry if _replica_death_retries > 0 else None)
 
     def remote_streaming(self, *args, **kwargs) -> DeploymentStreamingResponse:
         """Invoke through the replica's streaming path: results arrive

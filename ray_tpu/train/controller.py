@@ -50,7 +50,13 @@ class ElasticScalingPolicy:
         self._check_interval = check_interval_s
         # Injectable clock so the debounce is testable without wall-time
         # sleeps (load-sensitive timing was a full-suite flake source).
-        self._clock = clock or time.monotonic
+        # Default: the chaos clock (wall time unless a VirtualClock is
+        # installed — chaos/clock.py), generalizing the PR-1 fake clock.
+        if clock is None:
+            from ..chaos import clock as chaos_clock
+
+            clock = chaos_clock.now
+        self._clock = clock
         self._next_check = 0.0
         self._pending_target: int | None = None
 

@@ -231,8 +231,13 @@ def cluster_diagnostics(error_limit: int = 50) -> dict:
     async def _all():
         return await asyncio.gather(*(_one(n) for n in nodes))
 
+    from ..chaos.runner import active_plan
+
     return {
         "gcs": _gcs("GetDebugState").get("debug_state", {}),
         "nodes": list(worker.io.run_sync(_all())),
         "errors": list_errors(limit=error_limit),
+        # Registered FaultPlan, if chaos is running — operators must be
+        # able to tell injected pain from real pain.
+        "active_fault_plan": active_plan(),
     }

@@ -25,6 +25,15 @@ if os.environ.get("RAY_TPU_TEST_ON_TPU") != "1":
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 run")
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection tests (fast cases run tier-1; "
+        "randomized seed sweeps are additionally marked slow)")
+
+
 def pytest_sessionstart(session):
     # shm segments leaked by previously killed runs exhaust /dev/shm and
     # poison every store allocation in this run — clear them up front
