@@ -1,0 +1,150 @@
+"""Bench-regression guard: diff two recorded benchmark results.
+
+``python -m ray_tpu.bench_check BENCH_r05.json BENCH_r06.json`` compares
+every shared numeric metric and exits non-zero when any regresses by
+more than the threshold (default 10%) — so a silent drop like the
+round-5 ``flash_fwdbwd_tflops_s4096`` 26.16 → 22.99 slide, or a metric
+silently VANISHING (round 5's ``serve_p50_ttft_ms``, lost to a replica
+startup failure), gets flagged at PR time instead of two rounds later.
+
+Accepts either a bare metrics object (what ``bench.py`` prints) or the
+driver's ``BENCH_rNN.json`` wrapper (metrics under ``"parsed"``).
+
+Direction is inferred from the metric name: ``*_ms`` / ``*_pct`` /
+latency-like metrics regress UP, throughput-like metrics regress DOWN;
+bookkeeping fields (counts, config echoes, error strings) are skipped.
+``bench.py`` runs this automatically against the most recent
+``BENCH_r*.json`` in the working directory (report-only — the bench
+still records its numbers; CI decides what to do with the exit code).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+# Metrics that describe the run, not its performance.
+_SKIP_EXACT = {
+    "n", "rc", "vs_baseline", "loss", "serve_requests", "serve_concurrency",
+    "serve_decode_steps_per_dispatch",
+}
+_SKIP_SUBSTR = ("error", "preset", "metric", "unit", "cmd", "tail")
+# Lower is better. Peak-memory gauges count as regressions when they
+# GROW >threshold (a quiet 2x pool blowup is exactly what they exist
+# to catch).
+_LOWER_BETTER_SUFFIX = ("_ms", "_pct", "_bytes", "_s")
+_LOWER_BETTER_SUBSTR = ("latency", "ttft", "overhead")
+
+
+def load_metrics(path: str) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict) and isinstance(data.get("parsed"), dict):
+        data = data["parsed"]
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: expected a JSON object of metrics")
+    return data
+
+
+def _direction(name: str) -> str:
+    """'up' = larger is better, 'down' = smaller is better."""
+    if name.endswith(_LOWER_BETTER_SUFFIX) or any(
+            s in name for s in _LOWER_BETTER_SUBSTR):
+        return "down"
+    return "up"
+
+
+def _tracked(name: str, value) -> bool:
+    if name in _SKIP_EXACT or any(s in name for s in _SKIP_SUBSTR):
+        return False
+    return isinstance(value, (int, float)) and not isinstance(value, bool)
+
+
+def compare(old: dict, new: dict, threshold: float = 0.10) -> dict:
+    """Returns {"regressions": [...], "improvements": [...],
+    "missing": [...], "ok": [...]} — each row a dict with metric, old,
+    new, change (signed fraction, + = better)."""
+    out = {"regressions": [], "improvements": [], "missing": [], "ok": []}
+    for name, ov in sorted(old.items()):
+        if not _tracked(name, ov):
+            continue
+        nv = new.get(name)
+        if not isinstance(nv, (int, float)) or isinstance(nv, bool):
+            # was measured, now gone: exactly the silent failure mode
+            # this guard exists for
+            out["missing"].append({"metric": name, "old": ov, "new": None})
+            continue
+        if ov == 0:
+            continue
+        if name.endswith("_pct") and abs(nv - ov) < 1.0:
+            # percentages compare in POINTS: -0.14% -> -0.05% framework
+            # overhead is noise, not a 64% regression
+            out["ok"].append({"metric": name, "old": ov, "new": nv,
+                              "change": 0.0})
+            continue
+        delta = (nv - ov) / abs(ov)
+        better = delta if _direction(name) == "up" else -delta
+        row = {"metric": name, "old": ov, "new": nv,
+               "change": round(better, 4)}
+        if better < -threshold:
+            out["regressions"].append(row)
+        elif better > threshold:
+            out["improvements"].append(row)
+        else:
+            out["ok"].append(row)
+    return out
+
+
+def format_report(result: dict, old_path: str = "old", new_path: str = "new",
+                  threshold: float = 0.10) -> str:
+    lines = [f"bench_check: {old_path} -> {new_path} "
+             f"(threshold {threshold:.0%})"]
+    for row in result["regressions"]:
+        lines.append(f"  REGRESSION  {row['metric']}: {row['old']} -> "
+                     f"{row['new']} ({row['change']:+.1%})")
+    for row in result["missing"]:
+        lines.append(f"  MISSING     {row['metric']}: {row['old']} -> "
+                     "absent in new run")
+    for row in result["improvements"]:
+        lines.append(f"  improved    {row['metric']}: {row['old']} -> "
+                     f"{row['new']} ({row['change']:+.1%})")
+    n_ok = len(result["ok"])
+    lines.append(f"  {n_ok} metric(s) within threshold; "
+                 f"{len(result['regressions'])} regression(s), "
+                 f"{len(result['missing'])} missing")
+    return "\n".join(lines)
+
+
+def latest_bench_json(directory: str = ".") -> str | None:
+    """Most recent driver-recorded BENCH_r*.json, for bench.py's
+    self-check after a run."""
+    paths = sorted(glob.glob(os.path.join(directory, "BENCH_r[0-9]*.json")))
+    return paths[-1] if paths else None
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    threshold = 0.10
+    paths = []
+    it = iter(argv)
+    for a in it:
+        if a == "--threshold":
+            threshold = float(next(it))
+        elif a.startswith("--threshold="):
+            threshold = float(a.split("=", 1)[1])
+        else:
+            paths.append(a)
+    if len(paths) != 2:
+        print("usage: python -m ray_tpu.bench_check OLD.json NEW.json "
+              "[--threshold 0.10]", file=sys.stderr)
+        return 2
+    result = compare(load_metrics(paths[0]), load_metrics(paths[1]),
+                     threshold=threshold)
+    print(format_report(result, paths[0], paths[1], threshold))
+    return 1 if result["regressions"] or result["missing"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -183,6 +183,11 @@ class InferenceEngine:
                 lora_config=lora_config,
             )
         self.executor = executor
+        # Resolved decode path ("paged" = v2 staging-buffer kernel: pool
+        # read-only per K-step dispatch, one commit scatter at the
+        # dispatch boundary; "dense" = bucketed gather). "auto" resolves
+        # per backend/mesh in executor.resolve_attention_impl.
+        self.attention_impl = getattr(executor, "attention_impl", "dense")
         self.lora_manager = None
         if lora_config is not None:
             from .lora import LoRAManager
@@ -211,7 +216,8 @@ class InferenceEngine:
         self._block_tables = np.tile(
             np.arange(max_slots, dtype=np.int32)[:, None], (1, self.max_pages_per_seq)
         )
-        self.metrics = {"prefix_hit_pages": 0, "prefill_chunks": 0, "decode_steps": 0}
+        self.metrics = {"prefix_hit_pages": 0, "prefill_chunks": 0,
+                        "decode_steps": 0, "decode_dispatches": 0}
 
     @staticmethod
     def total_pages(max_slots: int, max_len: int, page_size: int,
@@ -549,6 +555,9 @@ class InferenceEngine:
             remaining, K, lora_idx=self._lora_idx,
         )  # [K, slots]
         self.metrics["decode_steps"] += K
+        # One dispatch == one staging-buffer commit on the paged path:
+        # the pool is written decode_dispatches times, not decode_steps.
+        self.metrics["decode_dispatches"] += 1
         events = []
         for k in range(K):
             for slot, r in active.items():

@@ -33,6 +33,42 @@ import numpy as np
 from ..models.llama import LlamaConfig, PRESETS, init_params
 from .model import decode_loop, init_pages, prefill_chunk, sample_first_batch
 
+# Backends with a real Mosaic compiler: the Pallas paged-attention kernel
+# runs native. "axon" is the remote-dispatch tunnel to the same chip.
+_TPU_BACKENDS = ("tpu", "axon")
+
+
+def resolve_attention_impl(attention_impl: str = "auto", mesh=None,
+                           backend: str | None = None) -> str:
+    """Resolve ``attention_impl`` to a concrete decode path.
+
+    ``"auto"`` picks the v2 staging-buffer Pallas kernel (``"paged"``)
+    whenever a TPU backend is present — per-slot-proportional HBM traffic
+    is the point of the paged design — and falls back to the bucketed
+    dense gather (``"dense"``) when:
+
+      * the backend is not a TPU (interpret-mode decode is far slower
+        than the dense gather on CPU — tests force ``"paged"`` explicitly
+        to exercise the kernel), or
+      * the mesh pipelines layers (``pp`` > 1): the pp tick loop does not
+        thread the staging carry yet (ROADMAP item 4's second half).
+
+    Tensor-parallel meshes DO take the kernel: it shard_maps over the
+    KV-head axis (``ops/paged_attention.py``), composing with the
+    executor's kv-head pool sharding.
+    """
+    if attention_impl not in ("auto", "paged", "dense"):
+        raise ValueError(f"unknown attention_impl {attention_impl!r}")
+    if attention_impl != "auto":
+        return attention_impl
+    if backend is None:
+        backend = jax.default_backend()
+    if backend not in _TPU_BACKENDS:
+        return "dense"
+    if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        return "dense"
+    return "paged"
+
 
 class LocalEngineExecutor:
     """Params, page pool, PRNG key and jitted programs on this process's
@@ -59,26 +95,27 @@ class LocalEngineExecutor:
         self.mesh = mesh
         self.max_slots = max_slots
         self.page_size = page_size
-        # "paged" = Pallas paged-attention decode kernel; "dense" =
-        # bucketed gather (width capped by the host-computed live_pages
-        # bound, so its cost tracks the batch-max LIVE context, not pool
-        # capacity); "auto" = dense. Dense wins on v5e today: the kernel
-        # must receive the pool as ppb separate operands (Mosaic can't
-        # DMA-slice unaligned minor dims or lane-reshape), and XLA
-        # inserts pool-sized copies around a mutating multi-operand
-        # custom call in a loop — see PERF.md "paged-attention kernel".
-        # The kernel stays parity-tested for the skewed-batch upside
-        # once those toolchain limits lift.
-        if attention_impl not in ("auto", "paged", "dense"):
-            raise ValueError(f"unknown attention_impl {attention_impl!r}")
-        if attention_impl == "paged" and mesh is not None:
-            # Refuse rather than silently fall back: the kernel is not
-            # shard_map-wrapped for sharded page pools, and the pp
-            # pipeline path doesn't thread paged/live_pages at all.
+        # "paged" = v2 staging-buffer Pallas kernel (pool read-only per
+        # K-step dispatch, token carry folded into the online softmax,
+        # one batched commit scatter per dispatch — HBM per step
+        # proportional to per-SLOT live context); "dense" = bucketed
+        # gather (cost tracks the batch-MAX live context); "auto" =
+        # paged on TPU backends, dense elsewhere (resolve_attention_impl).
+        self.attention_impl = resolve_attention_impl(attention_impl, mesh)
+        if self.attention_impl == "paged" and mesh is not None \
+                and mesh.shape.get("pp", 1) > 1:
+            # Refuse rather than silently fall back: the pp tick loop
+            # doesn't thread the staging carry (ROADMAP item 4). Plain tp
+            # is fine — the kernel shard_maps over the KV-head axis.
             raise ValueError(
-                "attention_impl='paged' is single-device only (the Pallas "
-                "kernel does not run over a mesh); use 'dense'")
-        self.paged_attention = attention_impl == "paged"
+                "attention_impl='paged' does not pipeline over pp yet; "
+                "use 'dense' or 'auto'")
+        self.paged_attention = self.attention_impl == "paged"
+        # shard_map the kernel over tp when the pool is head-sharded;
+        # single-axis (dp-only) meshes keep the plain call.
+        self._attn_mesh = (
+            mesh if self.paged_attention and mesh is not None
+            and mesh.shape.get("tp", 1) > 1 else None)
         pages = init_pages(self.config, num_pages, page_size)
         self._replicated = None
         self._pp = mesh.shape.get("pp", 1) if mesh is not None else 1
@@ -175,7 +212,7 @@ class LocalEngineExecutor:
             self._decode_loop = jax.jit(
                 decode_loop.__wrapped__,
                 static_argnames=("config", "page_size", "n_steps", "paged",
-                                 "live_pages"),
+                                 "live_pages", "attn_mesh"),
                 donate_argnames=("pages",),
                 out_shardings=(rep, rep, pg),
             )
@@ -286,12 +323,21 @@ class LocalEngineExecutor:
         if self._pp > 1:
             kwargs = {}
         else:
-            # Attend positions reach max(pos) + n_steps - 1 by the last
-            # fused step; bucket the page bound to a power of two.
-            needed = (int(pos.max()) + n_steps - 1) // self.page_size + 1
+            if self.paged_attention:
+                # The kernel only reads POOL context [0, pos): tokens
+                # generated mid-dispatch ride the staging carry, so the
+                # page bound ignores n_steps entirely — a strictly
+                # tighter grid than the dense bound below.
+                needed = max(1, (int(pos.max()) + self.page_size - 1)
+                             // self.page_size)
+            else:
+                # Dense attends in-pool: positions reach
+                # max(pos) + n_steps - 1 by the last fused step.
+                needed = (int(pos.max()) + n_steps - 1) // self.page_size + 1
             kwargs = {
                 "paged": self.paged_attention,
                 "live_pages": self._bucket_pages(needed, block_tables.shape[1]),
+                "attn_mesh": self._attn_mesh,
             }
             if self.lora_stack is not None:
                 kwargs["lora"] = self.lora_stack

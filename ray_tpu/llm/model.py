@@ -28,6 +28,14 @@ Design points:
     point at a per-slot trash page so their (ignored) writes never
     corrupt live pages — branchless, one compiled program for every
     occupancy.
+  * **Paged v2 staging schedule** (``decode_loop(paged=True)``): the
+    page pool is STRICTLY READ-ONLY across the whole K-step fused
+    dispatch — the Pallas kernel only ever reads it, so XLA inserts no
+    pool-sized copies around the custom call. Tokens generated inside
+    the dispatch accumulate in a small ``[L, slots, KH, SC, D]`` staging
+    carry (KBs, not GBs) the kernel folds into its online softmax as a
+    second KV source, and ``commit_staging`` writes them back with ONE
+    batched scatter at the dispatch boundary.
   * **The pool rides the layer scan as CARRY, never as scan xs.** The
     stacked pool is donated and updated in place layer by layer
     (``pool.at[l, pages, ...]``); gathers index ``pool[l, tables]``
@@ -55,7 +63,7 @@ from jax import lax
 
 from ..models.llama import LlamaConfig
 from ..ops import apply_rope, rms_norm
-from ..ops.paged_attention import paged_decode_attention
+from ..ops.paged_attention import paged_decode_attention, stage_rows
 
 
 def init_pages(config: LlamaConfig, num_pages: int, page_size: int) -> dict:
@@ -187,19 +195,36 @@ def prefill_chunk(params, pages: dict, block_table, tokens, start_pos,
 
 def decode_block(x, layer, kf, vf, l, block_tables, pos, write_idx,
                  c: LlamaConfig, page_size: int, paged: bool = False,
-                 live_pages: int | None = None, lora=None, lora_idx=None):
+                 live_pages: int | None = None, lora=None, lora_idx=None,
+                 stage=None, stage_step=None, attn_mesh=None):
     """One decoder block for a [n, 1, E] single-token batch against the
     FULL page pool (kf/vf: [L, P, KH, page, D]; ``l`` is this layer's
     index into it — traced, so the pool is only touched at gather/scatter
     granularity and updates stay in place). Shared by the unpipelined
     decode (``_decode_logits``) and the pp pipeline (``pp_model``) so the
     two paths stay bitwise-identical (greedy parity depends on it).
+    Returns ``(x2, kf, vf, stage)``.
 
     ``paged=True`` routes context attention through the Pallas
     paged-attention kernel (``ops/paged_attention.py``): HBM traffic per
-    step proportional to each slot's LIVE context. ``paged=False`` is the
-    dense gather — width capped by ``live_pages`` — kept as the CPU/test
-    default and the numerical ground truth."""
+    step proportional to each slot's LIVE context. The v2 staging-buffer
+    contract keeps the pool STRICTLY READ-ONLY around the kernel:
+
+      * With ``stage=(k_stage, v_stage)`` (the fused decode loop) this
+        layer's fresh K/V lands in staging row ``stage_step`` at layer
+        ``l`` and the kernel folds rows [0, stage_step] as a second KV
+        source; the pool is untouched — ``decode_loop`` commits the whole
+        staging buffer with ONE batched scatter at the dispatch boundary.
+      * Without ``stage`` (single-step ``decode_step``) the fresh K/V
+        rides the kernel's compat path (``k_cur``/``v_cur``) and is
+        scattered into the pool AFTER the kernel call — the pool is never
+        simultaneously a kernel operand and a write target, so the
+        donated buffer updates in place with no defensive copies.
+
+    ``paged=False`` is the dense gather — width capped by ``live_pages``
+    — kept as the CPU/test default and the numerical ground truth.
+    ``attn_mesh`` (static) shard_maps the kernel over the mesh's tp axis
+    (KV heads)."""
     n = x.shape[0]
     kh, g = c.n_kv_heads, c.n_heads // c.n_kv_heads
     offset = pos % page_size
@@ -224,14 +249,26 @@ def decode_block(x, layer, kf, vf, l, block_tables, pos, write_idx,
     k = apply_rope(k, pos[:, None], theta=c.rope_theta)
     qg = q[:, :, 0].reshape(n, kh, g, c.head_dim)
     if paged:
-        # The kernel both attends AND writes the current token's K/V
-        # into the pool (aliased outputs): any pool-mutating XLA scatter
-        # beside the opaque custom call would force a pool-sized copy
-        # per step.
-        attn, kf, vf = paged_decode_attention(
-            qg, kf, vf, block_tables, pos, k[:, :, 0], v[:, :, 0],
-            page_size=page_size, live_pages=live_pages, layer=l,
-            write_idx=write_idx)
+        k_tok, v_tok = k[:, :, 0], v[:, :, 0]            # [n, KH, D]
+        if stage is not None:
+            ks, vs = stage
+            ks = ks.at[l, :, :, stage_step].set(k_tok.astype(ks.dtype))
+            vs = vs.at[l, :, :, stage_step].set(v_tok.astype(vs.dtype))
+            attn = paged_decode_attention(
+                qg, kf, vf, block_tables, pos,
+                page_size=page_size, live_pages=live_pages, layer=l,
+                k_stage=ks, v_stage=vs, stage_idx=stage_step,
+                mesh=attn_mesh)
+            stage = (ks, vs)
+        else:
+            attn = paged_decode_attention(
+                qg, kf, vf, block_tables, pos, k_tok, v_tok,
+                page_size=page_size, live_pages=live_pages, layer=l,
+                mesh=attn_mesh)
+            # Commit AFTER the read-only kernel: same per-step scatter
+            # cost as the dense path, in place on the donated pool.
+            kf = kf.at[l, write_idx, :, offset, :].set(k_tok)
+            vf = vf.at[l, write_idx, :, offset, :].set(v_tok)
         attn = attn.reshape(n, 1, c.n_heads * c.head_dim)
     else:
         # Write each slot's new K/V at (its current page, offset), then
@@ -259,13 +296,14 @@ def decode_block(x, layer, kf, vf, l, block_tables, pos, write_idx,
 
         out = out + lora_delta(attn, lora["wo.A"], lora["wo.B"],
                                l, lora_idx).astype(out.dtype)
-    return _mlp(x + out, layer, c), kf, vf
+    return _mlp(x + out, layer, c), kf, vf, stage
 
 
 def _decode_logits(params, pages: dict, block_tables, tokens, pos,
                    config: LlamaConfig, page_size: int, write_page_idx=None,
                    paged: bool = False, live_pages: int | None = None,
-                   lora=None, lora_idx=None):
+                   lora=None, lora_idx=None, stage=None, stage_step=None,
+                   attn_mesh=None):
     """One batched decode step over all slots.
 
     block_tables: [slots, max_pages_per_seq] int32 (inactive slots must
@@ -275,7 +313,11 @@ def _decode_logits(params, pages: dict, block_tables, tokens, pos,
     write_page_idx: optional [slots] override of the page each slot writes
                   to (the multi-step loop redirects finished slots to
                   their trash page).
-    Returns (logits [slots, vocab] f32, new pages).
+    stage/stage_step: paged-v2 staging carry — see ``decode_block``. With
+                  staging, the pool comes back UNTOUCHED and the fresh
+                  K/V rides the returned stage buffers; the caller owns
+                  the dispatch-boundary commit (``commit_staging``).
+    Returns (logits [slots, vocab] f32, new pages, stage).
     """
     c = config
     x = params["embed"][tokens][:, None].astype(c.dtype)   # [slots, 1, E]
@@ -285,33 +327,81 @@ def _decode_logits(params, pages: dict, block_tables, tokens, pos,
     page_idx = write_page_idx
 
     def body(carry, xs):
-        x, kf, vf = carry
+        x, kf, vf, stg = carry
         layer, l = xs
-        x2, kf, vf = decode_block(
+        x2, kf, vf, stg = decode_block(
             x, layer, kf, vf, l, block_tables, pos, page_idx, c, page_size,
-            paged=paged, live_pages=live_pages, lora=lora, lora_idx=lora_idx)
-        return (x2, kf, vf), None
+            paged=paged, live_pages=live_pages, lora=lora, lora_idx=lora_idx,
+            stage=stg, stage_step=stage_step, attn_mesh=attn_mesh)
+        return (x2, kf, vf, stg), None
 
-    (x, new_k, new_v), _ = lax.scan(
-        body, (x, pages["k"], pages["v"]),
+    (x, new_k, new_v, stage), _ = lax.scan(
+        body, (x, pages["k"], pages["v"], stage),
         (params["layers"], jnp.arange(c.n_layers)))
     hidden = rms_norm(x, params["final_norm"], eps=c.norm_eps)     # [slots, 1, E]
     logits = jnp.einsum("bse,ev->bsv", hidden, params["lm_head"])[:, 0]
-    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}, stage
+
+
+def commit_staging(pages: dict, stage, write_idx_steps, pos0, n_steps: int,
+                   page_size: int):
+    """Dispatch-boundary commit: ONE batched scatter folds the staging
+    buffer back into the (donated, read-only-until-now) page pool.
+
+    stage:           (k_stage, v_stage) [L, slots, KH, SC, D] — row j of
+                     slot s holds the roped K/V of position pos0_s + j.
+    write_idx_steps: [n_steps, slots] int32 — the page each slot wrote at
+                     each fused step (trash pages for finished slots),
+                     recorded by the decode scan.
+    pos0:            [slots] int32 — each slot's position at dispatch
+                     start (the pool held [0, pos0) throughout).
+
+    By the time this scatter runs the scan that READ the pool has
+    completed, so XLA updates the donated buffer in place — the whole
+    point of the v2 design: zero pool-sized copies per dispatch.
+    """
+    k_stage, v_stage = stage
+    L, n, kh, _, d = k_stage.shape
+    steps = jnp.arange(n_steps, dtype=jnp.int32)
+    off = ((pos0[None, :] + steps[:, None]) % page_size).reshape(-1)  # [K*S]
+    widx = write_idx_steps.reshape(-1)                                # [K*S]
+
+    def rows(s):
+        # [L, S, KH, SC, D] -> staged rows [K*S, L, KH, D] in (step, slot)
+        # order matching ``widx``/``off``.
+        r = jnp.transpose(s[:, :, :, :n_steps], (3, 1, 0, 2, 4))
+        return r.reshape(n_steps * n, L, kh, d)
+
+    new_k = pages["k"].at[:, widx, :, off, :].set(
+        rows(k_stage).astype(pages["k"].dtype))
+    new_v = pages["v"].at[:, widx, :, off, :].set(
+        rows(v_stage).astype(pages["v"].dtype))
+    return {"k": new_k, "v": new_v}
+
+
+@functools.wraps(_decode_logits)
+def _decode_step(*args, **kwargs):
+    logits, pages, _ = _decode_logits(*args, **kwargs)
+    return logits, pages
 
 
 decode_step = functools.partial(
-    jax.jit, static_argnames=("config", "page_size", "paged", "live_pages"),
+    jax.jit,
+    static_argnames=("config", "page_size", "paged", "live_pages",
+                     "attn_mesh"),
     donate_argnames=("pages",)
-)(_decode_logits)
+)(_decode_step)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("config", "page_size", "paged", "live_pages"),
+    jax.jit,
+    static_argnames=("config", "page_size", "paged", "live_pages",
+                     "attn_mesh"),
     donate_argnames=("pages",))
 def decode_and_sample(params, pages: dict, block_tables, tokens, pos, temps, key,
                       config: LlamaConfig, page_size: int, paged: bool = False,
-                      live_pages: int | None = None, lora=None, lora_idx=None):
+                      live_pages: int | None = None, lora=None, lora_idx=None,
+                      attn_mesh=None):
     """``decode_step`` + on-device sampling in ONE compiled program.
 
     The engine drives the chip through a (possibly remote) dispatch
@@ -321,10 +411,11 @@ def decode_and_sample(params, pages: dict, block_tables, tokens, pos, temps, key
     tempered categorical otherwise) and the RNG split happen on device —
     one dispatch, and only [slots] int32 tokens cross back.
     """
-    logits, new_pages = _decode_logits(params, pages, block_tables, tokens, pos,
-                                       config, page_size, paged=paged,
-                                       live_pages=live_pages, lora=lora,
-                                       lora_idx=lora_idx)
+    logits, new_pages, _ = _decode_logits(params, pages, block_tables, tokens,
+                                          pos, config, page_size, paged=paged,
+                                          live_pages=live_pages, lora=lora,
+                                          lora_idx=lora_idx,
+                                          attn_mesh=attn_mesh)
     key, sub = jax.random.split(key)
     greedy = jnp.argmax(logits, axis=-1)
     sampled = jax.random.categorical(sub, logits / jnp.maximum(temps, 1e-6)[:, None])
@@ -360,12 +451,13 @@ def sample_first_batch(hiddens, lm_head, temps, key):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("config", "page_size", "n_steps", "paged", "live_pages"),
+    static_argnames=("config", "page_size", "n_steps", "paged", "live_pages",
+                     "attn_mesh"),
     donate_argnames=("pages",))
 def decode_loop(params, pages: dict, block_tables, tokens, pos, temps, eos_ids,
                 remaining, key, config: LlamaConfig, page_size: int, n_steps: int,
                 paged: bool = False, live_pages: int | None = None,
-                lora=None, lora_idx=None):
+                lora=None, lora_idx=None, attn_mesh=None):
     """``n_steps`` decode+sample iterations in ONE dispatch (on-device
     ``lax.scan`` generate loop, JetStream-style).
 
@@ -378,35 +470,57 @@ def decode_loop(params, pages: dict, block_tables, tokens, pos, temps, eos_ids,
     allocation or corrupt shared prefix pages; the host discards their
     surplus tokens.
 
+    ``paged=True`` runs the v2 staging-buffer schedule: the pool is
+    STRICTLY READ-ONLY across all ``n_steps`` (nothing for XLA to copy
+    around the opaque kernel), step ``j`` appends its fresh K/V to a
+    small ``[L, slots, KH, SC, D]`` staging carry the kernel folds into
+    its online softmax, and ``commit_staging`` writes everything back
+    with ONE batched scatter after the scan.
+
     eos_ids:   [slots] int32 (-1 = no EOS for that slot).
     remaining: [slots] int32 — tokens the slot may still emit (bounds
                both max_new_tokens and the page allocation).
-    live_pages: static bound covering ``max(pos) + n_steps - 1`` (the
-               last fused step's attend position) — see module docstring.
+    live_pages: static bound on the attention width — for the dense path
+               it must cover ``max(pos) + n_steps - 1`` (tokens land in
+               the pool mid-dispatch); for paged it need only cover the
+               POOL context ``max(pos)`` (fresher tokens ride staging).
     Returns (tokens [n_steps, slots] int32, key, pages).
     """
     n = tokens.shape[0]
     trash = jnp.arange(n, dtype=jnp.int32)  # slot i's trash page is page i
+    stage0 = None
+    if paged:
+        sc = stage_rows(n_steps)
+        shape = (config.n_layers, n, config.n_kv_heads, sc, config.head_dim)
+        stage0 = (jnp.zeros(shape, pages["k"].dtype),
+                  jnp.zeros(shape, pages["v"].dtype))
 
-    def body(carry, _):
-        tokens, pos, done, remaining, key, pages = carry
+    def body(carry, j):
+        tokens, cur, done, remaining, key, pages, stage = carry
         real_page = jnp.take_along_axis(
             block_tables,
-            jnp.minimum(pos // page_size, block_tables.shape[1] - 1)[:, None],
+            jnp.minimum(cur // page_size, block_tables.shape[1] - 1)[:, None],
             axis=1)[:, 0]
         write_idx = jnp.where(done, trash, real_page)
-        logits, pages = _decode_logits(params, pages, block_tables, tokens, pos,
-                                       config, page_size, write_page_idx=write_idx,
-                                       paged=paged, live_pages=live_pages,
-                                       lora=lora, lora_idx=lora_idx)
+        logits, pages, stage = _decode_logits(
+            params, pages, block_tables, tokens, cur, config, page_size,
+            write_page_idx=write_idx, paged=paged, live_pages=live_pages,
+            lora=lora, lora_idx=lora_idx, stage=stage,
+            stage_step=j if paged else None, attn_mesh=attn_mesh)
         key, sub = jax.random.split(key)
         greedy = jnp.argmax(logits, axis=-1)
         sampled = jax.random.categorical(sub, logits / jnp.maximum(temps, 1e-6)[:, None])
         new_tok = jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
         remaining = remaining - jnp.where(done, 0, 1)
         done = done | (new_tok == eos_ids) | (remaining <= 0)
-        return (new_tok, pos + 1, done, remaining, key, pages), new_tok
+        return ((new_tok, cur + 1, done, remaining, key, pages, stage),
+                (new_tok, write_idx))
 
-    init = (tokens, pos, remaining <= 0, remaining, key, pages)
-    (_, _, _, _, key, pages), toks = lax.scan(body, init, None, length=n_steps)
+    init = (tokens, pos, remaining <= 0, remaining, key, pages, stage0)
+    ((_, _, _, _, key, pages, stage), (toks, widx)) = lax.scan(
+        body, init, jnp.arange(n_steps, dtype=jnp.int32))
+    if paged:
+        # The one pool write of the whole dispatch — the scan above only
+        # READ the pool, so the donated buffer updates in place here.
+        pages = commit_staging(pages, stage, widx, pos, n_steps, page_size)
     return toks, key, pages

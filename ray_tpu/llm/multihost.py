@@ -43,10 +43,14 @@ class EngineShardWorker:
         return initialize_process(coordinator, self.world, self.rank)
 
     def build(self, config, *, max_slots: int, num_pages: int, page_size: int,
-              tp: int | None = None, pp: int | None = None, seed: int = 0) -> int:
+              tp: int | None = None, pp: int | None = None, seed: int = 0,
+              attention_impl: str = "auto") -> int:
         """Create the executor over the global mesh (all hosts' devices).
         Default tp = every device in the group (pure TP); pass ``pp`` to
-        stage layers across hosts instead (pure PP this round)."""
+        stage layers across hosts instead (pure PP this round).
+        ``attention_impl="auto"`` resolves per shard exactly as on a
+        single host: the paged kernel shard_maps over the kv-head/tp
+        axis, dense for pp meshes."""
         import jax
 
         from ..parallel import MeshConfig, create_mesh
@@ -62,6 +66,7 @@ class EngineShardWorker:
         self.executor = LocalEngineExecutor(
             config, max_slots=max_slots, num_pages=num_pages,
             page_size=page_size, mesh=mesh, seed=seed,
+            attention_impl=attention_impl,
         )
         return n
 
@@ -160,6 +165,7 @@ def create_sharded_executor(
     topology: str | None = None,
     strategy: str | None = None,
     runtime_env: dict | None = None,
+    attention_impl: str = "auto",
 ) -> ShardedEngineExecutor:
     """Place one shard actor per host and bootstrap the group.
 
@@ -199,7 +205,8 @@ def create_sharded_executor(
                 timeout=300)
         ray.get([
             s.build.remote(config, max_slots=max_slots, num_pages=num_pages,
-                           page_size=page_size, tp=tp, pp=pp, seed=seed)
+                           page_size=page_size, tp=tp, pp=pp, seed=seed,
+                           attention_impl=attention_impl)
             for s in shards
         ], timeout=600)
     except Exception:

@@ -205,7 +205,7 @@ def pp_decode_loop(params, pages, block_tables, tokens, pos, temps, eos_ids,
             def body(carry, xs):
                 xc, kp, vp = carry
                 layer, l = xs
-                x2, kp, vp = decode_block(
+                x2, kp, vp, _ = decode_block(
                     xc, layer, kp, vp, l, bt, cpos, write_idx, c, page_size)
                 return (x2, kp, vp), None
 

@@ -86,6 +86,7 @@ class LLMDeployment:
         seed: int = 0,
         request_timeout_s: float = 300.0,
         lora_config: dict | None = None,
+        attention_impl: str = "auto",
     ):
         mesh = None
         executor = None
@@ -108,6 +109,7 @@ class LLMDeployment:
                 bundle_resources=shard_resources,
                 topology=topology,
                 runtime_env=shard_runtime_env,
+                attention_impl=attention_impl,
             )
         elif tensor_parallel > 1 or pipeline_parallel > 1:
             # Shard the engine across this replica's visible chips (e.g.
@@ -137,6 +139,7 @@ class LLMDeployment:
             prefill_chunk_size=prefill_chunk_size,
             decode_steps_per_dispatch=decode_steps_per_dispatch, mesh=mesh,
             executor=executor, seed=seed, lora_config=lora,
+            attention_impl=attention_impl,
         )
         self.model_id = model_id or (preset if isinstance(preset, str) else "custom")
         self.tokenizer = ByteTokenizer()
@@ -412,7 +415,8 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
                   shard_runtime_env: dict | None = None,
                   topology: str | None = None,
                   max_ongoing_requests: int = 32, model_id: str | None = None,
-                  ray_actor_options: dict | None = None):
+                  ray_actor_options: dict | None = None,
+                  attention_impl: str = "auto"):
     """Build a Serve Application serving ``preset`` (serve.run-able).
     Pass ``ray_actor_options={"resources": {"TPU": 1}, ...}`` to pin each
     replica (engine) to a TPU chip. For an engine that SPANS hosts, set
@@ -435,4 +439,5 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
                     tensor_parallel=tensor_parallel,
                     pipeline_parallel=pipeline_parallel, num_hosts=num_hosts,
                     shard_resources=shard_resources,
-                    shard_runtime_env=shard_runtime_env, topology=topology)
+                    shard_runtime_env=shard_runtime_env, topology=topology,
+                    attention_impl=attention_impl)

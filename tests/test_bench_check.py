@@ -1,0 +1,65 @@
+"""Bench-regression guard (``python -m ray_tpu.bench_check``)."""
+
+import json
+
+from ray_tpu import bench_check
+
+
+def test_direction_inference():
+    assert bench_check._direction("serve_p50_ttft_ms") == "down"
+    assert bench_check._direction("framework_overhead_pct") == "down"
+    assert bench_check._direction("peak_hbm_used_bytes") == "down"
+    assert bench_check._direction("flash_fwdbwd_tflops_s4096") == "up"
+    assert bench_check._direction("raw_tokens_per_sec") == "up"
+
+
+def test_compare_flags_drops_and_missing():
+    old = {"flash_fwdbwd_tflops_s4096": 26.16, "serve_p50_ttft_ms": 272.1,
+           "value": 11363.9, "serve_preset": "llama3-1b", "n": 4}
+    new = {"flash_fwdbwd_tflops_s4096": 22.99, "value": 11349.5,
+           "serve_error": "TimeoutError: not healthy", "n": 5}
+    result = bench_check.compare(old, new)
+    regressed = {r["metric"] for r in result["regressions"]}
+    assert regressed == {"flash_fwdbwd_tflops_s4096"}   # -12.1% > 10%
+    missing = {r["metric"] for r in result["missing"]}
+    assert missing == {"serve_p50_ttft_ms"}             # silently vanished
+    ok = {r["metric"] for r in result["ok"]}
+    assert ok == {"value"}                               # -0.1% is fine
+    # non-numeric / bookkeeping fields never tracked
+    assert not any("preset" in r["metric"] for rows in result.values()
+                   for r in rows)
+
+
+def test_lower_better_regresses_up():
+    old = {"serve_p50_ttft_ms": 272.1}
+    new = {"serve_p50_ttft_ms": 320.0}
+    result = bench_check.compare(old, new)
+    assert [r["metric"] for r in result["regressions"]] == ["serve_p50_ttft_ms"]
+    # and an improvement in latency is an improvement
+    result = bench_check.compare(old, {"serve_p50_ttft_ms": 200.0})
+    assert [r["metric"] for r in result["improvements"]] == ["serve_p50_ttft_ms"]
+
+
+def test_cli_exit_codes_and_wrapper_format(tmp_path):
+    """Accepts both bare metrics and the driver's BENCH_rNN wrapper;
+    exit 1 on regression, 0 when clean."""
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(
+        {"n": 4, "cmd": "python bench.py", "rc": 0,
+         "parsed": {"flash_fwdbwd_tflops_s4096": 26.16}}))
+    new.write_text(json.dumps({"flash_fwdbwd_tflops_s4096": 22.99}))
+    assert bench_check.main([str(old), str(new)]) == 1
+    # within a generous threshold the same pair passes
+    assert bench_check.main([str(old), str(new), "--threshold", "0.2"]) == 0
+    new.write_text(json.dumps({"flash_fwdbwd_tflops_s4096": 26.5}))
+    assert bench_check.main([str(old), str(new)]) == 0
+    assert bench_check.main([str(old)]) == 2  # usage error
+
+
+def test_latest_bench_json(tmp_path):
+    assert bench_check.latest_bench_json(str(tmp_path)) is None
+    (tmp_path / "BENCH_r04.json").write_text("{}")
+    (tmp_path / "BENCH_r05.json").write_text("{}")
+    latest = bench_check.latest_bench_json(str(tmp_path))
+    assert latest is not None and latest.endswith("BENCH_r05.json")
