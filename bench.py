@@ -385,6 +385,17 @@ print(json.dumps(out))
     return _run_chip_subprocess(code, "paged decode", timeout=1200)
 
 
+def run_core_bench() -> dict:
+    """Core task-path throughput (ROADMAP item 3): no-op task, actor-call,
+    and object put/get round-trip rates through the REAL
+    submit→lease→push→return path, plus the lease-stage p50s the run
+    produced. Implementation lives in ``ray_tpu/_core_bench.py`` (also
+    runnable standalone: ``python -m ray_tpu.cli bench core``)."""
+    from ray_tpu._core_bench import run_core_bench as _run
+
+    return _run()
+
+
 def run_serve_bench() -> dict:
     """Serve p50 TTFT north star (BASELINE.json): concurrent streaming
     completions through the REAL stack — HTTP proxy → pow-2 router →
@@ -598,6 +609,19 @@ def main() -> None:
         except Exception as e:
             print(f"paged decode bench failed: {e}", file=sys.stderr)
             extra_paged = {"paged_bench_error": f"{type(e).__name__}: {e}"}
+    extra_core: dict = {}
+    if os.environ.get("RAY_TPU_BENCH_SKIP_CORE") != "1":
+        try:
+            extra_core = run_core_bench()
+        except Exception as e:
+            print(f"core bench failed: {e}", file=sys.stderr)
+            extra_core = {"core_bench_error": f"{type(e).__name__}: {e}"}
+            try:
+                import ray_tpu
+
+                ray_tpu.shutdown()
+            except Exception:
+                pass
     value = fw["tokens_per_sec_per_chip"]
     baseline = None
     if os.path.exists("BENCH_BASELINE.json"):
@@ -620,6 +644,7 @@ def main() -> None:
         **extra_8b,
         **extra_longctx,
         **extra_paged,
+        **extra_core,
     }
     print(json.dumps(result))
     # Regression guard against the most recent recorded round: report-only

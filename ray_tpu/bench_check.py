@@ -30,7 +30,13 @@ _SKIP_EXACT = {
     "n", "rc", "vs_baseline", "loss", "serve_requests", "serve_concurrency",
     "serve_decode_steps_per_dispatch",
 }
-_SKIP_SUBSTR = ("error", "preset", "metric", "unit", "cmd", "tail")
+# "_cfg": config echoes (core-bench phase sizes etc.) — sizes are inputs,
+# not results.
+_SKIP_SUBSTR = ("error", "preset", "metric", "unit", "cmd", "tail", "_cfg")
+# Throughput rates: ALWAYS higher-better, checked BEFORE the lower-better
+# suffixes — "core_tasks_per_s" ends in "_s" but a drop in it is the
+# regression, not an improvement.
+_HIGHER_BETTER_SUFFIX = ("_per_s", "_per_sec")
 # Lower is better. Peak-memory gauges count as regressions when they
 # GROW >threshold (a quiet 2x pool blowup is exactly what they exist
 # to catch).
@@ -50,6 +56,8 @@ def load_metrics(path: str) -> dict:
 
 def _direction(name: str) -> str:
     """'up' = larger is better, 'down' = smaller is better."""
+    if name.endswith(_HIGHER_BETTER_SUFFIX):
+        return "up"
     if name.endswith(_LOWER_BETTER_SUFFIX) or any(
             s in name for s in _LOWER_BETTER_SUBSTR):
         return "down"

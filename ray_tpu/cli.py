@@ -64,6 +64,31 @@ def _cmd_chaos(args) -> int:
     return 0
 
 
+def _cmd_bench(args) -> int:
+    # Runs against its OWN local cluster (no --address needed): the suite
+    # saturates the task path, which would be rude to a shared cluster.
+    from ray_tpu._core_bench import run_core_bench
+
+    result = run_core_bench(num_tasks=args.tasks, num_actors=args.actors,
+                            calls_per_actor=args.calls,
+                            num_objects=args.objects)
+    print(json.dumps(result, indent=None if args.as_json else 2))
+    if args.check_against:
+        from ray_tpu import bench_check
+
+        # A recorded BENCH_r*.json carries train/serve/flash metrics this
+        # standalone run never produces — compare the core_* slice only.
+        old = {k: v for k, v in
+               bench_check.load_metrics(args.check_against).items()
+               if k.startswith("core_")}
+        report = bench_check.compare(old, result)
+        print(bench_check.format_report(report, args.check_against,
+                                        "this run"), file=sys.stderr)
+        if report["regressions"] or report["missing"]:
+            return 1
+    return 0 if result.get("core_tasks_per_s") else 1
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
     parser.add_argument("--address", help="GCS address of a running cluster")
@@ -101,6 +126,25 @@ def main(argv: list[str] | None = None) -> int:
                         help="capture length in seconds")
     prof_p.add_argument("--list", action="store_true", dest="list_profiles",
                         help="list previously captured artifacts instead")
+    bench_p = sub.add_parser(
+        "bench", help="run a benchmark suite standalone")
+    bench_sub = bench_p.add_subparsers(dest="bench_cmd", required=True)
+    bcore = bench_sub.add_parser(
+        "core", help="core task-path throughput: no-op tasks, actor calls, "
+                     "object put/get round trips (records core_*_per_s + "
+                     "lease-stage p50s; guarded by ray_tpu.bench_check)")
+    bcore.add_argument("--tasks", type=int, default=None,
+                       help="no-op tasks (default $RAY_TPU_CORE_BENCH_TASKS "
+                            "or 100000)")
+    bcore.add_argument("--actors", type=int, default=None,
+                       help="actor pool size (default 100)")
+    bcore.add_argument("--calls", type=int, default=None,
+                       help="calls per actor (default 100)")
+    bcore.add_argument("--objects", type=int, default=None,
+                       help="put/get round trips (default 10000)")
+    bcore.add_argument("--check-against", default=None, metavar="BENCH_JSON",
+                       help="run ray_tpu.bench_check against a recorded "
+                            "BENCH_r*.json and exit non-zero on regression")
     chaos_p = sub.add_parser(
         "chaos", help="deterministic fault injection (seeded FaultPlans)")
     chaos_sub = chaos_p.add_subparsers(dest="chaos_cmd", required=True)
@@ -121,6 +165,8 @@ def main(argv: list[str] | None = None) -> int:
     chaos_sub.add_parser("plans", help="list bundled fault plans")
 
     args = parser.parse_args(argv)
+    if args.cmd == "bench":
+        return _cmd_bench(args)
     if args.cmd == "chaos":
         return _cmd_chaos(args)
     _connect(args.address)

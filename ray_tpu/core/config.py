@@ -64,6 +64,13 @@ class RayTpuConfig:
     # Max normal-task specs pushed to a leased worker in ONE RPC: the
     # batch-submit path is RPC/handoff-bound, not execution-bound.
     task_push_batch_size: int = 16
+    # Max workers ONE RequestWorkerLease may grant (owner-side lease
+    # multiplexing): a deep task queue asks for several workers in one
+    # round trip, and same-shape lease requests across pipelines coalesce
+    # onto the in-flight RPC instead of each paying its own. Extra grants
+    # are best-effort — the raylet only adds workers that are idle and
+    # admissible right now. 1 = the legacy one-lease-per-RPC protocol.
+    lease_grant_batch_size: int = 4
     # Fork default-env workers from a warm pre-imported zygote process
     # instead of paying interpreter boot + imports per worker.
     enable_worker_zygote: bool = True
@@ -150,6 +157,12 @@ class RayTpuConfig:
     # --- task events / observability ----------------------------------------
     task_events_buffer_size: int = 10000
     task_events_flush_interval_ms: int = 1000
+    # Coalesce one task's status transitions recorded within this window
+    # into ONE wire event per flush (SUBMITTED/LEASED/FINISHED become a
+    # single dict with a `transitions` list; the GCS replays them in
+    # order, so records and lease-stage histograms are identical to the
+    # unbatched path). 0 = one wire event per transition (legacy).
+    task_event_coalesce_ms: int = 1000
     enable_timeline: bool = True
     # Distributed tracing: trace-context propagation through TaskSpec /
     # serve requests + span recording (observability/tracing.py).

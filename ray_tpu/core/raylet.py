@@ -186,6 +186,15 @@ class Raylet:
         self._pg_bundles: dict[tuple[str, int], dict] = {}  # (pg_id, idx) -> {resources, committed}
         self._tasks: list[asyncio.Task] = []
         self._node_table: dict[str, dict] = {}
+        # Node-table refresh sharing: concurrent refreshers ride ONE
+        # in-flight GetAllNodes, and bounded-staleness callers (the
+        # infeasible-lease wait loop) accept a recent cache outright.
+        self._node_table_ts = 0.0
+        self._node_table_refresh: asyncio.Future | None = None
+        # Lease admission fast-path: resource shapes recur (a 100k-task
+        # bench is 100k×{"CPU": 1}) — cache the fixed-point ResourceSet
+        # per shape instead of rebuilding it for every request.
+        self._request_shape_cache: dict[tuple, ResourceSet] = {}
         self._remote_store_clients: dict[str, RpcClient] = {}
         self._fetching: dict[bytes, asyncio.Future] = {}
         self._session_dir = session_dir
@@ -1054,7 +1063,7 @@ class Raylet:
         node, or queue until resources free up."""
         spec = p["spec"]
         t_arrive = time.monotonic()
-        request = ResourceSet(self._lease_resources(spec))
+        request = self._lease_request_set(spec)
         grant_only_local = bool(p.get("grant_only_local") or p.get("dedicated"))
 
         # Placement-group tasks run on the node holding their bundle and
@@ -1103,7 +1112,9 @@ class Raylet:
             deadline = time.monotonic() + get_config().worker_register_timeout_s
             with self._track_demand(request):
                 while True:
-                    await self._refresh_node_table()
+                    # Infeasible waiters SHARE one cached refresh per poll
+                    # beat instead of each paying a GCS round trip.
+                    await self._refresh_node_table(max_age_s=0.45)
                     node = self._pick_remote_node(request)
                     if node is not None:
                         return {"spillback": True, "node_address": node["address"], "node_id": node["node_id"]}
@@ -1159,13 +1170,74 @@ class Raylet:
         self._record_lease_grant(spec, t_arrive, queue_wait_ms,
                                  (time.monotonic() - t_spawn) * 1000.0)
         self._maybe_chaos_kill_lease(worker)
+        extras = self._try_extra_grants(p, spec, request)
         self._wake_lease_waiters()
-        return {
+        reply = {
             "granted": True,
             "worker_id": worker.worker_id,
             "worker_address": worker.address,
             "node_id": self.node_id.hex(),
         }
+        if extras:
+            reply["extra_grants"] = extras
+        return reply
+
+    def _try_extra_grants(self, p: dict, spec: dict,
+                          request: ResourceSet) -> list[dict]:
+        """Best-effort additional grants for a multiplexed lease request
+        (``num_workers`` > 1: the owner's queue is deep). Only workers
+        that are idle RIGHT NOW with a matching env, and resources that
+        fit without queuing, are granted — anything slower would delay
+        the primary reply — and nothing is granted past parked admission
+        waiters (they reserved their place in line first). LEASED task
+        events are NOT recorded here: the owner stamps LEASED at dispatch
+        for every task it pushes onto a multiplexed lease, exactly as it
+        does for reused leases, so per-task records stay identical to the
+        one-lease-per-RPC path."""
+        want = min(int(p.get("num_workers") or 1), 64) - 1
+        extras: list[dict] = []
+        if want <= 0 or p.get("dedicated"):
+            return extras
+        env_hash = self._env_hash(spec.get("runtime_env"))
+        while len(extras) < want:
+            if self._admission_queue or not self.resources.can_fit(request):
+                break
+            w = None
+            for wid in list(self._idle):
+                cand = self._workers.get(wid)
+                if cand is None:
+                    self._idle.remove(wid)
+                    continue
+                if cand.proc is not None and cand.proc.poll() is not None:
+                    self._on_worker_dead(cand)
+                    continue
+                if cand.state == "idle" and cand.env_hash == env_hash:
+                    self._idle.remove(wid)
+                    w = cand
+                    break
+            if w is None:
+                # No idle worker: warm the pool for the NEXT request, but
+                # never block this reply on a spawn.
+                starting = sum(1 for x in self._workers.values()
+                               if x.state == "starting" and x.env_hash == env_hash)
+                if starting < get_config().maximum_startup_concurrency:
+                    try:
+                        self._start_worker(spec.get("runtime_env"))
+                    except Exception:
+                        pass
+                break
+            self.resources.acquire(request)
+            w.lease_resources = request
+            w.state = "leased"
+            w.lease_time = time.monotonic()
+            w.retriable = bool(spec.get("max_retries", 0))
+            w.lease_acked = False
+            w.lease_granted_at = chaos_clock.now()
+            w.orphan_probe = None
+            self._maybe_chaos_kill_lease(w)
+            extras.append({"worker_id": w.worker_id,
+                           "worker_address": w.address})
+        return extras
 
     def _maybe_chaos_kill_lease(self, worker: WorkerHandle) -> None:
         """Chaos injection point: SIGKILL the worker of the lease just
@@ -1291,12 +1363,42 @@ class Raylet:
             res = {"CPU": 1.0}
         return res
 
-    async def _refresh_node_table(self) -> None:
+    def _lease_request_set(self, spec: dict) -> ResourceSet:
+        """Cached fixed-point ResourceSet for a lease request's shape.
+        Safe to share: ResourceSet algebra never mutates in place (every
+        acquire/release builds a new set), so N requests and N worker
+        ``lease_resources`` fields may all alias one object."""
+        res = self._lease_resources(spec)
+        key = tuple(sorted(res.items()))
+        cached = self._request_shape_cache.get(key)
+        if cached is None:
+            if len(self._request_shape_cache) > 256:
+                self._request_shape_cache.clear()
+            cached = self._request_shape_cache[key] = ResourceSet(res)
+        return cached
+
+    async def _refresh_node_table(self, max_age_s: float = 0.0) -> None:
+        """GetAllNodes into the local cache. Concurrent refreshers share
+        ONE in-flight RPC, and ``max_age_s`` > 0 accepts a recent-enough
+        cache outright — N parked infeasible-lease waiters used to each
+        fire their own GCS round trip every 0.5 s poll beat."""
+        if max_age_s > 0 and time.monotonic() - self._node_table_ts < max_age_s:
+            return
+        if self._node_table_refresh is not None:
+            await asyncio.shield(self._node_table_refresh)
+            return
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._node_table_refresh = fut
         try:
             nodes = await self._gcs.call("GetAllNodes", {}, timeout=5.0)
             self._node_table = {n["node_id"]: n for n in nodes["nodes"]}
+            self._node_table_ts = time.monotonic()
         except Exception:
             pass
+        finally:
+            self._node_table_refresh = None
+            if not fut.done():
+                fut.set_result(None)
 
     def _pick_remote_node(self, request: ResourceSet, require_available: bool = False) -> dict | None:
         best = None
@@ -1318,9 +1420,13 @@ class Raylet:
         are reclaimed by the watchdog — a grant whose reply was lost in
         transit otherwise strands its reservation forever (the ROADMAP-1c
         lease-timeout cascade)."""
-        w = self._workers.get(p.get("worker_id", ""))
-        if w is not None:
-            w.lease_acked = True
+        ids = list(p.get("worker_ids") or ())
+        if p.get("worker_id"):
+            ids.append(p["worker_id"])
+        for wid in ids:
+            w = self._workers.get(wid)
+            if w is not None:
+                w.lease_acked = True
         return {}
 
     async def handle_ReturnWorker(self, p: dict) -> dict:

@@ -43,6 +43,71 @@ def _resolve_state(events: dict) -> str:
     return SUBMITTED
 
 
+# Fields every status event carries; anything else is a per-transition
+# extra (error, trace_id, queue_wait_ms, a LEASED worker_id override...)
+# and must survive coalescing on the transition entry itself.
+_BASE_KEYS = ("task_id", "name", "status", "ts", "worker_id", "node_id", "kind")
+
+
+def coalesce_events(events: list[dict], window_ms: float) -> list[dict]:
+    """Merge one task's status transitions recorded within ``window_ms``
+    into ONE wire event carrying a ``transitions`` list — a task that ran
+    SUBMITTED→LEASED→FINISHED inside a flush interval ships as one dict
+    instead of three. SPAN/MEMORY pseudo-events never coalesce (the GCS
+    routes them to different stores). The GCS replays transitions in
+    recorded order, so per-task records and the lease-stage histograms
+    are byte-identical to the unbatched path."""
+    window_s = window_ms / 1000.0
+    out: list[dict] = []
+    open_groups: dict[str, dict] = {}  # task_id -> coalesced event
+    for ev in events:
+        status = ev.get("status")
+        tid = ev.get("task_id")
+        if status in (SPAN, MEMORY) or not tid:
+            out.append(ev)
+            continue
+        extras = {k: v for k, v in ev.items() if k not in _BASE_KEYS}
+        # worker_id varies per transition on the owner's LEASED records:
+        # keep any value that differs from the group base.
+        group = open_groups.get(tid)
+        if group is not None and ev["ts"] - group["transitions"][0]["ts"] > window_s:
+            group = None  # beyond the window: start a fresh group
+        if group is None:
+            group = dict(ev)
+            group.pop("status", None)
+            for k in list(extras):
+                group.pop(k, None)
+            group["transitions"] = []
+            open_groups[tid] = group
+            out.append(group)
+        tr = {"status": status, "ts": ev["ts"]}
+        if ev.get("worker_id") != group.get("worker_id"):
+            tr["worker_id"] = ev.get("worker_id")
+        tr.update(extras)
+        group["transitions"].append(tr)
+        # The wire dict stays a valid single event too (status/ts = the
+        # latest transition) so foreign consumers that predate coalescing
+        # still see a sane record.
+        group["status"] = status
+        group["ts"] = ev["ts"]
+    return out
+
+
+def expand_event(ev: dict) -> list[dict]:
+    """Inverse of :func:`coalesce_events` for one wire event: yield one
+    plain event per transition (transition fields override the base)."""
+    transitions = ev.get("transitions")
+    if not transitions:
+        return [ev]
+    base = {k: v for k, v in ev.items() if k != "transitions"}
+    out = []
+    for tr in transitions:
+        e = dict(base)
+        e.update(tr)
+        out.append(e)
+    return out
+
+
 class TaskEventBuffer:
     """Worker-side bounded buffer of task status events."""
 
@@ -111,10 +176,20 @@ class TaskEventBuffer:
                 return
             self._events.append(ev)
 
-    def drain(self) -> tuple[list[dict], int]:
+    def drain(self, coalesce_window_ms: float | None = None) -> tuple[list[dict], int]:
+        """Take the buffered events. ``coalesce_window_ms`` (None = read
+        the config knob) > 0 merges each task's transitions into one wire
+        event — the flush RPC ships and the GCS ingests a fraction of the
+        dicts for the same information."""
         with self._lock:
             events, self._events = self._events, []
             dropped, self._dropped = self._dropped, 0
+        if coalesce_window_ms is None:
+            from .config import get_config
+
+            coalesce_window_ms = get_config().task_event_coalesce_ms
+        if coalesce_window_ms and coalesce_window_ms > 0 and len(events) > 1:
+            events = coalesce_events(events, coalesce_window_ms)
         return events, dropped
 
 
@@ -135,39 +210,50 @@ class GcsTaskEventStore:
         self._on_stage = on_stage
 
     def add_events(self, events: list[dict], dropped: int = 0) -> None:
+        # ONE lock acquisition per wire batch; coalesced events expand to
+        # their individual transitions here, applied in recorded order, so
+        # the store (and the stage observer) sees exactly the sequence the
+        # unbatched path would have delivered.
         with self._lock:
             self.num_dropped += dropped
-            for ev in events:
-                tid = ev["task_id"]
-                if isinstance(tid, bytes):
-                    # Normalize at ingest: every reporter (worker buffer,
-                    # raylet, GCS-side stamps) must land on ONE record per
-                    # task, whatever id form it sends.
-                    tid = tid.hex()
-                status = ev["status"]
-                ts = ev["ts"]
-                rec = self._tasks.get(tid)
-                if rec is None:
-                    if len(self._tasks) >= self._max:
-                        self._tasks.pop(next(iter(self._tasks)), None)
-                    rec = self._tasks[tid] = {
-                        "task_id": tid,
-                        "name": ev.get("name", ""),
-                        "kind": ev.get("kind", 0),
-                        "events": {},
-                    }
-                self._observe_stages(rec, ev, status, ts)
-                if status == LEASED:
-                    # Both the raylet (at grant) and the owner (at
-                    # dispatch) report LEASED: keep the earliest — the
-                    # actual grant time.
-                    rec["events"].setdefault(status, ts)
+            for wire in events:
+                if wire.get("transitions"):
+                    for ev in expand_event(wire):
+                        self._ingest_locked(ev)
                 else:
-                    rec["events"][status] = ts
-                rec["name"] = ev.get("name") or rec["name"]
-                for key in ("worker_id", "node_id", "error", "trace_id"):
-                    if ev.get(key):
-                        rec[key] = ev[key]
+                    self._ingest_locked(wire)
+
+    def _ingest_locked(self, ev: dict) -> None:
+        tid = ev["task_id"]
+        if isinstance(tid, bytes):
+            # Normalize at ingest: every reporter (worker buffer,
+            # raylet, GCS-side stamps) must land on ONE record per
+            # task, whatever id form it sends.
+            tid = tid.hex()
+        status = ev["status"]
+        ts = ev["ts"]
+        rec = self._tasks.get(tid)
+        if rec is None:
+            if len(self._tasks) >= self._max:
+                self._tasks.pop(next(iter(self._tasks)), None)
+            rec = self._tasks[tid] = {
+                "task_id": tid,
+                "name": ev.get("name", ""),
+                "kind": ev.get("kind", 0),
+                "events": {},
+            }
+        self._observe_stages(rec, ev, status, ts)
+        if status == LEASED:
+            # Both the raylet (at grant) and the owner (at
+            # dispatch) report LEASED: keep the earliest — the
+            # actual grant time.
+            rec["events"].setdefault(status, ts)
+        else:
+            rec["events"][status] = ts
+        rec["name"] = ev.get("name") or rec["name"]
+        for key in ("worker_id", "node_id", "error", "trace_id"):
+            if ev.get(key):
+                rec[key] = ev[key]
 
     def _observe_stages(self, rec: dict, ev: dict, status: str, ts: float) -> None:
         if self._on_stage is None:

@@ -68,10 +68,33 @@ class FunctionManager:
     (reference ``python/ray/_private/function_manager.py``)."""
 
     def __init__(self, worker: "CoreWorker"):
+        import weakref
+
         self._worker = worker
         self._exported: set[bytes] = set()
         self._cache: dict[bytes, Any] = {}
+        # Submit-hot-path memo: ``export`` must cloudpickle the function on
+        # EVERY call just to compute its content hash — 100k no-op submits
+        # would pay 100k pickles. Keyed weakly on the live object (a
+        # collected function frees its slot, so a recycled id can never
+        # alias), one pickle per function definition — the reference's
+        # export-once semantics.
+        self._memo: "weakref.WeakKeyDictionary[Any, bytes]" = weakref.WeakKeyDictionary()
         self._lock = threading.Lock()
+
+    def export_cached(self, fn: Any, tag: str) -> bytes:
+        try:
+            fid = self._memo.get(fn)
+        except TypeError:  # unhashable/unweakrefable callable
+            return self.export((fn, tag))
+        if fid is not None:
+            return fid
+        fid = self.export((fn, tag))
+        try:
+            self._memo[fn] = fid
+        except TypeError:
+            pass
+        return fid
 
     def export(self, fn: Any) -> bytes:
         payload = cloudpickle.dumps(fn)
@@ -197,6 +220,12 @@ class _ActorState:
         self.incarnation = 0
         self.client: RpcClient | None = None
         self.death_cause = ""
+        # True only when THIS process created the actor with
+        # max_concurrency=1 and no concurrency groups: calls execute
+        # strictly serially, so a burst may ride one PushActorTasks RPC
+        # without changing overlap semantics. None = unknown (handle
+        # received from elsewhere) — never batch those.
+        self.serialized: bool | None = None
         self.lock = threading.Lock()
 
 
@@ -252,9 +281,23 @@ class CoreWorker:
         # over its size bound — it cannot accumulate forever
         self._cancelled_inbound: dict[bytes, None] = {}
         self._pipelines: dict[tuple, int] = {}
+        # Per-shape-key lease-acquisition gate (io-loop only): while one
+        # pipeline's multiplexed RequestWorkerLease is in flight, sibling
+        # pipelines park here and take grants from its reply instead of
+        # issuing their own RPC.
+        self._lease_gates: dict[tuple, dict] = {}
         self._spread_salt = 0
         self._queue_lock = threading.Lock()
         self._actors: dict[bytes, _ActorState] = {}
+        # Actor-call submit fast path: specs queue here and the io loop is
+        # woken ONCE per burst — run_coroutine_threadsafe's self-pipe
+        # write per call is ~0.4 ms of pure syscall, the single biggest
+        # cost of a tight actor-call loop before PR 6.
+        from collections import deque as _deque
+
+        self._actor_submit_q: "_deque" = _deque()
+        self._actor_submit_active = False
+        self._actor_submit_lock = threading.Lock()
         self._node_table: dict[str, dict] = {}
         # Actor-handle GC: non-detached, unnamed actors die when the last
         # handle in the owning process is dropped (reference actor.py
@@ -867,7 +910,7 @@ class CoreWorker:
         cfg = get_config()
         streaming = num_returns == "streaming"
         n_returns = -1 if streaming else num_returns
-        fid = self.functions.export((fn, "task"))
+        fid = self.functions.export_cached(fn, "task")
         task_id = self.next_task_id()
         spec = TaskSpec(
             task_id=task_id.binary(),
@@ -1040,31 +1083,141 @@ class CoreWorker:
                 self._pipelines[key] = active + 1
                 self.io.run_coro(self._lease_pipeline(key))
 
-    async def _lease_pipeline(self, key: tuple) -> None:
+    def _lease_want(self, key: tuple, extra_waiters: int) -> int:
+        """How many workers one RequestWorkerLease should ask for: enough
+        for the pipelines parked on this key plus the queue's depth, up to
+        ``lease_grant_batch_size``. Spread keys are salted per task (one
+        spec per key) — never multiplex those."""
+        cap = get_config().lease_grant_batch_size
+        if cap <= 1 or key[-1]:
+            return 1
+        with self._queue_lock:
+            queued = len(self._task_queues.get(key) or ())
+        return max(1, min(cap, max(1 + extra_waiters, queued)))
+
+    # How long a pipeline parks on a sibling's in-flight lease RPC before
+    # de-coalescing and issuing its own. Fast-path replies land in
+    # milliseconds, so coalescing keeps its win there; a leader stuck on a
+    # dropped reply or a slow worker spawn must NOT hold every other
+    # pipeline hostage for its full RPC timeout — under faults the owner
+    # degrades to the old one-RPC-per-pipeline concurrency.
+    _LEASE_GATE_WAIT_S = 0.5
+
+    async def _acquire_lease_shared(self, key: tuple, spec: TaskSpec):
+        """Coalesce same-shape lease acquisition across this owner's
+        pipelines: one leader RPC requests workers for everyone parked on
+        the key; followers receive grants from the leader's reply instead
+        of each paying ``_acquire_lease``'s serial round trip. Returns
+        ``(leases, reason)`` like ``_acquire_lease`` — the caller owns
+        every returned lease (extras beyond the first come from
+        multiplexed grants the waiters didn't absorb)."""
+        import asyncio
+
+        if get_config().lease_grant_batch_size <= 1 or key[-1]:
+            # Multiplexing off (or a salted spread key, one spec per key):
+            # the legacy fully-concurrent one-RPC-per-pipeline protocol.
+            return await self._acquire_lease(spec)
+        while True:
+            gate = self._lease_gates.get(key)
+            if gate is not None:
+                fut: asyncio.Future = asyncio.get_running_loop().create_future()
+                gate["waiters"].append(fut)
+                try:
+                    outcome, value = await asyncio.wait_for(
+                        asyncio.shield(fut), self._LEASE_GATE_WAIT_S)
+                except asyncio.TimeoutError:
+                    if fut in gate["waiters"]:
+                        gate["waiters"].remove(fut)
+                    if fut.done():  # resolved in the race window
+                        outcome, value = fut.result()
+                    else:
+                        fut.cancel()
+                        return await self._acquire_lease(
+                            spec, num_workers=self._lease_want(key, 0))
+                if outcome == "lease":
+                    return [value], ""
+                if outcome == "denied":
+                    return None, value
+                continue  # grants ran out before our turn: try again
+            gate = {"waiters": []}
+            self._lease_gates[key] = gate
+            try:
+                leases, reason = await self._acquire_lease(
+                    spec, num_workers=self._lease_want(key, 0))
+            finally:
+                self._lease_gates.pop(key, None)
+            waiters = gate["waiters"]
+            if leases is None:
+                for f in waiters:
+                    if not f.done():
+                        f.set_result(("denied", reason))
+                return None, reason
+            keep, extras = [leases[0]], leases[1:]
+            for f in waiters:
+                if f.done():
+                    continue
+                if extras:
+                    f.set_result(("lease", extras.pop(0)))
+                else:
+                    f.set_result(("retry", None))
+            keep.extend(extras)
+            return keep, ""
+
+    async def _return_lease(self, lease) -> None:
+        """Give an unused multiplexed grant back to its raylet."""
+        _addr, worker_id, client, owns = lease
+        try:
+            await client.call("ReturnWorker", {"worker_id": worker_id},
+                              timeout=10.0)
+        except Exception:
+            pass
+        if owns:
+            await client.close()
+
+    async def _lease_pipeline(self, key: tuple, preacquired=None) -> None:
         """One lease worker: acquire a lease, drain the queue, return it
         (NormalTaskSubmitter::RequestNewWorkerIfNeeded, :291).
+        ``preacquired`` carries a multiplexed grant handed over by a
+        sibling pipeline — the first iteration skips acquisition.
 
         Invariant: once a spec is popped from the queue it is ALWAYS resolved
         — completed, re-enqueued for retry, or failed — on every exit path,
         including cancellation and unexpected exceptions."""
         try:
             while True:
-                with self._queue_lock:
-                    if not self._task_queues.get(key):
-                        return
-                    probe_spec = self._task_queues[key][0]
-                lease = await self._acquire_lease(probe_spec)
-                if lease is None:
+                if preacquired is not None:
+                    leases, preacquired = [preacquired], None
+                else:
                     with self._queue_lock:
-                        queue = self._task_queues.get(key) or []
-                        specs, self._task_queues[key] = list(queue), []
-                    reason = getattr(self, "_last_lease_denial", "") or \
-                        "cluster infeasible or timeout"
-                    for spec in specs:
-                        self._fail_task(spec, RayTpuError(
-                            f"Failed to lease a worker ({reason})"))
-                    return
-                worker_addr, worker_id, raylet_client = lease
+                        if not self._task_queues.get(key):
+                            return
+                        probe_spec = self._task_queues[key][0]
+                    leases, reason = await self._acquire_lease_shared(key, probe_spec)
+                    if leases is None:
+                        with self._queue_lock:
+                            queue = self._task_queues.get(key) or []
+                            specs, self._task_queues[key] = list(queue), []
+                        reason = reason or "cluster infeasible or timeout"
+                        for spec in specs:
+                            self._fail_task(spec, RayTpuError(
+                                f"Failed to lease a worker ({reason})"))
+                        return
+                # Extra multiplexed grants: hand each to a fresh pipeline
+                # (bounded by the per-key cap); grants the cap or an
+                # emptied queue leave unused go straight back.
+                for lease in leases[1:]:
+                    spawned = False
+                    with self._queue_lock:
+                        cap = get_config().max_pending_lease_requests_per_scheduling_category
+                        if (self._task_queues.get(key)
+                                and self._pipelines.get(key, 0) < cap):
+                            self._pipelines[key] = self._pipelines.get(key, 0) + 1
+                            spawned = True
+                    if spawned:
+                        self.io.run_coro(self._lease_pipeline(key, preacquired=lease))
+                    else:
+                        await self._return_lease(lease)
+                worker_addr, worker_id, raylet_client, owns_client = leases[0]
                 worker = RpcClient(worker_addr)
                 # Spread tasks salt the key per task (key[-1] != 0): their
                 # queue can never refill, so skip the grace.
@@ -1075,38 +1228,18 @@ class CoreWorker:
                 # — two 1s tasks in one batch take 2s on one worker while
                 # other leased workers idle. Start at 1 and ramp up only
                 # while observed per-task time stays well under the RPC
-                # overhead scale; any slow batch resets to 1.
+                # overhead scale; any slow batch resets to 1
+                # (_next_push_batch).
                 cur_batch = 1
 
                 pipeline_cap = get_config().max_pending_lease_requests_per_scheduling_category
-
-                def _pop_batch(queue) -> list:
-                    # Batched pushes defer every reply to the end of the
-                    # batch, so a spec with an ObjectRef arg must go ALONE:
-                    # its dependency may be an earlier task of the same
-                    # batch, whose result only reaches the owner with the
-                    # reply — batching them would deadlock the chain.
-                    # A SHORT queue (fewer specs than pipelines allowed)
-                    # is parallel opportunity, not batching material: other
-                    # lease pipelines can run those specs on other workers
-                    # concurrently — only batch genuine backlog.
-                    limit = cur_batch if len(queue) > pipeline_cap else 1
-                    specs: list = []
-                    while queue and len(specs) < limit:
-                        has_ref = any(
-                            e.get("t") == "r" for e in queue[0].args)
-                        if has_ref and specs:
-                            break
-                        specs.append(queue.pop(0))
-                        if has_ref:
-                            break
-                    return specs
 
                 try:
                     while True:
                         with self._queue_lock:
                             queue = self._task_queues.get(key)
-                            specs = _pop_batch(queue) if queue else []
+                            specs = _pop_push_batch(
+                                queue, cur_batch, pipeline_cap) if queue else []
                         if not specs:
                             # Drained: hold the lease for a short grace so
                             # an immediate next submit reuses it (sync
@@ -1119,7 +1252,8 @@ class CoreWorker:
                                     with self._queue_lock:
                                         queue = self._task_queues.get(key)
                                         if queue:
-                                            specs = _pop_batch(queue)
+                                            specs = _pop_push_batch(
+                                                queue, cur_batch, pipeline_cap)
                             if not specs:
                                 break
                         try:
@@ -1127,10 +1261,8 @@ class CoreWorker:
                             worker_alive = await self._push_and_complete_batch(
                                 specs, worker, worker_id)
                             per_task = (time.monotonic() - push_t0) / len(specs)
-                            if per_task < 0.005:
-                                cur_batch = min(push_batch_cap, cur_batch * 4)
-                            else:
-                                cur_batch = 1
+                            cur_batch = _next_push_batch(
+                                cur_batch, per_task, push_batch_cap)
                         except BaseException as e:
                             # Never lose a popped spec: cancellation and
                             # unexpected errors fail them visibly.
@@ -1148,7 +1280,7 @@ class CoreWorker:
                         await raylet_client.call("ReturnWorker", {"worker_id": worker_id}, timeout=10.0)
                     except Exception:
                         pass
-                    if raylet_client is not self.raylet:
+                    if owns_client:
                         await raylet_client.close()
         finally:
             with self._queue_lock:
@@ -1162,11 +1294,21 @@ class CoreWorker:
                     self._pipelines.pop(key, None)
                     self._task_queues.pop(key, None)
 
-    async def _acquire_lease(self, spec: TaskSpec):
+    async def _acquire_lease(self, spec: TaskSpec, num_workers: int = 1):
         """Follow the lease/spillback protocol. A dead spillback target (its
         raylet unreachable) sends us back to the local raylet for a fresh
         placement — nodes can die between the spill decision and the hop —
-        until an overall deadline expires."""
+        until an overall deadline expires.
+
+        Returns ``(leases, reason)``: ``leases`` is a list of
+        ``(worker_address, worker_id, raylet_client, owns_client)`` tuples
+        — the first is the caller's; extras come from multiplexed grants
+        (``num_workers`` > 1) and, when granted by a spillback raylet,
+        each carry their own client — or ``None`` with the denial reason.
+        The reason is RETURNED, never stashed on the instance: concurrent
+        acquires for other scheduling keys must not see each other's
+        denials (the old ``_last_lease_denial`` attribute raced exactly
+        that way)."""
         import asyncio
 
         cfg = get_config()
@@ -1182,16 +1324,18 @@ class CoreWorker:
         lease_rpc_timeout = (cfg.worker_register_timeout_s
                              + min(10.0, cfg.worker_register_timeout_s))
         raylet = self.raylet
-        self._last_lease_denial = ""  # never report a stale reason
+        raylet_addr = self.raylet_address
         try:
             while time.monotonic() < deadline:
                 for _hop in range(4):
+                    payload = {"spec": spec.to_wire(), "spilled": _hop > 0}
+                    # `spilled` marks follow-up hops so policies that
+                    # redirect (spread) don't ping-pong the lease
+                    if num_workers > 1:
+                        payload["num_workers"] = num_workers
                     try:
                         reply = await raylet.call(
-                            "RequestWorkerLease",
-                            # `spilled` marks follow-up hops so policies that
-                            # redirect (spread) don't ping-pong the lease
-                            {"spec": spec.to_wire(), "spilled": _hop > 0},
+                            "RequestWorkerLease", payload,
                             timeout=lease_rpc_timeout,
                         )
                     except RpcError as e:
@@ -1203,38 +1347,48 @@ class CoreWorker:
                                     time.monotonic()
                                     + cfg.worker_register_timeout_s)
                                 break
-                            return None  # our own raylet is gone
+                            return None, "local raylet unreachable"
                         break  # spill target died: restart from local
                     if reply.get("granted"):
+                        local = raylet is self.raylet
+                        grants = [(reply["worker_address"], reply["worker_id"],
+                                   raylet, not local)]
+                        for g in reply.get("extra_grants") or ():
+                            client = (self.raylet if local
+                                      else RetryableRpcClient(raylet_addr))
+                            grants.append((g["worker_address"], g["worker_id"],
+                                           client, not local))
                         try:
-                            # Confirm receipt of the grant: the raylet
-                            # reclaims leases that are never acked (the
-                            # reply may die on the wire — ROADMAP 1c).
+                            # Confirm receipt of EVERY grant in one RPC:
+                            # the raylet reclaims leases that are never
+                            # acked (the reply may die on the wire —
+                            # ROADMAP 1c).
                             await raylet.call(
                                 "AckLease",
-                                {"worker_id": reply["worker_id"]},
+                                {"worker_id": reply["worker_id"],
+                                 "worker_ids": [g[1] for g in grants[1:]]},
                                 timeout=10.0)
                         except RpcError:
                             pass  # raylet reclaims; the lease still works
-                        lease = reply["worker_address"], reply["worker_id"], raylet
-                        raylet = self.raylet  # returned client kept by caller
-                        return lease
+                        raylet = self.raylet  # returned clients kept by caller
+                        return grants, ""
                     if reply.get("spillback"):
                         if raylet is not self.raylet:
                             await raylet.close()
-                        raylet = RetryableRpcClient(reply["node_address"])
+                        raylet_addr = reply["node_address"]
+                        raylet = RetryableRpcClient(raylet_addr)
                         continue
                     # definitive denial (infeasible / timeout / worker
-                    # start failure): keep the raylet's reason so the
+                    # start failure): return the raylet's reason so the
                     # task error names the actual cause (e.g. a
                     # runtime_env plugin setup failure)
-                    self._last_lease_denial = reply.get("reason", "")
-                    return None
+                    return None, reply.get("reason", "")
                 if raylet is not self.raylet:
                     await raylet.close()
                     raylet = self.raylet
+                    raylet_addr = self.raylet_address
                 await asyncio.sleep(0.5)
-            return None
+            return None, ""
         finally:
             if raylet is not self.raylet:
                 await raylet.close()
@@ -1436,7 +1590,7 @@ class CoreWorker:
             self._task_counter += 1
             counter = self._task_counter
         actor_id = ActorID.of(self.job_id, self.current_task_id, counter)
-        fid = self.functions.export((cls, "actor"))
+        fid = self.functions.export_cached(cls, "actor")
         task_id = TaskID.for_actor_creation_task(actor_id)
         res = dict(resources or {})
         if num_cpus is not None:
@@ -1467,7 +1621,10 @@ class CoreWorker:
         )
         if reply.get("error"):
             raise RayTpuError(reply["error"])
-        self._actors[actor_id.binary()] = _ActorState(actor_id.binary())
+        state = _ActorState(actor_id.binary())
+        state.serialized = (max_concurrency <= 1
+                            and not spec.concurrency_groups)
+        self._actors[actor_id.binary()] = state
         return actor_id.binary()
 
     def _actor_state(self, actor_id: bytes) -> _ActorState:
@@ -1521,8 +1678,106 @@ class CoreWorker:
             self.refcounter.add_owned_object(rid)
         self.task_manager.add_pending(spec, return_ids)
         self._record_submit(spec)
-        self.io.run_coro(self._submit_actor_task_async(spec))
+        wake = False
+        with self._actor_submit_lock:
+            self._actor_submit_q.append(spec)
+            if not self._actor_submit_active:
+                self._actor_submit_active = True
+                wake = True
+        if wake:
+            self.io.run_coro(self._drain_actor_submits())
         return [ObjectRef(rid, self.address) for rid in return_ids]
+
+    async def _drain_actor_submits(self) -> None:
+        """Dispatch queued actor-task specs on the io loop, in submission
+        order (seq numbers were assigned in ``submit_actor_task``, and the
+        executor's per-caller buffer reorders stragglers anyway). Exits
+        only after observing an empty queue under the lock, so a producer
+        that appends after the last pop always sees ``active`` and wakes a
+        new drainer.
+
+        Specs addressed to the same SERIALIZED actor that are queued in
+        the same sweep coalesce into one ``PushActorTasks`` RPC (executed
+        strictly in seq order executor-side): a burst of K calls pays one
+        wire round trip and one worker wakeup instead of K — the
+        actor-call sibling of the normal-task push batch."""
+        import asyncio
+
+        batch_cap = get_config().task_push_batch_size
+        while True:
+            with self._actor_submit_lock:
+                if not self._actor_submit_q:
+                    self._actor_submit_active = False
+                    return
+                sweep = list(self._actor_submit_q)
+                self._actor_submit_q.clear()
+            groups: dict[bytes, list] = {}
+            order: list[bytes] = []
+            for spec in sweep:
+                if spec.actor_id not in groups:
+                    groups[spec.actor_id] = []
+                    order.append(spec.actor_id)
+                groups[spec.actor_id].append(spec)
+            for aid in order:
+                specs = groups[aid]
+                batchable = (len(specs) > 1
+                             and self._actors.get(aid) is not None
+                             and self._actors[aid].serialized)
+                if not batchable:
+                    for spec in specs:
+                        asyncio.ensure_future(self._submit_actor_task_async(spec))
+                    continue
+                for i in range(0, len(specs), batch_cap):
+                    asyncio.ensure_future(
+                        self._submit_actor_batch_async(specs[i:i + batch_cap]))
+            # Let the dispatched sends make progress mid-burst.
+            await asyncio.sleep(0)
+
+    async def _submit_actor_batch_async(self, specs: list, attempts: int = 3) -> None:
+        """Batched sibling of ``_submit_actor_task_async``: one
+        PushActorTasks RPC for K in-seq-order calls to one serialized
+        actor; per-spec replies settle exactly like the single path."""
+        if len(specs) == 1:
+            await self._submit_actor_task_async(specs[0])
+            return
+        state = self._actor_state(specs[0].actor_id)
+        try:
+            address = await self._resolve_actor(state)
+        except ActorDiedError as e:
+            for spec in specs:
+                self._fail_task(spec, e)
+            return
+        with state.lock:
+            for spec in specs:
+                if getattr(spec, "_incarnation", state.incarnation) != state.incarnation:
+                    spec.seq_no = state.seq_no
+                    state.seq_no += 1
+                    spec._incarnation = state.incarnation
+        try:
+            if state.client is None or state.client.address != address:
+                state.client = RpcClient(address)
+            reply = await state.client.call(
+                "PushActorTasks", {"specs": [s.to_wire() for s in specs]},
+                timeout=None)
+            for spec, r in zip(specs, reply["replies"]):
+                if r.get("error"):
+                    self._fail_task(spec, RayTpuError(r["error"]))
+                else:
+                    self._handle_task_reply(spec, r)
+        except RpcError as e:
+            with state.lock:
+                if state.address == address:  # first observer of this death
+                    state.incarnation += 1
+                    state.seq_no = 0
+                    state.address = ""
+                    state.client = None
+            if getattr(e, "undelivered", False) and attempts > 0:
+                await self._submit_actor_batch_async(specs, attempts - 1)
+                return
+            for spec in specs:
+                self._fail_task(
+                    spec, ActorDiedError(spec.actor_id.hex(),
+                                         f"actor died while executing {spec.name}: {e}"))
 
     async def _submit_actor_task_async(self, spec: TaskSpec, attempts: int = 3) -> None:
         state = self._actor_state(spec.actor_id)
@@ -1990,6 +2245,21 @@ class CoreWorker:
             return await self._execute_actor_task(spec, loop)
         return await loop.run_in_executor(None, self._execute_task, spec)
 
+    async def handle_PushActorTasks(self, p: dict) -> dict:
+        """Batched PushTask for ACTOR tasks: K in-order calls from one
+        caller to this (serialized) actor in one RPC. Each spec still
+        passes through the per-caller sequencing buffer and the actor
+        semaphore — execution semantics are identical to K single pushes
+        on the same ordered connection; only the wire round trips and
+        process wakeups collapse."""
+        import asyncio
+
+        self._pushes_total += 1
+        specs = [TaskSpec.from_wire(w) for w in p["specs"]]
+        loop = asyncio.get_running_loop()
+        return {"replies": [await self._execute_actor_task(spec, loop)
+                            for spec in specs]}
+
     async def handle_PushTasks(self, p: dict) -> dict:
         """Batched PushTask for normal tasks: K specs in one RPC, executed
         sequentially in ONE executor-thread hop, K replies in one response.
@@ -2355,6 +2625,41 @@ def asyncio_sleep(t: float):
     import asyncio
 
     return asyncio.sleep(t)
+
+
+def _pop_push_batch(queue: list, cur_batch: int, pipeline_cap: int) -> list:
+    """Pop the next push batch off a lease pipeline's queue. Load-bearing
+    invariants (unit-tested in test_core_throughput.py):
+
+    * Batched pushes defer every reply to the end of the batch, so a spec
+      with an ObjectRef arg must ship ALONE: its dependency may be an
+      earlier task of the same batch, whose result only reaches the owner
+      with the reply — batching them would deadlock the chain.
+    * A SHORT queue (no more specs than pipelines allowed) is parallel
+      opportunity, not batching material: other lease pipelines can run
+      those specs on other workers concurrently — only batch genuine
+      backlog.
+    """
+    limit = cur_batch if len(queue) > pipeline_cap else 1
+    specs: list = []
+    while queue and len(specs) < limit:
+        has_ref = any(e.get("t") == "r" for e in queue[0].args)
+        if has_ref and specs:
+            break
+        specs.append(queue.pop(0))
+        if has_ref:
+            break
+    return specs
+
+
+def _next_push_batch(cur_batch: int, per_task_s: float, cap: int) -> int:
+    """Adaptive push-batch ramp: grow (×4 up to ``cap``) only while the
+    observed per-task time stays well under the RPC-overhead scale; ANY
+    slow batch resets to 1 — a batch serializes execution on one worker,
+    so batching slow tasks wastes every other leased worker."""
+    if per_task_s < 0.005:
+        return min(cap, cur_batch * 4)
+    return 1
 
 
 def _iter_generator(gen):

@@ -21,6 +21,7 @@ from .dataset import (
     read_binary_files,
     read_images,
     read_tfrecords,
+    read_webdataset,
     read_csv,
     read_json,
     read_numpy,
@@ -47,6 +48,7 @@ __all__ = [
     "read_binary_files",
     "read_images",
     "read_tfrecords",
+    "read_webdataset",
     "read_csv",
     "read_json",
     "read_numpy",

@@ -263,6 +263,18 @@ class Dataset:
                 for row in BlockAccessor.for_block(block).iter_rows():
                     write_record(f, encode_example(row))
 
+    def write_webdataset(self, path: str) -> None:
+        """Tar shards in the webdataset layout (one member per column per
+        row, grouped by key — webdataset.py; one shard per block)."""
+        from .webdataset import write_shard
+
+        start = 0
+        for i, block in enumerate(self._iter_blocks()):
+            with ds.open_output(path, f"part-{i:05d}.tar") as f:
+                start += write_shard(
+                    f, BlockAccessor.for_block(block).iter_rows(),
+                    start_index=start)
+
     def __repr__(self):
         return f"Dataset(ops={[o.name for o in self._last_op.chain()]})"
 
@@ -410,6 +422,16 @@ def read_tfrecords(paths) -> Dataset:
     from .tfrecords import tfrecords_tasks
 
     return Dataset(L.Read("read_tfrecords", read_tasks=tfrecords_tasks(paths)))
+
+
+def read_webdataset(paths) -> Dataset:
+    """WebDataset tar shards: members group into samples by basename
+    stem, decoded by extension (json/txt/cls/... — bytes otherwise), with
+    the stem in a ``__key__`` column. One streaming read task per shard
+    (reference ``datasource/webdataset_datasource.py``)."""
+    from .webdataset import webdataset_tasks
+
+    return Dataset(L.Read("read_webdataset", read_tasks=webdataset_tasks(paths)))
 
 
 def from_huggingface(hf_dataset, *, parallelism: int = 8) -> Dataset:

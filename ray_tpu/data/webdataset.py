@@ -1,0 +1,133 @@
+"""WebDataset datasource: tar-sharded sample archives (ROADMAP item 8).
+
+Reference: ``python/ray/data/_internal/datasource/webdataset_datasource.py``
+and the webdataset convention itself: a shard is a plain ``.tar`` file
+whose members group into samples by basename stem — ``000017.jpg``,
+``000017.txt`` and ``000017.json`` are one sample with columns ``jpg``,
+``txt`` and ``json``. Members of one sample are stored contiguously, so
+shards stream sequentially (the property that makes the format fast on
+object stores; no random access needed — we read with ``tarfile`` in
+streaming mode).
+
+Decoding is by extension, mirroring the reference's default decoder
+table: ``json`` → parsed object, text-ish extensions → ``str``,
+``cls``/``cls2``/``index`` → ``int``, everything else stays raw
+``bytes``. An extra ``__key__`` column carries the sample stem.
+
+Writing inverts the mapping: every row becomes one basename stem, every
+column one member named ``<key>.<column>`` (bytes written raw, str as
+UTF-8, anything else as JSON).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import posixpath
+import tarfile
+from typing import Callable
+
+_TEXT_EXTS = {"txt", "text", "transcript", "caption", "cap"}
+_INT_EXTS = {"cls", "cls2", "index", "label"}
+
+
+def _decode_member(ext: str, payload: bytes):
+    if ext == "json":
+        return json.loads(payload.decode())
+    if ext in _TEXT_EXTS:
+        return payload.decode()
+    if ext in _INT_EXTS:
+        return int(payload.decode().strip())
+    return payload
+
+
+def _encode_member(ext: str, value) -> bytes:
+    if isinstance(value, bytes):
+        return value
+    if isinstance(value, str):
+        return value.encode()
+    if hasattr(value, "tolist"):  # numpy scalar/array
+        value = value.tolist()
+    if ext in _INT_EXTS and isinstance(value, int):
+        return str(value).encode()
+    return json.dumps(value).encode()
+
+
+def _split_member(name: str) -> tuple[str, str]:
+    """``dir/000017.seg.json`` → (stem ``dir/000017``, ext ``seg.json``
+    lowered to its last component for decoding). The FIRST dot after the
+    basename starts the extension (webdataset convention: extensions may
+    themselves be dotted)."""
+    dirname, _, base = name.rpartition("/")
+    stem, dot, ext = base.partition(".")
+    if dirname:
+        stem = f"{dirname}/{stem}"
+    return stem, ext if dot else ""
+
+
+def iter_samples(fileobj) -> "list[dict]":
+    """Group a tar stream's members into samples by stem, in order."""
+    samples: list[dict] = []
+    current_key: str | None = None
+    current: dict = {}
+    with tarfile.open(fileobj=fileobj, mode="r|*") as tf:
+        for member in tf:
+            if not member.isfile():
+                continue
+            stem, ext = _split_member(member.name)
+            if not ext:
+                continue
+            if stem != current_key:
+                if current:
+                    samples.append(current)
+                current_key, current = stem, {"__key__": stem}
+            payload = tf.extractfile(member).read()
+            current[ext] = _decode_member(ext.rpartition(".")[2].lower(),
+                                          payload)
+    if current:
+        samples.append(current)
+    return samples
+
+
+def webdataset_tasks(paths) -> list[Callable]:
+    """One read task per tar shard (the reference's file-parallel split)."""
+    from . import datasource as ds
+
+    def make(fs, path):
+        def task():
+            import pyarrow as pa
+
+            with fs.open_input_stream(path) as f:
+                # tarfile streaming mode wants a file-like with read();
+                # pyarrow streams provide it directly.
+                rows = iter_samples(f)
+            cols: dict[str, list] = {}
+            for r in rows:
+                for k in r:
+                    cols.setdefault(k, [])
+            for r in rows:
+                for k, col in cols.items():
+                    col.append(r.get(k))
+            return pa.table(cols) if cols else pa.table({})
+        return task
+
+    return [make(fs, path) for fs, path in ds._expand_paths(paths)]
+
+
+def write_shard(stream, rows, *, start_index: int = 0) -> int:
+    """Write rows as one webdataset tar shard; returns rows written.
+    Row keys come from a ``__key__`` column when present, else zero-padded
+    sequence numbers."""
+    count = 0
+    with tarfile.open(fileobj=stream, mode="w") as tf:
+        for i, row in enumerate(rows):
+            key = row.get("__key__") or f"{start_index + i:08d}"
+            for col, value in row.items():
+                if col == "__key__" or value is None:
+                    continue
+                payload = _encode_member(col.rpartition(".")[2].lower(), value)
+                info = tarfile.TarInfo(name=f"{key}.{col}")
+                info.size = len(payload)
+                tf.addfile(info, io.BytesIO(payload))
+            count += 1
+    return count

@@ -23,9 +23,10 @@ itself) fall back to a bounded process-local buffer readable via
 from __future__ import annotations
 
 import contextlib
+import os
+import random
 import threading
 import time
-import uuid
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -38,12 +39,33 @@ _local_spans: list[dict] = []
 _LOCAL_MAX = 4096
 
 
+# Id generation is ON the task-submit hot path (one trace id + one span
+# id per submit): uuid4 costs an os.urandom syscall each — ~60% of a
+# 100k-no-op submit loop's wall time before PR 6. A process-seeded
+# Random gives the same 128/64 bits of collision resistance for tracing
+# purposes at ~30x less cost (os.urandom seeds it once; forked workers
+# reseed via the pid mix so children never replay the parent's stream).
+_id_rng = random.Random()
+_id_rng.seed(int.from_bytes(os.urandom(16), "big") ^ os.getpid())
+_id_pid = os.getpid()
+_id_lock = threading.Lock()
+
+
+def _id_hex(bits: int) -> str:
+    global _id_pid
+    with _id_lock:
+        if os.getpid() != _id_pid:  # forked child: never replay the parent
+            _id_rng.seed(int.from_bytes(os.urandom(16), "big") ^ os.getpid())
+            _id_pid = os.getpid()
+        return f"{_id_rng.getrandbits(bits):0{bits // 4}x}"
+
+
 def new_trace_id() -> str:
-    return uuid.uuid4().hex
+    return _id_hex(128)
 
 
 def new_span_id() -> str:
-    return uuid.uuid4().hex[:16]
+    return _id_hex(64)
 
 
 @dataclass(frozen=True)

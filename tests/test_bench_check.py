@@ -11,6 +11,34 @@ def test_direction_inference():
     assert bench_check._direction("peak_hbm_used_bytes") == "down"
     assert bench_check._direction("flash_fwdbwd_tflops_s4096") == "up"
     assert bench_check._direction("raw_tokens_per_sec") == "up"
+    # throughput rates trump the "_s" lower-better suffix
+    assert bench_check._direction("core_tasks_per_s") == "up"
+    assert bench_check._direction("core_actor_calls_per_s") == "up"
+    assert bench_check._direction("core_obj_roundtrip_per_s") == "up"
+    assert bench_check._direction("serve_tokens_per_sec") == "up"
+    # lease-stage latencies stay lower-better
+    assert bench_check._direction("core_lease_submit_to_lease_p50_ms") == "down"
+
+
+def test_core_metrics_guarded():
+    """ISSUE 6 satellite: a >10% core-metric drop or a silently-vanished
+    core metric fails the bench; config echoes (_cfg) are never tracked."""
+    old = {"core_tasks_per_s": 3439.4, "core_actor_calls_per_s": 1973.8,
+           "core_obj_roundtrip_per_s": 27682.9, "core_tasks_cfg": 20000}
+    # a 20% tasks drop regresses; cfg echo resized without complaint
+    new = {"core_tasks_per_s": 2751.5, "core_actor_calls_per_s": 1990.0,
+           "core_obj_roundtrip_per_s": 27000.0, "core_tasks_cfg": 50000}
+    result = bench_check.compare(old, new)
+    assert {r["metric"] for r in result["regressions"]} == {"core_tasks_per_s"}
+    assert not result["missing"]
+    # a vanished core metric is flagged even when the others improved
+    new2 = {"core_tasks_per_s": 5000.0, "core_actor_calls_per_s": 2500.0}
+    result2 = bench_check.compare(old, new2)
+    assert {r["metric"] for r in result2["missing"]} == {
+        "core_obj_roundtrip_per_s"}
+    # an INCREASE in a rate is an improvement, never a regression
+    assert {r["metric"] for r in result2["improvements"]} == {
+        "core_tasks_per_s", "core_actor_calls_per_s"}
 
 
 def test_compare_flags_drops_and_missing():

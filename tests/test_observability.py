@@ -42,7 +42,13 @@ def test_task_events_reach_state_api():
     deadline = time.monotonic() + 30
     while time.monotonic() < deadline:
         tasks = [t for t in state.list_tasks() if t["name"] == "traced_task"]
-        if tasks and tasks[-1]["state"] == "FINISHED":
+        # Owner (SUBMITTED/terminal) and executor (RUNNING/FINISHED)
+        # events ride two DIFFERENT processes' flush cadences: poll until
+        # the record is COMPLETE, not merely terminal — breaking on the
+        # executor's FINISHED alone raced the owner's flush by up to one
+        # interval (pre-existing flake, seen whenever the phase aligned).
+        if (tasks and tasks[-1]["state"] == "FINISHED"
+                and "SUBMITTED" in tasks[-1]["events"]):
             break
         time.sleep(0.3)
     assert tasks, "task events never reached the GCS"
