@@ -414,12 +414,16 @@ def run_serve_bench() -> dict:
     decode_k = int(os.environ.get("RAY_TPU_SERVE_DECODE_K", "32"))
     reqs_per_client = int(os.environ.get("RAY_TPU_SERVE_REQS", "6"))
     max_tokens = int(os.environ.get("RAY_TPU_SERVE_MAX_TOKENS", "64"))
+    # max_len must cover the matrix's 2k-token prompt cell (+ generation
+    # headroom); the decode cost stays proportional to LIVE context (the
+    # live_pages bucketing), so the short-prompt phases don't pay for it.
+    max_len = int(os.environ.get("RAY_TPU_SERVE_MAX_LEN", "2560"))
 
     ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
     app = build_llm_app(
         preset,
         max_slots=8,
-        max_len=512,
+        max_len=max_len,
         page_size=64,
         prefill_chunk_size=256,
         # 32 fused decode steps per dispatch: the axon dispatch channel
@@ -453,6 +457,9 @@ def run_serve_bench() -> dict:
     addr = serve.http_address()
 
     def one_request(prompt: str, timeout: float = 600.0):
+        """Returns (ttft_s, n_tokens, wall_s, itl_gaps_s): itl_gaps are
+        the client-observed delays between consecutive SSE token events —
+        the inter-token latency the mixed-dispatch scheduler bounds."""
         body = json.dumps({"prompt": prompt, "max_tokens": max_tokens,
                            "stream": True}).encode()
         req = urllib.request.Request(
@@ -460,15 +467,21 @@ def run_serve_bench() -> dict:
             headers={"Content-Type": "application/json"})
         t0 = time.perf_counter()
         ttft = None
+        last_tok = None
+        gaps: list[float] = []
         n_tokens = 0
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             for line in resp:
                 line = line.decode().strip()
                 if line.startswith("data: ") and line != "data: [DONE]":
+                    now = time.perf_counter()
                     if ttft is None:
-                        ttft = time.perf_counter() - t0
+                        ttft = now - t0
+                    else:
+                        gaps.append(now - last_tok)
+                    last_tok = now
                     n_tokens += 1
-        return ttft, n_tokens, time.perf_counter() - t0
+        return ttft, n_tokens, time.perf_counter() - t0, gaps
 
     # Warmup: compile prefill buckets + decode program.
     one_request("w" * 90)
@@ -480,7 +493,7 @@ def run_serve_bench() -> dict:
     ttft_unloaded = []
     for j in range(4):
         try:
-            t, _, _ = one_request(f"unloaded {j}: " + "abcd" * 12)
+            t, _, _, _ = one_request(f"unloaded {j}: " + "abcd" * 12)
         except Exception as e:  # best-effort: the loaded phase still runs
             print(f"unloaded-ttft request failed: {e}", file=sys.stderr)
             continue
@@ -496,7 +509,7 @@ def run_serve_bench() -> dict:
         for j in range(reqs_per_client):
             prompt = f"client {cid} request {j}: " + "abcdefgh" * (8 + (cid + j) % 12)
             try:
-                ttft, n_tok, _ = one_request(prompt)
+                ttft, n_tok, _, _ = one_request(prompt)
             except Exception as e:
                 with lock:
                     errors.append(f"{type(e).__name__}: {e}")
@@ -513,6 +526,7 @@ def run_serve_bench() -> dict:
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
+
     # Server-side TTFT from the serve_ttft_ms histogram (arrival → first
     # sampled token inside the engine): the queueing/SSE-transport share
     # of the client TTFT is the spread between the two numbers. The
@@ -536,6 +550,88 @@ def run_serve_bench() -> dict:
             engine_ttft_p50 = round(q, 1) if q is not None else None
     except Exception as e:
         print(f"engine ttft histogram unavailable: {e}", file=sys.stderr)
+
+    # ---- serve bench MATRIX (ROADMAP item 2 acceptance): concurrency
+    # {8,32} × prompt {short,2k}, each cell recording client p50/p95 TTFT
+    # and the p95 inter-token latency — the number the token-budget mixed
+    # scheduler exists to bound under the 32-way 2k-prompt cell. The 2k
+    # prompts share a system-prompt-style prefix so the cell also
+    # exercises the prefix cache (serve_prefix_cache_hit_rate below).
+    matrix: dict = {}
+    matrix_reqs = int(os.environ.get("RAY_TPU_SERVE_MATRIX_REQS", "3"))
+    cells_env = os.environ.get("RAY_TPU_SERVE_MATRIX_CELLS", "")
+    wanted_cells = {c.strip() for c in cells_env.split(",") if c.strip()}
+    shared_2k_prefix = "You are a helpful assistant. " * 55  # ~1.6k tokens
+    if os.environ.get("RAY_TPU_BENCH_SKIP_SERVE_MATRIX") != "1":
+        for conc in (8, 32):
+            for kind in ("short", "2k"):
+                cell = f"c{conc}_{kind}"
+                if wanted_cells and cell not in wanted_cells:
+                    # Intentionally skipped: record the marker so
+                    # bench_check never treats the cell's metrics as
+                    # silently vanished.
+                    matrix[f"serve_{cell}_skipped"] = True
+                    continue
+                cell_ttfts: list[float] = []
+                cell_gaps: list[float] = []
+                cell_errors: list[str] = []
+
+                def cell_client(cid: int) -> None:
+                    for j in range(matrix_reqs):
+                        if kind == "short":
+                            prompt = f"cell {cell} client {cid} req {j}: " \
+                                + "abcdefgh" * (6 + (cid + j) % 8)
+                        else:
+                            prompt = shared_2k_prefix + \
+                                f"cell {cell} client {cid} req {j}: " \
+                                + "wxyz" * (80 + (cid + j) % 16)
+                        try:
+                            t, _, _, gaps = one_request(prompt)
+                        except Exception as e:
+                            with lock:
+                                cell_errors.append(f"{type(e).__name__}: {e}")
+                            return
+                        with lock:
+                            if t is not None:
+                                cell_ttfts.append(t)
+                            cell_gaps.extend(gaps)
+
+                cthreads = [threading.Thread(target=cell_client, args=(i,))
+                            for i in range(conc)]
+                for t in cthreads:
+                    t.start()
+                for t in cthreads:
+                    t.join()
+                if cell_errors or not cell_ttfts:
+                    matrix[f"serve_{cell}_error"] = "; ".join(cell_errors[:3])
+                    continue
+                cell_ttfts.sort()
+                cell_gaps.sort()
+
+                def pct(sorted_vals, q):
+                    return sorted_vals[max(0, int(len(sorted_vals) * q) - 1)]
+
+                matrix[f"serve_{cell}_p50_ttft_ms"] = round(
+                    1000 * statistics.median(cell_ttfts), 1)
+                matrix[f"serve_{cell}_p95_ttft_ms"] = round(
+                    1000 * pct(cell_ttfts, 0.95), 1)
+                if cell_gaps:
+                    matrix[f"serve_{cell}_p95_itl_ms"] = round(
+                        1000 * pct(cell_gaps, 0.95), 1)
+    # Engine prefix-cache effectiveness (ROADMAP item 5 first step): the
+    # replica's gauge, flushed with the same metrics push as the TTFT
+    # histogram polled above.
+    prefix_hit_rate = None
+    try:
+        from ray_tpu.util.metrics import get_metrics
+
+        time.sleep(6.0)  # one metrics-flusher period: cover the matrix phase
+        vals = [m["value"] for m in get_metrics()
+                if m["name"] == "serve_prefix_cache_hit_rate"]
+        if vals:
+            prefix_hit_rate = round(max(vals), 4)
+    except Exception as e:
+        print(f"prefix cache gauge unavailable: {e}", file=sys.stderr)
     serve.shutdown()
     ray_tpu.shutdown()
     if errors or not ttfts:
@@ -553,6 +649,8 @@ def run_serve_bench() -> dict:
         "serve_concurrency": n_clients,
         "serve_decode_steps_per_dispatch": decode_k,
         "serve_preset": preset,
+        "serve_prefix_cache_hit_rate": prefix_hit_rate,
+        **matrix,
     }
 
 

@@ -70,16 +70,32 @@ def _tracked(name: str, value) -> bool:
     return isinstance(value, (int, float)) and not isinstance(value, bool)
 
 
+def _skip_prefixes(new: dict) -> tuple:
+    """``<prefix>_skipped: true`` markers: the run declares it
+    INTENTIONALLY skipped every ``<prefix>*`` metric (e.g. a serve-matrix
+    cell filtered out via RAY_TPU_SERVE_MATRIX_CELLS). Such metrics are
+    reported as skipped, never as silently vanished."""
+    return tuple(k[: -len("_skipped")] for k, v in new.items()
+                 if k.endswith("_skipped") and v)
+
+
 def compare(old: dict, new: dict, threshold: float = 0.10) -> dict:
     """Returns {"regressions": [...], "improvements": [...],
-    "missing": [...], "ok": [...]} — each row a dict with metric, old,
-    new, change (signed fraction, + = better)."""
-    out = {"regressions": [], "improvements": [], "missing": [], "ok": []}
+    "missing": [...], "skipped": [...], "ok": [...]} — each row a dict
+    with metric, old, new, change (signed fraction, + = better).
+    ``skipped`` rows are absences covered by a ``*_skipped`` marker in
+    the new run (intentional, non-failing)."""
+    out = {"regressions": [], "improvements": [], "missing": [],
+           "skipped": [], "ok": []}
+    skipped = _skip_prefixes(new)
     for name, ov in sorted(old.items()):
         if not _tracked(name, ov):
             continue
         nv = new.get(name)
         if not isinstance(nv, (int, float)) or isinstance(nv, bool):
+            if skipped and name.startswith(skipped):
+                out["skipped"].append({"metric": name, "old": ov, "new": None})
+                continue
             # was measured, now gone: exactly the silent failure mode
             # this guard exists for
             out["missing"].append({"metric": name, "old": ov, "new": None})
@@ -115,6 +131,9 @@ def format_report(result: dict, old_path: str = "old", new_path: str = "new",
     for row in result["missing"]:
         lines.append(f"  MISSING     {row['metric']}: {row['old']} -> "
                      "absent in new run")
+    for row in result.get("skipped", []):
+        lines.append(f"  skipped     {row['metric']}: intentionally "
+                     "skipped in new run (marker present)")
     for row in result["improvements"]:
         lines.append(f"  improved    {row['metric']}: {row['old']} -> "
                      f"{row['new']} ({row['change']:+.1%})")

@@ -145,6 +145,13 @@ def main(argv: list[str] | None = None) -> int:
     bcore.add_argument("--check-against", default=None, metavar="BENCH_JSON",
                        help="run ray_tpu.bench_check against a recorded "
                             "BENCH_r*.json and exit non-zero on regression")
+    serve_p = sub.add_parser(
+        "serve", help="Serve control-plane inspection")
+    serve_sub = serve_p.add_subparsers(dest="serve_cmd", required=True)
+    serve_sub.add_parser(
+        "status", help="apps, deployments, replica counts, autoscaling "
+                       "mode and the recent scale decisions with their "
+                       "trigger metric (TTFT p95 etc.)")
     chaos_p = sub.add_parser(
         "chaos", help="deterministic fault injection (seeded FaultPlans)")
     chaos_sub = chaos_p.add_subparsers(dest="chaos_cmd", required=True)
@@ -283,6 +290,39 @@ def main(argv: list[str] | None = None) -> int:
         from ray_tpu.observability import format_memory_summary
 
         print(format_memory_summary(summary, st.list_nodes()))
+    elif args.cmd == "serve":
+        from ray_tpu import serve as serve_api
+
+        try:
+            status = serve_api.status()
+        except ValueError:
+            print("no Serve instance running")
+            return 1
+        if args.as_json:
+            print(json.dumps(status, indent=2, default=str))
+            return 0
+        if not status:
+            print("no Serve applications deployed")
+            return 0
+        import datetime
+
+        for app, deps in status.items():
+            for name, st in deps.items():
+                mode = st.get("autoscaling_mode") or "static"
+                line = (f"{app}/{name}: {st['running_replicas']}/"
+                        f"{st['target_replicas']} replicas "
+                        f"[{'healthy' if st['healthy'] else 'UNHEALTHY'}] "
+                        f"autoscaling={mode}")
+                if st.get("last_start_failure"):
+                    line += (" last_start_failure="
+                             + str(st["last_start_failure"]).splitlines()[0][:80])
+                print(line)
+                for e in st.get("autoscale_events") or []:
+                    ts = datetime.datetime.fromtimestamp(e["ts"]).strftime(
+                        "%H:%M:%S")
+                    print(f"  [{ts}] scale {e['from']} -> {e['to']} "
+                          f"({e['trigger']}={e['value']} vs target "
+                          f"{e['target']})")
     elif args.cmd == "profile":
         if args.list_profiles:
             rows = st.list_profiles()

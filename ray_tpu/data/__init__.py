@@ -18,6 +18,7 @@ from .dataset import (
     from_numpy,
     from_pandas,
     range,  # noqa: A004
+    read_avro,
     read_binary_files,
     read_images,
     read_tfrecords,
@@ -45,6 +46,7 @@ __all__ = [
     "from_numpy",
     "from_pandas",
     "range",
+    "read_avro",
     "read_binary_files",
     "read_images",
     "read_tfrecords",

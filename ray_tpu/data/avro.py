@@ -1,0 +1,327 @@
+"""Avro datasource: Object Container Files without an avro dependency.
+
+Reference: ``python/ray/data/read_api.py`` ``read_avro`` (which parses
+via the ``fastavro`` package). This module implements the container
+format and a binary codec for the subset a columnar roundtrip needs,
+natively (ROADMAP item 8, closing the readers backlog):
+
+  * Container framing (the Avro 1.11 spec's Object Container File):
+    magic ``Obj\\x01``, a file-metadata map carrying ``avro.schema``
+    (JSON) + ``avro.codec`` (``null`` — no compression dependency), a
+    16-byte sync marker, then blocks of ``count | byte_size | records |
+    sync``.
+  * Binary encoding: zig-zag varint longs, little-endian IEEE doubles,
+    length-prefixed string/bytes, 1-byte booleans, block-encoded arrays,
+    ``["null", T]`` unions for nullable columns, one top-level record
+    per row.
+
+The writer infers the record schema from the rows' columns (long /
+double / boolean / string / bytes, arrays thereof, nullable via union);
+the reader decodes any schema built from those primitives.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import struct
+from typing import Callable, Iterator
+
+MAGIC = b"Obj\x01"
+
+# --------------------------------------------------------------- primitives
+
+
+def _write_long(out: bytearray, value: int) -> None:
+    """Zig-zag varint (the Avro ``long`` wire format)."""
+    n = (value << 1) ^ (value >> 63)
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _read_long(stream) -> int:
+    result = shift = 0
+    while True:
+        raw = stream.read(1)
+        if not raw:
+            raise EOFError("truncated avro long")
+        b = raw[0]
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return (result >> 1) ^ -(result & 1)
+
+
+def _write_bytes(out: bytearray, value: bytes) -> None:
+    _write_long(out, len(value))
+    out += value
+
+
+def _read_bytes(stream) -> bytes:
+    n = _read_long(stream)
+    data = stream.read(n)
+    if len(data) < n:
+        raise EOFError("truncated avro bytes")
+    return data
+
+
+# ------------------------------------------------------------ schema values
+
+
+def _encode_value(out: bytearray, schema, value) -> None:
+    if isinstance(schema, list):  # union: [null, T]
+        if value is None:
+            _write_long(out, schema.index("null"))
+            return
+        idx = next(i for i, s in enumerate(schema) if s != "null")
+        _write_long(out, idx)
+        _encode_value(out, schema[idx], value)
+        return
+    if isinstance(schema, dict) and schema.get("type") == "array":
+        value = list(value)
+        if value:
+            _write_long(out, len(value))
+            for v in value:
+                _encode_value(out, schema["items"], v)
+        _write_long(out, 0)  # terminator
+        return
+    if isinstance(schema, dict) and schema.get("type") == "record":
+        for field in schema["fields"]:
+            _encode_value(out, field["type"], value.get(field["name"]))
+        return
+    if schema == "null":
+        return
+    if schema == "boolean":
+        out.append(1 if value else 0)
+        return
+    if schema == "long":
+        _write_long(out, int(value))
+        return
+    if schema == "double":
+        out += struct.pack("<d", float(value))
+        return
+    if schema == "string":
+        _write_bytes(out, str(value).encode())
+        return
+    if schema == "bytes":
+        _write_bytes(out, bytes(value))
+        return
+    raise TypeError(f"unsupported avro schema {schema!r}")
+
+
+def _decode_value(stream, schema):
+    if isinstance(schema, list):  # union
+        idx = _read_long(stream)
+        return _decode_value(stream, schema[idx])
+    if isinstance(schema, dict) and schema.get("type") == "array":
+        out = []
+        while True:
+            count = _read_long(stream)
+            if count == 0:
+                return out
+            if count < 0:  # spec: negative count is followed by byte size
+                _read_long(stream)
+                count = -count
+            for _ in range(count):
+                out.append(_decode_value(stream, schema["items"]))
+    if isinstance(schema, dict) and schema.get("type") == "record":
+        return {f["name"]: _decode_value(stream, f["type"])
+                for f in schema["fields"]}
+    if isinstance(schema, dict):  # {"type": "long"} wrapper form
+        return _decode_value(stream, schema["type"])
+    if schema == "null":
+        return None
+    if schema == "boolean":
+        return stream.read(1)[0] != 0
+    if schema in ("long", "int"):
+        return _read_long(stream)
+    if schema == "double":
+        return struct.unpack("<d", stream.read(8))[0]
+    if schema == "float":
+        return struct.unpack("<f", stream.read(4))[0]
+    if schema == "string":
+        return _read_bytes(stream).decode()
+    if schema == "bytes":
+        return _read_bytes(stream)
+    raise TypeError(f"unsupported avro schema {schema!r}")
+
+
+# --------------------------------------------------------- schema inference
+
+
+def _primitive_for(value):
+    if hasattr(value, "item") and not hasattr(value, "__len__"):
+        value = value.item()  # numpy scalar
+    if isinstance(value, bool):
+        return "boolean"
+    if isinstance(value, int):
+        return "long"
+    if isinstance(value, float):
+        return "double"
+    if isinstance(value, str):
+        return "string"
+    if isinstance(value, bytes):
+        return "bytes"
+    return None
+
+
+def _merge_prim(a: str | None, b: str | None) -> str | None:
+    if a is None or a == b:
+        return b
+    if b is None:
+        return a
+    if {a, b} == {"long", "double"}:
+        return "double"
+    raise TypeError(f"column mixes avro types {a!r} and {b!r}")
+
+
+def infer_schema(rows: list[dict], name: str = "row") -> dict:
+    """Record schema over the union of the rows' columns: long / double /
+    boolean / string / bytes, arrays thereof, ``["null", T]`` unions for
+    columns with missing values."""
+    cols: dict[str, dict] = {}
+    for row in rows:
+        for key in row:
+            cols.setdefault(key, {"prim": None, "array": False,
+                                  "nullable": False})
+    for row in rows:
+        for key, spec in cols.items():
+            value = row.get(key)
+            if value is None:
+                spec["nullable"] = True
+                continue
+            if hasattr(value, "tolist"):  # numpy array/scalar
+                value = value.tolist()
+            if isinstance(value, (list, tuple)):
+                spec["array"] = True
+                for v in value:
+                    spec["prim"] = _merge_prim(spec["prim"], _primitive_for(v))
+            else:
+                prim = _primitive_for(value)
+                if prim is None:
+                    raise TypeError(
+                        f"column {key!r}: cannot map {type(value).__name__} "
+                        "to an avro type")
+                spec["prim"] = _merge_prim(spec["prim"], prim)
+    fields = []
+    for key, spec in sorted(cols.items()):
+        t: object = spec["prim"] or "string"
+        if spec["array"]:
+            t = {"type": "array", "items": t}
+        if spec["nullable"]:
+            t = ["null", t]
+        fields.append({"name": key, "type": t})
+    return {"type": "record", "name": name, "fields": fields}
+
+
+# ------------------------------------------------------------ container IO
+
+
+def write_container(stream, rows: list[dict], schema: dict | None = None,
+                    block_rows: int = 1000) -> int:
+    """Write rows as one Avro Object Container File; returns rows
+    written. Values are normalized through ``tolist`` so numpy columns
+    round-trip as plain python."""
+    rows = [
+        {k: (v.tolist() if hasattr(v, "tolist") else v) for k, v in r.items()}
+        for r in rows
+    ]
+    if schema is None:
+        schema = infer_schema(rows)
+    schema_json = json.dumps(schema).encode()
+    sync = hashlib.md5(schema_json).digest()  # any 16 bytes; deterministic
+    header = bytearray(MAGIC)
+    _write_long(header, 2)  # metadata map: one block of two entries
+    _write_bytes(header, b"avro.schema")
+    _write_bytes(header, schema_json)
+    _write_bytes(header, b"avro.codec")
+    _write_bytes(header, b"null")
+    _write_long(header, 0)  # map terminator
+    header += sync
+    stream.write(bytes(header))
+    for start in range(0, len(rows), block_rows):
+        chunk = rows[start:start + block_rows]
+        body = bytearray()
+        for row in chunk:
+            _encode_value(body, schema, row)
+        block = bytearray()
+        _write_long(block, len(chunk))
+        _write_long(block, len(body))
+        block += body
+        block += sync
+        stream.write(bytes(block))
+    return len(rows)
+
+
+def read_container(stream) -> list[dict]:
+    """Parse one Object Container File into its rows."""
+    if stream.read(4) != MAGIC:
+        raise ValueError("not an avro object container file (bad magic)")
+    meta: dict[str, bytes] = {}
+    while True:
+        count = _read_long(stream)
+        if count == 0:
+            break
+        if count < 0:
+            _read_long(stream)  # byte size of the block, unused
+            count = -count
+        for _ in range(count):
+            key = _read_bytes(stream).decode()
+            meta[key] = _read_bytes(stream)
+    codec = meta.get("avro.codec", b"null")
+    if codec not in (b"null", b""):
+        raise ValueError(f"unsupported avro codec {codec!r} "
+                         "(only 'null' — uncompressed — is built in)")
+    schema = json.loads(meta["avro.schema"])
+    sync = stream.read(16)
+    rows: list[dict] = []
+    while True:
+        try:
+            count = _read_long(stream)
+        except EOFError:
+            return rows
+        size = _read_long(stream)
+        block = stream.read(size)
+        if len(block) < size:
+            raise EOFError("truncated avro block")
+        buf = io.BytesIO(block)
+        for _ in range(count):
+            rows.append(_decode_value(buf, schema))
+        if stream.read(16) != sync:
+            raise ValueError("avro sync marker mismatch (corrupt shard?)")
+
+
+# ---------------------------------------------------------------- read tasks
+
+
+def avro_tasks(paths) -> list[Callable]:
+    """One read task per container file (the file-parallel split every
+    other datasource uses)."""
+    from . import datasource as ds
+
+    def make(fs, path):
+        def task():
+            import pyarrow as pa
+
+            with fs.open_input_stream(path) as f:
+                # container blocks are sequential; buffer once
+                rows = read_container(io.BytesIO(f.read()))
+            cols: dict[str, list] = {}
+            for r in rows:
+                for k in r:
+                    cols.setdefault(k, [])
+            for r in rows:
+                for k, col in cols.items():
+                    col.append(r.get(k))
+            return pa.table(cols) if cols else pa.table({})
+        return task
+
+    return [make(fs, path) for fs, path in ds._expand_paths(paths)]

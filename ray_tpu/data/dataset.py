@@ -263,6 +263,17 @@ class Dataset:
                 for row in BlockAccessor.for_block(block).iter_rows():
                     write_record(f, encode_example(row))
 
+    def write_avro(self, path: str) -> None:
+        """Avro Object Container File shards (native codec, avro.py —
+        schema inferred per block from the columns; no avro/fastavro
+        dependency)."""
+        from .avro import write_container
+
+        for i, block in enumerate(self._iter_blocks()):
+            with ds.open_output(path, f"part-{i:05d}.avro") as f:
+                write_container(
+                    f, list(BlockAccessor.for_block(block).iter_rows()))
+
     def write_webdataset(self, path: str) -> None:
         """Tar shards in the webdataset layout (one member per column per
         row, grouped by key — webdataset.py; one shard per block)."""
@@ -422,6 +433,16 @@ def read_tfrecords(paths) -> Dataset:
     from .tfrecords import tfrecords_tasks
 
     return Dataset(L.Read("read_tfrecords", read_tasks=tfrecords_tasks(paths)))
+
+
+def read_avro(paths) -> Dataset:
+    """Avro Object Container Files, parsed natively (no fastavro import)
+    — reference ``read_api.py read_avro``. One read task per container
+    file; long/double/boolean/string/bytes columns, arrays thereof, and
+    nullable unions decode to plain python values."""
+    from .avro import avro_tasks
+
+    return Dataset(L.Read("read_avro", read_tasks=avro_tasks(paths)))
 
 
 def read_webdataset(paths) -> Dataset:

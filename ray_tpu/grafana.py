@@ -153,6 +153,15 @@ def generate_dashboard(title: str = "ray_tpu cluster") -> dict:
                      "(rate(ray_tpu_lease_stage_ms_bucket[5m])))",
              "legend": "{{stage}}"},
         ], grid={"x": 2 * W, "y": 4 + 3 * H, "w": W, "h": H}, unit="ms"),
+        # SLO-serving row: the prefix-cache gauge explains TTFT moves
+        # (a hit-rate drop = cold prompts = slower prefill), and the
+        # per-deployment TTFT p95 is the latency_slo autoscaler's own
+        # trigger signal — the panel shows exactly what it reacts to.
+        _panel(45, "Prefix cache hit rate", [
+            {"expr": "serve_prefix_cache_hit_rate",
+             "legend": "{{deployment}}"},
+        ], grid={"x": 2 * W, "y": 4 + 4 * H, "w": W, "h": H},
+            unit="percentunit"),
         # Chaos injections live NEXT TO the lease-stage / leak panels: a
         # spike here explains spikes there (injected pain vs real pain).
         _panel(43, "Chaos injections by kind", [

@@ -158,6 +158,9 @@ class InferenceEngine:
         seed: int = 0,
         attention_impl: str = "auto",
         lora_config=None,
+        prefill_token_budget: int | None = None,
+        max_prefill_seqs_per_step: int = 2,
+        decode_starvation_limit: int = 8,
     ):
         self.config = PRESETS[config] if isinstance(config, str) else config
         self.mesh = mesh
@@ -174,6 +177,23 @@ class InferenceEngine:
         # tunnel), so syncing once per K tokens is the difference between
         # 7 tok/s/slot and wire-speed decode.
         self.decode_steps_per_dispatch = max(1, decode_steps_per_dispatch)
+        # Token-budget mixed dispatch (Sarathi/vLLM chunked-prefill
+        # scheduling): each step carries the full decode batch PLUS up to
+        # `prefill_token_budget` prompt tokens (≤ `max_prefill_seqs_per_step`
+        # distinct prompts) in ONE fused dispatch, so a long prompt no
+        # longer head-of-line-blocks running streams. Default budget = one
+        # prefill chunk per step; 0 = legacy strict prefill-first
+        # schedule. `decode_starvation_limit` guards the FALLBACK path
+        # (pp meshes, LoRA stacks — no fused entry point): after that many
+        # consecutive prefill-only steps with live decoders, one decode
+        # burst is forced (0 disables the guard).
+        if prefill_token_budget is None:
+            prefill_token_budget = self.prefill_chunk_size
+        self.prefill_token_budget = (
+            max(page_size, prefill_token_budget) if prefill_token_budget else 0)
+        self.max_prefill_seqs_per_step = max(1, max_prefill_seqs_per_step)
+        self.decode_starvation_limit = max(0, decode_starvation_limit)
+        self._starved_steps = 0
         self.num_pages = self.total_pages(max_slots, max_len, page_size, num_pages)
         if executor is None:
             executor = LocalEngineExecutor(
@@ -216,8 +236,18 @@ class InferenceEngine:
         self._block_tables = np.tile(
             np.arange(max_slots, dtype=np.int32)[:, None], (1, self.max_pages_per_seq)
         )
-        self.metrics = {"prefix_hit_pages": 0, "prefill_chunks": 0,
-                        "decode_steps": 0, "decode_dispatches": 0}
+        self.metrics = {"prefix_hit_pages": 0, "prefix_lookup_pages": 0,
+                        "prefill_chunks": 0,
+                        "decode_steps": 0, "decode_dispatches": 0,
+                        # Per-step schedule mix: how many engine steps ran
+                        # fused prefill+decode vs either alone (plus
+                        # first-token flush-only steps).
+                        "engine_step_mix": {"mixed": 0, "prefill": 0,
+                                            "decode": 0, "flush": 0},
+                        # Steps where live decode streams waited behind a
+                        # prefill-only dispatch (0 under mixed dispatch —
+                        # the number the token budget exists to kill).
+                        "decode_stall_steps": 0}
 
     @staticmethod
     def total_pages(max_slots: int, max_len: int, page_size: int,
@@ -318,25 +348,76 @@ class InferenceEngine:
         r.slot = -1
 
     # ------------------------------------------------------------------ step
+    @property
+    def mixed_dispatch_enabled(self) -> bool:
+        """True when steps fuse prefill chunks into the decode dispatch
+        (token budget > 0 and the executor has the fused entry point)."""
+        return (self.prefill_token_budget > 0
+                and self.lora_manager is None
+                and getattr(self.executor, "supports_mixed_dispatch", False))
+
+    @property
+    def prefix_cache_hit_rate(self) -> float:
+        """Fraction of cacheable prompt pages served from the prefix
+        cache (hit pages / looked-up pages since engine start)."""
+        lookups = self.metrics.get("prefix_lookup_pages", 0)
+        return self.metrics["prefix_hit_pages"] / lookups if lookups else 0.0
+
     def step(self) -> list[dict]:
         """Advance the engine one tick: admit waiting requests while slots
-        and pages allow; run ONE prefill chunk if any admitted prompt has
-        chunks pending; flush batched first-token samples once the prefill
-        queue drains; else run ONE batched decode burst. Returns emission
-        events ``{"request_id", "token", "done", "finish_reason"}``."""
+        and pages allow, then dispatch.
+
+        With mixed dispatch enabled (the default off the pp/LoRA paths),
+        a step with both live decoders and pending prefill issues ONE
+        fused dispatch: the full ``[max_slots]`` decode burst plus up to
+        ``prefill_token_budget`` prompt tokens — prefill rides along with
+        decode instead of preempting it, and just-finished prompts flush
+        their batched first-token samples the same step.
+
+        The legacy schedule (budget 0, pp meshes, LoRA stacks) runs ONE
+        prefill chunk per step strictly ahead of decode, with the
+        starvation guard forcing a decode burst after
+        ``decode_starvation_limit`` consecutive stalled steps.
+
+        Returns emission events ``{"request_id", "token", "done",
+        "finish_reason"}``."""
         self._admit()
+        mix = self.metrics["engine_step_mix"]
         with self._lock:
             r = self._prefilling[0] if self._prefilling else None
+            has_active = bool(self._active)
+        if r is not None and has_active and self.mixed_dispatch_enabled:
+            events = self._mixed_step()
+            if events is not None:
+                mix["mixed"] += 1
+                self._starved_steps = 0
+                if self._pending_first:
+                    events = events + self._flush_first_samples()
+                return events
+            # no fusable prefill candidate (e.g. all adapter-bound):
+            # fall through to the legacy schedule for this step
         if r is not None:
+            if (has_active and self.decode_starvation_limit
+                    and self._starved_steps >= self.decode_starvation_limit):
+                self._starved_steps = 0
+                mix["decode"] += 1
+                return self._decode_all()
+            if has_active:
+                self._starved_steps += 1
+                self.metrics["decode_stall_steps"] += 1
             events = self._prefill_chunk_one(r)
+            mix["prefill"] += 1
             with self._lock:
                 drained = not self._prefilling
             if drained and self._pending_first:
                 events = events + self._flush_first_samples()
             return events
+        self._starved_steps = 0
         if self._pending_first:
+            mix["flush"] += 1
             return self._flush_first_samples()
         if self._active:
+            mix["decode"] += 1
             return self._decode_all()
         return []
 
@@ -402,6 +483,7 @@ class InferenceEngine:
         least one prompt token is always computed (its hidden state seeds
         sampling — the reference caps identically)."""
         max_hit_pages = (len(r.prompt) - 1) // self.page_size
+        self.metrics["prefix_lookup_pages"] += max_hit_pages
         hits: list[int] = []
         h = hashlib.sha1()
         h.update((r.model or "").encode())  # adapter-scoped prefix space
@@ -529,11 +611,9 @@ class InferenceEngine:
                    "generated_tokens": len(r.generated),
                    "finish_reason": r.finish_reason}))
 
-    def _decode_all(self) -> list[dict]:
-        with self._lock:
-            active = dict(self._active)
-        if not active:
-            return []
+    def _decode_batch_args(self, active: dict):
+        """Fill the host mirrors for one decode burst over ``active`` and
+        return the per-slot (temps, eos_ids, remaining) arrays."""
         temps = np.ones(self.max_slots, np.float32)
         eos_ids = np.full(self.max_slots, -1, np.int32)
         remaining = np.zeros(self.max_slots, np.int32)
@@ -546,6 +626,27 @@ class InferenceEngine:
                 r.max_new_tokens - len(r.generated),
                 len(r.block_table) * self.page_size - r.pos,
             )
+        return temps, eos_ids, remaining
+
+    def _emit_decode_events(self, active: dict, tokens, K: int) -> list[dict]:
+        events = []
+        for k in range(K):
+            for slot, r in active.items():
+                if r.done:
+                    continue
+                r.pos += 1
+                if r.first_token_at is None:
+                    r.first_token_at = time.monotonic()
+                    r.first_token_wall = time.time()
+                events.append(self._emit(r, int(tokens[k, slot])))
+        return events
+
+    def _decode_all(self) -> list[dict]:
+        with self._lock:
+            active = dict(self._active)
+        if not active:
+            return []
+        temps, eos_ids, remaining = self._decode_batch_args(active)
         # K fused decode+sample steps in ONE dispatch, ONE host sync
         # (on-device lax.scan). Finished slots redirect writes to trash;
         # their surplus tokens are discarded below.
@@ -558,17 +659,94 @@ class InferenceEngine:
         # One dispatch == one staging-buffer commit on the paged path:
         # the pool is written decode_dispatches times, not decode_steps.
         self.metrics["decode_dispatches"] += 1
-        events = []
-        for k in range(K):
-            for slot, r in active.items():
-                if r.done:
+        return self._emit_decode_events(active, tokens, K)
+
+    def _select_prefill_plans(self) -> list[dict]:
+        """Chunks riding the next mixed dispatch: walk the prefill queue
+        in admission order, taking one chunk per prompt until the token
+        budget or ``max_prefill_seqs_per_step`` is spent. Chunk sizes are
+        the SAME buckets as the standalone prefill path (a budget smaller
+        than the natural bucket drops to the largest fitting bucket), so
+        mixed dispatch adds no new prefill shapes — only combinations."""
+        plans: list[dict] = []
+        budget = self.prefill_token_budget
+        with self._lock:
+            queue = [r for r in self._prefilling if not r.done]
+        for r in queue:
+            if len(plans) >= self.max_prefill_seqs_per_step:
+                break
+            if budget < self.page_size:
+                break
+            if r.lora_slot:
+                continue  # adapter prefill stays on the legacy path
+            remaining = len(r.prompt) - r.prefill_pos
+            chunk = self._chunk_bucket(remaining)
+            if chunk > budget:
+                b = self.page_size
+                while b * 2 <= budget:
+                    b *= 2
+                chunk = b
+            # Clamp so the chunk's pages never run past the table (same
+            # clamp as the standalone path — both operands page-aligned).
+            chunk = min(chunk, self.max_len - r.prefill_pos)
+            take = min(remaining, chunk)
+            if take <= 0:
+                continue
+            bt = np.full(self.max_pages_per_seq, r.slot, np.int32)
+            bt[:len(r.block_table)] = r.block_table
+            tokens = np.zeros(chunk, np.int32)
+            tokens[:take] = r.prompt[r.prefill_pos:r.prefill_pos + take]
+            final = r.prefill_pos + take >= len(r.prompt)
+            plans.append({
+                "request": r, "block_table": bt, "tokens": tokens,
+                "start_pos": r.prefill_pos,
+                "handle": next(self._handle_counter) if final else None,
+                "take": take, "final": final,
+            })
+            budget -= chunk
+        return plans
+
+    def _mixed_step(self) -> list[dict] | None:
+        """ONE fused dispatch: the full decode burst plus the selected
+        prefill chunks. Returns the decode emission events, or None when
+        no prefill chunk was fusable (caller falls back to the legacy
+        schedule for this step)."""
+        plans = self._select_prefill_plans()
+        if not plans:
+            return None
+        with self._lock:
+            active = dict(self._active)
+        if not active:
+            return None  # decoders finished since the caller looked
+        temps, eos_ids, remaining = self._decode_batch_args(active)
+        K = self.decode_steps_per_dispatch
+        wire = [{k: p[k] for k in ("block_table", "tokens", "start_pos",
+                                   "handle", "take")} for p in plans]
+        tokens = self.executor.mixed(
+            wire, self._block_tables, self._tokens, self._pos, temps,
+            eos_ids, remaining, K, lora_idx=self._lora_idx,
+        )  # [K, slots]
+        self.metrics["decode_steps"] += K
+        self.metrics["decode_dispatches"] += 1
+        # Prefill bookkeeping AFTER the dispatch (mirrors
+        # _prefill_chunk_one): advance positions, move finished prompts to
+        # the batched first-token queue, drop handles of cancelled ones.
+        for p in plans:
+            r = p["request"]
+            self.metrics["prefill_chunks"] += 1
+            r.prefill_pos = p["start_pos"] + p["take"]
+            if not p["final"]:
+                continue
+            with self._lock:
+                try:
+                    self._prefilling.remove(r)
+                except ValueError:
+                    pass  # cancel() already rebuilt the queue without it
+                if r.done:  # cancelled mid-dispatch
+                    self.executor.drop_handle(p["handle"])
                     continue
-                r.pos += 1
-                if r.first_token_at is None:
-                    r.first_token_at = time.monotonic()
-                    r.first_token_wall = time.time()
-                events.append(self._emit(r, int(tokens[k, slot])))
-        return events
+            self._pending_first.append((r, p["handle"]))
+        return self._emit_decode_events(active, tokens, K)
 
     def _emit(self, r: Request, token: int) -> dict:
         r.generated.append(token)

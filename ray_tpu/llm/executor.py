@@ -31,7 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.llama import LlamaConfig, PRESETS, init_params
-from .model import decode_loop, init_pages, prefill_chunk, sample_first_batch
+from .model import (decode_loop, init_pages, mixed_dispatch, prefill_chunk,
+                    sample_first_batch)
 
 # Backends with a real Mosaic compiler: the Pallas paged-attention kernel
 # runs native. "axon" is the remote-dispatch tunnel to the same chip.
@@ -224,10 +225,19 @@ class LocalEngineExecutor:
                 donate_argnames=("pages",),
                 out_shardings=(pg, rep),
             )
+            self._mixed = jax.jit(
+                mixed_dispatch.__wrapped__,
+                static_argnames=("config", "page_size", "n_steps", "paged",
+                                 "live_pages", "prefill_live_pages",
+                                 "attn_mesh"),
+                donate_argnames=("pages",),
+                out_shardings=(rep, rep, pg, rep),
+            )
         else:
             self._decode_loop = decode_loop
             self._sample_first = sample_first_batch
             self._prefill = prefill_chunk
+            self._mixed = mixed_dispatch
 
     def _put(self, x: np.ndarray):
         """Host input -> device, replicated over the mesh when present (a
@@ -316,6 +326,32 @@ class LocalEngineExecutor:
             hiddens, self.params["lm_head"], self._put(padded), self._key)
         return np.asarray(toks)[:m]
 
+    def _decode_kwargs(self, pos: np.ndarray, n_steps: int,
+                       block_tables: np.ndarray, lora_idx) -> dict:
+        """Static decode kwargs shared by ``decode`` and ``mixed``."""
+        if self.paged_attention:
+            # The kernel only reads POOL context [0, pos): tokens
+            # generated mid-dispatch ride the staging carry, so the
+            # page bound ignores n_steps entirely — a strictly
+            # tighter grid than the dense bound below.
+            needed = max(1, (int(pos.max()) + self.page_size - 1)
+                         // self.page_size)
+        else:
+            # Dense attends in-pool: positions reach
+            # max(pos) + n_steps - 1 by the last fused step.
+            needed = (int(pos.max()) + n_steps - 1) // self.page_size + 1
+        kwargs = {
+            "paged": self.paged_attention,
+            "live_pages": self._bucket_pages(needed, block_tables.shape[1]),
+            "attn_mesh": self._attn_mesh,
+        }
+        if self.lora_stack is not None:
+            kwargs["lora"] = self.lora_stack
+            kwargs["lora_idx"] = self._put(
+                (lora_idx if lora_idx is not None
+                 else np.zeros(block_tables.shape[0], np.int32)).astype(np.int32))
+        return kwargs
+
     def decode(self, block_tables: np.ndarray, tokens: np.ndarray,
                pos: np.ndarray, temps: np.ndarray, eos_ids: np.ndarray,
                remaining: np.ndarray, n_steps: int,
@@ -323,27 +359,7 @@ class LocalEngineExecutor:
         if self._pp > 1:
             kwargs = {}
         else:
-            if self.paged_attention:
-                # The kernel only reads POOL context [0, pos): tokens
-                # generated mid-dispatch ride the staging carry, so the
-                # page bound ignores n_steps entirely — a strictly
-                # tighter grid than the dense bound below.
-                needed = max(1, (int(pos.max()) + self.page_size - 1)
-                             // self.page_size)
-            else:
-                # Dense attends in-pool: positions reach
-                # max(pos) + n_steps - 1 by the last fused step.
-                needed = (int(pos.max()) + n_steps - 1) // self.page_size + 1
-            kwargs = {
-                "paged": self.paged_attention,
-                "live_pages": self._bucket_pages(needed, block_tables.shape[1]),
-                "attn_mesh": self._attn_mesh,
-            }
-            if self.lora_stack is not None:
-                kwargs["lora"] = self.lora_stack
-                kwargs["lora_idx"] = self._put(
-                    (lora_idx if lora_idx is not None
-                     else np.zeros(tokens.shape[0], np.int32)).astype(np.int32))
+            kwargs = self._decode_kwargs(pos, n_steps, block_tables, lora_idx)
         toks, self._key, self.pages = self._decode_loop(
             self.params, self.pages, self._put(block_tables.astype(np.int32)),
             self._put(tokens.astype(np.int32)), self._put(pos.astype(np.int32)),
@@ -354,6 +370,54 @@ class LocalEngineExecutor:
             n_steps=n_steps, **kwargs,
         )
         return np.asarray(toks)  # [n_steps, slots] — the one sync
+
+    @property
+    def supports_mixed_dispatch(self) -> bool:
+        """Mixed (prefill+decode fused) dispatch: available off the pp
+        path (the pp tick loop doesn't thread the fused program yet) and
+        without a LoRA stack (adapter prefill needs per-op slot plumbing
+        the fused program doesn't carry — the engine's starvation guard
+        bounds decode stalls there instead)."""
+        return self._pp == 1 and self.lora_stack is None
+
+    def mixed(self, prefill_plans: list, block_tables: np.ndarray,
+              tokens: np.ndarray, pos: np.ndarray, temps: np.ndarray,
+              eos_ids: np.ndarray, remaining: np.ndarray, n_steps: int,
+              lora_idx: np.ndarray | None = None) -> np.ndarray:
+        """ONE dispatch carrying the full decode burst plus up to the
+        engine's prefill token budget of prompt chunks.
+
+        prefill_plans: list of dicts ``{"block_table", "tokens",
+        "start_pos", "handle", "take"}`` — page-aligned chunks of DISTINCT
+        admitted prompts; a plan with a ``handle`` is its prompt's final
+        chunk and stashes position ``take - 1``'s hidden state for
+        first-token sampling, exactly like ``prefill``.
+        """
+        assert self.supports_mixed_dispatch
+        ops = []
+        op_live = []
+        for p in prefill_plans:
+            bt = np.asarray(p["block_table"], np.int32)
+            ops.append((self._put(bt),
+                        self._put(np.asarray(p["tokens"], np.int32)),
+                        self._put(np.int32(p["start_pos"]))))
+            op_live.append(self._bucket_pages(
+                -(-int(p["start_pos"]) // self.page_size), bt.shape[0]))
+        kwargs = self._decode_kwargs(pos, n_steps, block_tables, lora_idx)
+        toks, self._key, self.pages, hiddens = self._mixed(
+            self.params, self.pages, tuple(ops),
+            self._put(block_tables.astype(np.int32)),
+            self._put(tokens.astype(np.int32)), self._put(pos.astype(np.int32)),
+            self._put(temps.astype(np.float32)),
+            self._put(eos_ids.astype(np.int32)),
+            self._put(remaining.astype(np.int32)),
+            self._key, config=self.config, page_size=self.page_size,
+            n_steps=n_steps, prefill_live_pages=tuple(op_live), **kwargs,
+        )
+        for p, hidden in zip(prefill_plans, hiddens):
+            if p.get("handle") is not None:
+                self._hidden[p["handle"]] = hidden[p["take"] - 1]
+        return np.asarray(toks)  # [n_steps, slots] — still the one sync
 
     @property
     def lm_head(self):

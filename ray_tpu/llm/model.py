@@ -423,6 +423,57 @@ def decode_and_sample(params, pages: dict, block_tables, tokens, pos, temps, key
     return out, key, new_pages
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("config", "page_size", "n_steps", "paged", "live_pages",
+                     "prefill_live_pages", "attn_mesh"),
+    donate_argnames=("pages",))
+def mixed_dispatch(params, pages: dict, prefill_ops, block_tables, tokens,
+                   pos, temps, eos_ids, remaining, key, config: LlamaConfig,
+                   page_size: int, n_steps: int, paged: bool = False,
+                   live_pages: int | None = None,
+                   prefill_live_pages: tuple = (),
+                   lora=None, lora_idx=None, attn_mesh=None):
+    """Token-budget mixed step: prefill chunk(s) AND the full-batch decode
+    burst in ONE compiled program / ONE dispatch (Sarathi-style
+    chunked-prefill scheduling: prefill rides along with decode instead of
+    preempting it, so a long prompt can no longer head-of-line-block the
+    running streams' inter-token latency).
+
+    prefill_ops: static-length tuple of ``(block_table [max_pages],
+        tokens [C_i], start_pos)`` — one page-aligned chunk per admitted
+        prompt, each ``C_i`` a legacy chunk bucket so this program adds NO
+        new prefill shapes, only combinations (the compile key is the
+        tuple of bucket sizes × the decode ``live_pages`` bucket).
+    prefill_live_pages: per-op static context bound (same bucketing as the
+        standalone prefill path).
+
+    The pool interaction is safe by construction: the prefilling
+    sequences own disjoint pages from every decoding slot (the allocator
+    hands out distinct pages; inactive slots write to private trash
+    pages), so chunk scatters and the decode schedule never alias. On the
+    paged path the decode scan still only READS the pool — the chunk
+    scatters happen before it and ``commit_staging`` after, preserving
+    the v2 no-pool-copies property.
+
+    Returns ``(decode_tokens [n_steps, slots], key, pages,
+    hiddens tuple)`` — one ``[C_i, E]`` hidden per prefill op, for
+    first-token sampling of ops that finished their prompt.
+    """
+    hiddens = []
+    for (p_bt, p_tokens, p_start), lp in zip(prefill_ops, prefill_live_pages):
+        pages, hidden = prefill_chunk.__wrapped__(
+            params, pages, p_bt, p_tokens, p_start,
+            config=config, page_size=page_size, live_pages=lp)
+        hiddens.append(hidden)
+    toks, key, pages = decode_loop.__wrapped__(
+        params, pages, block_tables, tokens, pos, temps, eos_ids, remaining,
+        key, config=config, page_size=page_size, n_steps=n_steps, paged=paged,
+        live_pages=live_pages, lora=lora, lora_idx=lora_idx,
+        attn_mesh=attn_mesh)
+    return toks, key, pages, tuple(hiddens)
+
+
 @jax.jit
 def sample_first_token(last_hidden, lm_head, temp, key):
     """First-token sampling after prefill, on device (one dispatch)."""

@@ -87,6 +87,16 @@ class EngineShardWorker:
         return self.executor.decode(
             block_tables, tokens, pos, temps, eos_ids, remaining, n_steps)
 
+    def supports_mixed(self) -> bool:
+        return bool(self.executor is not None
+                    and self.executor.supports_mixed_dispatch)
+
+    def mixed(self, prefill_plans, block_tables, tokens, pos, temps, eos_ids,
+              remaining, n_steps):
+        return self.executor.mixed(
+            prefill_plans, block_tables, tokens, pos, temps, eos_ids,
+            remaining, n_steps)
+
 
 class ShardedEngineExecutor:
     """Driver-side executor fanning every operation out to the shard
@@ -98,6 +108,9 @@ class ShardedEngineExecutor:
         self.shards = shards
         self._pg = pg
         self._pending: list = []  # in-flight async dispatches (prefill/drop)
+        # Set after build() by create_sharded_executor: whether every
+        # shard's local executor takes the fused mixed entry point.
+        self.supports_mixed_dispatch = False
 
     def _dispatch(self, method: str, *args) -> None:
         """Fire-and-forget to every shard: per-caller actor ordering keeps
@@ -135,6 +148,16 @@ class ShardedEngineExecutor:
         return self._all(
             "decode", block_tables, tokens, pos, temps, eos_ids, remaining,
             n_steps)[0]
+
+    def mixed(self, prefill_plans, block_tables, tokens, pos, temps, eos_ids,
+              remaining, n_steps, lora_idx=None) -> np.ndarray:
+        """Fused prefill+decode step on every shard: each shard stashes
+        final-chunk hiddens under the same handles, so a later
+        ``sample_first`` fan-out finds them everywhere (the SPMD
+        invariant — identical program sequence per shard)."""
+        return self._all(
+            "mixed", prefill_plans, block_tables, tokens, pos, temps,
+            eos_ids, remaining, n_steps)[0]
 
     def shutdown(self) -> None:
         for s in self.shards:
@@ -209,6 +232,8 @@ def create_sharded_executor(
                            attention_impl=attention_impl)
             for s in shards
         ], timeout=600)
+        executor.supports_mixed_dispatch = bool(ray.get(
+            shards[0].supports_mixed.remote(), timeout=60))
     except Exception:
         executor.shutdown()
         raise

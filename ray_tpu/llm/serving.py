@@ -33,11 +33,16 @@ _metrics: dict = {}
 def _llm_metrics() -> dict:
     with _metrics_lock:
         if not _metrics:
-            from ..util.metrics import Histogram
+            from ..util.metrics import Gauge, Histogram
 
             _metrics["ttft"] = Histogram(
                 "serve_ttft_ms",
                 "Time from request arrival to first generated token",
+                tag_keys=("deployment",))
+            _metrics["prefix_hit_rate"] = Gauge(
+                "serve_prefix_cache_hit_rate",
+                "Fraction of cacheable prompt pages served from the "
+                "engine's prefix cache (0-1, since engine start)",
                 tag_keys=("deployment",))
         return _metrics
 
@@ -54,12 +59,15 @@ def _deployment_tag(fallback: str) -> str:
     return fallback
 
 
-def _observe_ttft(req: Request, deployment: str) -> None:
+def _observe_ttft(req: Request, deployment: str, engine=None) -> None:
     if req.first_token_at is None:
         return
     _llm_metrics()["ttft"].observe(
         1000.0 * (req.first_token_at - req.arrived_at),
         tags={"deployment": deployment})
+    if engine is not None:
+        _llm_metrics()["prefix_hit_rate"].set(
+            engine.prefix_cache_hit_rate, tags={"deployment": deployment})
 
 
 class LLMDeployment:
@@ -87,6 +95,9 @@ class LLMDeployment:
         request_timeout_s: float = 300.0,
         lora_config: dict | None = None,
         attention_impl: str = "auto",
+        prefill_token_budget: int | None = None,
+        max_prefill_seqs_per_step: int = 2,
+        decode_starvation_limit: int = 8,
     ):
         mesh = None
         executor = None
@@ -140,6 +151,9 @@ class LLMDeployment:
             decode_steps_per_dispatch=decode_steps_per_dispatch, mesh=mesh,
             executor=executor, seed=seed, lora_config=lora,
             attention_impl=attention_impl,
+            prefill_token_budget=prefill_token_budget,
+            max_prefill_seqs_per_step=max_prefill_seqs_per_step,
+            decode_starvation_limit=decode_starvation_limit,
         )
         self.model_id = model_id or (preset if isinstance(preset, str) else "custom")
         self.tokenizer = ByteTokenizer()
@@ -224,7 +238,7 @@ class LLMDeployment:
             finish = "timeout"
         else:
             finish = req.finish_reason
-        _observe_ttft(req, _deployment_tag(self.model_id))
+        _observe_ttft(req, _deployment_tag(self.model_id), self.engine)
         return {
             "request_id": rid,
             "text": self.tokenizer.decode(req.generated),
@@ -258,7 +272,8 @@ class LLMDeployment:
                     continue
                 if first:
                     first = False
-                    _observe_ttft(req, _deployment_tag(self.model_id))
+                    _observe_ttft(req, _deployment_tag(self.model_id),
+                                  self.engine)
                 yield event
                 if event["done"]:
                     return
@@ -368,7 +383,9 @@ class LLMDeployment:
         }]}
 
     def engine_metrics(self) -> dict:
-        return dict(self.engine.metrics)
+        return {**self.engine.metrics,
+                "prefix_cache_hit_rate": self.engine.prefix_cache_hit_rate,
+                "mixed_dispatch_enabled": self.engine.mixed_dispatch_enabled}
 
     # ---------------------------------------------------------- HTTP entry
     def __call__(self, request):
@@ -416,7 +433,11 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
                   topology: str | None = None,
                   max_ongoing_requests: int = 32, model_id: str | None = None,
                   ray_actor_options: dict | None = None,
-                  attention_impl: str = "auto"):
+                  attention_impl: str = "auto",
+                  autoscaling_config=None,
+                  prefill_token_budget: int | None = None,
+                  max_prefill_seqs_per_step: int = 2,
+                  decode_starvation_limit: int = 8):
     """Build a Serve Application serving ``preset`` (serve.run-able).
     Pass ``ray_actor_options={"resources": {"TPU": 1}, ...}`` to pin each
     replica (engine) to a TPU chip. For an engine that SPANS hosts, set
@@ -431,6 +452,7 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
         LLMDeployment,
         num_replicas=num_replicas,
         max_ongoing_requests=max_ongoing_requests,
+        autoscaling_config=autoscaling_config,
         ray_actor_options=ray_actor_options,
     )
     return dep.bind(preset, model_id=model_id, max_slots=max_slots, max_len=max_len,
@@ -440,4 +462,7 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
                     pipeline_parallel=pipeline_parallel, num_hosts=num_hosts,
                     shard_resources=shard_resources,
                     shard_runtime_env=shard_runtime_env, topology=topology,
-                    attention_impl=attention_impl)
+                    attention_impl=attention_impl,
+                    prefill_token_budget=prefill_token_budget,
+                    max_prefill_seqs_per_step=max_prefill_seqs_per_step,
+                    decode_starvation_limit=decode_starvation_limit)

@@ -89,6 +89,13 @@ def _deployment_config(app: Application, app_name: str) -> dict:
                 "target_ongoing_requests": auto.target_ongoing_requests,
                 "upscale_delay_s": auto.upscale_delay_s,
                 "downscale_delay_s": auto.downscale_delay_s,
+                "mode": auto.mode,
+                "target_ttft_ms": auto.target_ttft_ms,
+                "target_queue_wait_ms": auto.target_queue_wait_ms,
+                "latency_window_s": auto.latency_window_s,
+                "slo_quantile": auto.slo_quantile,
+                "downscale_headroom": auto.downscale_headroom,
+                "breach_cycles": auto.breach_cycles,
             }
             if auto
             else None

@@ -58,6 +58,14 @@ class _DeploymentState:
         self.autoscale_history: list[tuple[float, float]] = []
         self.last_scale_up = 0.0
         self.last_scale_down = 0.0
+        # latency_slo mode: ring of (ts, {metric: (buckets, boundaries,
+        # count)}) cumulative snapshots for windowed quantiles, breach/
+        # clear streak counters (hysteresis), and the decision history
+        # surfaced in `cli serve status` / get_app_status.
+        self.latency_history: list[tuple[float, dict]] = []
+        self.slo_breach_streak = 0
+        self.slo_ok_streak = 0
+        self.scale_events: list[dict] = []
         self.target_replicas = config["num_replicas"]
         # crash-loop backoff: consecutive failed starts delay the next one
         # exponentially (a broken constructor must not spin replica churn)
@@ -149,6 +157,7 @@ class ServeController:
             out = {}
             for name, state in app.items():
                 running = [r for r in state.replicas if r.state == RUNNING and r.version == state.version]
+                auto = state.config.get("autoscaling") or {}
                 out[name] = {
                     "target_replicas": state.target_replicas,
                     "running_replicas": len(running),
@@ -156,6 +165,8 @@ class ServeController:
                     "healthy": len(running) >= state.target_replicas,
                     "deleted": bool(state.config.get("deleted")),
                     "last_start_failure": state.last_start_failure,
+                    "autoscaling_mode": auto.get("mode") if auto else None,
+                    "autoscale_events": list(state.scale_events[-10:]),
                 }
             return out
 
@@ -260,6 +271,13 @@ class ServeController:
                     p["queue"] = ray.get(r.actor.get_queue_len.remote(), timeout=5)
                 except Exception:
                     p["queue"] = 0
+                auto = state.config.get("autoscaling") or {}
+                if p["alive"] and auto.get("mode") == "latency_slo":
+                    try:
+                        p["latency"] = ray.get(
+                            r.actor.latency_snapshot.remote(), timeout=5)
+                    except Exception:
+                        p["latency"] = []
                 if p["alive"] and r.applied_user_config != user_config:
                     # config-only change: in-place reconfigure, no restart
                     try:
@@ -286,7 +304,12 @@ class ServeController:
                 if r.state == STARTING:
                     if p.get("ready"):
                         r.state = RUNNING
-                        r.applied_user_config = user_config
+                        # Keep the CONSTRUCTION-time user_config recorded at
+                        # _start_replica: if the target config changed while
+                        # this replica was starting, the next probe's
+                        # reconfigure pass must still see the mismatch and
+                        # apply it (overwriting with the probe-time config
+                        # here silently skipped the update).
                         state.consecutive_start_failures = 0
                         state.next_start_allowed = 0.0
                         state.last_start_failure = None
@@ -406,16 +429,52 @@ class ServeController:
             r.draining_since = chaos_clock.now()
 
     # ----------------------------------------------------------- autoscaling
+    def _record_scale_event(self, state: _DeploymentState, old: int, new: int,
+                            trigger: str, value, target) -> None:
+        """Every scale decision becomes (a) a history row in
+        ``get_app_status()`` / ``cli serve status`` and (b) a span in the
+        trace store, so 'why did we scale at 12:04' is answerable from
+        either surface."""
+        now = time.time()
+        event = {
+            "ts": now, "from": old, "to": new, "trigger": trigger,
+            "value": None if value is None else round(float(value), 2),
+            "target": target,
+        }
+        state.scale_events.append(event)
+        del state.scale_events[:-50]
+        logger.info("autoscale %s: %d -> %d (%s=%s target=%s)",
+                    state.name, old, new, trigger, event["value"], target)
+        try:
+            from ..observability import tracing
+
+            span = tracing.make_span(
+                f"serve.autoscale {state.name}", "serve", now, now,
+                tracing.new_trace_id(),
+                attrs={"deployment": state.name, "app": state.app_name,
+                       "from": old, "to": new, "trigger": trigger,
+                       "value": event["value"], "target": target})
+            tracing.record_span(span)
+        except Exception:
+            pass
+
     def _autoscale_from_probes(self, state: _DeploymentState, probes: dict) -> None:
-        """Queue-based autoscaling (reference autoscaling_state.py): desired
-        replicas = ceil(total ongoing / target_ongoing_requests), clamped,
-        with separate up/downscale delays."""
         auto = state.config.get("autoscaling")
         if not auto or state.config.get("deleted"):
             return
         running = [r for r in state.replicas if r.state == RUNNING]
         if not running:
             return
+        if auto.get("mode") == "latency_slo":
+            self._autoscale_latency_slo(state, auto, running, probes)
+            return
+        self._autoscale_queue_based(state, auto, running, probes)
+
+    def _autoscale_queue_based(self, state: _DeploymentState, auto: dict,
+                               running: list, probes: dict) -> None:
+        """Queue-based autoscaling (reference autoscaling_state.py): desired
+        replicas = ceil(total ongoing / target_ongoing_requests), clamped,
+        with separate up/downscale delays."""
         total = float(sum(probes.get(r.replica_id, {}).get("queue", 0) for r in running))
         now = time.time()
         state.autoscale_history.append((now, total))
@@ -426,11 +485,134 @@ class ServeController:
         if desired > cur and now - state.last_scale_up >= auto["upscale_delay_s"]:
             state.target_replicas = desired
             state.last_scale_up = now
-            logger.info("autoscale %s: %d -> %d (ongoing=%.1f)", state.name, cur, desired, total)
+            self._record_scale_event(state, cur, desired, "ongoing_requests",
+                                     total, auto["target_ongoing_requests"])
         elif desired < cur and now - state.last_scale_down >= auto["downscale_delay_s"]:
             state.target_replicas = desired
             state.last_scale_down = now
-            logger.info("autoscale %s: %d -> %d (ongoing=%.1f)", state.name, cur, desired, total)
+            self._record_scale_event(state, cur, desired, "ongoing_requests",
+                                     total, auto["target_ongoing_requests"])
+
+    @staticmethod
+    def _merge_latency_rows(probes: dict) -> dict:
+        """Sum each latency histogram across replica probe snapshots:
+        {metric_name: (buckets, boundaries, count)}."""
+        merged: dict[str, tuple[list[int], list[float], int]] = {}
+        for p in probes.values():
+            for row in p.get("latency") or []:
+                buckets = list(row.get("buckets") or [])
+                if not buckets:
+                    continue
+                name = row["name"]
+                cur = merged.get(name)
+                if cur is None:
+                    merged[name] = (buckets, list(row.get("boundaries") or []),
+                                    int(row.get("count", 0)))
+                else:
+                    summed = [a + b for a, b in zip(cur[0], buckets)]
+                    merged[name] = (summed, cur[1],
+                                    cur[2] + int(row.get("count", 0)))
+        return merged
+
+    def _windowed_quantile(self, state: _DeploymentState, metric: str,
+                           q: float, window_s: float, now: float):
+        """Quantile of the observations that landed within the window:
+        delta of the cumulative merged histogram vs the snapshot at the
+        window's start (replica restarts can shrink counts — negative
+        deltas clamp to 0). None = no traffic in the window."""
+        from ..util.metrics import histogram_quantile
+
+        latest = state.latency_history[-1][1].get(metric) if state.latency_history else None
+        if latest is None:
+            return None
+        base = None
+        for ts, snap in state.latency_history[:-1]:
+            if now - ts <= window_s:
+                break
+            if metric in snap:
+                base = snap[metric]
+        buckets, boundaries, _ = latest
+        if base is not None:
+            buckets = [max(0, a - b) for a, b in zip(buckets, base[0])]
+        if sum(buckets) == 0:
+            return None
+        return histogram_quantile(
+            {"buckets": buckets, "boundaries": boundaries}, q)
+
+    def _autoscale_latency_slo(self, state: _DeploymentState, auto: dict,
+                               running: list, probes: dict) -> None:
+        """Latency-SLO autoscaling: scale from the windowed TTFT quantile
+        the replicas actually served (the PR-2 ``serve_ttft_ms`` /
+        ``serve_queue_wait_ms`` histograms) instead of the queue-depth
+        proxy. Hysteresis = ``breach_cycles`` consecutive breaching (or
+        clear) probe rounds AND the up/downscale delay debounce."""
+        now = time.time()
+        merged = self._merge_latency_rows(probes)
+        if auto.get("target_queue_wait_ms") is not None \
+                and "serve_queue_wait_ms" not in merged:
+            # Queue wait is observed router-side (proxy/driver processes),
+            # so the replica probes never carry it — pull the cluster
+            # aggregate from the GCS instead (flushed every ~5 s; fine
+            # for a windowed quantile).
+            try:
+                from ..util.metrics import get_metrics
+
+                for m in get_metrics():
+                    if (m["name"] == "serve_queue_wait_ms" and m.get("buckets")
+                            and m.get("tags", {}).get("deployment")
+                            == state.name):
+                        cur = merged.get("serve_queue_wait_ms")
+                        buckets = list(m["buckets"])
+                        if cur is not None:
+                            buckets = [a + b for a, b in zip(cur[0], buckets)]
+                        merged["serve_queue_wait_ms"] = (
+                            buckets, list(m.get("boundaries") or []),
+                            int(m.get("count", 0)) + (cur[2] if cur else 0))
+            except Exception:
+                pass
+        state.latency_history.append((now, merged))
+        window = float(auto.get("latency_window_s") or 30.0)
+        state.latency_history = [
+            (t, s) for t, s in state.latency_history if now - t <= 2 * window]
+        q = float(auto.get("slo_quantile") or 0.95)
+        target_ttft = float(auto.get("target_ttft_ms") or 500.0)
+        p_ttft = self._windowed_quantile(state, "serve_ttft_ms", q, window, now)
+        target_qw = auto.get("target_queue_wait_ms")
+        p_qw = (self._windowed_quantile(state, "serve_queue_wait_ms", q,
+                                        window, now)
+                if target_qw else None)
+        breach = (p_ttft is not None and p_ttft > target_ttft) or (
+            target_qw is not None and p_qw is not None and p_qw > float(target_qw))
+        headroom = float(auto.get("downscale_headroom") or 0.5)
+        clear = (p_ttft is None or p_ttft < headroom * target_ttft) and (
+            target_qw is None or p_qw is None or p_qw < headroom * float(target_qw))
+        state.slo_breach_streak = state.slo_breach_streak + 1 if breach else 0
+        state.slo_ok_streak = state.slo_ok_streak + 1 if clear else 0
+        cycles = max(1, int(auto.get("breach_cycles") or 1))
+        cur = state.target_replicas
+        trigger = ("serve_queue_wait_ms_p%d" % round(100 * q)
+                   if breach and target_qw is not None and p_qw is not None
+                   and p_qw > float(target_qw)
+                   else "serve_ttft_ms_p%d" % round(100 * q))
+        if (breach and cur < auto["max_replicas"]
+                and state.slo_breach_streak >= cycles
+                and now - state.last_scale_up >= auto["upscale_delay_s"]):
+            state.target_replicas = cur + 1
+            state.last_scale_up = now
+            state.slo_breach_streak = 0
+            self._record_scale_event(
+                state, cur, cur + 1, trigger,
+                p_qw if "queue_wait" in trigger else p_ttft,
+                float(target_qw) if "queue_wait" in trigger else target_ttft)
+        elif (clear and cur > auto["min_replicas"]
+                and state.slo_ok_streak >= cycles
+                and now - state.last_scale_down >= auto["downscale_delay_s"]):
+            state.target_replicas = cur - 1
+            state.last_scale_down = now
+            state.slo_ok_streak = 0
+            self._record_scale_event(
+                state, cur, cur - 1, "serve_ttft_ms_p%d" % round(100 * q),
+                p_ttft, target_ttft)
 
     # ------------------------------------------------------------- push/ckpt
     def _push_replica_table(self, state: _DeploymentState) -> None:

@@ -15,11 +15,35 @@ from typing import Any, Callable
 
 @dataclasses.dataclass
 class AutoscalingConfig:
+    """Replica autoscaling policy.
+
+    ``mode="ongoing_requests"`` (default) is the reference's queue-based
+    policy: desired = ceil(total ongoing / target_ongoing_requests).
+
+    ``mode="latency_slo"`` scales directly from the serving latency SLO:
+    the controller pulls each replica's local ``serve_ttft_ms`` histogram
+    through the probe path, computes the windowed ``slo_quantile`` (p95
+    by default) over ``latency_window_s``, and steps the replica count up
+    when it breaches ``target_ttft_ms`` (or ``target_queue_wait_ms``
+    against the cluster ``serve_queue_wait_ms`` histogram, when set) and
+    down when it sits below ``downscale_headroom * target``. Hysteresis:
+    a breach/clear must persist ``breach_cycles`` consecutive probe
+    rounds AND the up/downscale delays still debounce, so one slow
+    request never doubles the fleet."""
+
     min_replicas: int = 1
     max_replicas: int = 4
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 2.0
     downscale_delay_s: float = 10.0
+    # --- latency_slo mode ---
+    mode: str = "ongoing_requests"
+    target_ttft_ms: float = 500.0
+    target_queue_wait_ms: float | None = None
+    latency_window_s: float = 30.0
+    slo_quantile: float = 0.95
+    downscale_headroom: float = 0.5
+    breach_cycles: int = 2
 
 
 class Deployment:

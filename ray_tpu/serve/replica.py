@@ -106,6 +106,22 @@ class ReplicaActor:
         with self._lock:
             return {"ongoing": self._ongoing, "total": self._total}
 
+    def latency_snapshot(self) -> list[dict]:
+        """Cumulative latency histograms recorded IN this replica process
+        (``serve_ttft_ms`` from an engine-hosting callable, plus any
+        ``serve_queue_wait_ms`` observed locally), for the controller's
+        latency-SLO autoscaler — pulled via the probe path so scaling
+        never waits on the ~5 s GCS metrics flush."""
+        from ..util.metrics import snapshot_all
+
+        names = ("serve_ttft_ms", "serve_queue_wait_ms")
+        return [
+            m for m in snapshot_all()
+            if m["name"] in names
+            and m.get("tags", {}).get("deployment", "") in (
+                "", self._deployment_name)
+        ]
+
     def reconfigure(self, user_config: Any) -> bool:
         fn = getattr(self._callable, "reconfigure", None)
         if fn is not None:

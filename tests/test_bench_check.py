@@ -58,6 +58,60 @@ def test_compare_flags_drops_and_missing():
                    for r in rows)
 
 
+def test_matrix_metrics_directions():
+    """ISSUE 7 satellite: every serve-matrix cell metric compares
+    lower-better — `*_ttft_ms` and the new `*_itl_ms` inter-token
+    latency both regress UP."""
+    for cell in ("c8_short", "c8_2k", "c32_short", "c32_2k"):
+        assert bench_check._direction(f"serve_{cell}_p50_ttft_ms") == "down"
+        assert bench_check._direction(f"serve_{cell}_p95_ttft_ms") == "down"
+        assert bench_check._direction(f"serve_{cell}_p95_itl_ms") == "down"
+    old = {"serve_c32_2k_p95_itl_ms": 120.0, "serve_c32_2k_p95_ttft_ms": 800.0}
+    worse = {"serve_c32_2k_p95_itl_ms": 200.0, "serve_c32_2k_p95_ttft_ms": 1200.0}
+    result = bench_check.compare(old, worse)
+    assert {r["metric"] for r in result["regressions"]} == set(old)
+    better = {"serve_c32_2k_p95_itl_ms": 60.0, "serve_c32_2k_p95_ttft_ms": 500.0}
+    result = bench_check.compare(old, better)
+    assert {r["metric"] for r in result["improvements"]} == set(old)
+
+
+def test_skipped_matrix_cells_not_missing(tmp_path):
+    """A matrix cell the new run INTENTIONALLY skipped (its
+    `serve_<cell>_skipped` marker is recorded) must not be flagged as a
+    silently-vanished metric; an uncovered absence still is."""
+    old = {"serve_c8_short_p50_ttft_ms": 150.0,
+           "serve_c8_short_p95_itl_ms": 90.0,
+           "serve_c32_2k_p95_ttft_ms": 900.0,
+           "serve_p50_ttft_ms": 250.0}
+    new = {"serve_c8_short_skipped": True,
+           "serve_c32_2k_p95_ttft_ms": 850.0,
+           "serve_p50_ttft_ms": 240.0}
+    result = bench_check.compare(old, new)
+    assert {r["metric"] for r in result["skipped"]} == {
+        "serve_c8_short_p50_ttft_ms", "serve_c8_short_p95_itl_ms"}
+    assert not result["missing"] and not result["regressions"]
+    # a false marker covers nothing
+    new_false = dict(new, serve_c8_short_skipped=False)
+    result = bench_check.compare(old, new_false)
+    assert {r["metric"] for r in result["missing"]} == {
+        "serve_c8_short_p50_ttft_ms", "serve_c8_short_p95_itl_ms"}
+    # and an absence without a marker still fails the CLI
+    import json
+
+    o, n = tmp_path / "o.json", tmp_path / "n.json"
+    o.write_text(json.dumps(old))
+    n.write_text(json.dumps(new))
+    assert bench_check.main([str(o), str(n)]) == 0   # skipped: clean exit
+    n.write_text(json.dumps({k: v for k, v in new.items()
+                             if not k.endswith("_skipped")}))
+    assert bench_check.main([str(o), str(n)]) == 1   # vanished: fails
+
+
+def test_prefix_hit_rate_direction():
+    # higher-better: more prompt pages served from the prefix cache
+    assert bench_check._direction("serve_prefix_cache_hit_rate") == "up"
+
+
 def test_lower_better_regresses_up():
     old = {"serve_p50_ttft_ms": 272.1}
     new = {"serve_p50_ttft_ms": 320.0}

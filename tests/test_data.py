@@ -511,3 +511,76 @@ def test_from_huggingface_and_stats(ray_cluster):
     ds2.take_all()
     report = ds2.stats()
     assert "Read" in report and "tasks" in report and "wall" in report
+
+
+def test_avro_roundtrip(ray_cluster, tmp_path):
+    """Write Avro Object Container File shards with the native codec,
+    read them back through the streaming executor (reference
+    read_api.read_avro; ROADMAP item 8, closing the readers backlog)."""
+    import glob
+
+    from ray_tpu import data
+
+    rows = [{"id": i, "score": i * 0.5, "name": f"row {i}",
+             "blob": bytes([i, 7]), "flag": i % 2 == 0,
+             "vec": [i, i + 1, i + 2],
+             "maybe": None if i % 3 == 0 else f"v{i}"}
+            for i in range(20)]
+    ds1 = data.from_items(rows, parallelism=3)
+    ds1.write_avro(str(tmp_path))
+    shards = sorted(glob.glob(str(tmp_path / "*.avro")))
+    assert len(shards) >= 1
+    # shards carry the spec'd container magic + self-describing schema
+    with open(shards[0], "rb") as f:
+        head = f.read(256)
+    assert head.startswith(b"Obj\x01") and b"avro.schema" in head
+
+    back = data.read_avro(str(tmp_path)).take_all()
+    back.sort(key=lambda r: r["id"])
+    assert len(back) == len(rows)
+    for orig, got in zip(rows, back):
+        assert got["id"] == orig["id"]
+        assert got["score"] == orig["score"]
+        assert got["name"] == orig["name"]
+        assert got["blob"] == orig["blob"]
+        assert got["flag"] == orig["flag"]
+        assert list(got["vec"]) == orig["vec"]
+        assert got["maybe"] == orig["maybe"]          # nullable union
+
+
+def test_avro_codec_units():
+    """Container-level invariants: zig-zag longs, schema inference
+    (nullable unions, arrays, long+double merge), sync-marker check, and
+    numpy normalization."""
+    import io
+
+    import numpy as np
+    import pytest
+
+    from ray_tpu.data import avro
+
+    # zig-zag longs round-trip across the signed range
+    for v in (0, -1, 1, 63, -64, 2**40, -(2**40)):
+        buf = bytearray()
+        avro._write_long(buf, v)
+        assert avro._read_long(io.BytesIO(bytes(buf))) == v
+
+    schema = avro.infer_schema([
+        {"a": 1, "b": [1.5], "c": None}, {"a": 2.5, "b": [], "c": "x"}])
+    by_name = {f["name"]: f["type"] for f in schema["fields"]}
+    assert by_name["a"] == "double"                     # long+double merge
+    assert by_name["b"] == {"type": "array", "items": "double"}
+    assert by_name["c"] == ["null", "string"]
+
+    # numpy arrays/scalars normalize through tolist
+    buf = io.BytesIO()
+    avro.write_container(buf, [{"x": np.int64(3), "y": np.arange(4)}])
+    buf.seek(0)
+    (row,) = avro.read_container(buf)
+    assert row == {"x": 3, "y": [0, 1, 2, 3]}
+
+    # corrupt sync marker fails loudly, not with garbage rows
+    data_bytes = bytearray(buf.getvalue())
+    data_bytes[-1] ^= 0xFF
+    with pytest.raises(ValueError, match="sync"):
+        avro.read_container(io.BytesIO(bytes(data_bytes)))

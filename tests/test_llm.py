@@ -571,3 +571,188 @@ def test_pp_chunk_pipelined_prefill_parity(small_model):
     assert got == expected
     # the pipelined path actually ran: 40 tokens = 2 pipelined + 1 tail
     assert eng.metrics["prefill_chunks"] >= 3
+
+
+def test_page_allocator_lru_eviction_order():
+    """ISSUE 7 satellite: among refcount-0 cached pages the LRU victim is
+    evicted first, and eviction unregisters the page's prefix hash."""
+    from ray_tpu.llm.engine import PageAllocator
+
+    alloc = PageAllocator(4)
+    pages = alloc.alloc(4)
+    assert pages is not None and not alloc.free
+    # release all four into the prefix cache with distinct LRU stamps
+    # (monotonic stamps: release order == recency order)
+    for i, pid in enumerate(pages):
+        alloc.register_prefix(pid, b"h%d" % i)
+        alloc.release(pid)
+    assert alloc.available() == 4 and not alloc.free  # all cached, evictable
+    # allocation under pressure evicts in LRU order: pages[0] first
+    (fresh,) = alloc.alloc(1)
+    assert fresh == pages[0]
+    assert alloc.lookup_prefix(b"h0") is None       # hash unregistered
+    assert alloc.lookup_prefix(b"h1") == pages[1]   # newer entries intact
+    (fresh2,) = alloc.alloc(1)
+    assert fresh2 == pages[1]
+
+
+def test_page_allocator_refcount_roundtrip():
+    """register_prefix + share/release refcounting: a cached page revives
+    through lookup, is pinned while shared, and only becomes evictable at
+    refcount 0."""
+    from ray_tpu.llm.engine import PageAllocator
+
+    alloc = PageAllocator(2)
+    (pid,) = alloc.alloc(1)
+    alloc.register_prefix(pid, b"hash")
+    alloc.release(pid)                      # cached, refcount 0
+    assert alloc.lookup_prefix(b"hash") == pid
+    alloc.share(pid)                        # a second sequence adopts it
+    alloc.share(pid)
+    assert alloc.refcount[pid] == 2
+    # pinned: eviction must never pick it, so only the 1 free page remains
+    assert alloc.available() == 1
+    got = alloc.alloc(2)
+    assert got is None                      # pool under pressure, pin holds
+    alloc.release(pid)
+    assert alloc.refcount[pid] == 1 and alloc.available() == 1
+    alloc.release(pid)                      # back to cached-evictable
+    assert alloc.available() == 2
+    got = alloc.alloc(2)                    # now eviction may claim it
+    assert got is not None and pid in got
+    assert alloc.lookup_prefix(b"hash") is None
+
+
+def test_page_allocator_alloc_under_pressure_prefers_free():
+    """alloc() takes free pages before evicting cached ones, and a
+    non-prefix page releases back to the free list (not the cache)."""
+    from ray_tpu.llm.engine import PageAllocator
+
+    alloc = PageAllocator(3)
+    a, b = alloc.alloc(2)
+    alloc.register_prefix(a, b"ha")
+    alloc.release(a)          # cached
+    alloc.release(b)          # plain free
+    assert b in alloc.free and a not in alloc.free
+    got = alloc.alloc(2)      # 2 free pages available: no eviction needed
+    assert got is not None
+    assert alloc.lookup_prefix(b"ha") == a  # cache entry survived
+    (third,) = alloc.alloc(1)               # now eviction must claim `a`
+    assert third == a and alloc.lookup_prefix(b"ha") is None
+
+
+def test_mixed_dispatch_bounds_inter_token_latency(small_model):
+    """ISSUE 7 acceptance: with a 2k-ish prompt admitted mid-stream, the
+    token-budget mixed schedule keeps every running stream's max
+    inter-token step gap STRICTLY below the legacy prefill-first
+    schedule's, with byte-identical generated tokens."""
+    cfg, params = small_model
+
+    def run(budget, starvation):
+        eng = InferenceEngine(
+            cfg, params, max_slots=4, max_len=128, page_size=8,
+            prefill_chunk_size=16, decode_steps_per_dispatch=2,
+            prefill_token_budget=budget,
+            decode_starvation_limit=starvation)
+        a = Request("a", [1, 2, 3], max_new_tokens=30)
+        eng.add_request(a)
+        step_idx = 0
+        emits: dict[str, list[int]] = {}
+
+        def tick():
+            nonlocal step_idx
+            step_idx += 1
+            for e in eng.step():
+                emits.setdefault(e["request_id"], []).append(step_idx)
+
+        for _ in range(4):
+            tick()  # `a` is streaming
+        long_prompt = list(range(1, 100))  # 99 tokens -> 7 chunks of 16
+        b = Request("b", long_prompt, max_new_tokens=4)
+        eng.add_request(b)
+        while not (a.done and b.done):
+            tick()
+            assert step_idx < 500
+        gaps = [j - i for i, j in zip(emits["a"], emits["a"][1:])]
+        return a.generated, b.generated, max(gaps), eng.metrics
+
+    # budget 0 + guard off = the old strict prefill-first schedule
+    gen_a_old, gen_b_old, gap_old, m_old = run(budget=0, starvation=0)
+    gen_a_mix, gen_b_mix, gap_mix, m_mix = run(budget=None, starvation=8)
+    assert gen_a_mix == gen_a_old       # byte-identical running stream
+    assert gen_b_mix == gen_b_old       # byte-identical admitted prompt
+    assert gap_mix < gap_old, (gap_mix, gap_old)
+    assert m_mix["engine_step_mix"]["mixed"] > 0
+    assert m_old["decode_stall_steps"] >= 7   # one per prefill chunk
+    assert m_mix["decode_stall_steps"] == 0   # decode rode every dispatch
+    # and both agree with the ground-truth forward
+    assert gen_a_mix == naive_greedy(params, cfg, [1, 2, 3], 30)
+    assert gen_b_mix == naive_greedy(params, cfg, list(range(1, 100)), 4)
+
+
+def test_decode_starvation_guard_on_legacy_path(small_model):
+    """With mixed dispatch disabled (budget 0) the starvation guard still
+    bounds decode stalls: after `decode_starvation_limit` consecutive
+    prefill-only steps a decode burst is forced."""
+    cfg, params = small_model
+    eng = InferenceEngine(
+        cfg, params, max_slots=4, max_len=128, page_size=8,
+        prefill_chunk_size=16, decode_steps_per_dispatch=2,
+        prefill_token_budget=0, decode_starvation_limit=2)
+    a = Request("a", [1, 2, 3], max_new_tokens=30)
+    eng.add_request(a)
+    step_idx = 0
+    emits: list[int] = []
+
+    def tick():
+        nonlocal step_idx
+        step_idx += 1
+        for e in eng.step():
+            if e["request_id"] == "a":
+                emits.append(step_idx)
+
+    for _ in range(4):
+        tick()
+    b = Request("b", list(range(1, 100)), max_new_tokens=4)
+    eng.add_request(b)
+    while not (a.done and b.done):
+        tick()
+        assert step_idx < 500
+    gaps = [j - i for i, j in zip(emits, emits[1:])]
+    # guard fires after 2 stalled steps: gap bounded by limit+1, far
+    # below the 8-step head-of-line block of the unguarded schedule
+    assert max(gaps) <= 3, gaps
+    assert eng.metrics["engine_step_mix"]["mixed"] == 0
+    assert a.generated == naive_greedy(params, cfg, [1, 2, 3], 30)
+    assert b.generated == naive_greedy(params, cfg, list(range(1, 100)), 4)
+
+
+def test_mixed_dispatch_multi_prompt_budget(small_model):
+    """Several admitted prompts share one mixed dispatch up to
+    max_prefill_seqs_per_step/prefill_token_budget, and the
+    prefix-cache hit-rate metric tracks lookups vs hits."""
+    cfg, params = small_model
+    eng = InferenceEngine(
+        cfg, params, max_slots=4, max_len=64, page_size=8,
+        prefill_chunk_size=16, decode_steps_per_dispatch=2,
+        prefill_token_budget=32, max_prefill_seqs_per_step=2)
+    a = Request("a", [1, 2, 3], max_new_tokens=24)
+    eng.add_request(a)
+    for _ in range(3):
+        eng.step()
+    reqs = [Request(f"p{i}", [10 + i] * 20, max_new_tokens=3)
+            for i in range(3)]
+    for r in reqs:
+        eng.add_request(r)
+    n = 0
+    while not all(r.done for r in reqs + [a]):
+        eng.step()
+        n += 1
+        assert n < 500
+    assert eng.metrics["engine_step_mix"]["mixed"] > 0
+    for r, orig in zip(reqs, range(3)):
+        assert r.generated == naive_greedy(params, cfg, [10 + orig] * 20, 3)
+    assert a.generated == naive_greedy(params, cfg, [1, 2, 3], 24)
+    # hit-rate plumbing: lookups recorded, rate in [0, 1]
+    assert eng.metrics["prefix_lookup_pages"] > 0
+    assert 0.0 <= eng.prefix_cache_hit_rate <= 1.0

@@ -190,6 +190,188 @@ def test_autoscaling_up(serve_instance):
     serve.delete("auto")
 
 
+def test_autoscaling_latency_slo_up_and_down(serve_instance, capsys):
+    """ISSUE 7 acceptance: `latency_slo` mode scales replicas from the
+    windowed p95 of the replicas' own serve_ttft_ms histograms — up when
+    the SLO is breached, back down once the quantile clears the headroom
+    band — with each decision visible in the status history (`cli serve
+    status`) and as a serve.autoscale span."""
+
+    @serve.deployment(
+        max_ongoing_requests=8,
+        user_config={"ttft_ms": 400.0},
+        autoscaling_config={
+            "min_replicas": 1,
+            "max_replicas": 3,
+            "mode": "latency_slo",
+            "target_ttft_ms": 100.0,
+            "latency_window_s": 2.0,
+            "slo_quantile": 0.95,
+            "downscale_headroom": 0.5,
+            "breach_cycles": 2,
+            "upscale_delay_s": 0.5,
+            "downscale_delay_s": 0.5,
+        },
+    )
+    class FakeEngine:
+        """Stands in for the LLM engine: records a configurable TTFT into
+        the same serve_ttft_ms histogram the engine feeds, so the test
+        drives the autoscaler's actual signal path deterministically."""
+
+        def __init__(self):
+            from ray_tpu.serve.replica import get_replica_context
+            from ray_tpu.util.metrics import Histogram
+
+            self._dep = (get_replica_context() or {}).get(
+                "deployment", "FakeEngine")
+            self._hist = Histogram(
+                "serve_ttft_ms", "test ttft", tag_keys=("deployment",))
+            self._ttft = 400.0
+
+        def reconfigure(self, cfg):
+            if cfg:
+                self._ttft = float(cfg.get("ttft_ms", 400.0))
+
+        def __call__(self, request):
+            self._hist.observe(self._ttft, tags={"deployment": self._dep})
+            return {"ttft": self._ttft}
+
+    app = FakeEngine.bind()
+    serve.run(app, name="slo", route_prefix="/slo")
+    addr = serve.http_address()
+
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                _http_get(addr + "/slo", timeout=30)
+            except Exception:
+                pass
+            time.sleep(0.2)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = serve.status()["slo"]["FakeEngine"]
+            if st["target_replicas"] >= 2:
+                break
+            time.sleep(0.25)
+        assert st["target_replicas"] >= 2, f"never scaled up: {st}"
+        up_events = [e for e in st["autoscale_events"] if e["to"] > e["from"]]
+        assert up_events and up_events[0]["trigger"].startswith(
+            "serve_ttft_ms_p95"), st["autoscale_events"]
+        assert up_events[0]["value"] > 100.0  # the breaching p95 itself
+
+        # Flip the simulated engine fast (config-only change, applied via
+        # in-place reconfigure) and keep the traffic flowing: the
+        # windowed p95 must clear the 50 ms headroom band and walk the
+        # deployment back down to min_replicas.
+        serve.run(app.deployment.options(
+            user_config={"ttft_ms": 5.0}).bind(), name="slo",
+            route_prefix="/slo")
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            st = serve.status()["slo"]["FakeEngine"]
+            if st["target_replicas"] == 1 and any(
+                    e["to"] < e["from"] for e in st["autoscale_events"]):
+                break
+            time.sleep(0.25)
+        down_events = [e for e in st["autoscale_events"] if e["to"] < e["from"]]
+        assert st["target_replicas"] == 1 and down_events, st["autoscale_events"]
+        assert down_events[-1]["trigger"].startswith("serve_ttft_ms_p95")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+    # The decision history is the `cli serve status` surface verbatim.
+    from ray_tpu.cli import main as cli_main
+
+    capsys.readouterr()
+    assert cli_main(["serve", "status"]) == 0
+    cli_out = capsys.readouterr().out
+    assert "autoscaling=latency_slo" in cli_out
+    assert "scale 1 -> 2" in cli_out and "scale 2 -> 1" in cli_out
+    assert "serve_ttft_ms_p95" in cli_out
+
+    # Every decision is also a span (flushed to the GCS span store).
+    from ray_tpu.util.state import list_spans
+
+    deadline = time.monotonic() + 30
+    autoscale_spans = []
+    while time.monotonic() < deadline:
+        # High limit: the hammer phase floods the store with per-request
+        # spans and the default most-recent-1000 window would cut the
+        # handful of autoscale spans recorded mid-run.
+        autoscale_spans = [s for s in list_spans(limit=50_000)
+                           if s.get("name", "").startswith("serve.autoscale")]
+        directions = {s.get("attrs", {}).get("to", 0)
+                      - s.get("attrs", {}).get("from", 0)
+                      for s in autoscale_spans}
+        if any(d > 0 for d in directions) and any(d < 0 for d in directions):
+            break
+        time.sleep(1.0)
+    assert any(s.get("attrs", {}).get("to", 0)
+               > s.get("attrs", {}).get("from", 0) for s in autoscale_spans)
+    assert any(s.get("attrs", {}).get("to", 0)
+               < s.get("attrs", {}).get("from", 0) for s in autoscale_spans)
+    serve.delete("slo")
+
+
+def test_latency_slo_windowed_quantile_units():
+    """Controller-internal SLO math, no cluster: probe histograms merge
+    across replicas, the windowed quantile is a cumulative delta vs the
+    snapshot preceding the window, and replica restarts (shrinking
+    counts) clamp instead of going negative."""
+    from ray_tpu.serve.controller import ServeController, _DeploymentState
+
+    bounds = [10.0, 100.0, 1000.0]
+
+    def row(buckets, count):
+        return {"name": "serve_ttft_ms", "buckets": list(buckets),
+                "boundaries": bounds, "count": count}
+
+    merged = ServeController._merge_latency_rows({
+        "r1": {"latency": [row([1, 2, 0, 0], 3)]},
+        "r2": {"latency": [row([0, 1, 4, 0], 5)]},
+        "r3": {"latency": []},
+    })
+    assert merged["serve_ttft_ms"][0] == [1, 3, 4, 0]
+    assert merged["serve_ttft_ms"][2] == 8
+
+    state = _DeploymentState("app", {"name": "d", "version": "v",
+                                     "num_replicas": 1, "max_ongoing": 8})
+    qtile = ServeController._windowed_quantile
+    now = 1000.0
+    # t=900: 10 slow observations; t=999: those plus 20 fast ones
+    state.latency_history = [
+        (900.0, {"serve_ttft_ms": ([0, 0, 10, 0], bounds, 10)}),
+        (999.0, {"serve_ttft_ms": ([20, 0, 10, 0], bounds, 30)}),
+    ]
+    # window 30s: delta vs the t=900 snapshot = 20 fast obs -> p95 <= 10ms
+    p95 = qtile(None, state, "serve_ttft_ms", 0.95, 30.0, now)
+    assert p95 is not None and p95 <= 10.0
+    # window covering everything: cumulative includes the slow bucket
+    p95_all = qtile(None, state, "serve_ttft_ms", 0.95, 500.0, now)
+    assert p95_all > 100.0
+    # empty delta (no traffic since the pre-window snapshot) -> None
+    full = ([20, 0, 10, 0], bounds, 30)
+    state.latency_history = [(969.0, {"serve_ttft_ms": full}),
+                             (999.5, {"serve_ttft_ms": full}),
+                             (now, {"serve_ttft_ms": full})]
+    assert qtile(None, state, "serve_ttft_ms", 0.95, 30.0, now) is None
+    # replica restart: counts shrink below the base -> clamp, not negative
+    state.latency_history = [
+        (900.0, {"serve_ttft_ms": ([50, 0, 0, 0], bounds, 50)}),
+        (now, {"serve_ttft_ms": ([5, 0, 0, 0], bounds, 5)}),
+    ]
+    assert qtile(None, state, "serve_ttft_ms", 0.95, 30.0, now) is None
+
+
 def test_delete_application(serve_instance):
     serve.run(Echo.bind(), name="gone", route_prefix="/gone")
     addr = serve.http_address()
