@@ -396,6 +396,19 @@ def run_core_bench() -> dict:
     return _run()
 
 
+def run_dag_bench() -> dict:
+    """Compiled-loop dispatch suite (ROADMAP item 4): per-tick dispatch
+    overhead dynamic vs compiled (`dag_tick_dispatch_overhead*_us`,
+    `dag_loop_ticks_per_s`) and the pp=2 engine decode rate through both
+    paths (`pp_decode_tok_s_{dynamic,compiled}`; skip markers on hosts
+    that can't run the pp shard_map). Implementation in
+    ``ray_tpu/_dag_bench.py``; standalone: ``python -m ray_tpu.cli bench
+    dag``."""
+    from ray_tpu._dag_bench import run_dag_bench as _run
+
+    return _run()
+
+
 def run_serve_bench() -> dict:
     """Serve p50 TTFT north star (BASELINE.json): concurrent streaming
     completions through the REAL stack — HTTP proxy → pow-2 router →
@@ -720,6 +733,19 @@ def main() -> None:
                 ray_tpu.shutdown()
             except Exception:
                 pass
+    extra_dag: dict = {}
+    if os.environ.get("RAY_TPU_BENCH_SKIP_DAG") != "1":
+        try:
+            extra_dag = run_dag_bench()
+        except Exception as e:
+            print(f"dag bench failed: {e}", file=sys.stderr)
+            extra_dag = {"dag_bench_error": f"{type(e).__name__}: {e}"}
+            try:
+                import ray_tpu
+
+                ray_tpu.shutdown()
+            except Exception:
+                pass
     value = fw["tokens_per_sec_per_chip"]
     baseline = None
     if os.path.exists("BENCH_BASELINE.json"):
@@ -743,6 +769,7 @@ def main() -> None:
         **extra_longctx,
         **extra_paged,
         **extra_core,
+        **extra_dag,
     }
     print(json.dumps(result))
     # Regression guard against the most recent recorded round: report-only

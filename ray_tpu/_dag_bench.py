@@ -1,0 +1,191 @@
+"""Compiled-loop dispatch suite (ROADMAP item 4).
+
+Measures what the persistent compiled-loop runtime (``dag/loop.py``)
+exists to kill: the per-tick dynamic dispatch cost of steady-state
+iteration, and its effect on the pipeline-parallel engine tick path.
+
+Two phases, guarded by ``ray_tpu.bench_check``:
+
+  * **Tick dispatch overhead** — a 2-stage trivial actor pipeline driven
+    (a) dynamically (one ``.remote()`` chain + ``get`` per tick, the
+    submit→lease→push path every iteration) and (b) through a compiled
+    loop (channel write + read per tick, zero task submission).
+
+      - ``dag_tick_dispatch_overhead_dynamic_us`` — dynamic per-tick µs
+      - ``dag_tick_dispatch_overhead_us``         — compiled per-tick µs
+      - ``dag_loop_ticks_per_s``                  — compiled PIPELINED
+        tick rate (puts streamed ``credits`` deep, gets drained behind)
+
+  * **pp decode tok/s** — the debug-model engine over a 1-host sharded
+    executor with a pp=2 mesh, decoding the same workload through the
+    dynamic per-burst RPC path and the compiled loop:
+
+      - ``pp_decode_tok_s_dynamic`` / ``pp_decode_tok_s_compiled``
+
+    On hosts whose jax cannot run the pp shard_map programs (< 2
+    devices, or no ``jax.shard_map``) the phase records
+    ``pp_decode_*_skipped`` markers instead — ``bench_check`` treats the
+    absence as intentional, never as a silent regression.
+
+Sizes are env-tunable (``RAY_TPU_DAG_BENCH_{TICKS,DECODE_BURSTS}``). Run
+standalone via ``python -m ray_tpu.cli bench dag`` or as part of
+``bench.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+def _bench_tick_overhead(out: dict, ticks: int) -> None:
+    import ray_tpu
+    from ray_tpu.dag import InputNode, compile_loop
+
+    @ray_tpu.remote
+    class _Stage:
+        def f(self, x):
+            return x + 1
+
+    a, b = _Stage.remote(), _Stage.remote()
+    # Warm both actors (worker spawn + first-call export are not
+    # dispatch overhead).
+    ray_tpu.get([a.f.remote(0), b.f.remote(0)], timeout=120)
+
+    # Dynamic: the per-tick task path — one submit→lease→push→return
+    # chain per stage per tick, refs threading stage to stage.
+    t0 = time.perf_counter()
+    for i in range(ticks):
+        assert ray_tpu.get(b.f.remote(a.f.remote(i)), timeout=120) == i + 2
+    dyn_s = time.perf_counter() - t0
+    out["dag_tick_dispatch_overhead_dynamic_us"] = round(
+        dyn_s / ticks * 1e6, 1)
+
+    with InputNode() as inp:
+        dag = b.f.bind(a.f.bind(inp))
+    loop = compile_loop(dag)
+    try:
+        assert loop.run(0) == 2  # warm the resident executors
+        # Compiled, synchronous: one full channel round trip per tick —
+        # the steady-state dispatch cost with zero task submission.
+        t0 = time.perf_counter()
+        for i in range(ticks):
+            assert loop.run(i) == i + 2
+        comp_s = time.perf_counter() - t0
+        out["dag_tick_dispatch_overhead_us"] = round(comp_s / ticks * 1e6, 1)
+        # Compiled, pipelined: puts stream ahead of gets (credits deep) —
+        # the sustained tick rate of a busy loop.
+        t0 = time.perf_counter()
+        done = 0
+        for i in range(ticks):
+            loop.put(i)
+            while loop.in_flight >= loop.credits:
+                loop.get()
+                done += 1
+        while done < ticks:
+            loop.get()
+            done += 1
+        out["dag_loop_ticks_per_s"] = round(
+            ticks / (time.perf_counter() - t0), 1)
+    finally:
+        loop.teardown()
+    out["dag_bench_ticks_cfg"] = ticks
+
+
+def _bench_pp_decode(out: dict, bursts: int) -> None:
+    """Debug-model pp=2 decode through the sharded engine, dynamic vs
+    compiled loop. Records skip markers when the host can't run pp."""
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        raise RuntimeError("jax.shard_map unavailable (needs jax >= 0.6)")
+
+    from ray_tpu.llm import InferenceEngine, create_sharded_executor
+    from ray_tpu.llm.engine import Request
+
+    max_slots, max_len, page_size = 4, 128, 16
+
+    def run(use_loop: bool) -> tuple[float, int]:
+        executor = create_sharded_executor(
+            "debug", 1,
+            max_slots=max_slots,
+            num_pages=InferenceEngine.total_pages(max_slots, max_len,
+                                                  page_size),
+            page_size=page_size,
+            pp=2,
+            seed=0,
+            use_compiled_loop=use_loop,
+        )
+        try:
+            eng = InferenceEngine(
+                "debug", max_slots=max_slots, max_len=max_len,
+                page_size=page_size, executor=executor, seed=0)
+            budget = bursts * eng.decode_steps_per_dispatch
+            reqs = [Request(f"r{i}", [7, 3, 5, 9][: i + 1] * 2,
+                            max_new_tokens=budget + 8)
+                    for i in range(max_slots)]
+            for r in reqs:
+                eng.add_request(r)
+            # Drain admission + prefill + first-token flush so the timed
+            # window is pure steady-state decode ticks.
+            while not eng._active or eng._prefilling or eng._pending_first:
+                eng.step()
+            t0 = time.perf_counter()
+            tokens = 0
+            for _ in range(bursts):
+                tokens += len(eng.step())
+            dt = time.perf_counter() - t0
+            return dt, tokens
+        finally:
+            executor.shutdown()
+
+    dyn_s, dyn_tok = run(False)
+    comp_s, comp_tok = run(True)
+    out["pp_decode_tok_s_dynamic"] = round(dyn_tok / dyn_s, 1)
+    out["pp_decode_tok_s_compiled"] = round(comp_tok / comp_s, 1)
+    out["dag_bench_decode_bursts_cfg"] = bursts
+
+
+def run_dag_bench(*, ticks: int | None = None, bursts: int | None = None,
+                  connect: bool = True) -> dict:
+    """Run both phases and return the metrics dict. With ``connect``
+    (default) a local cluster is started and shut down; pass False to
+    run inside an already-initialized driver."""
+    import ray_tpu
+
+    ticks = ticks or _env_int("RAY_TPU_DAG_BENCH_TICKS", 300)
+    bursts = bursts or _env_int("RAY_TPU_DAG_BENCH_DECODE_BURSTS", 12)
+    out: dict = {}
+    if connect:
+        ray_tpu.init(num_cpus=max(8, os.cpu_count() or 8),
+                     ignore_reinit_error=True)
+    try:
+        _bench_tick_overhead(out, ticks)
+        try:
+            _bench_pp_decode(out, bursts)
+        except Exception as e:
+            # Intentional skip on env gaps (bench_check honors the
+            # markers); the real pp numbers come from the chip box.
+            print(f"dag bench: pp decode phase skipped: {e}",
+                  file=sys.stderr)
+            out["pp_decode_skip_reason"] = f"{type(e).__name__}: {e}"
+            out["pp_decode_tok_s_dynamic_skipped"] = True
+            out["pp_decode_tok_s_compiled_skipped"] = True
+    finally:
+        if connect:
+            try:
+                ray_tpu.shutdown()
+            except Exception:
+                pass
+    return out
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run_dag_bench(), indent=2))

@@ -40,7 +40,7 @@ _HIGHER_BETTER_SUFFIX = ("_per_s", "_per_sec")
 # Lower is better. Peak-memory gauges count as regressions when they
 # GROW >threshold (a quiet 2x pool blowup is exactly what they exist
 # to catch).
-_LOWER_BETTER_SUFFIX = ("_ms", "_pct", "_bytes", "_s")
+_LOWER_BETTER_SUFFIX = ("_ms", "_us", "_pct", "_bytes", "_s")
 _LOWER_BETTER_SUBSTR = ("latency", "ttft", "overhead")
 
 

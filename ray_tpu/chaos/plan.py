@@ -33,9 +33,10 @@ KIND_STORE_FULL = "store_full"      # fail an object-store allocation
 KIND_PARTITION = "partition"        # block a peer address set for a window
 KIND_GCS_BLACKOUT = "gcs_blackout"  # partition targeting the GCS endpoint
 KIND_HTTP_INGRESS = "http_ingress"  # drop/delay at the serve HTTP proxy
+KIND_KILL_LOOP = "kill_loop_stage"  # os._exit a loop stage at its Nth tick
 
 _COUNTED_KINDS = (KIND_RPC, KIND_KILL_WORKER, KIND_SPILL_ERROR,
-                  KIND_STORE_FULL, KIND_HTTP_INGRESS)
+                  KIND_STORE_FULL, KIND_HTTP_INGRESS, KIND_KILL_LOOP)
 _WINDOW_KINDS = (KIND_PARTITION, KIND_GCS_BLACKOUT)
 
 # How many future calls a probabilistic rule pre-draws decisions for.
@@ -63,7 +64,8 @@ class FaultPlan:
                 if where not in ("request", "response", "client"):
                     raise FaultPlanError(
                         f"faults[{i}]: where must be request|response|client")
-            elif kind in (KIND_KILL_WORKER, KIND_SPILL_ERROR, KIND_STORE_FULL):
+            elif kind in (KIND_KILL_WORKER, KIND_SPILL_ERROR, KIND_STORE_FULL,
+                          KIND_KILL_LOOP):
                 pass
             elif kind in _WINDOW_KINDS:
                 if float(fault.get("duration_s", 0)) <= 0:
@@ -270,6 +272,16 @@ class PlanChaos(RpcChaos):
                 continue
             if self._take(idx, rule):
                 self._fire(idx, rule, "kill_worker", node_id[:12])
+                return True
+        return False
+
+    def take_kill_loop_tick(self) -> bool:
+        """One compiled-loop stage tick in this process: die here? The
+        tick index is the deterministic coordinate (``nth``-style rules
+        fire at exactly the Nth tick the schedule pre-drew)."""
+        for idx, rule in self._matching(KIND_KILL_LOOP):
+            if self._take(idx, rule):
+                self._fire(idx, rule, "kill_loop_stage", "")
                 return True
         return False
 

@@ -67,26 +67,35 @@ def _cmd_chaos(args) -> int:
 def _cmd_bench(args) -> int:
     # Runs against its OWN local cluster (no --address needed): the suite
     # saturates the task path, which would be rude to a shared cluster.
-    from ray_tpu._core_bench import run_core_bench
+    if args.bench_cmd == "dag":
+        from ray_tpu._dag_bench import run_dag_bench
 
-    result = run_core_bench(num_tasks=args.tasks, num_actors=args.actors,
-                            calls_per_actor=args.calls,
-                            num_objects=args.objects)
+        result = run_dag_bench(ticks=args.ticks, bursts=args.bursts)
+        ok = bool(result.get("dag_tick_dispatch_overhead_us"))
+        prefixes = ("dag_", "pp_decode_")
+    else:
+        from ray_tpu._core_bench import run_core_bench
+
+        result = run_core_bench(num_tasks=args.tasks, num_actors=args.actors,
+                                calls_per_actor=args.calls,
+                                num_objects=args.objects)
+        ok = bool(result.get("core_tasks_per_s"))
+        prefixes = ("core_",)
     print(json.dumps(result, indent=None if args.as_json else 2))
     if args.check_against:
         from ray_tpu import bench_check
 
         # A recorded BENCH_r*.json carries train/serve/flash metrics this
-        # standalone run never produces — compare the core_* slice only.
+        # standalone run never produces — compare this suite's slice only.
         old = {k: v for k, v in
                bench_check.load_metrics(args.check_against).items()
-               if k.startswith("core_")}
+               if k.startswith(prefixes)}
         report = bench_check.compare(old, result)
         print(bench_check.format_report(report, args.check_against,
                                         "this run"), file=sys.stderr)
         if report["regressions"] or report["missing"]:
             return 1
-    return 0 if result.get("core_tasks_per_s") else 1
+    return 0 if ok else 1
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -145,6 +154,21 @@ def main(argv: list[str] | None = None) -> int:
     bcore.add_argument("--check-against", default=None, metavar="BENCH_JSON",
                        help="run ray_tpu.bench_check against a recorded "
                             "BENCH_r*.json and exit non-zero on regression")
+    bdag = bench_sub.add_parser(
+        "dag", help="compiled-loop dispatch suite: per-tick overhead "
+                    "dynamic vs compiled (dag_tick_dispatch_overhead*_us, "
+                    "dag_loop_ticks_per_s) + pp=2 engine decode tok/s "
+                    "through both paths (pp_decode_tok_s_*; skip markers "
+                    "where the pp shard_map can't run)")
+    bdag.add_argument("--ticks", type=int, default=None,
+                      help="tick-overhead iterations (default "
+                           "$RAY_TPU_DAG_BENCH_TICKS or 300)")
+    bdag.add_argument("--bursts", type=int, default=None,
+                      help="timed decode bursts per mode (default "
+                           "$RAY_TPU_DAG_BENCH_DECODE_BURSTS or 12)")
+    bdag.add_argument("--check-against", default=None, metavar="BENCH_JSON",
+                      help="run ray_tpu.bench_check against a recorded "
+                           "BENCH_r*.json and exit non-zero on regression")
     serve_p = sub.add_parser(
         "serve", help="Serve control-plane inspection")
     serve_sub = serve_p.add_subparsers(dest="serve_cmd", required=True)

@@ -222,6 +222,12 @@ class RayTpuConfig:
     # --- compiled graphs -----------------------------------------------------
     dag_ready_timeout_s: float = 120.0
     dag_channel_capacity: int = 1 << 20
+    # Compiled LOOPS (dag/loop.py): ring depth = max iterations in flight
+    # before put() backpressures, and the dag.loop.tick span sampling
+    # stride (0 disables tick spans; every tick still counts in the
+    # ray_tpu_dag_loop_ticks_total metric).
+    dag_loop_credits: int = 8
+    dag_loop_span_every: int = 64
 
     # --- serve ---------------------------------------------------------------
     serve_router_assign_timeout_s: float = 60.0

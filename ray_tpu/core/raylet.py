@@ -134,6 +134,12 @@ class WorkerHandle:
     # pushes_total sampled at the watchdog's first orphan probe (a second
     # unchanged sample confirms the owner really never used the lease).
     orphan_probe: int | None = None
+    # Worker parks a resident compiled-loop executor (dag/loop.py): the
+    # owner declared it via PinLoopWorker. A parked loop is indistinguishable
+    # from a stranded grant to the orphan watchdog (no pushes, never
+    # finishes, probe may be unreachable under chaos) — pinned leases are
+    # exempt from orphan reclaim until the owner unpins at loop teardown.
+    loop_pinned: bool = False
 
 
 class Raylet:
@@ -1414,6 +1420,18 @@ class Raylet:
                 best = (node, nr.utilization())
         return best[0] if best else None
 
+    async def handle_PinLoopWorker(self, p: dict) -> dict:
+        """Mark/unmark the worker hosting ``actor_id`` as parking a
+        resident compiled-loop executor (exempt from orphan-lease
+        reclaim — see WorkerHandle.loop_pinned)."""
+        actor_id = p.get("actor_id") or ""
+        pinned = bool(p.get("pinned", True))
+        for w in self._workers.values():
+            if actor_id and w.actor_id == actor_id and w.state != "dead":
+                w.loop_pinned = pinned
+                return {"ok": True, "worker_id": w.worker_id}
+        return {"ok": False}
+
     async def handle_AckLease(self, p: dict) -> dict:
         """Owner (or the GCS, for dedicated leases) confirms it received
         the grant reply. Un-acked leases past ``lease_orphan_timeout_s``
@@ -2322,6 +2340,8 @@ class Raylet:
             "oom_kills_total": self._oom_kills_total,
             "wedge_events_total": self._wedge_events_total,
             "orphan_leases_total": self._orphan_leases_total,
+            "loop_pinned_workers": sum(
+                1 for w in self._workers.values() if w.loop_pinned),
         }
 
     async def handle_GetDebugState(self, p: dict) -> dict:
@@ -2431,6 +2451,12 @@ class Raylet:
         now = chaos_clock.now()
         for w in list(self._workers.values()):
             if w.state not in ("leased", "dedicated") or w.lease_acked:
+                continue
+            if w.loop_pinned:
+                # The owner declared a parked compiled-loop executor on
+                # this worker: it legitimately never finishes, never
+                # pushes, and may be unprobeable mid-chaos — reclaiming
+                # it would kill a live pipeline. Unpinned at teardown.
                 continue
             if not w.lease_granted_at or now - w.lease_granted_at < timeout:
                 continue

@@ -218,6 +218,11 @@ class RpcChaos:
         """Raylet asks: kill the worker of the lease just granted?"""
         return False
 
+    def take_kill_loop_tick(self) -> bool:
+        """A compiled-loop stage executor asks, once per tick: die here
+        (between consuming inputs and producing output)?"""
+        return False
+
     def maybe_fail_spill(self) -> bool:
         """Raylet asks: fail this spill-file disk write?"""
         return False

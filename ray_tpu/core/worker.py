@@ -496,6 +496,33 @@ class CoreWorker:
     def _raylet_call(self, method: str, payload: dict, timeout: float | None = 30.0) -> dict:
         return self.io.run_sync(self.raylet.call(method, payload, timeout))
 
+    def pin_loop_worker(self, actor_id: str, pinned: bool,
+                        node_id: str | None = None) -> bool:
+        """Tell the raylet hosting ``actor_id`` that its worker parks a
+        resident compiled-loop executor (``dag/loop.py``): pinned leases
+        are exempt from the orphan-lease watchdog's reclaim (a parked
+        loop looks exactly like a stranded grant — no pushes, no
+        finished task — and reclaiming it would kill a live pipeline)."""
+        async def _go() -> bool:
+            addr = (await self._raylet_address_for(node_id)
+                    if node_id else self.raylet_address)
+            if addr is None:
+                return False
+            client = RpcClient(addr)
+            try:
+                reply = await client.call(
+                    "PinLoopWorker",
+                    {"actor_id": actor_id, "pinned": bool(pinned)},
+                    timeout=10.0)
+                return bool(reply.get("ok"))
+            finally:
+                await client.close()
+
+        try:
+            return self.io.run_sync(_go())
+        except Exception:
+            return False  # pinning is protective, never fatal
+
     # -------------------------------------------------------------- refcount
     def _hook_add_local(self, ref: ObjectRef) -> None:
         oid = ref.id()

@@ -10,9 +10,11 @@ read. The channel is a seqlock'd mmap in /dev/shm (``channel.py``)
 standing in for the reference's versioned mutable plasma objects.
 """
 
-from .channel import Channel
+from .channel import Channel, RingChannel
 from .compiled import CompiledDAG
+from .loop import CompiledLoop, compile_loop
 from .nodes import AllReduceNode, ClassMethodNode, InputNode, MultiOutputNode, collective
 
-__all__ = ["AllReduceNode", "Channel", "CompiledDAG", "ClassMethodNode", "InputNode",
-           "MultiOutputNode", "collective"]
+__all__ = ["AllReduceNode", "Channel", "CompiledDAG", "CompiledLoop",
+           "ClassMethodNode", "InputNode", "MultiOutputNode", "RingChannel",
+           "collective", "compile_loop"]

@@ -102,6 +102,171 @@ class Channel:
             pass
 
 
+# ------------------------------------------------------------- ring channel
+# Credit-based STREAMING channel for compiled loops (dag/loop.py): unlike
+# the latest-wins mutable Channel above, every message is delivered
+# exactly once per reader, and the writer blocks once it runs
+# ``n_slots`` messages ahead of the slowest reader — backpressure
+# propagates hop by hop through a pipeline without any control RPCs
+# (the reference's bounded-buffer compiled-graph channels). Layout:
+#
+#     [u64 write_seq][u64 n_readers][u64 cursor * n_readers]
+#     [slot 0: u64 len + payload] ... [slot n_slots-1]
+#
+# Slot ``s`` holds message ``seq`` iff ``seq % n_slots == s``. A slot is
+# only rewritten after every reader's cursor has passed it (the credit
+# protocol), so no seqlock is needed: the writer fills the payload, then
+# publishes by bumping ``write_seq``. A ``len`` of STOP closes the
+# channel; readers drain every message queued before it, then raise
+# ChannelClosed forever after (close-after-drain semantics — loop
+# teardown lets in-flight iterations finish).
+
+_RING_HEAD = struct.Struct("<QQ")
+_SLOT_HEAD = struct.Struct("<Q")
+
+
+class RingChannel:
+    """Single-writer multi-reader bounded ring over an mmap'd shm file.
+
+    One process opens the writer end (``reader_index=None``); each
+    consumer opens a reader end with its compile-assigned
+    ``reader_index`` in ``[0, n_readers)``. ``write`` blocks while the
+    ring is full (slowest reader more than ``n_slots`` behind).
+    """
+
+    def __init__(self, path: str, slot_size: int, n_slots: int,
+                 n_readers: int = 1, create: bool = False,
+                 reader_index: int | None = None):
+        self.path = path
+        self.slot_size = slot_size
+        self.n_slots = n_slots
+        self.reader_index = reader_index
+        if create:
+            fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+        else:
+            # The reader-cursor table sizes the layout: always take the
+            # authoritative count from the creator's header.
+            fd = os.open(path, os.O_RDWR)
+            n_readers = _RING_HEAD.unpack(os.pread(fd, _RING_HEAD.size, 0))[1]
+        self.n_readers = n_readers
+        self._cursor_off = _RING_HEAD.size
+        self._slots_off = _RING_HEAD.size + 8 * n_readers
+        total = self._slots_off + n_slots * (_SLOT_HEAD.size + slot_size)
+        if create:
+            os.ftruncate(fd, total)
+        self._fd = fd
+        self._mm = mmap.mmap(fd, total)
+        self._view = memoryview(self._mm)
+        if create:
+            _RING_HEAD.pack_into(self._view, 0, 0, n_readers)
+
+    # ------------------------------------------------------------ internals
+    def _write_seq(self) -> int:
+        return _RING_HEAD.unpack_from(self._view, 0)[0]
+
+    def _cursor(self, r: int) -> int:
+        return struct.unpack_from("<Q", self._view, self._cursor_off + 8 * r)[0]
+
+    def _min_cursor(self) -> int:
+        return min(self._cursor(r) for r in range(self.n_readers))
+
+    def _slot(self, seq: int) -> int:
+        return self._slots_off + (seq % self.n_slots) * (
+            _SLOT_HEAD.size + self.slot_size)
+
+    def occupancy(self) -> int:
+        """Messages written but not yet consumed by the slowest reader —
+        the channel-fill gauge the loop runtime exports."""
+        return self._write_seq() - self._min_cursor()
+
+    # ------------------------------------------------------------------ write
+    def write(self, payload: bytes, timeout: float | None = None) -> None:
+        if len(payload) > self.slot_size:
+            raise ValueError(
+                f"message of {len(payload)} bytes exceeds ring slot size "
+                f"{self.slot_size} (raise max_buffer_size at compile time)")
+        seq = self._wait_for_credit(timeout)
+        off = self._slot(seq)
+        _SLOT_HEAD.pack_into(self._view, off, len(payload))
+        self._view[off + _SLOT_HEAD.size:
+                   off + _SLOT_HEAD.size + len(payload)] = payload
+        _RING_HEAD.pack_into(self._view, 0, seq + 1, self.n_readers)
+
+    def _wait_for_credit(self, timeout: float | None) -> int:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 1e-5
+        while True:
+            seq = self._write_seq()
+            if seq - self._min_cursor() < self.n_slots:
+                return seq
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"ring {self.path} full past {timeout}s (no reader credit)")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.001)
+
+    def close_writer(self, timeout: float | None = 30.0) -> None:
+        """Queue a STOP after everything already written (close-after-
+        drain). Falls back to ``force_close`` if readers never free a
+        slot within ``timeout`` (dead consumer)."""
+        try:
+            seq = self._wait_for_credit(timeout)
+        except TimeoutError:
+            self.force_close()
+            return
+        _SLOT_HEAD.pack_into(self._view, self._slot(seq), STOP)
+        _RING_HEAD.pack_into(self._view, 0, seq + 1, self.n_readers)
+
+    def force_close(self) -> None:
+        """Overwrite the OLDEST unconsumed slot with STOP, ignoring
+        credits. Loses queued messages — teardown-after-failure only
+        (e.g. the writing stage died and the driver unblocks its
+        consumers)."""
+        seq = max(self._min_cursor(), self._write_seq() - self.n_slots + 1)
+        _SLOT_HEAD.pack_into(self._view, self._slot(seq), STOP)
+        if self._write_seq() <= seq:
+            _RING_HEAD.pack_into(self._view, 0, seq + 1, self.n_readers)
+
+    # ------------------------------------------------------------------- read
+    def read(self, timeout: float | None = None) -> bytes:
+        """Next message for this reader end (exactly-once, in order).
+        Consuming it releases the slot back to the writer (the credit)."""
+        r = self.reader_index
+        if r is None:
+            raise RuntimeError("this end of the ring is the writer")
+        cur = self._cursor(r)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 1e-5
+        while self._write_seq() <= cur:
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"ring {self.path} idle past {timeout}s")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.001)
+        off = self._slot(cur)
+        (length,) = _SLOT_HEAD.unpack_from(self._view, off)
+        if length == STOP:
+            raise ChannelClosed(self.path)  # cursor stays: STOP is sticky
+        payload = bytes(self._view[off + _SLOT_HEAD.size:
+                                   off + _SLOT_HEAD.size + length])
+        struct.pack_into("<Q", self._view, self._cursor_off + 8 * r, cur + 1)
+        return payload
+
+    def close(self) -> None:
+        try:
+            self._view.release()
+            self._mm.close()
+            os.close(self._fd)
+        except Exception:
+            pass
+
+    def unlink(self) -> None:
+        self.close()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
 # ---------------------------------------------------------------- cross-node
 # TCP mutable channels with the same latest-wins/seq semantics as the shm
 # channel, for DAG edges whose endpoints live on different nodes (reference
@@ -235,3 +400,154 @@ def _recv_exact(conn: socket.socket, n: int) -> bytes | None:
             return None
         buf += chunk
     return buf
+
+
+# ----------------------------------------------------------- cross-node loop
+# Streaming (exactly-once, credit-bounded) TCP channel for compiled-loop
+# edges whose endpoints live on different nodes: the server buffers the
+# last ``n_slots`` messages and ``write`` blocks until the slowest of the
+# ``n_readers`` expected readers has consumed far enough — the TCP
+# equivalent of RingChannel, same close-after-drain STOP semantics.
+
+class TcpLoopServer:
+    """Writer end of a cross-node loop channel."""
+
+    def __init__(self, n_slots: int, n_readers: int = 1,
+                 host: str = "0.0.0.0", advertise: str | None = None):
+        self.n_slots = n_slots
+        self.n_readers = n_readers
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(64)
+        port = self._sock.getsockname()[1]
+        self.address = f"{advertise or '127.0.0.1'}:{port}"
+        self._cond = threading.Condition()
+        self._seq = 0                      # messages written so far
+        self._buffer: dict[int, bytes] = {}  # seq -> payload (last n_slots)
+        self._acked: dict[int, int] = {}   # conn id -> messages consumed
+        self._stopped = False
+        self._closed = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _min_acked(self) -> int:
+        # Readers that have not connected yet count as cursor 0 — the
+        # writer can run at most n_slots ahead of a late joiner.
+        acked = list(self._acked.values())
+        while len(acked) < self.n_readers:
+            acked.append(0)
+        return min(acked)
+
+    def occupancy(self) -> int:
+        with self._cond:
+            return self._seq - self._min_acked()
+
+    def write(self, payload: bytes, timeout: float | None = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._seq - self._min_acked() >= self.n_slots:
+                if self._closed:
+                    raise ChannelClosed(self.address)
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"loop channel {self.address} full past {timeout}s")
+                self._cond.wait(0.05)
+            self._buffer[self._seq] = bytes(payload)
+            self._seq += 1
+            self._buffer.pop(self._seq - self.n_slots - 1, None)
+            self._cond.notify_all()
+
+    def close_writer(self, timeout: float | None = None) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+    force_close = close_writer  # queued messages still drain; then STOP
+
+    def close(self) -> None:
+        self._closed = True
+        with self._cond:
+            self._cond.notify_all()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        cid = id(conn)
+        try:
+            while True:
+                req = _recv_exact(conn, _REQ.size)
+                if req is None:
+                    return
+                (cursor,) = _REQ.unpack(req)  # messages consumed so far
+                with self._cond:
+                    self._acked[cid] = max(self._acked.get(cid, 0), cursor)
+                    self._cond.notify_all()
+                    while self._seq <= cursor and not self._stopped:
+                        self._cond.wait(1.0)
+                        if self._closed:
+                            return
+                    if self._seq <= cursor and self._stopped:
+                        conn.sendall(_FRAME.pack(cursor, STOP_LEN))
+                        continue
+                    payload = self._buffer.get(cursor)
+                if payload is None:
+                    # Reader fell behind the buffer window (only possible
+                    # after a force_close raced it): surface as closed.
+                    conn.sendall(_FRAME.pack(cursor, STOP_LEN))
+                    continue
+                conn.sendall(_FRAME.pack(cursor, len(payload)) + payload)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class TcpLoopReader:
+    """Reader end: blocking, exactly-once, in-order (mirrors
+    RingChannel.read)."""
+
+    def __init__(self, address: str, connect_timeout: float = 30.0):
+        host, _, port = address.rpartition(":")
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._cursor = 0
+
+    def read(self, timeout: float | None = None) -> bytes:
+        self._sock.settimeout(timeout)
+        try:
+            self._sock.sendall(_REQ.pack(self._cursor))
+            head = _recv_exact(self._sock, _FRAME.size)
+            if head is None:
+                raise ChannelClosed("loop channel writer gone")
+            _seq, length = _FRAME.unpack(head)
+            if length == STOP_LEN:
+                raise ChannelClosed("loop channel stopped")
+            payload = _recv_exact(self._sock, length)
+            if payload is None:
+                raise ChannelClosed("loop channel writer gone")
+            self._cursor += 1
+            return payload
+        except socket.timeout:
+            raise TimeoutError(f"loop channel idle past {timeout}s")
+        finally:
+            self._sock.settimeout(None)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

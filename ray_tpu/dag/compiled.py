@@ -345,9 +345,20 @@ class CompiledDAG:
     # ---------------------------------------------------------------- execute
     def execute(self, value, timeout: float = 60.0):
         """Push one input through the graph; returns the output (or tuple
-        of outputs for MultiOutputNode). Synchronous: one round at a time."""
+        of outputs for MultiOutputNode). Synchronous: one round at a time.
+
+        ``timeout`` is ONE deadline for the whole round (not per output
+        channel). A timed-out round poisons the pipeline — the parked
+        executors may still be mid-compute, and their late results would
+        desync every later round's cursors — so the DAG tears itself
+        down: this call raises TimeoutError, and every subsequent
+        ``execute`` raises ChannelClosed (never hangs, never returns a
+        stale round)."""
         if self._torn_down:
-            raise RuntimeError("DAG has been torn down")
+            raise ChannelClosed("DAG has been torn down")
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
         self._input.write(_pack(value))
         # Drain EVERY output before raising: skipping channels on error
         # would leave their cursors one round behind and desync all later
@@ -355,15 +366,23 @@ class CompiledDAG:
         results, first_error = [], None
         for i, ch in enumerate(self._out_channels):
             try:
-                payload, seq = ch.read(self._out_cursors[i], timeout=timeout)
+                payload, seq = ch.read(
+                    self._out_cursors[i],
+                    timeout=max(0.0, deadline - _time.monotonic()))
             except TimeoutError:
                 # Surface a dead loop's real error instead of the timeout.
                 from ..core import api as ray
 
                 done, _ = ray.wait(list(self._loop_refs), num_returns=1, timeout=0)
-                if done:
-                    ray.get(done[0])
-                raise
+                try:
+                    if done:
+                        ray.get(done[0])
+                    raise
+                finally:
+                    # Tear down rather than leave a desynced pipeline: the
+                    # executor blocked on this round would complete it
+                    # AFTER our cursors moved on.
+                    self.teardown(timeout=1.0)
             self._out_cursors[i] = seq
             result, is_error = _unpack(payload)
             if is_error and first_error is None:

@@ -1,0 +1,538 @@
+"""Persistent compiled loops: the streaming sibling of ``CompiledDAG``.
+
+``CompiledDAG.execute()`` is one-shot — each call pushes ONE input and
+synchronously drains ONE output round. A steady-state iteration loop
+(the pp inference engine's decode tick path, a training step loop) wants
+the other half of the reference's compiled-graph design: pre-negotiate
+resources ONCE, then stream iterations over dedicated channels with NO
+per-tick task submission, RPC, or lease traffic at all.
+
+``compile_loop(graph)`` installs a never-returning tick executor on each
+stage actor (one ``__ray_call__`` submission per stage — the only task
+the loop ever submits), wires the stages with credit-based streaming
+channels (``RingChannel`` shm rings node-locally, ``TcpLoopServer``
+across nodes), and returns a :class:`CompiledLoop`:
+
+  * ``loop.put(x)`` enqueues an iteration input; it blocks only when the
+    pipeline is ``credits`` iterations deep (backpressure propagates hop
+    by hop through the ring credits — no control RPCs).
+  * ``loop.get()`` returns the next iteration's output(s), in order,
+    exactly once. ``put``/``get`` may run from different threads;
+    ``run(x)`` is the synchronous convenience.
+  * ``loop.teardown()`` closes the input ring; ``ChannelClosed`` cascades
+    stage to stage exactly like the one-shot DAG — in-flight iterations
+    drain first (close-after-drain STOP semantics).
+
+Differences from the one-shot DAG worth knowing:
+
+  * Channels DELIVER EVERY MESSAGE (bounded ring), not latest-wins — an
+    iteration can never be overwritten by the next one.
+  * Stage errors serialize through the pipe per iteration: the loop
+    survives, the failing iteration's ``get()`` re-raises.
+  * Stage workers are LEASE-PINNED: the raylet is told these workers
+    park a resident loop, so the chaos orphan-lease watchdog never
+    reclaims them as stranded grants (``PinLoopWorker``).
+  * Observability: every stage counts ``ray_tpu_dag_loop_ticks_total``
+    and gauges its output-channel occupancy; one ``dag.loop.tick`` span
+    per ``dag_loop_span_every`` ticks rides the normal span flush.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+import uuid
+
+from .channel import (ChannelClosed, RingChannel, TcpLoopReader,
+                      TcpLoopServer)
+from .compiled import _pack, _pack_error, _probe_node, _routable_host, _unpack
+from .nodes import ClassMethodNode, DAGNode, InputNode, MultiOutputNode
+
+
+def _open_loop_reader(spec):
+    """Open the reader end of an input spec ("ring", path, slots, readers,
+    index) or ("tcp", address)."""
+    if spec[0] == "tcp":
+        return TcpLoopReader(spec[1])
+    _, path, slot_size, n_slots, _n_readers, index = spec
+    return RingChannel(path, slot_size, n_slots, reader_index=index)
+
+
+def _create_loop_out_server(instance, n_slots: int, n_readers: int) -> str:
+    """Phase-1 for a cross-node loop producer: create the streaming TCP
+    server in the actor process and return its address."""
+    server = TcpLoopServer(n_slots, n_readers, advertise=_routable_host())
+    instance.__dict__["_dag_loop_out_server"] = server
+    return server.address
+
+
+_tick_metrics = None
+
+
+def _loop_metrics():
+    """Per-process loop metrics, created lazily so loop-free processes
+    never start the metrics flusher."""
+    global _tick_metrics
+    if _tick_metrics is None:
+        from ..util.metrics import Counter, Gauge
+
+        _tick_metrics = (
+            Counter("ray_tpu_dag_loop_ticks_total",
+                    "Iterations executed by resident compiled-loop stages",
+                    tag_keys=("loop", "stage")),
+            Gauge("ray_tpu_dag_loop_channel_occupancy",
+                  "Unconsumed iterations queued in a loop stage's output "
+                  "channel (0..credits; credits = backpressure engaged)",
+                  tag_keys=("loop", "stage")),
+        )
+    return _tick_metrics
+
+
+def _loop_tick(instance, method_name: str, in_specs: list, out_desc,
+               loop_id: str, span_every: int) -> str:
+    """The resident tick executor (ships to the stage actor via
+    ``__ray_call__`` and never returns until teardown): read one
+    iteration's inputs, apply the bound method, stream the result out.
+    Blocking anywhere in the channel protocol IS the backpressure."""
+    from ..core.rpc import get_chaos
+
+    readers = {i: _open_loop_reader(spec) for i, (kind, spec)
+               in enumerate(in_specs) if kind == "chan"}
+    if out_desc[0] == "tcp":
+        out = instance.__dict__.pop("_dag_loop_out_server")
+    else:
+        _, path, slot_size, n_slots, n_readers = out_desc
+        out = RingChannel(path, slot_size, n_slots)
+        with open(path + ".ready", "w") as f:
+            f.write("1")  # compile blocks on this marker (see _wait_ready)
+    method = getattr(instance, method_name)
+    ticks = 0
+    counter, occupancy = _loop_metrics()
+    tags = {"loop": loop_id, "stage": method_name}
+    try:
+        while True:
+            args, upstream_error = [], None
+            for i, (kind, spec) in enumerate(in_specs):
+                if kind == "const":
+                    args.append(spec)
+                    continue
+                value, is_error = _unpack(readers[i].read())
+                if is_error and upstream_error is None:
+                    upstream_error = value
+                args.append(value)
+            if get_chaos().take_kill_loop_tick():
+                # Deterministic chaos: this stage dies mid-loop, exactly
+                # between consuming its inputs and producing its output.
+                os._exit(1)
+            if upstream_error is not None:
+                out.write(_pack_error(upstream_error))
+                ticks += 1
+                continue
+            t0 = time.time()
+            try:
+                result = method(*args)
+                payload = _pack(result)  # inside try: unpicklable results
+            except Exception as e:
+                import traceback
+
+                from ..core.status import RayTaskError
+
+                payload = _pack_error(
+                    RayTaskError(method_name, traceback.format_exc(), e))
+            out.write(payload)
+            ticks += 1
+            counter.inc(tags=tags)
+            occupancy.set(out.occupancy(), tags=tags)
+            if span_every and ticks % span_every == 0:
+                from ..observability import tracing
+
+                tracing.record_span(tracing.make_span(
+                    "dag.loop.tick", "dag", t0, time.time(), loop_id,
+                    attrs={"stage": method_name, "tick": ticks,
+                           "out_occupancy": out.occupancy()}))
+    except ChannelClosed:
+        out.close_writer()  # cascade teardown downstream
+        return "closed"
+    finally:
+        for r in readers.values():
+            r.close()
+        out.close()
+
+
+class CompiledLoop:
+    """A compiled, resident iteration pipeline over stage actors.
+
+    Build with :func:`compile_loop` (or
+    ``node.experimental_compile_loop()``). One ``put`` produces exactly
+    one ``get``-able output round; rounds stream in order with at most
+    ``credits`` iterations in flight.
+    """
+
+    def __init__(self, output_node: DAGNode, max_buffer_size: int | None = None,
+                 credits: int | None = None):
+        from ..core import api as ray
+        from ..core.config import get_config
+
+        cfg = get_config()
+        self.capacity = max_buffer_size or cfg.dag_channel_capacity
+        self.credits = max(2, credits or cfg.dag_loop_credits)
+        self._span_every = cfg.dag_loop_span_every
+        self._dir: str | None = None
+        self._input_node: InputNode | None = None
+        self._outputs: list[ClassMethodNode] = []
+        self._loop_refs = []
+        self._torn_down = False
+        self._broken: str | None = None
+        self._puts = 0
+        self._gets = 0
+        self._resume: list | None = None  # partial round after a get timeout
+        from ..observability import tracing
+
+        self.loop_id = tracing.new_trace_id()
+
+        if isinstance(output_node, MultiOutputNode):
+            self._outputs = list(output_node.outputs)
+        else:
+            self._outputs = [output_node]
+        for out in self._outputs:
+            if not isinstance(out, ClassMethodNode):
+                raise TypeError("loop outputs must be actor method nodes")
+        if len({id(o) for o in self._outputs}) != len(self._outputs):
+            raise ValueError("a node may appear only once in a loop's "
+                             "outputs (duplicates would alias ring cursors)")
+
+        order = self._toposort()
+        if self._input_node is None:
+            raise ValueError("a compiled loop needs an InputNode")
+        seen_actors: dict[bytes, str] = {}
+        self._stage_nodes: list[ClassMethodNode] = []
+        for node in order:
+            if not isinstance(node, ClassMethodNode):
+                continue
+            if hasattr(node, "materialize_actor"):
+                node.materialize_actor()
+            actor_id = node.actor._actor_id
+            if actor_id in seen_actors:
+                raise ValueError(
+                    f"actor used by both '{seen_actors[actor_id]}' and "
+                    f"'{node.method_name}' — a compiled loop supports one "
+                    "node per actor (create a separate actor per stage)")
+            seen_actors[actor_id] = node.method_name
+            self._stage_nodes.append(node)
+
+        # Consumers per producer, in deterministic order; one reader end
+        # per (consumer, arg position) so a node consuming the same
+        # upstream twice gets two independent cursors. The driver is the
+        # final consumer of every output node.
+        consumers: dict[int, list] = {id(n): [] for n in order}
+        for node in order:
+            if isinstance(node, ClassMethodNode):
+                for pos, arg in enumerate(node.args):
+                    if isinstance(arg, DAGNode):
+                        consumers[id(arg)].append((node, pos))
+        for out in self._outputs:
+            consumers[id(out)].append(("driver", 0))
+
+        driver_node = ray.get_runtime_context().node_id
+        node_of: dict[int, str] = {id(self._input_node): driver_node}
+        for node in self._stage_nodes:
+            node_of[id(node)] = ray.get(
+                node.actor.__ray_call__.remote(_probe_node), timeout=60)
+
+        self._dir = tempfile.mkdtemp(prefix="raytpu_dag_", dir="/dev/shm")
+        # Producer -> writer descriptor + per-consumer reader specs.
+        self._out_desc: dict[int, tuple] = {}
+        self._reader_spec: dict[tuple, tuple] = {}  # (prod id, consumer idx)
+        self._ring_paths: list[str] = []
+        self._input_server = None
+        for node in order:
+            cons = consumers[id(node)]
+            if not cons:
+                continue
+            n_readers = len(cons)
+            # shm ring when every endpoint (producer + all consumers,
+            # driver included) shares a node; streaming TCP otherwise.
+            local = all(
+                (driver_node if c[0] == "driver" else node_of[id(c[0])])
+                == node_of[id(node)] for c in cons)
+            if local:
+                path = os.path.join(self._dir, f"lp_{uuid.uuid4().hex[:10]}")
+                RingChannel(path, self.capacity, self.credits,
+                            n_readers=n_readers, create=True).close()
+                self._ring_paths.append(path)
+                self._out_desc[id(node)] = (
+                    "ring", path, self.capacity, self.credits, n_readers)
+                for idx in range(n_readers):
+                    self._reader_spec[(id(node), idx)] = (
+                        "ring", path, self.capacity, self.credits,
+                        n_readers, idx)
+            elif node is self._input_node:
+                self._input_server = TcpLoopServer(
+                    self.credits, n_readers, advertise=_routable_host())
+                self._out_desc[id(node)] = ("tcp", self._input_server.address)
+                for idx in range(n_readers):
+                    self._reader_spec[(id(node), idx)] = (
+                        "tcp", self._input_server.address)
+            else:
+                addr = ray.get(node.actor.__ray_call__.remote(
+                    _create_loop_out_server, self.credits, n_readers),
+                    timeout=60)
+                self._out_desc[id(node)] = ("tcp", addr)
+                for idx in range(n_readers):
+                    self._reader_spec[(id(node), idx)] = ("tcp", addr)
+
+        # Driver ends: the input writer + one reader per output node.
+        in_desc = self._out_desc[id(self._input_node)]
+        if in_desc[0] == "tcp":
+            self._input = self._input_server
+        else:
+            self._input = RingChannel(in_desc[1], self.capacity, self.credits)
+        self._out_readers = []
+        for node in self._outputs:
+            idx = consumers[id(node)].index(("driver", 0))
+            self._out_readers.append(
+                _open_loop_reader(self._reader_spec[(id(node), idx)]))
+
+        # Install the resident tick executors, upstream-last so consumers
+        # are listening before producers can emit.
+        self._actors = []
+        self._actor_nodes: list[tuple[str, str]] = []  # (actor hex, node id)
+        for node in self._stage_nodes:
+            self._actor_nodes.append(
+                (node.actor._actor_id.hex(), node_of[id(node)]))
+            in_specs = []
+            for pos, arg in enumerate(node.args):
+                if isinstance(arg, DAGNode):
+                    idx = consumers[id(arg)].index((node, pos))
+                    in_specs.append(
+                        ("chan", self._reader_spec[(id(arg), idx)]))
+                else:
+                    in_specs.append(("const", arg))
+            ref = node.actor.__ray_call__.remote(
+                _loop_tick, node.method_name, in_specs,
+                self._out_desc[id(node)], self.loop_id, self._span_every)
+            self._loop_refs.append(ref)
+            self._actors.append(node.actor)
+        self._wait_ready(timeout=cfg.dag_ready_timeout_s)
+        # Lease-pin the stage workers: these actors now park a resident
+        # loop task, and the orphan-lease watchdog must not mistake the
+        # (idle-looking, never-returning) lease for a stranded grant.
+        self._pinned = self._pin_workers(True)
+
+    # ------------------------------------------------------------- plumbing
+    def _toposort(self) -> list[DAGNode]:
+        order: list[DAGNode] = []
+        seen: set[int] = set()
+
+        def visit(node: DAGNode) -> None:
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            if isinstance(node, InputNode):
+                if self._input_node is not None and self._input_node is not node:
+                    raise ValueError("a compiled loop supports one InputNode")
+                self._input_node = node
+                order.append(node)
+                return
+            if isinstance(node, ClassMethodNode):
+                if not node.upstream():
+                    raise ValueError(
+                        f"{node.method_name}.bind(...) has no upstream node — "
+                        "a loop stage needs at least one DAG input")
+                for up in node.upstream():
+                    visit(up)
+                order.append(node)
+                return
+            raise TypeError(f"unsupported DAG node {type(node).__name__}")
+
+        for out in self._outputs:
+            visit(out)
+        return order
+
+    def _wait_ready(self, timeout: float) -> None:
+        from ..core import api as ray
+
+        markers = [desc[1] + ".ready"
+                   for nid, desc in self._out_desc.items()
+                   if desc[0] == "ring" and nid != id(self._input_node)]
+        deadline = time.monotonic() + timeout
+        while True:
+            if all(os.path.exists(m) for m in markers):
+                return
+            done, _ = ray.wait(list(self._loop_refs), num_returns=1, timeout=0)
+            if done:
+                ray.get(done[0])
+                raise RuntimeError("loop executor exited during compile")
+            if time.monotonic() > deadline:
+                missing = [m for m in markers if not os.path.exists(m)]
+                raise TimeoutError(
+                    f"{len(missing)} loop executor(s) not ready after "
+                    f"{timeout}s: {missing[:3]}")
+            time.sleep(0.01)
+
+    def _pin_workers(self, pinned: bool) -> bool:
+        try:
+            from ..core.worker import global_worker
+
+            w = global_worker()
+            for actor_hex, node_id in self._actor_nodes:
+                w.pin_loop_worker(actor_hex, pinned, node_id=node_id)
+            return pinned
+        except Exception:
+            return False  # pinning is protective, never fatal
+
+    def _check_stage_death(self) -> None:
+        """A completed loop ref at steady state means its stage DIED (or
+        its install failed): surface the real error and break the loop."""
+        from ..core import api as ray
+
+        done, _ = ray.wait(list(self._loop_refs), num_returns=1, timeout=0)
+        if not done:
+            return
+        try:
+            result = ray.get(done[0])
+            if result == "closed":
+                return  # normal cascade exit, not a death
+            err: Exception = ChannelClosed(f"loop stage exited: {result!r}")
+        except Exception as e:
+            err = e
+        self._break(f"stage died: {err}")
+        raise err
+
+    def _break(self, reason: str) -> None:
+        """Force-teardown after a failure: unblock every parked stage by
+        force-closing the shm rings (a dead stage's consumers would
+        otherwise spin forever on a channel nobody will ever close)."""
+        if self._broken is not None:
+            return
+        self._broken = reason
+        if self._input is not None:
+            self._input.force_close()
+        for path in self._ring_paths:
+            try:
+                RingChannel(path, self.capacity, self.credits).force_close()
+            except OSError:
+                pass
+        self._pin_workers(False)
+
+    # ------------------------------------------------------------------- API
+    @property
+    def in_flight(self) -> int:
+        """Iterations put but not yet fully consumed by ``get``."""
+        return self._puts - self._gets
+
+    def put(self, value, timeout: float | None = 60.0) -> None:
+        """Enqueue one iteration input. Blocks only when the pipeline
+        already holds ``credits`` unconsumed iterations (backpressure)."""
+        if self._torn_down or self._broken:
+            raise ChannelClosed(self._broken or "loop torn down")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                self._input.write(_pack(value), timeout=0.25)
+                self._puts += 1
+                return
+            except TimeoutError:
+                self._check_stage_death()
+                if deadline is not None and time.monotonic() > deadline:
+                    raise
+
+    def get(self, timeout: float | None = 60.0):
+        """Next iteration's output (tuple for MultiOutputNode), in put
+        order. Re-raises a stage's per-iteration error; the loop itself
+        survives errors and keeps streaming."""
+        if self._torn_down:
+            raise ChannelClosed("loop torn down")
+        if self._broken and self._resume is None:
+            raise ChannelClosed(self._broken)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # Resume a round a previous timed-out get left half-read, so
+        # output cursors never desync across rounds.
+        results = self._resume if self._resume is not None else []
+        self._resume = None
+        first_error = None
+        while len(results) < len(self._out_readers):
+            reader = self._out_readers[len(results)]
+            try:
+                payload = reader.read(timeout=0.25)
+            except TimeoutError:
+                # Slicing the wait keeps stage-death detection prompt; a
+                # transient timeout here is NOT a failed round yet.
+                self._check_stage_death()
+                if deadline is not None and time.monotonic() > deadline:
+                    # Preserve the half-read round so the next get()
+                    # resumes at the SAME reader — cursors never desync.
+                    self._resume = results
+                    raise TimeoutError(
+                        f"loop output idle past {timeout}s "
+                        f"({self.in_flight} iterations in flight)")
+                continue
+            except ChannelClosed:
+                self._break("loop output channel closed")
+                raise
+            results.append(_unpack(payload))
+        self._gets += 1
+        values = []
+        for value, is_error in results:
+            if is_error and first_error is None:
+                first_error = value
+            values.append(value)
+        if first_error is not None:
+            from ..core.status import RayTaskError
+
+            raise (first_error.as_instanceof_cause()
+                   if isinstance(first_error, RayTaskError) else first_error)
+        return values[0] if len(values) == 1 else tuple(values)
+
+    def run(self, value, timeout: float | None = 60.0):
+        """Synchronous convenience: one put + one get."""
+        self.put(value, timeout=timeout)
+        return self.get(timeout=timeout)
+
+    # --------------------------------------------------------------- teardown
+    def teardown(self, timeout: float = 30.0) -> None:
+        if self._torn_down:
+            return
+        self._torn_down = True
+        from ..chaos import clock as chaos_clock
+        from ..core import api as ray
+
+        t0 = chaos_clock.now()
+        input_ch = getattr(self, "_input", None)
+        if input_ch is not None and self._broken is None:
+            input_ch.close_writer(timeout=min(timeout, 5.0))
+        try:
+            ray.get(list(self._loop_refs), timeout=timeout)
+        except Exception:
+            # A stage died or is stuck on a dead peer's channel: force
+            # the cascade through every ring so the rest exit.
+            self._break("teardown")
+            try:
+                ray.get(list(self._loop_refs), timeout=timeout)
+            except Exception:
+                pass
+        self._pin_workers(False)
+        if input_ch is not None:
+            input_ch.close()
+        for r in getattr(self, "_out_readers", []):
+            r.close()
+        if self._dir is not None:
+            import shutil
+
+            shutil.rmtree(self._dir, ignore_errors=True)
+        self.torn_down_in_s = chaos_clock.now() - t0
+
+    def __del__(self):
+        try:
+            self.teardown(timeout=1.0)
+        except Exception:
+            pass
+
+
+def compile_loop(output_node: DAGNode, max_buffer_size: int | None = None,
+                 credits: int | None = None) -> CompiledLoop:
+    """Compile a DAG built with ``actor.method.bind(...)`` into a
+    persistent streaming loop (see module docstring)."""
+    return CompiledLoop(output_node, max_buffer_size=max_buffer_size,
+                        credits=credits)

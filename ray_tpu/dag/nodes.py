@@ -11,6 +11,17 @@ class DAGNode:
 
         return CompiledDAG(self, max_buffer_size=max_buffer_size)
 
+    def experimental_compile_loop(self, max_buffer_size: int | None = None,
+                                  credits: int | None = None):
+        """Compile into a persistent streaming loop (``dag/loop.py``):
+        resident tick executors + credit-based streaming channels, for
+        steady-state iteration (``put``/``get``) instead of one-shot
+        ``execute``."""
+        from .loop import CompiledLoop
+
+        return CompiledLoop(self, max_buffer_size=max_buffer_size,
+                            credits=credits)
+
 
 class InputNode(DAGNode):
     """The driver-supplied input (``with InputNode() as inp:``)."""

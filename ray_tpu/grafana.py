@@ -174,6 +174,18 @@ def generate_dashboard(title: str = "ray_tpu cluster") -> dict:
                      "(rate(ray_tpu_chaos_injections_total[5m]))",
              "legend": "{{method}}"},
         ], grid={"x": W, "y": 4 + 5 * H, "w": W, "h": H}, unit="ops"),
+        # Compiled-loop steady state (dag/loop.py): tick rate per stage
+        # proves the zero-RPC path is doing the work; ring occupancy at
+        # its credit ceiling pinpoints the backpressuring stage.
+        _panel(46, "Compiled-loop ticks by stage", [
+            {"expr": "sum by (stage) "
+                     "(rate(ray_tpu_dag_loop_ticks_total[1m]))",
+             "legend": "{{stage}}"},
+        ], grid={"x": 2 * W, "y": 4 + 5 * H, "w": W, "h": H}, unit="ops"),
+        _panel(47, "Compiled-loop channel occupancy", [
+            {"expr": "ray_tpu_dag_loop_channel_occupancy",
+             "legend": "{{stage}}"},
+        ], grid={"x": 2 * W, "y": 4 + 6 * H, "w": W, "h": H}),
         # Row 6: memory observability (memory PR): per-node object-store
         # usage vs capacity/pinned, HBM used vs limit, worker RSS, and the
         # spill-rate-by-node view that pairs with the leak watcher.

@@ -247,7 +247,12 @@ class InferenceEngine:
                         # Steps where live decode streams waited behind a
                         # prefill-only dispatch (0 under mixed dispatch —
                         # the number the token budget exists to kill).
-                        "decode_stall_steps": 0}
+                        "decode_stall_steps": 0,
+                        # Engine operations streamed through a persistent
+                        # compiled loop (dag/loop.py) instead of per-tick
+                        # actor RPC — nonzero exactly when the executor
+                        # drives a loop (sharded pp path).
+                        "dag_loop_ticks": 0}
 
     @staticmethod
     def total_pages(max_slots: int, max_len: int, page_size: int,
@@ -659,7 +664,15 @@ class InferenceEngine:
         # One dispatch == one staging-buffer commit on the paged path:
         # the pool is written decode_dispatches times, not decode_steps.
         self.metrics["decode_dispatches"] += 1
+        self._note_loop_ticks()
         return self._emit_decode_events(active, tokens, K)
+
+    def _note_loop_ticks(self) -> None:
+        """Mirror the executor's compiled-loop tick count (zero-RPC
+        steady-state dispatch, dag/loop.py) into the engine metrics."""
+        ticks = getattr(self.executor, "loop_ticks", None)
+        if ticks is not None:
+            self.metrics["dag_loop_ticks"] = ticks
 
     def _select_prefill_plans(self) -> list[dict]:
         """Chunks riding the next mixed dispatch: walk the prefill queue

@@ -51,12 +51,15 @@ def resolve_attention_impl(attention_impl: str = "auto", mesh=None,
       * the backend is not a TPU (interpret-mode decode is far slower
         than the dense gather on CPU — tests force ``"paged"`` explicitly
         to exercise the kernel), or
-      * the mesh pipelines layers (``pp`` > 1): the pp tick loop does not
-        thread the staging carry yet (ROADMAP item 4's second half).
+      * the mesh BOTH pipelines layers and shards heads (``pp`` > 1 AND
+        ``tp`` > 1): the kernel's tp shard_map cannot nest inside the pp
+        pipeline's manual region yet (the one residue of ROADMAP item 4).
 
-    Tensor-parallel meshes DO take the kernel: it shard_maps over the
-    KV-head axis (``ops/paged_attention.py``), composing with the
-    executor's kv-head pool sharding.
+    Tensor-parallel meshes take the kernel (shard_mapped over the
+    KV-head axis), and since round 8 PIPELINE meshes do too: the pp tick
+    loop threads the v2 staging carry per stage
+    (``pp_model.pp_decode_loop``), so the lifted refusal covers pure-pp
+    meshes of any depth.
     """
     if attention_impl not in ("auto", "paged", "dense"):
         raise ValueError(f"unknown attention_impl {attention_impl!r}")
@@ -66,7 +69,8 @@ def resolve_attention_impl(attention_impl: str = "auto", mesh=None,
         backend = jax.default_backend()
     if backend not in _TPU_BACKENDS:
         return "dense"
-    if mesh is not None and mesh.shape.get("pp", 1) > 1:
+    if (mesh is not None and mesh.shape.get("pp", 1) > 1
+            and mesh.shape.get("tp", 1) > 1):
         return "dense"
     return "paged"
 
@@ -104,16 +108,20 @@ class LocalEngineExecutor:
         # paged on TPU backends, dense elsewhere (resolve_attention_impl).
         self.attention_impl = resolve_attention_impl(attention_impl, mesh)
         if self.attention_impl == "paged" and mesh is not None \
-                and mesh.shape.get("pp", 1) > 1:
-            # Refuse rather than silently fall back: the pp tick loop
-            # doesn't thread the staging carry (ROADMAP item 4). Plain tp
-            # is fine — the kernel shard_maps over the KV-head axis.
+                and mesh.shape.get("pp", 1) > 1 \
+                and mesh.shape.get("tp", 1) > 1:
+            # The round-8 residue: the kernel's tp shard_map cannot nest
+            # inside the pp pipeline's manual region. Pure pp takes the
+            # kernel (staging carry threaded per stage); pure tp always
+            # did; the 3-way composition stays dense for now.
             raise ValueError(
-                "attention_impl='paged' does not pipeline over pp yet; "
-                "use 'dense' or 'auto'")
+                "attention_impl='paged' does not compose pp x tp yet; "
+                "use 'dense' or 'auto' (pure pp and pure tp both take "
+                "the kernel)")
         self.paged_attention = self.attention_impl == "paged"
         # shard_map the kernel over tp when the pool is head-sharded;
-        # single-axis (dp-only) meshes keep the plain call.
+        # single-axis (dp-only) meshes keep the plain call. (pp paged
+        # runs tp=1, so the kernel is called per stage, unsharded.)
         self._attn_mesh = (
             mesh if self.paged_attention and mesh is not None
             and mesh.shape.get("tp", 1) > 1 else None)
@@ -176,13 +184,28 @@ class LocalEngineExecutor:
         self.lora_config = lora_config
         self.lora_stack = None
         if lora_config is not None:
-            if mesh is not None:
-                raise ValueError("lora serving is single-device for now "
-                                 "(stacks are not mesh-sharded)")
+            if mesh is not None and mesh.shape.get("tp", 1) > 1:
+                raise ValueError("lora serving does not shard stacks over "
+                                 "tp (adapters are head-stacked; use pp or "
+                                 "a single device)")
             from .lora import init_lora_stack
 
             self.lora_stack = init_lora_stack(
                 self.config, lora_config.max_loras, lora_config.max_rank)
+            if self._pp > 1:
+                # Stacks shard over pp on their LAYER axis, exactly like
+                # params["layers"], so pp_model's local layer indices
+                # address the local stack shard directly (round 8:
+                # LoRA threads through the pp pipeline).
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                lora_sharding = NamedSharding(mesh, PartitionSpec("pp"))
+                self.lora_stack = {
+                    k: jax.device_put(v, lora_sharding)
+                    for k, v in self.lora_stack.items()}
+            elif mesh is not None:
+                self.lora_stack = jax.device_put(
+                    self.lora_stack, self._replicated)
         self.params = params
         self.pages = pages
         self._key = jax.random.PRNGKey(seed ^ 0x5EED)
@@ -259,12 +282,13 @@ class LocalEngineExecutor:
 
     def install_adapter(self, slot: int, arrays: dict) -> None:
         """Write one adapter's padded A/B arrays into stack slot ``slot``
-        (the ``LoRAManager``'s device hook)."""
+        (the ``LoRAManager``'s device hook). Arrays ride ``_put`` so a
+        mesh-sharded stack (pp) takes them as replicated global inputs."""
         from .lora import _install
 
         self.lora_stack = _install(
-            self.lora_stack, jnp.int32(slot),
-            {k: jnp.asarray(v) for k, v in arrays.items()})
+            self.lora_stack, self._put(np.int32(slot)),
+            {k: self._put(np.asarray(v)) for k, v in arrays.items()})
 
     # ------------------------------------------------------------- operations
     def prefill(self, block_table: np.ndarray, tokens: np.ndarray,
@@ -272,6 +296,9 @@ class LocalEngineExecutor:
                 lora_slot: int = 0) -> None:
         if self._pp > 1:
             kwargs = {}
+            if self.lora_stack is not None:
+                kwargs["lora"] = self.lora_stack
+                kwargs["lora_slot"] = self._put(np.int32(lora_slot))
         else:
             # Context gathered is [0, start_pos): cap the gather width.
             kwargs = {"live_pages": self._bucket_pages(
@@ -358,6 +385,21 @@ class LocalEngineExecutor:
                lora_idx: np.ndarray | None = None) -> np.ndarray:
         if self._pp > 1:
             kwargs = {}
+            if self.paged_attention:
+                # Same pool-context-only bound as the unpipelined paged
+                # path: staged tokens ride the per-stage carry, so the
+                # kernel grid ignores n_steps entirely.
+                needed = max(1, (int(pos.max()) + self.page_size - 1)
+                             // self.page_size)
+                kwargs["paged"] = True
+                kwargs["live_pages"] = self._bucket_pages(
+                    needed, block_tables.shape[1])
+            if self.lora_stack is not None:
+                kwargs["lora"] = self.lora_stack
+                kwargs["lora_idx"] = self._put(
+                    (lora_idx if lora_idx is not None
+                     else np.zeros(block_tables.shape[0], np.int32)
+                     ).astype(np.int32))
         else:
             kwargs = self._decode_kwargs(pos, n_steps, block_tables, lora_idx)
         toks, self._key, self.pages = self._decode_loop(

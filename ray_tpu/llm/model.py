@@ -196,7 +196,8 @@ def prefill_chunk(params, pages: dict, block_table, tokens, start_pos,
 def decode_block(x, layer, kf, vf, l, block_tables, pos, write_idx,
                  c: LlamaConfig, page_size: int, paged: bool = False,
                  live_pages: int | None = None, lora=None, lora_idx=None,
-                 stage=None, stage_step=None, attn_mesh=None):
+                 stage=None, stage_step=None, stage_live=None,
+                 attn_mesh=None):
     """One decoder block for a [n, 1, E] single-token batch against the
     FULL page pool (kf/vf: [L, P, KH, page, D]; ``l`` is this layer's
     index into it — traced, so the pool is only touched at gather/scatter
@@ -252,8 +253,15 @@ def decode_block(x, layer, kf, vf, l, block_tables, pos, write_idx,
         k_tok, v_tok = k[:, :, 0], v[:, :, 0]            # [n, KH, D]
         if stage is not None:
             ks, vs = stage
-            ks = ks.at[l, :, :, stage_step].set(k_tok.astype(ks.dtype))
-            vs = vs.at[l, :, :, stage_step].set(v_tok.astype(vs.dtype))
+            k_row, v_row = k_tok.astype(ks.dtype), v_tok.astype(vs.dtype)
+            if stage_live is not None:
+                # Pipeline warmup/cooldown ticks compute garbage rows
+                # (pp_model): a guarded write keeps the round's REAL
+                # staged K/V intact for the dispatch-boundary commit.
+                k_row = jnp.where(stage_live, k_row, ks[l, :, :, stage_step])
+                v_row = jnp.where(stage_live, v_row, vs[l, :, :, stage_step])
+            ks = ks.at[l, :, :, stage_step].set(k_row)
+            vs = vs.at[l, :, :, stage_step].set(v_row)
             attn = paged_decode_attention(
                 qg, kf, vf, block_tables, pos,
                 page_size=page_size, live_pages=live_pages, layer=l,

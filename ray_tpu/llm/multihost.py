@@ -44,13 +44,16 @@ class EngineShardWorker:
 
     def build(self, config, *, max_slots: int, num_pages: int, page_size: int,
               tp: int | None = None, pp: int | None = None, seed: int = 0,
-              attention_impl: str = "auto") -> int:
+              attention_impl: str = "auto", lora_config=None) -> int:
         """Create the executor over the global mesh (all hosts' devices).
         Default tp = every device in the group (pure TP); pass ``pp`` to
-        stage layers across hosts instead (pure PP this round).
+        stage layers across hosts instead.
         ``attention_impl="auto"`` resolves per shard exactly as on a
         single host: the paged kernel shard_maps over the kv-head/tp
-        axis, dense for pp meshes."""
+        axis and rides the pp tick loop's staging carry; only the
+        pp x tp composition stays dense. ``lora_config`` builds the
+        device-resident adapter stacks on every shard (pp-sharded over
+        the layer axis on pipeline meshes)."""
         import jax
 
         from ..parallel import MeshConfig, create_mesh
@@ -66,79 +69,166 @@ class EngineShardWorker:
         self.executor = LocalEngineExecutor(
             config, max_slots=max_slots, num_pages=num_pages,
             page_size=page_size, mesh=mesh, seed=seed,
-            attention_impl=attention_impl,
+            attention_impl=attention_impl, lora_config=lora_config,
         )
         return n
 
     # ------------------------------------------------ executor operations
-    def prefill(self, block_table, tokens, start_pos, handle, take) -> bool:
-        self.executor.prefill(block_table, tokens, start_pos, handle, take)
+    def tick(self, plan):
+        """Compiled-loop entry point: ONE method drives every engine
+        operation so a resident loop executor (dag/loop.py) can stream
+        step plans over a channel with zero per-tick RPC. ``plan`` is
+        ``(method_name, args)``; per-channel FIFO ordering preserves the
+        SPMD invariant exactly like per-caller actor ordering did."""
+        method, args = plan
+        return getattr(self, method)(*args)
+
+    def prefill(self, block_table, tokens, start_pos, handle, take,
+                lora_slot=0) -> bool:
+        self.executor.prefill(block_table, tokens, start_pos, handle, take,
+                              lora_slot=lora_slot)
         return True
 
     def drop_handle(self, handle) -> bool:
         self.executor.drop_handle(handle)
         return True
 
+    def install_adapter(self, slot, arrays) -> bool:
+        self.executor.install_adapter(slot, arrays)
+        return True
+
     def sample_first(self, handles, temps):
         return self.executor.sample_first(handles, temps)
 
     def decode(self, block_tables, tokens, pos, temps, eos_ids, remaining,
-               n_steps):
+               n_steps, lora_idx=None):
         return self.executor.decode(
-            block_tables, tokens, pos, temps, eos_ids, remaining, n_steps)
+            block_tables, tokens, pos, temps, eos_ids, remaining, n_steps,
+            lora_idx=lora_idx)
 
     def supports_mixed(self) -> bool:
         return bool(self.executor is not None
                     and self.executor.supports_mixed_dispatch)
 
     def mixed(self, prefill_plans, block_tables, tokens, pos, temps, eos_ids,
-              remaining, n_steps):
+              remaining, n_steps, lora_idx=None):
         return self.executor.mixed(
             prefill_plans, block_tables, tokens, pos, temps, eos_ids,
-            remaining, n_steps)
+            remaining, n_steps, lora_idx=lora_idx)
 
 
 class ShardedEngineExecutor:
     """Driver-side executor fanning every operation out to the shard
-    actors (duck-types ``LocalEngineExecutor``). Actor-call ordering per
-    caller guarantees every shard sees the identical program sequence —
-    the SPMD invariant."""
+    actors (duck-types ``LocalEngineExecutor``).
 
-    def __init__(self, shards: list, pg=None):
+    Two dispatch modes, IDENTICAL program sequence per shard (the SPMD
+    invariant) in both:
+
+      * **dynamic** (default off the pp path): one actor call per shard
+        per operation — per-caller actor ordering sequences the shards.
+        Every steady-state decode burst pays the full submit→lease→push
+        RPC path per shard.
+      * **compiled loop** (``use_compiled_loop=True``; the default the
+        pp tick path gets from ``create_sharded_executor``): ONE
+        owner-side submit per shard installs a resident
+        ``EngineShardWorker.tick`` executor (``dag/loop.py``), and every
+        operation afterwards is a channel write — ``put((method, args))``
+        — with results streamed back in order. Zero per-tick task
+        submission, RPC, or lease traffic at steady state; channel FIFO
+        ordering replaces actor-call ordering. Fire-and-forget
+        operations (prefill chunks, drop_handle) pipeline up to the
+        loop's credits ahead of their results, mirroring the dynamic
+        ``_dispatch``'s pure-dispatch behavior.
+    """
+
+    def __init__(self, shards: list, pg=None, use_compiled_loop: bool = False):
         self.shards = shards
         self._pg = pg
         self._pending: list = []  # in-flight async dispatches (prefill/drop)
+        self._loop = None
+        self._loop_pending = 0    # loop results put but not yet consumed
+        self.use_compiled_loop = use_compiled_loop
         # Set after build() by create_sharded_executor: whether every
         # shard's local executor takes the fused mixed entry point.
         self.supports_mixed_dispatch = False
 
+    # ---------------------------------------------------- compiled loop
+    def _ensure_loop(self):
+        if self._loop is None:
+            from ..dag import InputNode, MultiOutputNode, compile_loop
+
+            with InputNode() as inp:
+                outs = [s.tick.bind(inp) for s in self.shards]
+            graph = outs[0] if len(outs) == 1 else MultiOutputNode(outs)
+            self._loop = compile_loop(graph)
+        return self._loop
+
+    @property
+    def loop_ticks(self) -> int:
+        """Engine ticks streamed through the compiled loop so far."""
+        return self._loop._gets if self._loop is not None else 0
+
+    def _loop_put(self, method: str, *args) -> None:
+        self._ensure_loop().put((method, tuple(args)), timeout=300.0)
+        self._loop_pending += 1
+
+    def _loop_drain(self, keep_last: bool, timeout: float = 300.0):
+        """Consume queued results in order; returns the LAST one (the
+        per-shard result list) when ``keep_last``."""
+        last = None
+        while self._loop_pending:
+            self._loop_pending -= 1
+            got = self._loop.get(timeout=timeout)
+            if keep_last and not self._loop_pending:
+                last = got if isinstance(got, tuple) else (got,)
+        return last
+
+    # --------------------------------------------------------- dispatch
     def _dispatch(self, method: str, *args) -> None:
-        """Fire-and-forget to every shard: per-caller actor ordering keeps
-        the program sequence identical on all shards, so prefill chunks
-        need no host sync (mirroring LocalEngineExecutor's pure-dispatch
-        prefill — one blocking round trip per CHUNK would wreck TTFT).
-        Errors surface at the next sync point."""
+        """Fire-and-forget to every shard: ordering (actor-call or loop
+        channel FIFO) keeps the program sequence identical on all
+        shards, so prefill chunks need no host sync (mirroring
+        LocalEngineExecutor's pure-dispatch prefill — one blocking round
+        trip per CHUNK would wreck TTFT). Errors surface at the next
+        sync point."""
+        if self.use_compiled_loop:
+            self._loop_put(method, *args)
+            return
         self._pending.extend(
             getattr(s, method).remote(*args) for s in self.shards)
 
     def _sync(self, timeout: float = 300.0) -> None:
+        if self.use_compiled_loop:
+            self._loop_drain(keep_last=False, timeout=timeout)
+            return
         if self._pending:
             pending, self._pending = self._pending, []
             ray.get(pending, timeout=timeout)
 
     def _all(self, method: str, *args, timeout: float = 300.0):
+        if self.use_compiled_loop:
+            self._loop_drain(keep_last=False, timeout=timeout)
+            self._loop_put(method, *args)
+            return list(self._loop_drain(keep_last=True, timeout=timeout))
         self._sync(timeout)
         refs = [getattr(s, method).remote(*args) for s in self.shards]
         return ray.get(refs, timeout=timeout)
 
     def prefill(self, block_table, tokens, start_pos, handle, take,
                 lora_slot: int = 0) -> None:
-        # lora is single-device-executor only; the engine never routes
-        # adapter requests here (admission fails them without a manager)
-        self._dispatch("prefill", block_table, tokens, start_pos, handle, take)
+        self._dispatch("prefill", block_table, tokens, start_pos, handle,
+                       take, int(lora_slot))
 
     def drop_handle(self, handle) -> None:
         self._dispatch("drop_handle", handle)
+
+    def install_adapter(self, slot, arrays) -> None:
+        """LoRA fan-out: the adapter's padded A/B arrays land on every
+        shard's device stack, ORDERED with the prefill/decode stream so
+        no shard can run a step before the adapter its plan references
+        is installed."""
+        self._dispatch("install_adapter", int(slot),
+                       {k: np.asarray(v) for k, v in arrays.items()})
 
     def sample_first(self, handles, temps) -> np.ndarray:
         return self._all("sample_first", list(handles), temps)[0]
@@ -147,7 +237,7 @@ class ShardedEngineExecutor:
                n_steps, lora_idx=None) -> np.ndarray:
         return self._all(
             "decode", block_tables, tokens, pos, temps, eos_ids, remaining,
-            n_steps)[0]
+            n_steps, lora_idx)[0]
 
     def mixed(self, prefill_plans, block_tables, tokens, pos, temps, eos_ids,
               remaining, n_steps, lora_idx=None) -> np.ndarray:
@@ -157,9 +247,15 @@ class ShardedEngineExecutor:
         invariant — identical program sequence per shard)."""
         return self._all(
             "mixed", prefill_plans, block_tables, tokens, pos, temps,
-            eos_ids, remaining, n_steps)[0]
+            eos_ids, remaining, n_steps, lora_idx)[0]
 
     def shutdown(self) -> None:
+        if self._loop is not None:
+            try:
+                self._loop.teardown(timeout=10.0)
+            except Exception:
+                pass
+            self._loop = None
         for s in self.shards:
             try:
                 ray.kill(s)
@@ -189,6 +285,8 @@ def create_sharded_executor(
     strategy: str | None = None,
     runtime_env: dict | None = None,
     attention_impl: str = "auto",
+    lora_config=None,
+    use_compiled_loop: bool | None = None,
 ) -> ShardedEngineExecutor:
     """Place one shard actor per host and bootstrap the group.
 
@@ -198,7 +296,14 @@ def create_sharded_executor(
     ``strategy``: placement strategy; defaults to the reference's choice —
     STRICT_PACK for a single-host engine, PACK across hosts
     (``vllm_models.py:131-168``).
+    ``use_compiled_loop``: drive the steady-state engine tick path
+    through a persistent compiled loop (``dag/loop.py``) instead of one
+    actor RPC per shard per operation. Default: ON for pipeline meshes
+    (``pp`` > 1) — the per-tick dispatch overhead the static schedule
+    exists to kill — OFF otherwise (pass ``True`` to force it anywhere).
     """
+    if use_compiled_loop is None:
+        use_compiled_loop = bool(pp and pp > 1)
     from ..util import PlacementGroupSchedulingStrategy, placement_group, remove_placement_group
 
     res = dict(bundle_resources or {"CPU": 1.0})
@@ -221,7 +326,8 @@ def create_sharded_executor(
         ).remote(i, num_hosts)
         for i in range(num_hosts)
     ]
-    executor = ShardedEngineExecutor(shards, pg)
+    executor = ShardedEngineExecutor(shards, pg,
+                                     use_compiled_loop=use_compiled_loop)
     try:
         coordinator = ray.get(shards[0].coordinator_address.remote(), timeout=120)
         ray.get([s.init_distributed.remote(coordinator) for s in shards],
@@ -229,11 +335,16 @@ def create_sharded_executor(
         ray.get([
             s.build.remote(config, max_slots=max_slots, num_pages=num_pages,
                            page_size=page_size, tp=tp, pp=pp, seed=seed,
-                           attention_impl=attention_impl)
+                           attention_impl=attention_impl,
+                           lora_config=lora_config)
             for s in shards
         ], timeout=600)
         executor.supports_mixed_dispatch = bool(ray.get(
             shards[0].supports_mixed.remote(), timeout=60))
+        if use_compiled_loop:
+            # Install the resident tick executors NOW (one submit per
+            # shard — the last tasks this executor ever submits).
+            executor._ensure_loop()
     except Exception:
         executor.shutdown()
         raise

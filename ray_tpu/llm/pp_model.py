@@ -52,10 +52,13 @@ from .model import _gather_ctx, _mlp, _project_qkv, decode_block
                    static_argnames=("config", "page_size", "mesh"),
                    donate_argnames=("pages",))
 def pp_prefill_chunk(params, pages, block_table, tokens, start_pos,
-                     config: LlamaConfig, page_size: int, mesh):
+                     config: LlamaConfig, page_size: int, mesh,
+                     lora=None, lora_slot=None):
     """Pipeline-staged ``prefill_chunk``: same contract as
     ``model.prefill_chunk`` (pages updated, hidden [C, E] returned) with
-    params["layers"]/pages sharded P("pp") on the layer axis."""
+    params["layers"]/pages sharded P("pp") on the layer axis.
+    ``lora``/``lora_slot`` apply one adapter to the whole chunk (stacks
+    sharded over pp on their layer axis, like the params)."""
     c = config
     pp = mesh.shape["pp"]
     C = tokens.shape[0]
@@ -65,7 +68,8 @@ def pp_prefill_chunk(params, pages, block_table, tokens, start_pos,
     causal = jnp.arange(C)[:, None] >= jnp.arange(C)[None, :]
 
     def per_device(layers_local, kp, vp, embed, final_norm,
-                   block_table, tokens, start_pos):
+                   block_table, tokens, start_pos, lora_local=None,
+                   lslot=None):
         stage = lax.axis_index("pp")
         perm = [(i, (i + 1) % pp) for i in range(pp)]
         positions = start_pos + jnp.arange(C, dtype=jnp.int32)
@@ -84,6 +88,20 @@ def pp_prefill_chunk(params, pages, block_table, tokens, start_pos,
                 layer, l = xs
                 h = rms_norm(xc, layer["attn_norm"], eps=c.norm_eps)
                 q, k, v = _project_qkv(h, layer)       # [1, H|KH, C, D]
+                if lora_local is not None:
+                    from .lora import lora_delta_single
+
+                    def add(t_, p, heads):
+                        d = lora_delta_single(
+                            h, lora_local[f"{p}.A"], lora_local[f"{p}.B"],
+                            l, lslot)
+                        return t_ + jnp.swapaxes(
+                            d.reshape(1, C, heads, c.head_dim), 1, 2
+                        ).astype(t_.dtype)
+
+                    q = add(q, "wq", c.n_heads)
+                    k = add(k, "wk", c.n_kv_heads)
+                    v = add(v, "wv", c.n_kv_heads)
                 q = apply_rope(q, positions, theta=c.rope_theta)
                 k = apply_rope(k, positions, theta=c.rope_theta)
                 ck = _gather_ctx(kp, l, block_table)   # [KH, ctx, D]
@@ -102,6 +120,13 @@ def pp_prefill_chunk(params, pages, block_table, tokens, start_pos,
                     "kgct,ktd->kgcd", p_self, v[0])
                 attn = attn.reshape(1, c.n_heads, C, c.head_dim)
                 out = jnp.einsum("bhsd,hde->bse", attn, layer["wo"])
+                if lora_local is not None:
+                    from .lora import lora_delta_single
+
+                    flat = jnp.swapaxes(attn, 1, 2).reshape(1, C, -1)
+                    out = out + lora_delta_single(
+                        flat, lora_local["wo.A"], lora_local["wo.B"],
+                        l, lslot).astype(out.dtype)
                 x2 = _mlp(xc + out, layer, c)
                 # Guarded page write: stages without the real chunk write
                 # the OLD page values back (branchless no-op).
@@ -135,33 +160,58 @@ def pp_prefill_chunk(params, pages, block_table, tokens, start_pos,
     # Manual over pp ONLY: tp stays an auto axis, so XLA partitions the
     # per-stage math from the params' tp shardings (TP inside PP stages
     # — the composition the reference gets from vLLM, vllm_models.py:117).
+    args = [params["layers"], pages["k"], pages["v"], params["embed"],
+            params["final_norm"], block_table, tokens, start_pos]
+    specs = [layer_spec, P("pp"), P("pp"), P(), P(), P(), P(), P()]
+    if lora is not None:
+        args += [lora, lora_slot]
+        specs += [jax.tree.map(lambda _: P("pp"), lora), P()]
     fn = jax.shard_map(
         per_device, mesh=mesh,
-        in_specs=(layer_spec, P("pp"), P("pp"), P(), P(), P(), P(), P()),
+        in_specs=tuple(specs),
         out_specs=({"k": P("pp"), "v": P("pp")}, P()),
         axis_names=frozenset({"pp"}),
         check_vma=False,
     )
-    return fn(params["layers"], pages["k"], pages["v"], params["embed"],
-              params["final_norm"], block_table, tokens, start_pos)
+    return fn(*args)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("config", "page_size", "n_steps", "mesh"),
+                   static_argnames=("config", "page_size", "n_steps", "mesh",
+                                    "paged", "live_pages"),
                    donate_argnames=("pages",))
 def pp_decode_loop(params, pages, block_tables, tokens, pos, temps, eos_ids,
                    remaining, key, config: LlamaConfig, page_size: int,
-                   n_steps: int, mesh):
+                   n_steps: int, mesh, paged: bool = False,
+                   live_pages: int | None = None, lora=None, lora_idx=None):
     """Pipelined ``decode_loop``: same contract (tokens [n_steps, slots],
     key, pages). ``slots`` must divide into ``pp`` groups; group ``g``'s
     round ``r`` runs on stage ``s`` at tick ``t = g + r*pp + s``, so all
     stages stay busy after a (pp-1)-tick warmup.
+
+    ``paged=True`` runs the v2 staging-buffer schedule INSIDE the
+    pipeline (ROADMAP item 4's second half): each stage's LOCAL layer
+    shard of the pool stays strictly read-only across all ticks, group
+    ``g``'s round-``r`` K/V lands in staging row ``r`` of a per-group
+    staging carry (guarded so warmup/cooldown ticks never clobber live
+    rows — ``decode_block(stage_live=...)``), the Pallas kernel folds
+    rows [0, r] as its second KV source exactly as unpipelined, and ONE
+    per-stage ``commit_staging`` scatter writes everything back at the
+    dispatch boundary. ``live_pages`` bounds the kernel grid by POOL
+    context only (staged tokens never touch the pool mid-dispatch).
+
+    ``lora``/``lora_idx`` thread the device-resident adapter stacks
+    through the pipeline: the stacks are sharded over ``pp`` on their
+    layer axis (matching ``params["layers"]``), so ``decode_block``'s
+    local layer index addresses the local stack shard directly.
 
     Token parity with the unpipelined engine holds for GREEDY decoding
     (temps == 0) only: this loop splits the PRNG key once per pipeline
     tick (T = n_steps*pp + pp - 1 splits) while ``decode_loop`` splits
     once per step, so sampled (temps > 0) outputs draw from the same
     distribution but are not bit-identical."""
+    from .model import commit_staging
+
     c = config
     pp = mesh.shape["pp"]
     slots = tokens.shape[0]
@@ -175,19 +225,35 @@ def pp_decode_loop(params, pages, block_tables, tokens, pos, temps, eos_ids,
     temp_g = temps.reshape(pp, m)
     eos_g = eos_ids.reshape(pp, m)
     rem_g = remaining.reshape(pp, m)
+    idx_g = None if lora_idx is None else lora_idx.reshape(pp, m)
     # slot i's trash page is page i (the unpipelined decode_loop invariant)
     trash_g = jnp.arange(slots, dtype=jnp.int32).reshape(pp, m)
 
     def per_device(layers_local, kp, vp, embed, final_norm, lm_head,
-                   bt_g, tok_g, pos_g, temp_g, eos_g, rem_g, key):
+                   bt_g, tok_g, pos_g, temp_g, eos_g, rem_g, pos0, key,
+                   lora_local=None, idx_g=None):
         stage = lax.axis_index("pp")
         perm = [(i, (i + 1) % pp) for i in range(pp)]
+        n_local = kp.shape[0]  # this stage's layer count
+        if paged:
+            from ..ops.paged_attention import stage_rows
+
+            sc = stage_rows(n_steps)
+            # Per-GROUP staging carry [Ll, pp, m, KH, SC, D]: group g's
+            # row r holds position pos0_g + r (LOCAL layers only — the
+            # pool shard and the staging shard stay aligned).
+            stage_shape = (n_local, pp, m, c.n_kv_heads, sc, c.head_dim)
+            ks0 = jnp.zeros(stage_shape, kp.dtype)
+            vs0 = jnp.zeros(stage_shape, vp.dtype)
+        else:
+            ks0 = vs0 = jnp.zeros((0,), c.dtype)  # unused carry filler
 
         def tick(carry, t):
-            rot, outputs, kp, vp, key = carry
+            rot, outputs, widx_all, kp, vp, ks, vs, key = carry
             g = (t - stage) % pp
             roundr = (t - stage) // pp
             live_round = (t >= stage) & (roundr < n_steps)
+            rc = jnp.clip(roundr, 0, n_steps - 1)
             inject = (stage == 0) & (t < pp)           # group g's first visit
             tok_in = jnp.where(inject, tok_g[g], rot["tok"])
             cpos = jnp.where(inject, pos_g[g], rot["pos"])
@@ -195,22 +261,37 @@ def pp_decode_loop(params, pages, block_tables, tokens, pos, temps, eos_ids,
             cdone = jnp.where(inject, rem_g[g] <= 0, rot["done"])
             done_eff = cdone | ~live_round
             bt = bt_g[g]
+            lidx = None if idx_g is None else idx_g[g]
             emb = embed[tok_in][:, None].astype(c.dtype)       # [m, 1, E]
             x = jnp.where(stage == 0, emb, rot["act"])
             real_page = jnp.take_along_axis(
                 bt, jnp.minimum(cpos // page_size, maxp - 1)[:, None],
                 axis=1)[:, 0]
             write_idx = jnp.where(done_eff, trash_g[g], real_page)
+            # Paged: the kernel reads pool [0, cpos - rc) — the group's
+            # dispatch-entry context — plus this group's staged rows
+            # [0, rc]; the pool shard is NEVER written inside the scan.
+            stage_g = (ks[:, g], vs[:, g]) if paged else None
 
             def body(carry, xs):
-                xc, kp, vp = carry
+                xc, kp, vp, stg = carry
                 layer, l = xs
-                x2, kp, vp, _ = decode_block(
-                    xc, layer, kp, vp, l, bt, cpos, write_idx, c, page_size)
-                return (x2, kp, vp), None
+                x2, kp, vp, stg = decode_block(
+                    xc, layer, kp, vp, l, bt, cpos, write_idx, c, page_size,
+                    paged=paged, live_pages=live_pages if paged else None,
+                    lora=lora_local, lora_idx=lidx,
+                    stage=stg, stage_step=rc if paged else None,
+                    stage_live=live_round if paged else None)
+                return (x2, kp, vp, stg), None
 
-            (x, kp, vp), _ = lax.scan(
-                body, (x, kp, vp), (layers_local, jnp.arange(kp.shape[0])))
+            (x, kp, vp, stage_g), _ = lax.scan(
+                body, (x, kp, vp, stage_g),
+                (layers_local, jnp.arange(n_local)))
+            if paged:
+                ks = ks.at[:, g].set(stage_g[0])
+                vs = vs.at[:, g].set(stage_g[1])
+                widx_all = widx_all.at[rc, g].set(
+                    jnp.where(live_round, write_idx, widx_all[rc, g]))
 
             # Last stage: logits + sample (computed on every stage for
             # SPMD uniformity; only the last stage's result is used).
@@ -227,7 +308,6 @@ def pp_decode_loop(params, pages, block_tables, tokens, pos, temps, eos_ids,
             done2 = done_eff | (new_tok == eos_g[g]) | (rem2 <= 0)
 
             is_last = stage == pp - 1
-            rc = jnp.clip(roundr, 0, n_steps - 1)
             ok = live_round & is_last
             vals = jnp.where(ok, new_tok, outputs[rc, g])
             outputs = outputs.at[rc, g].set(vals)
@@ -240,7 +320,7 @@ def pp_decode_loop(params, pages, block_tables, tokens, pos, temps, eos_ids,
                 "done": jnp.where(is_last, done2, cdone),
             }
             rot_next = lax.ppermute(rot_next, "pp", perm=perm)
-            return (rot_next, outputs, kp, vp, key), None
+            return (rot_next, outputs, widx_all, kp, vp, ks, vs, key), None
 
         rot0 = {
             "act": jnp.zeros((m, 1, c.hidden), c.dtype),
@@ -250,24 +330,45 @@ def pp_decode_loop(params, pages, block_tables, tokens, pos, temps, eos_ids,
             "done": jnp.zeros((m,), bool),
         }
         outputs0 = jnp.zeros((n_steps, pp, m), jnp.int32)
-        (_, outputs, kp, vp, key), _ = lax.scan(
-            tick, (rot0, outputs0, kp, vp, key), jnp.arange(T))
+        widx0 = jnp.zeros((n_steps, pp, m), jnp.int32)
+        (_, outputs, widx_all, kp, vp, ks, vs, key), _ = lax.scan(
+            tick, (rot0, outputs0, widx0, kp, vp, ks0, vs0, key),
+            jnp.arange(T))
+        if paged:
+            # The one pool write of the whole dispatch, per stage over its
+            # LOCAL layers: regroup the per-group staging carry back to
+            # slot order and commit (mirrors decode_loop + commit_staging).
+            ks_flat = ks.reshape(n_local, slots, c.n_kv_heads,
+                                 ks.shape[4], c.head_dim)
+            vs_flat = vs.reshape(n_local, slots, c.n_kv_heads,
+                                 vs.shape[4], c.head_dim)
+            committed = commit_staging(
+                {"k": kp, "v": vp}, (ks_flat, vs_flat),
+                widx_all.reshape(n_steps, slots), pos0, n_steps, page_size)
+            kp, vp = committed["k"], committed["v"]
         outputs = lax.psum(
             jnp.where(stage == pp - 1, outputs, jnp.zeros_like(outputs)), "pp")
         return outputs.reshape(n_steps, slots), key, {"k": kp, "v": vp}
 
     layer_spec = jax.tree.map(lambda _: P("pp"), params["layers"])
+    args = [params["layers"], pages["k"], pages["v"], params["embed"],
+            params["final_norm"], params["lm_head"],
+            bt_g, tok_g, pos_g, temp_g, eos_g, rem_g, pos, key]
+    specs = [layer_spec, P("pp"), P("pp"), P(), P(), P(),
+             P(), P(), P(), P(), P(), P(), P(), P()]
+    if lora is not None:
+        # Adapter stacks shard over pp on their layer axis, exactly like
+        # params["layers"] — local layer indices address them directly.
+        args += [lora, idx_g]
+        specs += [jax.tree.map(lambda _: P("pp"), lora), P()]
     fn = jax.shard_map(
         per_device, mesh=mesh,
-        in_specs=(layer_spec, P("pp"), P("pp"), P(), P(), P(),
-                  P(), P(), P(), P(), P(), P(), P()),
+        in_specs=tuple(specs),
         out_specs=(P(), P(), {"k": P("pp"), "v": P("pp")}),
         axis_names=frozenset({"pp"}),
         check_vma=False,
     )
-    return fn(params["layers"], pages["k"], pages["v"], params["embed"],
-              params["final_norm"], params["lm_head"],
-              bt_g, tok_g, pos_g, temp_g, eos_g, rem_g, key)
+    return fn(*args)
 
 
 @functools.partial(jax.jit,

@@ -98,15 +98,29 @@ class LLMDeployment:
         prefill_token_budget: int | None = None,
         max_prefill_seqs_per_step: int = 2,
         decode_starvation_limit: int = 8,
+        use_compiled_loop: bool | None = None,
     ):
         mesh = None
         executor = None
         self._sharded = None
+        lora = None
+        if lora_config is not None:
+            # Reference: LLMConfig.lora_config + dynamic_lora_loading_path
+            # (configs/server_models.py:141,236). Requests whose `model`
+            # differs from the base model_id load that adapter from
+            # `<dynamic_lora_loading_path>/<model>.npz` into the device
+            # stack and decode with it (multi-adapter batching).
+            from .lora import LoRAServingConfig
+
+            lora = LoRAServingConfig(**lora_config)
         if num_hosts > 1 or shard_resources is not None:
             # Replica-spans-hosts: one engine-shard actor per host placed
             # by a placement group, jax.distributed across them, the
             # scheduler here fanning step plans out (reference:
             # vllm_models.py:117-168 TP×PP placement; SURVEY §7.1 bridge).
+            # On the pp tick path the steady-state fan-out rides a
+            # persistent compiled loop (dag/loop.py) instead of per-tick
+            # actor RPC (use_compiled_loop defaults on for pp > 1).
             from .multihost import create_sharded_executor
 
             executor = self._sharded = create_sharded_executor(
@@ -121,6 +135,8 @@ class LLMDeployment:
                 topology=topology,
                 runtime_env=shard_runtime_env,
                 attention_impl=attention_impl,
+                lora_config=lora,
+                use_compiled_loop=use_compiled_loop,
             )
         elif tensor_parallel > 1 or pipeline_parallel > 1:
             # Shard the engine across this replica's visible chips (e.g.
@@ -135,16 +151,6 @@ class LLMDeployment:
             mesh = create_mesh(MeshConfig(
                 tp=tensor_parallel, pp=pipeline_parallel,
                 dp=max(1, n // (tensor_parallel * pipeline_parallel))))
-        lora = None
-        if lora_config is not None:
-            # Reference: LLMConfig.lora_config + dynamic_lora_loading_path
-            # (configs/server_models.py:141,236). Requests whose `model`
-            # differs from the base model_id load that adapter from
-            # `<dynamic_lora_loading_path>/<model>.npz` into the device
-            # stack and decode with it (multi-adapter batching).
-            from .lora import LoRAServingConfig
-
-            lora = LoRAServingConfig(**lora_config)
         self.engine = InferenceEngine(
             preset, max_slots=max_slots, max_len=max_len, page_size=page_size,
             prefill_chunk_size=prefill_chunk_size,
@@ -437,7 +443,8 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
                   autoscaling_config=None,
                   prefill_token_budget: int | None = None,
                   max_prefill_seqs_per_step: int = 2,
-                  decode_starvation_limit: int = 8):
+                  decode_starvation_limit: int = 8,
+                  use_compiled_loop: bool | None = None):
     """Build a Serve Application serving ``preset`` (serve.run-able).
     Pass ``ray_actor_options={"resources": {"TPU": 1}, ...}`` to pin each
     replica (engine) to a TPU chip. For an engine that SPANS hosts, set
@@ -465,4 +472,5 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
                     attention_impl=attention_impl,
                     prefill_token_budget=prefill_token_budget,
                     max_prefill_seqs_per_step=max_prefill_seqs_per_step,
-                    decode_starvation_limit=decode_starvation_limit)
+                    decode_starvation_limit=decode_starvation_limit,
+                    use_compiled_loop=use_compiled_loop)

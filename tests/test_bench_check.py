@@ -18,6 +18,29 @@ def test_direction_inference():
     assert bench_check._direction("serve_tokens_per_sec") == "up"
     # lease-stage latencies stay lower-better
     assert bench_check._direction("core_lease_submit_to_lease_p50_ms") == "down"
+    # round-8 dag metrics: dispatch overheads (µs) are lower-better,
+    # decode/tick rates higher-better
+    assert bench_check._direction("dag_tick_dispatch_overhead_us") == "down"
+    assert bench_check._direction(
+        "dag_tick_dispatch_overhead_dynamic_us") == "down"
+    assert bench_check._direction("dag_loop_ticks_per_s") == "up"
+    assert bench_check._direction("pp_decode_tok_s_dynamic") == "up"
+    assert bench_check._direction("pp_decode_tok_s_compiled") == "up"
+
+
+def test_dag_metrics_skip_markers():
+    """pp decode cells may be intentionally skipped on hosts that can't
+    run the pp shard_map — the markers route the absence to the
+    non-failing skipped bucket, exactly like serve matrix cells."""
+    old = {"pp_decode_tok_s_dynamic": 100.0, "pp_decode_tok_s_compiled": 120.0,
+           "dag_tick_dispatch_overhead_us": 900.0}
+    new = {"dag_tick_dispatch_overhead_us": 850.0,
+           "pp_decode_tok_s_dynamic_skipped": True,
+           "pp_decode_tok_s_compiled_skipped": True}
+    result = bench_check.compare(old, new)
+    assert not result["missing"]
+    assert {r["metric"] for r in result["skipped"]} == {
+        "pp_decode_tok_s_dynamic", "pp_decode_tok_s_compiled"}
 
 
 def test_core_metrics_guarded():

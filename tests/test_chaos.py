@@ -331,6 +331,64 @@ def test_spill_write_error_object_survives(chaos_cluster):
     assert np.array_equal(restored, value)
 
 
+def test_fault_plan_kills_loop_stage_mid_loop(chaos_cluster):
+    """Compiled-loop chaos (round 8): a `kill_loop_stage` FaultPlan rule
+    kills one stage actor at EXACTLY its Nth tick (deterministic —
+    between consuming the tick's inputs and producing its output). The
+    driver must surface the death on a bounded get(), teardown must
+    cascade through the surviving stages within a clock-bounded window
+    (no stage left parked on a dead peer's channel), and recovery must
+    verify green."""
+    from ray_tpu.chaos.verifier import RecoveryVerifier
+    from ray_tpu.dag import InputNode, compile_loop
+
+    @ray_tpu.remote
+    class Stage:
+        def __init__(self, k):
+            self.k = k
+
+        def f(self, x):
+            return x + self.k
+
+    verifier = RecoveryVerifier(timeout_s=60)
+    baseline = verifier.snapshot_baseline()
+    a, b = Stage.remote(1), Stage.remote(10)
+    plan = {"name": "loop-stage-kill", "faults": [
+        {"kind": "kill_loop_stage", "nth": 3, "max_injections": 1}]}
+
+    def _install_in_actor(instance, plan_dict, seed):
+        # Runs IN the stage actor process: loop-tick faults fire where
+        # the resident executor runs, not on the driver.
+        from ray_tpu import chaos as _chaos
+
+        _chaos.install(_chaos.FaultPlan.from_dict(plan_dict), seed,
+                       publish=False)
+        return True
+
+    assert ray_tpu.get(
+        a.__ray_call__.remote(_install_in_actor, plan, 0), timeout=60)
+
+    with InputNode() as inp:
+        dag = b.f.bind(a.f.bind(inp))
+    loop = compile_loop(dag, credits=2)
+    try:
+        # ticks 1 and 2 stream normally; tick 3 kills stage `a` mid-tick
+        assert loop.run(1) == 12
+        assert loop.run(2) == 13
+        loop.put(3)
+        t0 = time.monotonic()
+        with pytest.raises(Exception):
+            loop.get(timeout=45.0)
+        assert time.monotonic() - t0 < 60.0, "stage death never surfaced"
+    finally:
+        loop.teardown()
+    # cascade completed within the (chaos-clock-measured) window: the
+    # surviving stage exited via the force-closed ring, not a hang
+    assert loop.torn_down_in_s < 30.0
+    result = verifier.verify(baseline)
+    assert result.ok, result.violations
+
+
 def test_serve_replica_kill_request_retried(chaos_cluster):
     """A replica SIGKILLed under load: the in-flight request is re-routed
     to a live replica (router purges the corpse; the controller replaces

@@ -56,6 +56,41 @@ def test_multihost_engine_token_parity(ray_cluster, small_cfg):
         executor.shutdown()
 
 
+def test_multihost_compiled_loop_token_parity(ray_cluster, small_cfg):
+    """The compiled-loop tick path (round 8): the SAME shard fleet driven
+    through a persistent dag/loop.py pipeline — one owner-side submit per
+    shard, then every engine operation is a channel write/read with zero
+    per-tick RPC — must decode byte-identically to the per-call dynamic
+    path (channel FIFO ordering preserves the SPMD invariant exactly as
+    per-caller actor ordering did)."""
+    prompts = [list(range(1, 22)), [7, 3, 7, 3, 7]]
+
+    ref = InferenceEngine(small_cfg, max_slots=2, max_len=64, page_size=8, seed=0)
+    expected = [ref.generate(list(p), max_new_tokens=6) for p in prompts]
+
+    executor = create_sharded_executor(
+        small_cfg, 2,
+        max_slots=2,
+        num_pages=InferenceEngine.total_pages(2, 64, 8),
+        page_size=8,
+        seed=0,
+        runtime_env=SHARD_ENV,
+        use_compiled_loop=True,
+    )
+    try:
+        assert executor.use_compiled_loop and executor._loop is not None
+        eng = InferenceEngine(small_cfg, max_slots=2, max_len=64, page_size=8,
+                              executor=executor, seed=0)
+        got = [eng.generate(list(p), max_new_tokens=6) for p in prompts]
+        assert got == expected
+        # every prefill/sample/decode streamed through the loop, and the
+        # engine surfaces the count
+        assert executor.loop_ticks > 0
+        assert eng.metrics["dag_loop_ticks"] == executor.loop_ticks
+    finally:
+        executor.shutdown()
+
+
 def test_multihost_pp_token_parity(ray_cluster, small_cfg):
     """Pipeline parallelism across hosts: 2 shard processes × 1 device
     each form a pp=2 mesh — each host holds HALF the layers and half the

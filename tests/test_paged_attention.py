@@ -247,19 +247,23 @@ def test_engine_greedy_parity_stage_wraparound(small_model):
 # ------------------------------------------------- impl selection / tp
 
 def test_resolve_attention_impl():
-    """"auto" must pick the kernel exactly when a TPU backend is present
-    (and the mesh doesn't pipeline layers) — the unit-testable half of
-    "paged is the TPU default"."""
+    """"auto" must pick the kernel exactly when a TPU backend is present —
+    since round 8 that includes PIPELINE meshes (the pp tick loop threads
+    the staging carry); only the pp x tp composition stays dense."""
     import types
 
     tp_mesh = types.SimpleNamespace(shape={"tp": 4, "dp": 1})
     pp_mesh = types.SimpleNamespace(shape={"pp": 2, "dp": 1})
+    pp_tp_mesh = types.SimpleNamespace(shape={"pp": 2, "tp": 2})
     assert resolve_attention_impl("auto", backend="tpu") == "paged"
     assert resolve_attention_impl("auto", backend="axon") == "paged"
     assert resolve_attention_impl("auto", backend="cpu") == "dense"
     assert resolve_attention_impl("auto", backend="gpu") == "dense"
     assert resolve_attention_impl("auto", tp_mesh, backend="tpu") == "paged"
-    assert resolve_attention_impl("auto", pp_mesh, backend="tpu") == "dense"
+    # ROADMAP item 4 closed: pp meshes take the kernel too
+    assert resolve_attention_impl("auto", pp_mesh, backend="tpu") == "paged"
+    # residue: the kernel's tp shard_map can't nest inside the pp region
+    assert resolve_attention_impl("auto", pp_tp_mesh, backend="tpu") == "dense"
     # explicit choices pass through untouched
     assert resolve_attention_impl("dense", backend="tpu") == "dense"
     assert resolve_attention_impl("paged", backend="cpu") == "paged"
@@ -288,10 +292,40 @@ def test_tensor_parallel_paged_parity(small_model):
     assert eng.generate(list(prompt), max_new_tokens=6) == expected
 
 
-def test_paged_refused_over_pp_mesh(small_model):
-    """pp meshes must refuse 'paged' loudly (the staging carry is not
-    threaded through the pipeline tick loop) and resolve 'auto' to
-    dense instead of failing."""
+@pytest.mark.skipif(not hasattr(jax, "shard_map"),
+                    reason="jax.shard_map (>= 0.6) required for pp paged")
+def test_pipeline_parallel_paged_parity(small_model):
+    """attention_impl='paged' over a pp mesh: the v2 staging carry rides
+    the pipeline tick loop (per-stage local-layer staging + one
+    commit_staging per stage at the dispatch boundary) and must decode
+    token-identically to the single-device dense engine — the second
+    half of ROADMAP item 4's lifted mesh refusal. Covers multi-dispatch
+    continuation (committed pool re-read by the next burst) and
+    mid-flight EOS (trash-committed staging rows)."""
+    from ray_tpu.parallel import MeshConfig, create_mesh
+
+    cfg, params = small_model
+    prompts = [[1, 5, 9], [2, 4, 6, 8, 10, 12, 14], list(range(1, 20)),
+               [7, 3, 7]]
+    expected = _run_engine(cfg, params, prompts, "dense", max_new_tokens=12)
+
+    n = len(jax.devices())
+    mesh = create_mesh(MeshConfig(pp=2, dp=max(1, n // 2)))
+    eng = InferenceEngine(cfg, params, max_slots=4, max_len=64, page_size=8,
+                          mesh=mesh, attention_impl="paged")
+    reqs = [Request(f"r{i}", list(p), max_new_tokens=12)
+            for i, p in enumerate(prompts)]
+    for r in reqs:
+        eng.add_request(r)
+    while any(not r.done for r in reqs):
+        eng.step()
+    assert [r.generated for r in reqs] == expected
+
+
+def test_paged_refused_over_pp_tp_mesh(small_model):
+    """The one residue of the lifted refusal: pp x tp composed meshes
+    must refuse 'paged' loudly (the kernel's tp shard_map cannot nest
+    inside the pp manual region) and resolve 'auto' to dense."""
     pytest.importorskip("jax", reason="jax required")
     if not hasattr(jax, "shard_map"):
         pytest.skip("pp engine needs jax.shard_map")
@@ -299,8 +333,10 @@ def test_paged_refused_over_pp_mesh(small_model):
 
     cfg, params = small_model
     n = len(jax.devices())
-    mesh = create_mesh(MeshConfig(pp=2, dp=max(1, n // 2)))
-    with pytest.raises(ValueError, match="pp"):
+    if n < 4:
+        pytest.skip("needs 4 devices for a pp=2 x tp=2 mesh")
+    mesh = create_mesh(MeshConfig(pp=2, tp=2, dp=max(1, n // 4)))
+    with pytest.raises(ValueError, match="compose"):
         InferenceEngine(cfg, params, max_slots=2, max_len=64, page_size=8,
                         mesh=mesh, attention_impl="paged")
     eng = InferenceEngine(cfg, params, max_slots=2, max_len=64, page_size=8,
