@@ -409,6 +409,19 @@ def run_dag_bench() -> dict:
     return _run()
 
 
+def run_recovery_bench() -> dict:
+    """Preemption recovery SLOs (ROADMAP item 6): preempt-mid-train and
+    preempt-mid-serve through the real notice→drain→kill path, recording
+    `recovery_train_resume_s`, `recovery_serve_reroute_s`, and
+    `recovery_ckpt_lag_steps` (chaos-clock measured; `*_skipped` markers
+    on scenarios that cannot run). Implementation in
+    ``ray_tpu/_recovery_bench.py``; standalone: ``python -m ray_tpu.cli
+    bench recovery``."""
+    from ray_tpu._recovery_bench import run_recovery_bench as _run
+
+    return _run()
+
+
 def run_serve_bench() -> dict:
     """Serve p50 TTFT north star (BASELINE.json): concurrent streaming
     completions through the REAL stack — HTTP proxy → pow-2 router →
@@ -746,6 +759,24 @@ def main() -> None:
                 ray_tpu.shutdown()
             except Exception:
                 pass
+    extra_recovery: dict = {}
+    if os.environ.get("RAY_TPU_BENCH_SKIP_RECOVERY") != "1":
+        try:
+            extra_recovery = run_recovery_bench()
+        except Exception as e:
+            print(f"recovery bench failed: {e}", file=sys.stderr)
+            extra_recovery = {
+                "recovery_bench_error": f"{type(e).__name__}: {e}",
+                "recovery_train_resume_s_skipped": True,
+                "recovery_serve_reroute_s_skipped": True,
+                "recovery_ckpt_lag_steps_skipped": True,
+            }
+            try:
+                import ray_tpu
+
+                ray_tpu.shutdown()
+            except Exception:
+                pass
     value = fw["tokens_per_sec_per_chip"]
     baseline = None
     if os.path.exists("BENCH_BASELINE.json"):
@@ -770,6 +801,7 @@ def main() -> None:
         **extra_paged,
         **extra_core,
         **extra_dag,
+        **extra_recovery,
     }
     print(json.dumps(result))
     # Regression guard against the most recent recorded round: report-only

@@ -1,0 +1,276 @@
+"""Recovery SLO suite (ROADMAP item 6): preemption as a measured event.
+
+Two scenarios run against an in-process multi-node cluster, each driven
+by the REAL preemption path (preemption notice -> raylet drain -> GCS
+``node_preempted`` -> grace-window kill -> node dead) and timed with the
+chaos clock:
+
+  * **preempt-mid-train** — an async-checkpointing trainer pinned to a
+    spot node; a ``preempt_slice`` FaultPlan kills the slice mid-run,
+    a replacement node joins, and the controller resumes from the
+    latest GCS-registered committed checkpoint. Records
+    ``recovery_train_resume_s`` (notice -> first resumed report) and
+    ``recovery_ckpt_lag_steps`` (steps replayed after resume).
+  * **preempt-mid-serve** — a 2-replica deployment with one replica on
+    the spot node; after the notice the serve controller evicts it
+    proactively and traffic re-routes with zero failed requests.
+    Records ``recovery_serve_reroute_s`` (notice -> eviction + table
+    push) and ``recovery_serve_failed_requests``.
+
+A scenario that cannot run records ``<metric>_skipped`` markers (honored
+by ``ray_tpu.bench_check``) instead of silently vanishing. Sizes/grace
+are env-tunable (``RAY_TPU_RECOVERY_BENCH_{TRAIN_STEPS,GRACE_S}``).
+Standalone: ``python -m ray_tpu.cli bench recovery``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+TRAIN_METRICS = ("recovery_train_resume_s", "recovery_ckpt_lag_steps")
+SERVE_METRICS = ("recovery_serve_reroute_s",)
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+def _env_float(name: str, default: float) -> float:
+    return float(os.environ.get(name, default))
+
+
+def _wait_for(predicate, timeout: float, interval: float = 0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result = predicate()
+        if result:
+            return result
+        time.sleep(interval)
+    return predicate()
+
+
+def _fresh_shutdown() -> None:
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+
+
+def _notice_clock(timeout: float = 30.0) -> float | None:
+    """Chaos-clock stamp of the first node_preempted ErrorEvent."""
+    from ray_tpu.util import state
+
+    events = _wait_for(
+        lambda: state.list_errors(error_type="node_preempted", limit=100),
+        timeout)
+    if not events:
+        return None
+    return float((events[0].get("extra") or {}).get("notice_clock") or 0.0)
+
+
+def run_train_scenario(train_steps: int, grace_s: float,
+                       storage: str) -> dict:
+    import ray_tpu
+    from ray_tpu import chaos, train
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train import (CheckpointConfig, DataParallelTrainer,
+                               FailureConfig, RunConfig, ScalingConfig)
+
+    _fresh_shutdown()
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4},
+                _system_config={"health_check_period_ms": 200,
+                                "preempt_grace_s": grace_s})
+    spot = c.add_node(num_cpus=2, resources={"spot_slice": 1.0})
+    ray_tpu.init(address=c.address, num_cpus=0)
+    every_n = 2
+    out: dict = {}
+    try:
+        def train_fn(config):
+            import time as _t
+
+            import numpy as np
+
+            from ray_tpu import train as tr
+            from ray_tpu.resilience import load_checkpoint
+
+            start = 0
+            ck = tr.get_checkpoint()
+            if ck is not None:
+                tree, _meta = load_checkpoint(ck.path)
+                start = int(tree["step"]) + 1
+            for step in range(start, config["steps"]):
+                tr.report({"step": step, "loss": 1.0 / (1.0 + step)},
+                          state={"step": step,
+                                 "w": np.full(1024, float(step),
+                                              dtype=np.float32)})
+                _t.sleep(0.1)
+
+        trainer = DataParallelTrainer(
+            train_fn,
+            train_loop_config={"steps": train_steps},
+            scaling_config=ScalingConfig(
+                num_workers=1,
+                resources_per_worker={"CPU": 1.0, "spot_slice": 1.0}),
+            run_config=RunConfig(
+                name="recovery_bench", storage_path=storage,
+                checkpoint_config=CheckpointConfig(
+                    async_save=True, every_n_steps=every_n, num_to_keep=3),
+                failure_config=FailureConfig(max_failures=3)),
+        )
+        box: dict = {}
+        t = threading.Thread(target=lambda: box.update(result=trainer.fit()))
+        t.start()
+        # Inject only once training is underway AND committed at least one
+        # checkpoint — the preemption must provably land MID-train.
+        from ray_tpu.resilience import latest_registered
+
+        if not _wait_for(lambda: latest_registered("recovery_bench"),
+                         timeout=120.0):
+            raise TimeoutError("no async checkpoint was ever registered")
+        chaos.install({
+            "name": "bench-preempt-train",
+            "faults": [{"kind": "preempt_slice", "nth": 3,
+                        "max_injections": 1,
+                        "node": spot.node_id.hex()[:16]}],
+        }, seed=0, publish=False)
+        notice = _notice_clock(timeout=60.0)
+        # the replacement slice the autoscaler would launch
+        c.add_node(num_cpus=2, resources={"spot_slice": 1.0})
+        t.join(timeout=240.0)
+        if t.is_alive() or notice is None:
+            raise TimeoutError("train scenario did not finish")
+        result = box["result"]
+        if result.error is not None:
+            raise RuntimeError(f"train run failed: {result.error}")
+        resumed = [e for e in result.recovery_events
+                   if e.get("resumed_clock") is not None]
+        if not resumed:
+            raise RuntimeError("no recovery event was stamped")
+        out["recovery_train_resume_s"] = round(
+            max(0.0, resumed[0]["resumed_clock"] - notice), 3)
+        steps = [m["step"] for m in result.metrics_history]
+        replayed = 0
+        for prev, cur in zip(steps, steps[1:]):
+            if cur <= prev:  # the restart point: overlap = replayed work
+                replayed = prev - cur + 1
+        out["recovery_ckpt_lag_steps"] = replayed
+        if replayed > every_n:
+            out["recovery_ckpt_lag_warning"] = (
+                f"lag {replayed} > every_n_steps {every_n}")
+        if steps[-1] != train_steps - 1:
+            raise RuntimeError(f"run did not reach step {train_steps - 1}")
+    finally:
+        try:
+            chaos.uninstall()
+        except Exception:
+            pass
+        _fresh_shutdown()
+        c.shutdown()
+    return out
+
+
+def run_serve_scenario(grace_s: float) -> dict:
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.cluster_utils import Cluster
+
+    _fresh_shutdown()
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 4,
+                                "resources": {"replica_slot": 1.0}},
+                _system_config={"health_check_period_ms": 200,
+                                "preempt_grace_s": grace_s})
+    spot = c.add_node(num_cpus=2, resources={"replica_slot": 1.0})
+    ray_tpu.init(address=c.address, num_cpus=0)
+    out: dict = {}
+    try:
+        @serve.deployment(num_replicas=2, ray_actor_options={
+            "num_cpus": 0.1, "resources": {"replica_slot": 1.0}})
+        class Echo:
+            def hello(self, x):
+                return f"hello {x}"
+
+        handle = serve.run(Echo.bind(), name="recovery_bench_app",
+                           route_prefix=None, _blocking=False)
+        ready = _wait_for(
+            lambda: (serve.status().get("recovery_bench_app", {})
+                     .get("Echo", {}).get("running_replicas") == 2),
+            timeout=120.0)
+        if not ready:
+            raise TimeoutError("2 replicas never became ready")
+        # Preempt a node hosting a replica but NOT the serve controller —
+        # the controller must survive to run the proactive eviction (in
+        # production the controller would be restarted elsewhere first).
+        from ray_tpu.util import state as st
+
+        ctrl_node = next((a.get("node_id") for a in st.list_actors()
+                          if a.get("name") == "SERVE_CONTROLLER"), "")
+        victim = c.head_node if spot.node_id.hex() == ctrl_node else spot
+        # long grace: the PROACTIVE eviction, not the eventual death,
+        # must do the re-routing
+        c._loop.run_sync(victim.handle_PreemptionNotice(
+            {"reason": "bench spot reclaim", "grace_s": max(5.0, grace_s)}))
+        failures = 0
+        for i in range(40):
+            try:
+                if handle.hello.remote(i).result(timeout=30) != f"hello {i}":
+                    failures += 1
+            except Exception:
+                failures += 1
+            time.sleep(0.05)
+        evictions = _wait_for(
+            lambda: (serve.status().get("recovery_bench_app", {})
+                     .get("Echo", {}).get("preemption_evictions")),
+            timeout=30.0)
+        if not evictions:
+            raise RuntimeError("no proactive preemption eviction recorded")
+        out["recovery_serve_reroute_s"] = round(
+            float(evictions[0]["reroute_s"]), 3)
+        out["recovery_serve_failed_requests"] = failures
+    finally:
+        try:
+            serve.delete("recovery_bench_app")
+        except Exception:
+            pass
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        _fresh_shutdown()
+        c.shutdown()
+    return out
+
+
+def run_recovery_bench(train_steps: int | None = None,
+                       grace_s: float | None = None) -> dict:
+    train_steps = train_steps or _env_int(
+        "RAY_TPU_RECOVERY_BENCH_TRAIN_STEPS", 24)
+    grace_s = grace_s or _env_float("RAY_TPU_RECOVERY_BENCH_GRACE_S", 0.5)
+    import tempfile
+
+    out: dict = {"recovery_grace_cfg": grace_s}
+    try:
+        with tempfile.TemporaryDirectory(prefix="raytpu-recovery-") as d:
+            out.update(run_train_scenario(train_steps, grace_s, d))
+    except Exception as e:
+        print(f"recovery train scenario failed: {e}", file=sys.stderr)
+        out["recovery_train_error"] = f"{type(e).__name__}: {e}"
+        for m in TRAIN_METRICS:
+            out[f"{m}_skipped"] = True
+    try:
+        out.update(run_serve_scenario(grace_s))
+    except Exception as e:
+        print(f"recovery serve scenario failed: {e}", file=sys.stderr)
+        out["recovery_serve_error"] = f"{type(e).__name__}: {e}"
+        for m in SERVE_METRICS:
+            out[f"{m}_skipped"] = True
+    return out
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run_recovery_bench(), indent=2))

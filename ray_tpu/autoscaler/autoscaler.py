@@ -127,7 +127,10 @@ class Autoscaler:
     def _capacity_views(self, nodes: list[dict]):
         available, total = [], []
         for node in nodes:
-            if node.get("state") != "ALIVE":
+            # Draining (preempted) nodes must not absorb demand during
+            # bin-packing — the replacement launch they displaced is the
+            # entire point of surfacing the notice early.
+            if node.get("state") != "ALIVE" or node.get("draining"):
                 continue
             res = node.get("resources") or {}
             available.append(dict(res.get("available") or {}))

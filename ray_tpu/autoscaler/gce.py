@@ -135,6 +135,10 @@ class GceTpuNodeProvider(NodeProvider):
         self._lock = threading.Lock()
         self._instances: dict[str, dict] = {}  # instance_id -> {type, state}
         self._counter = 0
+        # Spot-reclaim notices: instances the cloud listed as PREEMPTED,
+        # held until the reconciler acks them (preemption_notices /
+        # ack_preemption) so it can terminate + replace the slice.
+        self._preempted: dict[str, str] = {}  # instance_id -> node_type
 
     # ------------------------------------------------------------- helpers
     def _parent(self) -> str:
@@ -216,6 +220,11 @@ class GceTpuNodeProvider(NodeProvider):
                 iid = node["name"].rsplit("/", 1)[-1]
                 listed.add(iid)
                 if node.get("state") in ("DELETING", "TERMINATED", "PREEMPTED"):
+                    if node.get("state") == "PREEMPTED" and iid in self._instances:
+                        # Surface the GCE spot reclaim as a typed notice
+                        # the reconciler consumes (terminate + replace).
+                        self._preempted[iid] = labels.get(
+                            "raytpu-node-type", "unknown")
                     continue
                 live[iid] = labels.get("raytpu-node-type", "unknown")
                 entry = self._instances.setdefault(
@@ -251,3 +260,15 @@ class GceTpuNodeProvider(NodeProvider):
         # the GCS; mapping instance -> cluster node id happens there (the
         # reconciler matches by pending-launch expiry, not identity).
         return None
+
+    # ------------------------------------------------------------- preemption
+    def preemption_notices(self) -> dict[str, str]:
+        """instance_id -> node_type for slices the cloud reported
+        PREEMPTED and nobody acked yet. The ``InstanceManager`` consumes
+        these: terminate the instance, request a same-shape replacement."""
+        with self._lock:
+            return dict(self._preempted)
+
+    def ack_preemption(self, instance_id: str) -> None:
+        with self._lock:
+            self._preempted.pop(instance_id, None)

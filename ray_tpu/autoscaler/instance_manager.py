@@ -87,12 +87,18 @@ class InstanceManager:
         ray_boot_timeout_s: float = 600.0,
         terminate_timeout_s: float = 300.0,
         max_allocation_retries: int = 3,
+        replace_preempted: bool = True,
     ):
         self.provider = provider
         self.request_timeout_s = request_timeout_s
         self.ray_boot_timeout_s = ray_boot_timeout_s
         self.terminate_timeout_s = terminate_timeout_s
         self.max_allocation_retries = max_allocation_retries
+        # Spot preemption handling: providers that surface
+        # ``preemption_notices()`` (GCE spot reclaim, the local harness)
+        # get their preempted instances terminated AND replaced with a
+        # same-shape launch in the same reconcile round.
+        self.replace_preempted = replace_preempted
         self._lock = threading.Lock()
         self._instances: dict[str, Instance] = {}
         self._by_cloud_id: dict[str, str] = {}
@@ -169,10 +175,51 @@ class InstanceManager:
         if self._preexisting is None:
             self._preexisting = set(alive)
         repairs = {"allocation_retried": 0, "allocation_failed": 0,
-                   "ray_boot_timeout": 0, "terminate_reissued": 0}
+                   "ray_boot_timeout": 0, "terminate_reissued": 0,
+                   "preempt_replaced": 0}
+        notices: dict[str, str] = {}
+        if self.replace_preempted:
+            notices_fn = getattr(self.provider, "preemption_notices", None)
+            if notices_fn is not None:
+                try:
+                    notices = dict(notices_fn() or {})
+                except Exception:
+                    notices = {}
         with self._lock:
             claimed = {i.cloud_instance_id for i in self._instances.values()
                        if i.cloud_instance_id}
+            # Spot preemptions first: the cloud is reclaiming these
+            # slices — confirm the terminate and queue a same-shape
+            # replacement BEFORE the per-state pass, so the replacement
+            # request lands in this same round.
+            if notices:
+                for inst in list(self._instances.values()):
+                    if inst.cloud_instance_id not in notices:
+                        continue
+                    if inst.state not in (REQUESTED, ALLOCATED, RAY_RUNNING):
+                        continue
+                    logger.warning(
+                        "instance %s (%s) preempted by the cloud: "
+                        "terminating + requesting replacement",
+                        inst.instance_id, inst.node_type)
+                    repairs["preempt_replaced"] += 1
+                    self._transition(inst, TERMINATING)
+                    try:
+                        self.provider.terminate_node(inst.cloud_instance_id)
+                    except Exception:
+                        pass
+                    ack = getattr(self.provider, "ack_preemption", None)
+                    if ack is not None:
+                        try:
+                            ack(inst.cloud_instance_id)
+                        except Exception:
+                            pass
+                    replacement = Instance(
+                        f"inst-{next(self._counter)}", inst.node_type,
+                        resources=dict(inst.resources))
+                    self._instances[replacement.instance_id] = replacement
+                    self._request_locked(replacement, replacement.resources)
+                    claimed.add(replacement.cloud_instance_id)
             for inst in list(self._instances.values()):
                 if inst.state == REQUESTED:
                     if inst.cloud_instance_id not in listing:

@@ -52,6 +52,7 @@ class LocalNodeProvider(NodeProvider):
         self._lock = threading.Lock()
         self._instances: dict[str, dict] = {}  # instance_id -> {type, raylet}
         self._counter = 0
+        self._preempted: dict[str, str] = {}  # instance_id -> node_type
 
     def create_node(self, node_type: str, resources: dict) -> str:
         res = dict(resources)
@@ -77,3 +78,29 @@ class LocalNodeProvider(NodeProvider):
         with self._lock:
             inst = self._instances.get(instance_id)
         return inst["raylet"].node_id.hex() if inst else None
+
+    # ------------------------------------------------------------- preemption
+    def preempt_node(self, instance_id: str,
+                     grace_s: float | None = None) -> bool:
+        """Simulate a GCE spot reclaim of a launched node: the raylet gets
+        a preemption notice (drains, then its workers die after the
+        grace) and the instance surfaces in ``preemption_notices()`` so
+        the reconciler terminates + replaces it — the full preemption
+        path, end to end, on the in-process harness."""
+        with self._lock:
+            inst = self._instances.get(instance_id)
+        if inst is None:
+            return False
+        self.cluster._loop.run_sync(inst["raylet"].handle_PreemptionNotice({
+            "reason": "spot reclaim (simulated)", "grace_s": grace_s}))
+        with self._lock:
+            self._preempted[instance_id] = inst["type"]
+        return True
+
+    def preemption_notices(self) -> dict[str, str]:
+        with self._lock:
+            return dict(self._preempted)
+
+    def ack_preemption(self, instance_id: str) -> None:
+        with self._lock:
+            self._preempted.pop(instance_id, None)

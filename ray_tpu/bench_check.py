@@ -39,9 +39,10 @@ _SKIP_SUBSTR = ("error", "preset", "metric", "unit", "cmd", "tail", "_cfg")
 _HIGHER_BETTER_SUFFIX = ("_per_s", "_per_sec")
 # Lower is better. Peak-memory gauges count as regressions when they
 # GROW >threshold (a quiet 2x pool blowup is exactly what they exist
-# to catch).
-_LOWER_BETTER_SUFFIX = ("_ms", "_us", "_pct", "_bytes", "_s")
-_LOWER_BETTER_SUBSTR = ("latency", "ttft", "overhead")
+# to catch). "_lag_steps": checkpoint lag (steps replayed after a
+# preemption recovery) regresses UP — more lost work is worse.
+_LOWER_BETTER_SUFFIX = ("_ms", "_us", "_pct", "_bytes", "_s", "_lag_steps")
+_LOWER_BETTER_SUBSTR = ("latency", "ttft", "overhead", "failed")
 
 
 def load_metrics(path: str) -> dict:

@@ -34,9 +34,11 @@ KIND_PARTITION = "partition"        # block a peer address set for a window
 KIND_GCS_BLACKOUT = "gcs_blackout"  # partition targeting the GCS endpoint
 KIND_HTTP_INGRESS = "http_ingress"  # drop/delay at the serve HTTP proxy
 KIND_KILL_LOOP = "kill_loop_stage"  # os._exit a loop stage at its Nth tick
+KIND_PREEMPT = "preempt_slice"      # GCE preemption notice at a node's Nth tick
 
 _COUNTED_KINDS = (KIND_RPC, KIND_KILL_WORKER, KIND_SPILL_ERROR,
-                  KIND_STORE_FULL, KIND_HTTP_INGRESS, KIND_KILL_LOOP)
+                  KIND_STORE_FULL, KIND_HTTP_INGRESS, KIND_KILL_LOOP,
+                  KIND_PREEMPT)
 _WINDOW_KINDS = (KIND_PARTITION, KIND_GCS_BLACKOUT)
 
 # How many future calls a probabilistic rule pre-draws decisions for.
@@ -65,7 +67,7 @@ class FaultPlan:
                     raise FaultPlanError(
                         f"faults[{i}]: where must be request|response|client")
             elif kind in (KIND_KILL_WORKER, KIND_SPILL_ERROR, KIND_STORE_FULL,
-                          KIND_KILL_LOOP):
+                          KIND_KILL_LOOP, KIND_PREEMPT):
                 pass
             elif kind in _WINDOW_KINDS:
                 if float(fault.get("duration_s", 0)) <= 0:
@@ -285,6 +287,28 @@ class PlanChaos(RpcChaos):
                 return True
         return False
 
+    def take_preempt_slice(self, node_id: str = "") -> bool:
+        """One heartbeat tick on ``node_id``: does the GCE-style
+        preemption notice land here now? Rules target a node-id prefix
+        (``node``) or a runner-resolved ``target: node:<i>`` (i-th alive
+        node at install time); a targeted rule whose target did not
+        resolve never fires — so the bundled plan is a safe no-op on a
+        cluster too small to have the targeted node. Only MATCHING ticks
+        advance the rule counter, so ``nth`` is deterministic per
+        targeted node regardless of how many raylets share the engine."""
+        for idx, rule in self._matching(KIND_PREEMPT):
+            if rule.get("node"):
+                if not node_id.startswith(rule["node"]):
+                    continue
+            elif rule.get("target"):
+                targets = self._partition_peers.get(idx) or []
+                if not any(node_id.startswith(t) for t in targets):
+                    continue
+            if self._take(idx, rule):
+                self._fire(idx, rule, "preempt_slice", node_id[:12])
+                return True
+        return False
+
     def maybe_fail_spill(self) -> bool:
         for idx, rule in self._matching(KIND_SPILL_ERROR):
             if self._take(idx, rule):
@@ -358,6 +382,18 @@ BUILTIN_PLANS: dict[str, dict] = {
                        "ride it out on retry backoff and reconnect.",
         "faults": [
             {"kind": "gcs_blackout", "start_s": 0.0, "duration_s": 2.0},
+        ],
+    },
+    "slice-preempt": {
+        "name": "slice-preempt",
+        "description": "GCE-style preemption notice on the 2nd alive node "
+                       "at its 2nd heartbeat tick: the raylet drains, the "
+                       "GCS publishes node_preempted, and work re-routes "
+                       "to survivors. No-ops when the targeted node does "
+                       "not exist (single-node clusters).",
+        "faults": [
+            {"kind": "preempt_slice", "nth": 2, "max_injections": 1,
+             "target": "node:1"},
         ],
     },
     "mixed-seeded": {

@@ -24,14 +24,25 @@ ACTIVE_PLAN_KEY = "chaos:active_plan"
 
 
 def _resolve_partition_peers(schedule: FaultSchedule) -> dict[int, list[str]]:
-    """Resolve window rules' abstract targets into live addresses.
-    ``gcs_blackout`` / ``target: gcs`` -> the GCS endpoint;
-    ``target: node:<i>`` -> the i-th alive raylet; explicit ``peers``
-    lists pass through."""
+    """Resolve window/targeted rules' abstract targets into live
+    identities. ``gcs_blackout`` / ``target: gcs`` -> the GCS endpoint;
+    ``target: node:<i>`` -> the i-th alive raylet (its ADDRESS for
+    partitions, its NODE ID for ``preempt_slice``); explicit ``peers``
+    lists pass through. A target that does not resolve stays absent —
+    the rule then never fires (safe no-op on too-small clusters)."""
     from ..core.worker import global_worker
 
     peers: dict[int, list[str]] = {}
     nodes = None
+
+    def _alive_nodes():
+        nonlocal nodes
+        if nodes is None:
+            from ..util import state
+
+            nodes = [n for n in state.list_nodes() if n["state"] == "ALIVE"]
+        return nodes
+
     for idx, rule in enumerate(schedule.rules):
         if rule["kind"] == "gcs_blackout" or rule.get("target") == "gcs":
             peers[idx] = [global_worker().gcs_address]
@@ -39,14 +50,14 @@ def _resolve_partition_peers(schedule: FaultSchedule) -> dict[int, list[str]]:
             if rule.get("peers"):
                 peers[idx] = list(rule["peers"])
             elif str(rule.get("target", "")).startswith("node:"):
-                if nodes is None:
-                    from ..util import state
-
-                    nodes = [n for n in state.list_nodes()
-                             if n["state"] == "ALIVE"]
                 i = int(rule["target"].split(":", 1)[1])
-                if i < len(nodes):
-                    peers[idx] = [nodes[i]["address"]]
+                if i < len(_alive_nodes()):
+                    peers[idx] = [_alive_nodes()[i]["address"]]
+        elif rule["kind"] == "preempt_slice":
+            if str(rule.get("target", "")).startswith("node:"):
+                i = int(rule["target"].split(":", 1)[1])
+                if i < len(_alive_nodes()):
+                    peers[idx] = [_alive_nodes()[i]["node_id"]]
     return peers
 
 

@@ -32,6 +32,9 @@ from typing import Iterable
 EXPECTED_ORGANIC_TYPES = frozenset({
     "task_failure", "actor_creation_failure", "replica_start_failure",
     "lease_orphan", "lease_wedge", "oom_kill", "memory_leak",
+    # An injected preempt_slice rule drains a node: the GCS's
+    # node_preempted notice is the designed consequence, not an orphan.
+    "node_preempted",
 })
 
 

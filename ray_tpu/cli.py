@@ -73,6 +73,14 @@ def _cmd_bench(args) -> int:
         result = run_dag_bench(ticks=args.ticks, bursts=args.bursts)
         ok = bool(result.get("dag_tick_dispatch_overhead_us"))
         prefixes = ("dag_", "pp_decode_")
+    elif args.bench_cmd == "recovery":
+        from ray_tpu._recovery_bench import run_recovery_bench
+
+        result = run_recovery_bench(train_steps=args.train_steps,
+                                    grace_s=args.grace)
+        ok = bool(result.get("recovery_train_resume_s") is not None
+                  or result.get("recovery_serve_reroute_s") is not None)
+        prefixes = ("recovery_",)
     else:
         from ray_tpu._core_bench import run_core_bench
 
@@ -167,6 +175,22 @@ def main(argv: list[str] | None = None) -> int:
                       help="timed decode bursts per mode (default "
                            "$RAY_TPU_DAG_BENCH_DECODE_BURSTS or 12)")
     bdag.add_argument("--check-against", default=None, metavar="BENCH_JSON",
+                      help="run ray_tpu.bench_check against a recorded "
+                           "BENCH_r*.json and exit non-zero on regression")
+    brec = bench_sub.add_parser(
+        "recovery", help="preemption recovery SLO suite: preempt-mid-train "
+                         "and preempt-mid-serve through the real notice→"
+                         "drain→kill path (recovery_train_resume_s, "
+                         "recovery_serve_reroute_s, recovery_ckpt_lag_steps;"
+                         " *_skipped markers where a scenario can't run)")
+    brec.add_argument("--train-steps", type=int, default=None,
+                      help="train steps in the preempt-mid-train scenario "
+                           "(default $RAY_TPU_RECOVERY_BENCH_TRAIN_STEPS "
+                           "or 24)")
+    brec.add_argument("--grace", type=float, default=None,
+                      help="preemption grace window in seconds (default "
+                           "$RAY_TPU_RECOVERY_BENCH_GRACE_S or 0.5)")
+    brec.add_argument("--check-against", default=None, metavar="BENCH_JSON",
                       help="run ray_tpu.bench_check against a recorded "
                            "BENCH_r*.json and exit non-zero on regression")
     serve_p = sub.add_parser(

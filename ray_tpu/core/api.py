@@ -209,7 +209,10 @@ def available_resources() -> dict:
     reply = worker._gcs_call("GetAllNodes", {})
     total: dict[str, float] = {}
     for node in reply["nodes"]:
-        if node["state"] != "ALIVE":
+        # A draining node (preemption notice) is about to vanish: its
+        # capacity must not count as available, or the elastic train
+        # policy would size a group onto a node that dies mid-attempt.
+        if node["state"] != "ALIVE" or node.get("draining"):
             continue
         for k, v in node["resources"]["available"].items():
             total[k] = total.get(k, 0.0) + v

@@ -114,6 +114,13 @@ class RayTpuConfig:
     tpu_grant_fence_timeout_s: float = 90.0
 
     # --- fault tolerance -----------------------------------------------------
+    # Preemption drain window: seconds between a node's preemption notice
+    # (GCE-style, or an injected `preempt_slice` chaos rule) and the VM
+    # reclaim — the raylet drains (no new leases, task events flushed)
+    # and then its workers are killed. GCE gives spot TPU VMs ~30 s;
+    # tests/benches shrink it. Read through the chaos clock, so a
+    # VirtualClock replays the window in milliseconds.
+    preempt_grace_s: float = 10.0
     task_max_retries: int = 3
     actor_max_restarts: int = 0
     health_check_period_ms: int = 1000

@@ -297,6 +297,12 @@ class GcsServer:
         if node is None:
             return {"unknown": True}
         node["last_heartbeat"] = time.time()
+        if p.get("draining") and not node.get("draining"):
+            # Heartbeat-carried drain flag: belt-and-braces sync in case
+            # the explicit ReportNodeDraining RPC was lost.
+            await self._note_node_draining(
+                p["node_id"], p.get("drain_reason", "raylet heartbeat"),
+                notice_clock=p.get("drain_notice_clock"))
         if "resources" in p and p["resources"]:
             node["resources"] = p["resources"]
         node["pending_demand"] = p.get("pending_demand", [])
@@ -341,6 +347,48 @@ class GcsServer:
 
     async def handle_DrainNode(self, p: dict) -> dict:
         await self._mark_node_dead(p["node_id"], "drained")
+        return {}
+
+    # ------------------------------------------------------------- preemption
+    async def handle_ReportNodeDraining(self, p: dict) -> dict:
+        """A raylet received a preemption notice and entered draining.
+        The node stays ALIVE (it still serves objects and in-flight work)
+        but is flagged ``draining`` — schedulers, the autoscaler, and the
+        serve controller all treat it as capacity that is about to
+        vanish — and a ``node_preempted`` ErrorEvent goes out so
+        consumers react to the NOTICE, not the eventual death."""
+        if p["node_id"] not in self._nodes:
+            return {"unknown": True}
+        await self._note_node_draining(
+            p["node_id"], p.get("reason", ""),
+            notice_clock=p.get("notice_clock"), grace_s=p.get("grace_s"))
+        return {}
+
+    async def _note_node_draining(self, node_id: str, reason: str,
+                                  notice_clock=None, grace_s=None) -> None:
+        node = self._nodes.get(node_id)
+        if node is None or node.get("draining") or node["state"] != "ALIVE":
+            return
+        node["draining"] = True
+        node["drain_reason"] = reason
+        node["drain_notice_clock"] = (
+            float(notice_clock) if notice_clock else chaos_clock.now())
+        logger.warning("node %s draining (%s)", node_id[:8], reason)
+        from ..diagnostics.errors import make_event
+
+        await self.handle_PublishError({"event": make_event(
+            "node_preempted",
+            f"node {node_id[:8]} received a preemption notice ({reason}); "
+            "draining",
+            source="gcs", node_id=node_id,
+            extra={"reason": reason, "grace_s": grace_s,
+                   "notice_clock": node["drain_notice_clock"]})})
+
+    async def handle_NodePreempted(self, p: dict) -> dict:
+        """The drain grace expired: the node is gone (the cloud reclaimed
+        the VM). Terminal — actors there restart elsewhere."""
+        await self._mark_node_dead(
+            p["node_id"], f"preempted ({p.get('reason', '')})")
         return {}
 
     async def _health_check_loop(self) -> None:
@@ -915,6 +963,7 @@ class GcsServer:
             "found": True,
             "state": record["state"],
             "address": record["address"],
+            "node_id": record.get("node_id", ""),
             "num_restarts": record["num_restarts"],
             "death_cause": record["death_cause"],
         }

@@ -262,6 +262,14 @@ class Raylet:
         # Transfer counters (observability + the broadcast fan-out test).
         self.transfer_stats = {"chunks_served": 0, "pushes_served": 0,
                                "pulls_started": 0}
+        # Preemption draining (resilience subsystem): after a GCE-style
+        # preemption notice the node admits NO new leases, flushes its
+        # task events, and — once the grace window expires — its workers
+        # are killed and the GCS marks it dead. Timestamps ride the chaos
+        # clock so VirtualClock runs measure the drain window virtually.
+        self._draining = False
+        self._draining_since = 0.0
+        self._drain_reason = ""
         # Diagnostics counters (debug_state + the lease-wedge watchdog).
         self._wedge_events_total = 0
         self._oom_kills_total = 0
@@ -390,11 +398,20 @@ class Raylet:
         cfg = get_config()
         while True:
             await asyncio.sleep(cfg.health_check_period_ms / 1000.0)
+            # Chaos injection point: the `preempt_slice` FaultPlan kind
+            # delivers a GCE-style preemption notice at this node's Nth
+            # heartbeat tick (deterministic per targeted node).
+            if not self._draining and get_chaos().take_preempt_slice(
+                    self.node_id.hex()):
+                self.begin_draining("chaos: injected preemption notice")
             try:
                 reply = await self._gcs.call(
                     "Heartbeat",
                     {
                         "node_id": self.node_id.hex(),
+                        "draining": self._draining,
+                        "drain_reason": self._drain_reason,
+                        "drain_notice_clock": self._draining_since,
                         "resources": self.resources.to_dict(),
                         "pending_demand": [
                             {"shape": dict(shape), "count": count}
@@ -462,7 +479,7 @@ class Raylet:
                 1 for w in self._workers.values()
                 if w.state == "starting" and w.env_hash == ""
             )
-            if (not self._shutdown
+            if (not self._shutdown and not self._draining
                     and idle_default + starting < cfg.num_prestart_workers
                     and starting < cfg.maximum_startup_concurrency):
                 try:
@@ -1072,6 +1089,20 @@ class Raylet:
         request = self._lease_request_set(spec)
         grant_only_local = bool(p.get("grant_only_local") or p.get("dedicated"))
 
+        # Draining (preemption notice): this node admits NOTHING new —
+        # whatever it granted now would die inside the grace window.
+        # Spill to a non-draining peer when one fits; otherwise refuse.
+        if self._draining:
+            if not grant_only_local:
+                await self._refresh_node_table(max_age_s=0.45)
+                node = (self._pick_remote_node(request, require_available=True)
+                        or self._pick_remote_node(request))
+                if node is not None:
+                    return {"spillback": True, "node_address": node["address"],
+                            "node_id": node["node_id"]}
+            return {"granted": False,
+                    "reason": "node draining (preemption notice)"}
+
         # Placement-group tasks run on the node holding their bundle and
         # draw resources from the bundle's reservation, not the node pool
         # (reference: bundle_scheduling_policy.cc, bundle resources are real).
@@ -1409,7 +1440,8 @@ class Raylet:
     def _pick_remote_node(self, request: ResourceSet, require_available: bool = False) -> dict | None:
         best = None
         for node_id, node in self._node_table.items():
-            if node_id == self.node_id.hex() or node.get("state") != "ALIVE":
+            if node_id == self.node_id.hex() or node.get("state") != "ALIVE" \
+                    or node.get("draining"):
                 continue
             nr = NodeResources.from_dict(node["resources"])
             if require_available and not nr.can_fit(request):
@@ -1475,6 +1507,78 @@ class Raylet:
 
     async def handle_HealthCheck(self, p: dict) -> dict:
         return {"node_id": self.node_id.hex()}
+
+    # ------------------------------------------------------------- preemption
+    async def handle_PreemptionNotice(self, p: dict) -> dict:
+        """GCE-style preemption notice delivered over RPC (the instance
+        manager / test harness path; the chaos engine delivers the same
+        notice in-process via ``take_preempt_slice``)."""
+        started = self.begin_draining(
+            p.get("reason") or "preemption notice",
+            grace_s=p.get("grace_s"))
+        return {"draining": True, "started": started,
+                "node_id": self.node_id.hex()}
+
+    def begin_draining(self, reason: str, grace_s: float | None = None) -> bool:
+        """Enter the draining state: no new leases are admitted (requests
+        spill to non-draining peers), buffered task events are flushed,
+        the GCS is told to flag the node and publish ``node_preempted``,
+        and after the grace window the workers are killed and the node is
+        reported dead. Must run on the raylet loop."""
+        if self._draining or self._shutdown:
+            return False
+        self._draining = True
+        self._draining_since = chaos_clock.now()
+        self._drain_reason = reason
+        logger.warning("node %s draining (%s): refusing new leases, dying in "
+                       "%.1fs grace", self.node_id.hex()[:8], reason,
+                       get_config().preempt_grace_s if grace_s is None
+                       else float(grace_s))
+        self._tasks.append(spawn(self._drain_to_death(grace_s)))
+        return True
+
+    async def _drain_to_death(self, grace_s: float | None) -> None:
+        grace = (get_config().preempt_grace_s if grace_s is None
+                 else float(grace_s))
+        # Flush buffered task events NOW — after the VM reclaim nothing
+        # ships them, and the whole point of the drain is that no
+        # observability is lost to the preemption.
+        events, dropped = self._task_events.drain()
+        try:
+            if events or dropped:
+                await self._gcs.call(
+                    "AddTaskEvents", {"events": events, "dropped": dropped},
+                    timeout=10.0)
+        except Exception:
+            pass
+        try:
+            await self._gcs.call("ReportNodeDraining", {
+                "node_id": self.node_id.hex(),
+                "reason": self._drain_reason,
+                "grace_s": grace,
+                "notice_clock": self._draining_since,
+            }, timeout=10.0)
+        except Exception:
+            pass
+        await chaos_clock.sleep(grace)
+        if self._shutdown:
+            return
+        logger.warning("preemption grace expired on node %s: reclaiming "
+                       "(killing %d workers)", self.node_id.hex()[:8],
+                       len(self._workers))
+        for w in list(self._workers.values()):
+            if w.proc is not None and w.proc.poll() is None:
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+        try:
+            await self._gcs.call("NodePreempted", {
+                "node_id": self.node_id.hex(),
+                "reason": self._drain_reason,
+            }, timeout=10.0)
+        except Exception:
+            pass
 
     # ----------------------------------------------------------- spill manager
     def _create_with_spill(self, oid: bytes, data_size: int, meta_size: int) -> int:
@@ -2337,6 +2441,8 @@ class Raylet:
             "worker_rss_bytes": {
                 wid[:12]: rss for wid, rss in self._worker_rss().items()},
             "transfer_stats": dict(self.transfer_stats),
+            "draining": self._draining,
+            "drain_reason": self._drain_reason,
             "oom_kills_total": self._oom_kills_total,
             "wedge_events_total": self._wedge_events_total,
             "orphan_leases_total": self._orphan_leases_total,

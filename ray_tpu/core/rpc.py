@@ -223,6 +223,11 @@ class RpcChaos:
         (between consuming inputs and producing output)?"""
         return False
 
+    def take_preempt_slice(self, node_id: str = "") -> bool:
+        """A raylet asks, once per heartbeat tick: does a GCE-style
+        preemption notice land on this node now? (plan-driven)"""
+        return False
+
     def maybe_fail_spill(self) -> bool:
         """Raylet asks: fail this spill-file disk write?"""
         return False

@@ -28,7 +28,9 @@ _spread_counter = 0
 def _feasible(nodes: dict, request: ResourceSet, labels: dict | None = None) -> list[tuple[str, NodeResources]]:
     out = []
     for node_id, node in nodes.items():
-        if node.get("state") != "ALIVE":
+        if node.get("state") != "ALIVE" or node.get("draining"):
+            # Draining nodes (preemption notice) are capacity that is
+            # about to vanish — never schedule new work onto them.
             continue
         nr = NodeResources.from_dict(node["resources"])
         if labels and not all(nr.labels.get(k) == v for k, v in labels.items()):
@@ -92,7 +94,7 @@ def schedule_placement_group(
     alive = {
         nid: NodeResources.from_dict(n["resources"])
         for nid, n in nodes.items()
-        if n.get("state") == "ALIVE"
+        if n.get("state") == "ALIVE" and not n.get("draining")
     }
     if use_total:
         for nr in alive.values():

@@ -46,6 +46,10 @@ class _Replica:
         self.health_failures = 0
         self.draining_since = 0.0
         self.applied_user_config = None
+        # GCS-resolved placement, filled lazily by the probe phase: the
+        # preemption-eviction path needs replica -> node without an RPC
+        # to the (possibly dying) replica itself.
+        self.node_id = ""
 
 
 class _DeploymentState:
@@ -75,6 +79,11 @@ class _DeploymentState:
         # in the controller log, get_app_status(), and the error-info
         # channel so "failed to start" is never cause-less.
         self.last_start_failure: str | None = None
+        # Proactive preemption evictions (resilience): one row per replica
+        # removed because its NODE got a preemption notice — `reroute_s`
+        # (notice -> eviction+table push, chaos-clock) is the serve half
+        # of the recovery SLO bench.
+        self.preemption_evictions: list[dict] = []
 
     @property
     def name(self) -> str:
@@ -90,6 +99,10 @@ class ServeController:
         self._routes: dict[str, tuple[str, str]] = {}  # prefix -> (app, ingress dep)
         self._long_poll = LongPollHost()
         self._stopped = threading.Event()
+        # node_id -> PreemptionNotice for draining/preempted nodes
+        # (resilience/preemption.py), refreshed by the reconcile loop.
+        self._hazard_nodes: dict = {}
+        self._hazard_refreshed = 0.0
         self._reconcile_thread = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconcile"
         )
@@ -167,6 +180,7 @@ class ServeController:
                     "last_start_failure": state.last_start_failure,
                     "autoscaling_mode": auto.get("mode") if auto else None,
                     "autoscale_events": list(state.scale_events[-10:]),
+                    "preemption_evictions": list(state.preemption_evictions[-10:]),
                 }
             return out
 
@@ -221,7 +235,23 @@ class ServeController:
                 logger.exception("serve reconcile iteration failed")
             self._stopped.wait(0.25)
 
+    def _refresh_hazard_nodes(self) -> None:
+        """Poll the preemption signals (GCS node table ``draining`` flags
+        + ``node_preempted`` ErrorEvents) at most twice a second. This is
+        what makes replica eviction PROACTIVE: the router stops getting a
+        doomed replica at the NOTICE, not after per-request deaths or
+        three failed 10 s health probes."""
+        now = time.monotonic()
+        if now - self._hazard_refreshed < 0.5:
+            return
+        self._hazard_refreshed = now
+        from ..resilience.preemption import hazard_nodes
+
+        self._hazard_nodes = hazard_nodes(
+            lambda method, payload: ray.global_worker()._gcs_call(method, payload))
+
     def _reconcile_once(self) -> None:
+        self._refresh_hazard_nodes()
         with self._lock:
             apps = {a: dict(deps) for a, deps in self._apps.items()}
         dirty = False
@@ -266,6 +296,15 @@ class ServeController:
                     # "failed to start" log line must name the cause.
                     p["failure"] = f"{type(e).__name__}: {e}"
             elif r.state == RUNNING:
+                if not r.node_id:
+                    # Resolve placement from the GCS actor table (never
+                    # from the replica: a preempted node may not answer).
+                    try:
+                        info = ray.global_worker()._gcs_call(
+                            "GetActorInfo", {"actor_id": r.actor_id.hex()})
+                        r.node_id = info.get("node_id") or ""
+                    except Exception:
+                        pass
                 p["alive"] = self._replica_alive(r)
                 try:
                     p["queue"] = ray.get(r.actor.get_queue_len.remote(), timeout=5)
@@ -340,6 +379,35 @@ class ServeController:
                         state.replicas.remove(r)
                         to_kill.append(r)
                         dirty = True
+                elif r.state == RUNNING and r.node_id in self._hazard_nodes:
+                    # Proactive preemption eviction: the replica's NODE
+                    # got a preemption notice — stop routing to it NOW,
+                    # while it is still technically alive, instead of
+                    # waiting for per-request ActorDiedErrors after the
+                    # grace-window kill.
+                    notice = self._hazard_nodes[r.node_id]
+                    now_c = chaos_clock.now()
+                    event = {
+                        "replica_id": r.replica_id,
+                        "node_id": r.node_id,
+                        "reason": getattr(notice, "reason", ""),
+                        "notice_clock": getattr(notice, "notice_clock", now_c),
+                        "evicted_clock": now_c,
+                    }
+                    event["reroute_s"] = round(
+                        max(0.0, now_c - event["notice_clock"]), 4)
+                    state.preemption_evictions.append(event)
+                    del state.preemption_evictions[:-20]
+                    logger.warning(
+                        "replica %s evicted: node %s preempted (reroute "
+                        "%.2fs after the notice)", r.replica_id,
+                        r.node_id[:8], event["reroute_s"])
+                    # Drain, don't kill: routing stops immediately (the
+                    # table only carries RUNNING replicas) while requests
+                    # already on the replica finish inside the grace
+                    # window. The STOPPING cleanup reaps it.
+                    self._drain_replica(r)
+                    dirty = True
                 elif r.state == RUNNING and not p.get("alive", True):
                     logger.warning("replica %s died; removing", r.replica_id)
                     state.replicas.remove(r)

@@ -45,7 +45,15 @@ class Checkpoint:
 
 
 def save_pytree(tree, path: str, *, name: str = "state") -> None:
-    """Save a JAX pytree under ``path/name`` (orbax if present)."""
+    """Save a JAX pytree under ``path/name`` (orbax if present).
+
+    The pickle fallback writes ATOMICALLY: a kill mid-save used to leave
+    a truncated ``.pkl`` that unpickled a prefix of the tree without
+    complaint — a corrupt, loadable-looking checkpoint. Now the bytes go
+    to a same-directory temp file, are fsynced, and replace the target in
+    one ``os.replace`` — a reader sees the previous complete version or
+    none, never a partial one. (Orbax brings its own tmp+rename commit.)
+    """
     os.makedirs(path, exist_ok=True)
     target = os.path.join(path, name)
     try:
@@ -59,8 +67,18 @@ def save_pytree(tree, path: str, *, name: str = "state") -> None:
 
         import jax
 
-        with open(target + ".pkl", "wb") as f:
-            pickle.dump(jax.device_get(tree), f)
+        final = target + ".pkl"
+        tmp = final + f".tmp-{uuid.uuid4().hex[:8]}"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(jax.device_get(tree), f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, final)
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+            raise
 
 
 def load_pytree(path: str, *, name: str = "state", like=None):

@@ -62,6 +62,15 @@ class CheckpointConfig:
     num_to_keep: int | None = None
     checkpoint_score_attribute: str | None = None
     checkpoint_score_order: str = "max"
+    # Async checkpointing (ray_tpu/resilience/checkpoint.py): rank 0
+    # snapshots the ``state=`` pytree passed to ``train.report`` and
+    # commits it from a background thread every ``every_n_steps`` reports
+    # — the train step never blocks on I/O, commits are atomic (tmp dir +
+    # commit marker + rename, keep-K via num_to_keep), and each committed
+    # version registers with the GCS so recovery after node loss resolves
+    # the latest checkpoint without touching the dead node.
+    async_save: bool = False
+    every_n_steps: int = 1
 
 
 @dataclasses.dataclass
@@ -84,6 +93,10 @@ class Result:
     path: str | None
     error: Exception | None = None
     metrics_history: list[dict] = dataclasses.field(default_factory=list)
+    # One entry per group restart (resilience): chaos-clock stamps of the
+    # failure and of the first resumed report, plus the resume path — the
+    # recovery bench derives `recovery_train_resume_s` from these.
+    recovery_events: list[dict] = dataclasses.field(default_factory=list)
 
     @property
     def best_checkpoints(self) -> list:

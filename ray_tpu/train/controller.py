@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 import time
 
+from ..chaos import clock as chaos_clock
 from .checkpoint import Checkpoint, CheckpointManager
 from .config import Result, RunConfig, ScalingConfig
 from .worker_group import WorkerGroup
@@ -154,11 +155,19 @@ class TrainController:
         self._resume = resume_from_checkpoint
         self._poll_interval = poll_interval_s
         self._metrics_history: list[dict] = []
+        self._experiment_name: str = ""
+        # Recovery accounting (resilience subsystem): one entry per
+        # group restart, chaos-clock stamped at the failure and at the
+        # first report of the resumed attempt — the recovery bench and
+        # tests derive `recovery_train_resume_s` from these.
+        self.recovery_events: list[dict] = []
+        self._pending_recovery: dict | None = None
 
     def run(self) -> Result:
         import os
 
         name = self._run_config.name or f"train_{int(time.time())}"
+        self._experiment_name = name
         storage = self._run_config.storage_path or "/tmp/ray_tpu/results"
         run_dir = os.path.join(storage, name)
         os.makedirs(run_dir, exist_ok=True)
@@ -191,7 +200,14 @@ class TrainController:
             except WorkerGroupError as e:
                 last_error = e
                 if self._failure_policy.should_restart():
-                    resume = self._ckpt_manager.latest or self._resume
+                    resume = self._resolve_resume()
+                    self._pending_recovery = {
+                        "failed_clock": chaos_clock.now(),
+                        "attempt": self._failure_policy.failures,
+                        "resume_path": resume.path if resume else None,
+                        "resumed_clock": None,
+                    }
+                    self.recovery_events.append(self._pending_recovery)
                     logger.warning(
                         "Worker group failed (attempt %d); restarting whole "
                         "group from %s: %s",
@@ -207,6 +223,7 @@ class TrainController:
                     path=run_dir,
                     error=last_error,
                     metrics_history=self._metrics_history,
+                    recovery_events=self.recovery_events,
                 )
             finally:
                 if group is not None:
@@ -218,14 +235,39 @@ class TrainController:
             path=run_dir,
             error=None,
             metrics_history=self._metrics_history,
+            recovery_events=self.recovery_events,
         )
 
     # ------------------------------------------------------------------
+    def _resolve_resume(self) -> Checkpoint | None:
+        """The checkpoint the next attempt resumes from. With async_save,
+        the GCS-registered latest committed version wins — it is found
+        through the control plane, so a dead worker node cannot hide it;
+        the report()-registered manager is the sync-mode fallback."""
+        ckpt_cfg = self._run_config.checkpoint_config
+        if getattr(ckpt_cfg, "async_save", False) and self._experiment_name:
+            try:
+                from ..resilience import latest_registered
+
+                entry = latest_registered(self._experiment_name)
+            except Exception:
+                entry = None
+            if entry is not None:
+                return Checkpoint(entry["path"])
+        return self._ckpt_manager.latest or self._resume
+
     def _run_attempt(self, group: WorkerGroup, size: int) -> None:
-        resume = self._ckpt_manager.latest or self._resume
+        resume = self._resolve_resume()
         resume_path = resume.path if resume else None
+        ckpt_cfg = self._run_config.checkpoint_config
+        ckpt_meta = {
+            "async_save": getattr(ckpt_cfg, "async_save", False),
+            "every_n_steps": getattr(ckpt_cfg, "every_n_steps", 1),
+            "keep_k": ckpt_cfg.num_to_keep,
+        }
         try:
-            group.run_on_all("run_train_fn", self._train_fn, self._config, resume_path)
+            group.run_on_all("run_train_fn", self._train_fn, self._config,
+                             resume_path, ckpt_meta)
         except Exception as e:
             raise WorkerGroupError(f"failed to start train_fn: {e}") from e
 
@@ -250,6 +292,12 @@ class TrainController:
             for entry in p.get("reports", []):
                 if entry["rank"] == 0:
                     metrics = entry["metrics"]
+                    if self._pending_recovery is not None:
+                        # First report after a restart: the run has
+                        # resumed — this stamp closes the recovery window.
+                        self._pending_recovery["resumed_clock"] = chaos_clock.now()
+                        self._pending_recovery["resume_step"] = metrics.get("step")
+                        self._pending_recovery = None
                     self._metrics_history.append(metrics)
                     if "checkpoint_path" in entry:
                         self._ckpt_manager.register(

@@ -75,7 +75,8 @@ class TrainContext:
 
 class _Session:
     def __init__(self, context: TrainContext, resume_checkpoint: Checkpoint | None,
-                 dataset_shards: dict | None = None):
+                 dataset_shards: dict | None = None, async_ckpt=None,
+                 ckpt_every: int = 1):
         self.context = context
         self.resume_checkpoint = resume_checkpoint
         self.dataset_shards = dataset_shards or {}
@@ -83,6 +84,11 @@ class _Session:
         self._reports: list[dict] = []
         self._step = 0
         self._last_report_t: float | None = None
+        # Async checkpointing (resilience subsystem): rank 0 holds the
+        # manager; report(state=...) snapshots + background-commits every
+        # `ckpt_every` reports without blocking the train step.
+        self._async_ckpt = async_ckpt
+        self._ckpt_every = max(1, int(ckpt_every or 1))
 
     def _export_step_metrics(self, metrics: dict) -> None:
         """Per-step gauges (step_time_s / tokens_per_s / mfu) so training
@@ -105,9 +111,15 @@ class _Session:
         except Exception:
             pass
 
-    def report(self, metrics: dict, checkpoint: Checkpoint | None = None) -> None:
+    def report(self, metrics: dict, checkpoint: Checkpoint | None = None,
+               state=None) -> None:
         entry: dict[str, Any] = {"metrics": dict(metrics or {}), "rank": self.context.world_rank}
         self._export_step_metrics(entry["metrics"])
+        if state is not None and self._async_ckpt is not None:
+            if self._step % self._ckpt_every == 0:
+                block_ms = self._async_ckpt.save(
+                    self._step, state, metrics=entry["metrics"])
+                entry["ckpt_save_block_ms"] = round(block_ms, 3)
         if checkpoint is not None:
             # persist into run storage so it outlives the worker's tmpdir
             dest = os.path.join(
@@ -144,10 +156,18 @@ def _get_session() -> _Session:
     return _session
 
 
-def report(metrics: dict, checkpoint: Checkpoint | None = None) -> None:
+def report(metrics: dict, checkpoint: Checkpoint | None = None,
+           state=None) -> None:
     """Report metrics (+ optional checkpoint) from the train loop.
-    Reference: v2/api/train_fn_utils.py:13."""
-    _get_session().report(metrics, checkpoint)
+    Reference: v2/api/train_fn_utils.py:13.
+
+    With ``CheckpointConfig(async_save=True, every_n_steps=N)``, pass the
+    train-state pytree as ``state=`` — rank 0 snapshots it and commits a
+    checkpoint from a background thread every N reports (atomic commit +
+    GCS registration; the step never blocks on I/O). Put everything
+    recovery needs inside the tree: parameters, the step counter, the
+    data-iterator position."""
+    _get_session().report(metrics, checkpoint, state=state)
 
 
 def get_context() -> TrainContext:

@@ -56,4 +56,11 @@ class JaxTrainer(DataParallelTrainer):
     Each worker hosts one JAX process; ``init_distributed`` wires
     ``jax.distributed`` for multi-host slices. Model/optimizer sharding is
     the train_fn's business via ``ray_tpu.parallel``.
+
+    Spot-slice resilience: with ``CheckpointConfig(async_save=True,
+    every_n_steps=N)`` the train_fn passes its state pytree to
+    ``train.report(metrics, state=...)`` — rank 0 commits it atomically
+    from a background thread and registers each version with the GCS, so
+    a preempted slice restarts from the latest committed step
+    (``ray_tpu/resilience/``; recovery SLOs in ``cli bench recovery``).
     """

@@ -66,9 +66,29 @@ class TrainWorker:
         self._dataset_shards = shards
         return True
 
-    def run_train_fn(self, train_fn, config: dict, resume_path: str | None) -> bool:
+    def run_train_fn(self, train_fn, config: dict, resume_path: str | None,
+                     ckpt: dict | None = None) -> bool:
+        import os
+
         resume = Checkpoint(resume_path) if resume_path else None
-        self._session = _Session(self._context, resume, dataset_shards=self._dataset_shards)
+        ckpt = ckpt or {}
+        async_mgr = None
+        if ckpt.get("async_save") and self._context.world_rank == 0:
+            # Rank 0 owns the async checkpoint stream (SPMD state is
+            # replicated or reassembled by the train_fn; one writer keeps
+            # commits linear). Root lives in run storage so checkpoints
+            # outlive the worker — and the node.
+            from ..resilience import AsyncCheckpointManager
+
+            async_mgr = AsyncCheckpointManager(
+                os.path.join(self._context.storage_path, "async_ckpts"),
+                run_name=self._context.experiment_name,
+                keep_k=ckpt.get("keep_k") or 2,
+            )
+        self._session = _Session(
+            self._context, resume, dataset_shards=self._dataset_shards,
+            async_ckpt=async_mgr,
+            ckpt_every=int(ckpt.get("every_n_steps") or 1))
         self._error = None
         self._done = False
 
@@ -79,6 +99,13 @@ class TrainWorker:
             except BaseException:
                 self._error = traceback.format_exc()
             finally:
+                if async_mgr is not None:
+                    # A clean exit must not lose the tail checkpoint that
+                    # is still in the writer queue.
+                    try:
+                        async_mgr.close(timeout=30.0)
+                    except Exception:
+                        pass
                 self._done = True
                 _set_session(None)
 

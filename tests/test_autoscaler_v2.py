@@ -31,6 +31,7 @@ class FakeCloud:
         self.fail_create = False
         self.ignore_terminate = False
         self._n = 0
+        self.preempted = {}  # iid -> node_type (GCE spot-reclaim notices)
 
     def create_node(self, node_type, resources):
         if self.fail_create:
@@ -51,6 +52,18 @@ class FakeCloud:
 
     def node_id_of(self, iid):
         return None
+
+    def preempt(self, iid):
+        """The cloud reclaims a spot VM: it leaves the listing and a
+        preemption notice surfaces (GceTpuNodeProvider semantics)."""
+        self.visible.discard(iid)
+        self.preempted[iid] = "t"
+
+    def preemption_notices(self):
+        return dict(self.preempted)
+
+    def ack_preemption(self, iid):
+        self.preempted.pop(iid, None)
 
 
 def test_instance_lifecycle_happy_path():
@@ -135,6 +148,84 @@ def test_preexisting_gcs_nodes_never_claimed():
                    {"node_id": "w1", "state": "ALIVE"}])
     inst = mgr.instances()[0]
     assert inst.state == RAY_RUNNING and inst.node_id == "w1"
+
+
+def test_preempted_instance_detected_and_replaced():
+    """ISSUE 9 satellite: a RAY_RUNNING instance the cloud preempts is
+    terminated AND a same-shape replacement is requested in the SAME
+    reconcile round (GCE spot-reclaim semantics)."""
+    cloud = FakeCloud()
+    mgr = InstanceManager(cloud)
+    iid = mgr.create_node("t", {"CPU": 4, "TPU": 8})
+    mgr.reconcile([])  # -> ALLOCATED
+    mgr.reconcile([{"node_id": "n1", "state": "ALIVE"}])  # -> RAY_RUNNING
+    assert mgr.instances()[0].state == RAY_RUNNING
+
+    cloud.preempt(iid)
+    repairs = mgr.reconcile([{"node_id": "n1", "state": "ALIVE"}])
+    assert repairs["preempt_replaced"] == 1
+    by_state = {i.state: i for i in mgr.instances()}
+    # the preempted instance is on its way out...
+    assert by_state.get(TERMINATING) or by_state.get(TERMINATED)
+    # ...and the replacement was REQUESTED with the same shape
+    replacement = by_state[REQUESTED]
+    assert replacement.node_type == "t"
+    assert replacement.resources == {"CPU": 4, "TPU": 8}
+    assert len(cloud.created) == 2
+    # the notice was acked: a second round must not replace again
+    repairs = mgr.reconcile([{"node_id": "n1", "state": "ALIVE"}])
+    assert repairs["preempt_replaced"] == 0
+    assert len(cloud.created) == 2
+
+
+def test_preemption_replacement_disabled():
+    """replace_preempted=False: the preempted instance still terminates
+    (it left the listing) but no replacement is requested."""
+    cloud = FakeCloud()
+    mgr = InstanceManager(cloud, replace_preempted=False)
+    iid = mgr.create_node("t", {})
+    mgr.reconcile([])
+    mgr.reconcile([{"node_id": "n1", "state": "ALIVE"}])
+    cloud.preempt(iid)
+    repairs = mgr.reconcile([])
+    assert repairs["preempt_replaced"] == 0
+    assert len(cloud.created) == 1
+    assert mgr.instances()[0].state == TERMINATED  # listing-vanish path
+
+
+def test_gce_provider_surfaces_preemption_notices():
+    """The GCE provider turns a node LISTED as PREEMPTED into a typed
+    notice (and out of the live listing) until the reconciler acks it."""
+    from ray_tpu.autoscaler.gce import GceTpuNodeProvider
+
+    class FakeTransport:
+        def __init__(self):
+            self.nodes = []
+
+        def request(self, method, url, body=None):
+            if method == "GET":
+                return {"nodes": self.nodes}
+            return {}
+
+    transport = FakeTransport()
+    p = GceTpuNodeProvider(
+        "proj", "zone", gcs_address="host:1",
+        node_types={"v5e-16": {"accelerator_type": "v5litepod-16"}},
+        transport=transport)
+    iid = p.create_node("v5e-16", {})
+    transport.nodes = [{
+        "name": f"projects/proj/locations/zone/nodes/{iid}",
+        "state": "READY",
+        "labels": {"raytpu-cluster": "raytpu", "raytpu-node-type": "v5e-16"},
+    }]
+    assert iid in p.non_terminated_nodes()
+    assert p.preemption_notices() == {}
+
+    transport.nodes[0]["state"] = "PREEMPTED"
+    assert iid not in p.non_terminated_nodes()
+    assert p.preemption_notices() == {iid: "v5e-16"}
+    p.ack_preemption(iid)
+    assert p.preemption_notices() == {}
 
 
 def test_invalid_transition_rejected():

@@ -130,6 +130,44 @@ def test_skipped_matrix_cells_not_missing(tmp_path):
     assert bench_check.main([str(o), str(n)]) == 1   # vanished: fails
 
 
+def test_recovery_metrics_directions():
+    """ISSUE 9 satellite: recovery SLOs are lower-better — seconds via
+    the `_s` suffix, checkpoint lag via the new `_lag_steps` suffix, and
+    failed-request counts via the `failed` substring."""
+    assert bench_check._direction("recovery_train_resume_s") == "down"
+    assert bench_check._direction("recovery_serve_reroute_s") == "down"
+    assert bench_check._direction("recovery_ckpt_lag_steps") == "down"
+    assert bench_check._direction("recovery_serve_failed_requests") == "down"
+    old = {"recovery_train_resume_s": 2.0, "recovery_ckpt_lag_steps": 1.0}
+    worse = {"recovery_train_resume_s": 4.0, "recovery_ckpt_lag_steps": 3.0}
+    result = bench_check.compare(old, worse)
+    assert {r["metric"] for r in result["regressions"]} == set(old)
+    better = {"recovery_train_resume_s": 1.0, "recovery_ckpt_lag_steps": 0.0}
+    result = bench_check.compare(old, better)
+    # lag going to 0 is fine (0-new never regresses a lower-better)
+    assert not result["regressions"]
+
+
+def test_recovery_skip_markers_honored():
+    """A recovery scenario that cannot run records `<metric>_skipped`
+    markers — routed to the non-failing skipped bucket, exactly like the
+    serve matrix cells; an uncovered absence still fails."""
+    old = {"recovery_train_resume_s": 2.0, "recovery_serve_reroute_s": 0.8,
+           "recovery_ckpt_lag_steps": 1.0}
+    new = {"recovery_serve_reroute_s": 0.7,
+           "recovery_train_resume_s_skipped": True,
+           "recovery_ckpt_lag_steps_skipped": True}
+    result = bench_check.compare(old, new)
+    assert not result["missing"] and not result["regressions"]
+    assert {r["metric"] for r in result["skipped"]} == {
+        "recovery_train_resume_s", "recovery_ckpt_lag_steps"}
+    # marker gone -> the absence is a failure again
+    bare = {"recovery_serve_reroute_s": 0.7}
+    result = bench_check.compare(old, bare)
+    assert {r["metric"] for r in result["missing"]} == {
+        "recovery_train_resume_s", "recovery_ckpt_lag_steps"}
+
+
 def test_prefix_hit_rate_direction():
     # higher-better: more prompt pages served from the prefix cache
     assert bench_check._direction("serve_prefix_cache_hit_rate") == "up"
