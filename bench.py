@@ -482,15 +482,20 @@ def run_serve_bench() -> dict:
         serve.run(app, name="llm-bench", timeout_s=240.0)
     addr = serve.http_address()
 
-    def one_request(prompt: str, timeout: float = 600.0):
+    def one_request(prompt: str, timeout: float = 600.0,
+                    session: str = ""):
         """Returns (ttft_s, n_tokens, wall_s, itl_gaps_s): itl_gaps are
         the client-observed delays between consecutive SSE token events —
-        the inter-token latency the mixed-dispatch scheduler bounds."""
+        the inter-token latency the mixed-dispatch scheduler bounds.
+        ``session`` sets the x-raytpu-session header: the router pins the
+        request to its prefix group's affine replica."""
         body = json.dumps({"prompt": prompt, "max_tokens": max_tokens,
                            "stream": True}).encode()
+        headers = {"Content-Type": "application/json"}
+        if session:
+            headers["x-raytpu-session"] = session
         req = urllib.request.Request(
-            addr + "/v1/completions", data=body,
-            headers={"Content-Type": "application/json"})
+            addr + "/v1/completions", data=body, headers=headers)
         t0 = time.perf_counter()
         ttft = None
         last_tok = None
@@ -644,18 +649,64 @@ def run_serve_bench() -> dict:
                 if cell_gaps:
                     matrix[f"serve_{cell}_p95_itl_ms"] = round(
                         1000 * pct(cell_gaps, 0.95), 1)
-    # Engine prefix-cache effectiveness (ROADMAP item 5 first step): the
-    # replica's gauge, flushed with the same metrics push as the TTFT
-    # histogram polled above.
+    # ---- cached vs cold TTFT (ROADMAP item 5 acceptance): K distinct,
+    # never-seen ~1.6k-token system prompts measured COLD (the visit
+    # primes the COW prefix cache), then re-visited with fresh user
+    # tails — the cached TTFT scales with the cold SUFFIX only, and the
+    # session header keeps each pair on one replica (prefix affinity).
+    cached_cold: dict = {}
+    if os.environ.get("RAY_TPU_BENCH_SKIP_SERVE_CACHED") == "1":
+        cached_cold["serve_ttft_cached_skipped"] = True
+        cached_cold["serve_ttft_cold_skipped"] = True
+    else:
+        cold_ttfts: list[float] = []
+        cached_ttfts: list[float] = []
+        cc_errors: list[str] = []
+        cc_samples = int(os.environ.get("RAY_TPU_SERVE_CACHED_SAMPLES", "4"))
+        for i in range(cc_samples):
+            prefix = (f"[system prompt {i}] "
+                      + "You are a terse assistant. Answer carefully. " * 36)
+            try:
+                t_cold, _, _, _ = one_request(
+                    prefix + f"cold tail {i}: " + "wxyz" * 24,
+                    session=f"bench-cc-{i}")
+                t_cached, _, _, _ = one_request(
+                    prefix + f"cached tail {i}: " + "abcd" * 24,
+                    session=f"bench-cc-{i}")
+            except Exception as e:
+                cc_errors.append(f"{type(e).__name__}: {e}")
+                continue
+            if t_cold is not None:
+                cold_ttfts.append(t_cold)
+            if t_cached is not None:
+                cached_ttfts.append(t_cached)
+        if cold_ttfts and cached_ttfts:
+            cached_cold["serve_ttft_cold_ms"] = round(
+                1000 * statistics.median(cold_ttfts), 1)
+            cached_cold["serve_ttft_cached_ms"] = round(
+                1000 * statistics.median(cached_ttfts), 1)
+        else:
+            cached_cold["serve_ttft_cached_skipped"] = True
+            cached_cold["serve_ttft_cold_skipped"] = True
+            cached_cold["serve_ttft_cached_error"] = "; ".join(cc_errors[:3])
+    # Engine prefix-cache effectiveness (ROADMAP item 5): the replica's
+    # TRUE-reuse gauge plus the router's affinity hit rate, flushed with
+    # the same metrics push as the TTFT histogram polled above.
     prefix_hit_rate = None
+    affinity_hit_rate = None
     try:
         from ray_tpu.util.metrics import get_metrics
 
         time.sleep(6.0)  # one metrics-flusher period: cover the matrix phase
-        vals = [m["value"] for m in get_metrics()
+        rows = get_metrics()
+        vals = [m["value"] for m in rows
                 if m["name"] == "serve_prefix_cache_hit_rate"]
         if vals:
             prefix_hit_rate = round(max(vals), 4)
+        aff = [m["value"] for m in rows
+               if m["name"] == "serve_prefix_affinity_hit_rate"]
+        if aff:
+            affinity_hit_rate = round(max(aff), 4)
     except Exception as e:
         print(f"prefix cache gauge unavailable: {e}", file=sys.stderr)
     serve.shutdown()
@@ -676,6 +727,8 @@ def run_serve_bench() -> dict:
         "serve_decode_steps_per_dispatch": decode_k,
         "serve_preset": preset,
         "serve_prefix_cache_hit_rate": prefix_hit_rate,
+        "serve_prefix_affinity_hit_rate": affinity_hit_rate,
+        **cached_cold,
         **matrix,
     }
 

@@ -37,6 +37,10 @@ _SKIP_SUBSTR = ("error", "preset", "metric", "unit", "cmd", "tail", "_cfg")
 # suffixes — "core_tasks_per_s" ends in "_s" but a drop in it is the
 # regression, not an improvement.
 _HIGHER_BETTER_SUFFIX = ("_per_s", "_per_sec")
+# 0-1 ratios (cache hit rates, affinity rates, fractions): higher-better
+# AND compared in POINTS like _pct — a hit rate sliding 0.90 -> 0.45 is
+# a 45-point collapse; 0.02 -> 0.01 is noise, not a 50% regression.
+_POINTWISE_RATE_SUFFIX = ("_hit_rate", "_frac")
 # Lower is better. Peak-memory gauges count as regressions when they
 # GROW >threshold (a quiet 2x pool blowup is exactly what they exist
 # to catch). "_lag_steps": checkpoint lag (steps replayed after a
@@ -57,7 +61,8 @@ def load_metrics(path: str) -> dict:
 
 def _direction(name: str) -> str:
     """'up' = larger is better, 'down' = smaller is better."""
-    if name.endswith(_HIGHER_BETTER_SUFFIX):
+    if name.endswith(_HIGHER_BETTER_SUFFIX) or \
+            name.endswith(_POINTWISE_RATE_SUFFIX):
         return "up"
     if name.endswith(_LOWER_BETTER_SUFFIX) or any(
             s in name for s in _LOWER_BETTER_SUBSTR):
@@ -100,6 +105,18 @@ def compare(old: dict, new: dict, threshold: float = 0.10) -> dict:
             # was measured, now gone: exactly the silent failure mode
             # this guard exists for
             out["missing"].append({"metric": name, "old": ov, "new": None})
+            continue
+        if name.endswith(_POINTWISE_RATE_SUFFIX):
+            # 0-1 rates compare in POINTS, higher-better: the threshold
+            # is a point budget on the 0-1 scale (0.10 = 10 points).
+            better = round(nv - ov, 4)
+            row = {"metric": name, "old": ov, "new": nv, "change": better}
+            if better < -threshold:
+                out["regressions"].append(row)
+            elif better > threshold:
+                out["improvements"].append(row)
+            else:
+                out["ok"].append(row)
             continue
         if ov == 0:
             continue

@@ -240,6 +240,17 @@ class RayTpuConfig:
     serve_router_assign_timeout_s: float = 60.0
     serve_stream_item_timeout_s: float = 120.0
     serve_stream_backpressure_items: int = 256
+    # Prefix/session affinity routing: requests carrying a prefix-group
+    # key (explicit session id, or a hash of the prompt's leading
+    # serve_prefix_group_chars characters ≈ the first token blocks under
+    # the byte tokenizer) stick to the replica whose engine already holds
+    # their KV — unless that replica is serve_affinity_spill_margin
+    # in-flight requests hotter than the coolest candidate (load-aware
+    # spill: never queue-blow a hot replica just for affinity). The
+    # group→replica map is bounded LRU (serve_affinity_map_size).
+    serve_affinity_map_size: int = 2048
+    serve_affinity_spill_margin: int = 4
+    serve_prefix_group_chars: int = 256
 
     # --- data ----------------------------------------------------------------
     data_max_in_flight_tasks: int = 8

@@ -2596,24 +2596,19 @@ class Raylet:
             "orphan-lease reclaim: worker %s lease un-acked for %.1fs (grant "
             "reply lost?); releasing %s",
             w.worker_id[:12], age, w.lease_resources.to_dict())
-        # Leases starving in the queue behind this strand ARE the wedge —
-        # report it with the queue snapshot before freeing the resources.
-        if self._admission_queue and cfg.lease_wedge_threshold_s > 0:
-            head = self._admission_queue[0]
-            head_age = chaos_clock.now() - head.get("enqueued_at", 0.0)
-            if (head_age >= cfg.lease_wedge_threshold_s
-                    and not head.get("wedge_reported")):
-                head["wedge_reported"] = True
-                self._wedge_events_total += 1
-                spawn(self._publish_error_event(make_event(
-                    "lease_wedge",
-                    f"lease {head['request'].to_dict()} pending "
-                    f"{head_age:.1f}s on node {self.node_id.hex()[:8]} "
-                    f"blocked behind an orphaned lease grant (worker "
-                    f"{w.worker_id[:12]}, queue depth "
-                    f"{len(self._admission_queue)})",
-                    source="raylet", node_id=self.node_id.hex(),
-                    extra={"debug_state": self._debug_state_snapshot()})))
+        # Classification must be robust to stale queue state (a previous
+        # workload's un-acked strands aging out mid-scan, the cross-file
+        # watchdog flake): the "blocked behind an orphaned lease" wedge
+        # is claimed ONLY for a live head entry that could not fit the
+        # free pool before this reclaim but CAN after it — the orphan
+        # provably held its resources. A head that already fits is the
+        # canonical missed-wake wedge and belongs to the watchdog loop's
+        # own scan (whose report names the free resources); an
+        # unsatisfiable head is infeasible, not orphan-blocked.
+        head = next((e for e in self._admission_queue
+                     if not e["fut"].done()), None)
+        head_fits_before = (head is not None
+                            and self.resources.can_fit(head["request"]))
         spawn(self._publish_error_event(make_event(
             "lease_orphan",
             f"reclaimed un-acked lease on worker {w.worker_id[:12]} after "
@@ -2629,6 +2624,23 @@ class Raylet:
             w.orphan_probe = None
             w.last_idle_time = time.monotonic()
             self._idle.append(w.worker_id)
+        if head is not None and cfg.lease_wedge_threshold_s > 0:
+            head_age = chaos_clock.now() - head.get("enqueued_at", 0.0)
+            if (head_age >= cfg.lease_wedge_threshold_s
+                    and not head.get("wedge_reported")
+                    and not head_fits_before
+                    and self.resources.can_fit(head["request"])):
+                head["wedge_reported"] = True
+                self._wedge_events_total += 1
+                spawn(self._publish_error_event(make_event(
+                    "lease_wedge",
+                    f"lease {head['request'].to_dict()} pending "
+                    f"{head_age:.1f}s on node {self.node_id.hex()[:8]} "
+                    f"blocked behind an orphaned lease grant (worker "
+                    f"{w.worker_id[:12]}, queue depth "
+                    f"{len(self._admission_queue)})",
+                    source="raylet", node_id=self.node_id.hex(),
+                    extra={"debug_state": self._debug_state_snapshot()})))
         self._wake_lease_waiters()
 
 

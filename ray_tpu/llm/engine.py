@@ -2,11 +2,13 @@
 
 The scheduler half of what the reference delegates to vLLM
 (``AsyncLLMEngine`` in ``python/ray/llm/_internal/serve/deployments/llm/
-vllm/vllm_engine.py:250``): requests arrive at any time, page-aligned
-**chunked prefill** interleaves with batched decode (bounding TTFT impact
-on running streams), finished sequences free their pages immediately, and
+vllm/vllm_engine.py:250``): requests arrive at any time, **chunked
+prefill** interleaves with batched decode (bounding TTFT impact on
+running streams), finished sequences free their pages immediately, and
 hash-matched prompt prefixes reuse previously computed pages without
-recomputation (prefix caching / automatic prefix reuse).
+recomputation — full token blocks AND partial tail blocks, shared
+read-only with copy-on-write forking at the first conflicting write
+(vLLM/SGLang-style block-level prefix caching).
 
 TPU shape discipline: decode always runs the full ``[max_slots]`` batch
 (inactive slots write to private trash pages — branchless, one compiled
@@ -60,6 +62,13 @@ class Request:
     first_token_at: float | None = None
     first_token_wall: float | None = None
     cached_prefix_tokens: int = 0
+    # Prefix sharing state: the first `shared_pages` block-table entries
+    # are refcounted read-only cache pages; `cow_page` is the page
+    # reserved at admission to receive the COW fork of a shared partial
+    # tail block the suffix will write into (None once forked/unused).
+    shared_pages: int = 0
+    partial_len: int = 0
+    cow_page: int | None = None
     # Trace context ({"trace_id", "span_id"}) captured from the submitting
     # thread at add_request: the engine loop runs detached, so prefill/
     # decode spans parent onto this instead of any thread-local state.
@@ -67,10 +76,28 @@ class Request:
 
 
 class PageAllocator:
-    """Page pool bookkeeping: free list, per-page refcounts, and the
-    content-hash prefix cache (pages are immutable once full, so a page
-    whose chain-hash matches can be shared read-only between sequences —
-    the reference's automatic prefix caching)."""
+    """Page pool bookkeeping: free list, per-page refcounts, and a prefix
+    TRIE keyed on token-block chain hashes (pages are immutable once
+    cached, so a page whose chain matches can be shared read-only between
+    sequences — the reference's automatic prefix caching, block-level as
+    in vLLM/SGLang).
+
+    The trie has two kinds of entries:
+
+      * **full-block nodes** (``prefix_map``: chain hash -> page id) with
+        parent/children edges, matched block-by-block by
+        ``match_prefix``;
+      * **partial tail blocks** (``_partials``: the raw token tuple of a
+        sequence's last, partially-filled page, keyed under its parent
+        node) matched by longest-common-prefix on ``match_partial`` — the
+        reader maps the page read-only and COW-forks it (``fork``) before
+        its first write lands mid-page.
+
+    Eviction is LRU over refcount-0 cached pages only (shared-page pins
+    always survive pressure), preferring LEAF entries so interior chain
+    nodes outlive their extensions; evicting an interior node unlinks its
+    now-unreachable cached descendants back to the free list.
+    """
 
     def __init__(self, num_pages: int):
         self.num_pages = num_pages
@@ -81,10 +108,20 @@ class PageAllocator:
         self.prefix_map: dict[bytes, int] = {}
         self.page_hash: dict[int, bytes] = {}
         self.last_used: dict[int, float] = {}
+        # Trie edges over chain hashes (a chain hash IS a path identity,
+        # so nodes are keyed by it directly; parents may be virtual —
+        # the adapter-scoped root hash has no page).
+        self._children: dict[bytes, set[bytes]] = {}
+        self._parent: dict[bytes, bytes] = {}
+        # Partial tail blocks: parent chain hash -> {token tuple: page_id}
+        self._partials: dict[bytes, dict[tuple, int]] = {}
+        self._partial_pages: dict[int, tuple[bytes, tuple]] = {}
 
     def available(self) -> int:
         return len(self.free) + sum(
-            1 for h, p in self.prefix_map.items() if self.refcount.get(p, 0) == 0
+            1 for p in self.page_hash if self.refcount.get(p, 0) == 0
+        ) + sum(
+            1 for p in self._partial_pages if self.refcount.get(p, 0) == 0
         )
 
     def alloc(self, n: int) -> list[int] | None:
@@ -100,13 +137,76 @@ class PageAllocator:
             out.append(pid)
         return out
 
+    def fork(self, page_id: int) -> int | None:
+        """COW fork: allocate a fresh page to receive a copy of shared
+        ``page_id`` (the caller device-copies the rows and swaps its own
+        table entry). Exactly one page — the shared original keeps its
+        refcount and cache entries untouched for its other readers."""
+        got = self.alloc(1)
+        return got[0] if got is not None else None
+
+    def _unlink(self, page_id: int) -> None:
+        """Drop every cache entry for ``page_id`` (full-block node edges
+        or partial-tail entry). The page itself is NOT freed."""
+        h = self.page_hash.pop(page_id, None)
+        if h is not None:
+            self.prefix_map.pop(h, None)
+            parent = self._parent.pop(h, None)
+            if parent is not None and parent in self._children:
+                self._children[parent].discard(h)
+                if not self._children[parent]:
+                    del self._children[parent]
+        entry = self._partial_pages.pop(page_id, None)
+        if entry is not None:
+            parent, key = entry
+            sub = self._partials.get(parent)
+            if sub is not None:
+                sub.pop(key, None)
+                if not sub:
+                    del self._partials[parent]
+
     def _evict_one(self) -> int:
-        victim_hash, victim = min(
-            ((h, p) for h, p in self.prefix_map.items() if self.refcount.get(p, 0) == 0),
-            key=lambda hp: self.last_used.get(hp[1], 0.0),
-        )
-        self.prefix_map.pop(victim_hash, None)
-        self.page_hash.pop(victim, None)
+        """LRU victim among refcount-0 cached pages, leaf entries first.
+        Evicting an interior chain node also unlinks its (unreachable)
+        cached descendants back to the free list."""
+        best = None
+        for h, p in self.prefix_map.items():
+            if self.refcount.get(p, 0):
+                continue
+            leaf = 0 if (h not in self._children
+                         and h not in self._partials) else 1
+            key = (leaf, self.last_used.get(p, 0.0))
+            if best is None or key < best[0]:
+                best = (key, p, h)
+        for p in self._partial_pages:
+            if self.refcount.get(p, 0):
+                continue
+            key = (0, self.last_used.get(p, 0.0))
+            if best is None or key < best[0]:
+                best = (key, p, None)
+        _, victim, victim_hash = best
+        descendants = []
+        if victim_hash is not None and victim_hash in self._children:
+            stack = [victim_hash]
+            while stack:
+                h = stack.pop()
+                stack.extend(self._children.pop(h, ()))
+                for key, p in self._partials.pop(h, {}).items():
+                    descendants.append(p)
+                    self._partial_pages.pop(p, None)
+                if h != victim_hash:
+                    p = self.prefix_map.pop(h, None)
+                    self._parent.pop(h, None)
+                    if p is not None:
+                        self.page_hash.pop(p, None)
+                        descendants.append(p)
+        self._unlink(victim)
+        for p in descendants:
+            # Unreachable now; cached refcount-0 descendants go straight
+            # back to the pool, pinned ones free on their final release.
+            if not self.refcount.get(p, 0) and p != victim \
+                    and p not in self.free:
+                self.free.append(p)
         return victim
 
     def share(self, page_id: int) -> None:
@@ -118,19 +218,68 @@ class PageAllocator:
         self.refcount[page_id] = count
         if count <= 0:
             self.refcount.pop(page_id, None)
-            if page_id in self.page_hash:
+            if page_id in self.page_hash or page_id in self._partial_pages:
                 self.last_used[page_id] = time.monotonic()  # evictable, cached
             else:
                 self.free.append(page_id)
 
-    def register_prefix(self, page_id: int, chain_hash: bytes) -> None:
-        if chain_hash not in self.prefix_map:
-            self.prefix_map[chain_hash] = page_id
-            self.page_hash[page_id] = chain_hash
-            self.last_used[page_id] = time.monotonic()
+    def register_prefix(self, page_id: int, chain_hash: bytes,
+                        parent_hash: bytes = b"") -> None:
+        if chain_hash in self.prefix_map or page_id in self.page_hash \
+                or page_id in self._partial_pages:
+            return
+        self.prefix_map[chain_hash] = page_id
+        self.page_hash[page_id] = chain_hash
+        self.last_used[page_id] = time.monotonic()
+        self._parent[chain_hash] = parent_hash
+        self._children.setdefault(parent_hash, set()).add(chain_hash)
+
+    def register_partial(self, parent_hash: bytes, tokens: tuple,
+                         page_id: int) -> None:
+        """Cache a sequence's partially-filled tail page: ``tokens`` are
+        the page's valid rows, stored raw so match_partial can take a
+        shorter common prefix than the producer wrote."""
+        if not tokens or page_id in self.page_hash \
+                or page_id in self._partial_pages:
+            return
+        sub = self._partials.setdefault(parent_hash, {})
+        if tokens in sub:
+            return
+        sub[tokens] = page_id
+        self._partial_pages[page_id] = (parent_hash, tokens)
+        self.last_used[page_id] = time.monotonic()
 
     def lookup_prefix(self, chain_hash: bytes) -> int | None:
         return self.prefix_map.get(chain_hash)
+
+    def match_prefix(self, chain_hashes: list[bytes]) -> list[int]:
+        """Longest cached chain: one page per matched full block, in
+        order, stopping at the first miss."""
+        hits: list[int] = []
+        for h in chain_hashes:
+            pid = self.prefix_map.get(h)
+            if pid is None:
+                break
+            hits.append(pid)
+        return hits
+
+    def match_partial(self, parent_hash: bytes, tokens: tuple,
+                      cap: int) -> tuple[int, int] | None:
+        """Best partial tail-block under ``parent_hash``: the entry with
+        the longest common prefix against ``tokens``, capped at ``cap``
+        rows (the caller caps so at least one prompt token is always
+        computed). Returns ``(page_id, matched_len)`` or None."""
+        best = None
+        for entry, pid in self._partials.get(parent_hash, {}).items():
+            n = 0
+            for a, b in zip(entry, tokens):
+                if a != b:
+                    break
+                n += 1
+            n = min(n, cap)
+            if n > 0 and (best is None or n > best[1]):
+                best = (pid, n)
+        return best
 
 
 class InferenceEngine:
@@ -236,7 +385,17 @@ class InferenceEngine:
         self._block_tables = np.tile(
             np.arange(max_slots, dtype=np.int32)[:, None], (1, self.max_pages_per_seq)
         )
+        # Copy-on-write prefix sharing (partial tail blocks) needs the
+        # executor's page-copy op + row-granular prefill writes; full
+        # page-aligned block sharing works everywhere.
+        self._cow_enabled = (enable_prefix_cache and
+                             getattr(executor, "supports_prefix_cow", False))
         self.metrics = {"prefix_hit_pages": 0, "prefix_lookup_pages": 0,
+                        # True-reuse accounting: prompt tokens served from
+                        # shared pages (full blocks + partial tails) vs
+                        # prompt tokens admitted, and COW fork count.
+                        "prefix_cached_tokens": 0, "prompt_tokens": 0,
+                        "cow_forks": 0,
                         "prefill_chunks": 0,
                         "decode_steps": 0, "decode_dispatches": 0,
                         # Per-step schedule mix: how many engine steps ran
@@ -338,18 +497,46 @@ class InferenceEngine:
                 # Register only pages whose K/V was actually COMPUTED: a
                 # cancel mid-prefill leaves later prompt pages holding
                 # garbage — caching them would poison future prefix hits.
-                full_prompt_pages = min(len(r.prompt), r.prefill_pos) // self.page_size
+                # The chain now covers the FULL sequence (prompt +
+                # generated tokens — their K/V is a pure function of the
+                # token ids, which the chain hash captures), so multi-turn
+                # follow-ups whose prompt embeds the previous answer hit
+                # too. The last generated token's K/V is never written
+                # (it was emitted, not fed back), hence the -1.
+                ps = self.page_size
+                seq = list(r.prompt) + list(r.generated)
+                if r.prefill_pos < len(r.prompt):
+                    valid = r.prefill_pos  # cancelled mid-prefill
+                else:
+                    valid = len(r.prompt) + max(0, len(r.generated) - 1)
+                valid = min(valid, len(r.block_table) * ps)
+                full_pages = valid // ps
                 h = hashlib.sha1()
                 # Adapter-specific K/V must never be shared across models
                 h.update((r.model or "").encode())
-                for i in range(full_prompt_pages):
+                parent = h.digest()
+                for i in range(full_pages):
                     h.update(bytes(np.asarray(
-                        r.prompt[i * self.page_size:(i + 1) * self.page_size],
-                        np.int32).tobytes()))
-                    self.allocator.register_prefix(r.block_table[i], h.digest())
+                        seq[i * ps:(i + 1) * ps], np.int32).tobytes()))
+                    self.allocator.register_prefix(
+                        r.block_table[i], h.digest(), parent)
+                    parent = h.digest()
+                if self._cow_enabled and full_pages < len(r.block_table):
+                    # Partial tail block: cache the raw token run so a
+                    # follow-up can map the page read-only and COW-fork
+                    # it at its first mid-page write.
+                    tail = tuple(int(t) for t in seq[full_pages * ps:valid])
+                    if tail:
+                        self.allocator.register_partial(
+                            parent, tail, r.block_table[full_pages])
             for pid in r.block_table:
                 self.allocator.release(pid)
             r.block_table = []
+        if r.cow_page is not None:
+            # Reserved fork page never used (cancel before the first
+            # suffix write): back to the pool.
+            self.allocator.release(r.cow_page)
+            r.cow_page = None
         r.slot = -1
 
     # ------------------------------------------------------------------ step
@@ -364,9 +551,20 @@ class InferenceEngine:
     @property
     def prefix_cache_hit_rate(self) -> float:
         """Fraction of cacheable prompt pages served from the prefix
-        cache (hit pages / looked-up pages since engine start)."""
+        cache (hit pages / looked-up pages since engine start). A TRUE
+        reuse rate: every hit page is mapped into the slot's table and
+        its tokens are skipped by the suffix prefill."""
         lookups = self.metrics.get("prefix_lookup_pages", 0)
         return self.metrics["prefix_hit_pages"] / lookups if lookups else 0.0
+
+    @property
+    def prefill_suffix_frac(self) -> float:
+        """Fraction of admitted prompt tokens actually prefilled (the
+        cold suffix); 1.0 = no prefix reuse. TTFT scales with this."""
+        total = self.metrics.get("prompt_tokens", 0)
+        if not total:
+            return 1.0
+        return 1.0 - self.metrics["prefix_cached_tokens"] / total
 
     def step(self) -> list[dict]:
         """Advance the engine one tick: admit waiting requests while slots
@@ -427,6 +625,7 @@ class InferenceEngine:
         return []
 
     def _admit(self) -> None:
+        admitted: list[Request] = []
         with self._lock:
             while self._waiting and self._free_slots:
                 r = self._waiting[0]
@@ -438,8 +637,13 @@ class InferenceEngine:
                     self.max_pages_per_seq,
                 )
                 hits: list[int] = []
+                partial: tuple[int, int] | None = None
                 if self.enable_prefix_cache:
-                    hits = self._prefix_hits(r)
+                    hits, partial = self._prefix_hits(r)
+                # A partial hit does not shrink the reservation: the
+                # fresh allocation keeps one spare page as the reserved
+                # COW fork target, so the write-triggered fork can never
+                # fail under pressure mid-stream.
                 if self.allocator.available() < n_pages - len(hits):
                     break  # head-of-line: wait for pages to free
                 self._waiting.popleft()
@@ -449,16 +653,31 @@ class InferenceEngine:
                 # page at two block-table positions (silent KV corruption).
                 for pid in hits:
                     self.allocator.share(pid)
+                if partial is not None:
+                    self.allocator.share(partial[0])
                 fresh = self.allocator.alloc(n_pages - len(hits))
                 if fresh is None:  # race-free under lock, but be safe
                     for pid in hits:
                         self.allocator.release(pid)
+                    if partial is not None:
+                        self.allocator.release(partial[0])
                     r.done, r.finish_reason = True, "admission_failed"
                     continue
-                r.block_table = hits + fresh
-                r.prefill_pos = len(hits) * self.page_size
+                if partial is not None:
+                    # Shared partial tail block maps read-only at the
+                    # suffix position; fresh[0] is the reserved fork.
+                    r.cow_page = fresh[0]
+                    r.partial_len = partial[1]
+                    r.block_table = hits + [partial[0]] + fresh[1:]
+                else:
+                    r.block_table = hits + fresh
+                r.shared_pages = len(hits) + (1 if partial is not None else 0)
+                r.prefill_pos = len(hits) * self.page_size + (
+                    partial[1] if partial is not None else 0)
                 r.cached_prefix_tokens = r.prefill_pos
                 self.metrics["prefix_hit_pages"] += len(hits)
+                self.metrics["prefix_cached_tokens"] += r.prefill_pos
+                self.metrics["prompt_tokens"] += len(r.prompt)
                 if r.model and self.lora_manager is not None:
                     try:
                         # May read the adapter from storage + write the
@@ -467,40 +686,83 @@ class InferenceEngine:
                         # after).
                         r.lora_slot = self.lora_manager.acquire(r.model)
                     except Exception as e:
-                        for pid in r.block_table:
-                            self.allocator.release(pid)
-                        r.block_table = []
+                        self._release_admission_locked(r)
                         r.done, r.finish_reason = True, "admission_failed"
                         logger.warning("adapter %r load failed: %s", r.model, e)
                         continue
                 elif r.model and self.lora_manager is None:
-                    for pid in hits + fresh:
-                        self.allocator.release(pid)
+                    self._release_admission_locked(r)
                     r.done, r.finish_reason = True, "admission_failed"
                     continue
                 r.slot = self._free_slots.pop()
                 self._lora_idx[r.slot] = r.lora_slot
                 self._block_tables[r.slot, :len(r.block_table)] = r.block_table
                 self._prefilling.append(r)
+                admitted.append(r)
+        for r in admitted:
+            self._record_prefix_match_span(r)
 
-    def _prefix_hits(self, r: Request) -> list[int]:
-        """Longest run of cached pages covering the prompt, capped so at
-        least one prompt token is always computed (its hidden state seeds
-        sampling — the reference caps identically)."""
-        max_hit_pages = (len(r.prompt) - 1) // self.page_size
+    def _release_admission_locked(self, r: Request) -> None:
+        """Undo a half-admitted request's page state (shared refs, fresh
+        pages, the reserved COW fork)."""
+        for pid in r.block_table:
+            self.allocator.release(pid)
+        r.block_table = []
+        if r.cow_page is not None:
+            self.allocator.release(r.cow_page)
+            r.cow_page = None
+        r.shared_pages = 0
+
+    def _record_prefix_match_span(self, r: Request) -> None:
+        """One span per admission: how much of the prompt the prefix
+        trie served (full-block hits + partial tail rows) — the
+        per-request view behind ``prefix_cache_hit_rate``."""
+        if not r.trace:
+            return
+        from ..observability import tracing
+
+        now = time.time()
+        tracing.record_span(tracing.make_span(
+            "llm.prefix_match", "llm", r.arrived_wall, now,
+            r.trace.get("trace_id", ""), r.trace.get("span_id", ""),
+            attrs={"request_id": r.request_id,
+                   "prompt_tokens": len(r.prompt),
+                   "cached_tokens": r.cached_prefix_tokens,
+                   "hit_pages": r.shared_pages,
+                   "partial_tokens": r.partial_len}))
+
+    def _prefix_hits(self, r: Request) -> tuple[list[int],
+                                                tuple[int, int] | None]:
+        """Longest cached chain covering the prompt: full token-block
+        pages from the trie, plus (with COW support) the best partial
+        tail-block match at the boundary — capped so at least one prompt
+        token is always computed (its hidden state seeds sampling — the
+        reference caps identically). Returns ``(full_hit_pages,
+        (partial_page, matched_rows) | None)``."""
+        ps = self.page_size
+        max_hit_pages = (len(r.prompt) - 1) // ps
         self.metrics["prefix_lookup_pages"] += max_hit_pages
-        hits: list[int] = []
         h = hashlib.sha1()
         h.update((r.model or "").encode())  # adapter-scoped prefix space
+        parent = h.digest()
+        hashes: list[bytes] = []
         for i in range(max_hit_pages):
             h.update(bytes(np.asarray(
-                r.prompt[i * self.page_size:(i + 1) * self.page_size],
-                np.int32).tobytes()))
-            pid = self.allocator.lookup_prefix(h.digest())
-            if pid is None:
-                break
-            hits.append(pid)
-        return hits
+                r.prompt[i * ps:(i + 1) * ps], np.int32).tobytes()))
+            hashes.append(h.digest())
+        hits = self.allocator.match_prefix(hashes)
+        partial = None
+        if self._cow_enabled:
+            if hits:
+                parent = hashes[len(hits) - 1]
+            remainder = r.prompt[len(hits) * ps:]
+            # ≥1 computed token AND the matched rows must stay a strict
+            # sub-page (a full page would be a full-block hit).
+            cap = min(len(remainder) - 1, ps - 1)
+            if cap > 0:
+                partial = self.allocator.match_partial(
+                    parent, tuple(int(t) for t in remainder), cap)
+        return hits, partial
 
     def _chunk_bucket(self, n: int) -> int:
         b = self.page_size
@@ -508,7 +770,39 @@ class InferenceEngine:
             b *= 2
         return min(b, self.prefill_chunk_size)
 
+    def _maybe_cow(self, r: Request) -> None:
+        """Write-triggered copy-on-write: the next suffix chunk starts at
+        ``prefill_pos``; when that position's page is still a SHARED
+        partial tail block, fork it now — device-copy the one page into
+        the fork reserved at admission, swap the slot's table entry, and
+        drop our ref on the shared original (which stays immutable for
+        its other readers). Never copies the pool, only the page."""
+        if r.cow_page is None:
+            return
+        with self._lock:
+            if r.done or not r.block_table:
+                return
+            idx = r.prefill_pos // self.page_size
+            if idx >= r.shared_pages:
+                # Pure full-block sharing after all (defensive): the
+                # reserve is never written — return it to the pool.
+                self.allocator.release(r.cow_page)
+                r.cow_page = None
+                return
+            old, new = r.block_table[idx], r.cow_page
+            # Copy before the swap is visible anywhere: the executor op
+            # rides the ordered dispatch stream, so every shard forks the
+            # rows before the chunk that writes past them.
+            self.executor.copy_pages([old], [new])
+            r.block_table[idx] = new
+            self._block_tables[r.slot, idx] = new
+            self.allocator.release(old)
+            r.shared_pages = idx
+            r.cow_page = None
+            self.metrics["cow_forks"] += 1
+
     def _prefill_chunk_one(self, r: Request) -> list[dict]:
+        self._maybe_cow(r)
         remaining = len(r.prompt) - r.prefill_pos
         bt = np.full(self.max_pages_per_seq, r.slot, np.int32)  # trash-pad
         bt[:len(r.block_table)] = r.block_table
@@ -692,6 +986,7 @@ class InferenceEngine:
                 break
             if r.lora_slot:
                 continue  # adapter prefill stays on the legacy path
+            self._maybe_cow(r)  # fork a shared tail before writing it
             remaining = len(r.prompt) - r.prefill_pos
             chunk = self._chunk_bucket(remaining)
             if chunk > budget:

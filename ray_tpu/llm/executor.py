@@ -31,8 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.llama import LlamaConfig, PRESETS, init_params
-from .model import (decode_loop, init_pages, mixed_dispatch, prefill_chunk,
-                    sample_first_batch)
+from .model import (copy_pages, decode_loop, init_pages, mixed_dispatch,
+                    prefill_chunk, sample_first_batch)
 
 # Backends with a real Mosaic compiler: the Pallas paged-attention kernel
 # runs native. "axon" is the remote-dispatch tunnel to the same chip.
@@ -224,6 +224,9 @@ class LocalEngineExecutor:
             self._sample_first = jax.jit(
                 sample_first_batch.__wrapped__,
                 out_shardings=(self._replicated, self._replicated))
+            # pp prefill requires page-aligned chunk starts (stage-local
+            # whole-page writes), so partial-block COW sharing stays off.
+            self._copy_pages = None
         elif self._replicated is not None:
             # Re-jit the model programs with EXPLICIT output shardings:
             # token/key/hidden outputs pinned replicated — on a
@@ -256,11 +259,15 @@ class LocalEngineExecutor:
                 donate_argnames=("pages",),
                 out_shardings=(rep, rep, pg, rep),
             )
+            self._copy_pages = jax.jit(
+                copy_pages.__wrapped__, donate_argnames=("pages",),
+                out_shardings=pg)
         else:
             self._decode_loop = decode_loop
             self._sample_first = sample_first_batch
             self._prefill = prefill_chunk
             self._mixed = mixed_dispatch
+            self._copy_pages = copy_pages
 
     def _put(self, x: np.ndarray):
         """Host input -> device, replicated over the mesh when present (a
@@ -412,6 +419,23 @@ class LocalEngineExecutor:
             n_steps=n_steps, **kwargs,
         )
         return np.asarray(toks)  # [n_steps, slots] — the one sync
+
+    @property
+    def supports_prefix_cow(self) -> bool:
+        """Copy-on-write prefix sharing: needs ``copy_pages`` plus the
+        row-granular prefill scatter (mid-page suffix starts) — both
+        available off the pp path (pp prefill writes whole pages per
+        stage, so partial-block sharing would clobber fork rows)."""
+        return self._copy_pages is not None
+
+    def copy_pages(self, src, dst) -> None:
+        """Fork shared pages: device-copies pages ``src`` onto ``dst``
+        (all layers, one dispatch). Ordered with the prefill/decode
+        stream — the engine calls it immediately before the first chunk
+        that writes into the fork."""
+        self.pages = self._copy_pages(
+            self.pages, self._put(np.asarray(src, np.int32)),
+            self._put(np.asarray(dst, np.int32)))
 
     @property
     def supports_mixed_dispatch(self) -> bool:

@@ -19,10 +19,12 @@ Design points:
     plus itself (causal), then scatters its K/V into the pool. Bounded
     chunk size keeps decode TTFT for other requests bounded — the
     reference's chunked-prefill scheduling.
-  * **Prefix reuse**: because chunk starts are page-aligned, a prompt
-    whose leading pages hash-match previously computed pages skips them
-    entirely — the block table points at the shared pages (engine-side
-    refcounting; pages are immutable once full).
+  * **Prefix reuse**: a prompt whose leading blocks hash-match cached
+    pages skips them entirely — the block table points at the shared
+    pages read-only (engine-side trie + refcounting), and a partial
+    tail-block match starts the suffix MID-page: the engine COW-forks
+    the shared page first (``copy_pages``) and the chunk's row-granular
+    ``(page, offset)`` scatter writes past the copied rows.
   * **Decode** (``decode_step``): one batched step over all slots;
     context K/V is read per-slot via the block tables. Inactive slots
     point at a per-slot trash page so their (ignored) writes never
@@ -102,22 +104,28 @@ def _gather_ctx(pool, l, tables):
 def prefill_chunk(params, pages: dict, block_table, tokens, start_pos,
                   config: LlamaConfig, page_size: int,
                   live_pages: int | None = None, lora=None, lora_slot=None):
-    """Process one page-aligned prompt chunk.
+    """Process one prompt chunk.
 
-    tokens:      [C] int32, C a multiple of ``page_size`` (static bucket).
+    tokens:      [C] int32 (static bucket size).
     block_table: [max_pages_per_seq] int32 — this sequence's pages.
-    start_pos:   scalar int32, multiple of ``page_size``.
-    live_pages:  static host-computed bound ≥ ``start_pos // page_size``
+    start_pos:   scalar int32. NOT required to be page-aligned: a
+                 prefix-cache partial-block hit starts the suffix
+                 mid-page (the engine COW-forks the shared page first),
+                 so K/V lands via a row-granular (page, offset) scatter —
+                 identical destinations to the old page-granular write
+                 when the start IS aligned.
+    live_pages:  static host-computed bound ≥ ``ceil(start_pos / page)``
                  — caps the context-gather width so chunk cost scales
                  with written context, not pool capacity.
 
     Attends over previously-written context ``[0, start_pos)`` (gathered
-    via the block table) plus the chunk itself (causal), writes the
-    chunk's K/V into its pages, and returns (pages, hidden [C, E]).
+    via the block table; partial-page context rows are masked by
+    position, so a mid-page start reads exactly the valid prefix rows)
+    plus the chunk itself (causal), writes the chunk's K/V into its
+    pages, and returns (pages, hidden [C, E]).
     """
     c = config
     C = tokens.shape[0]
-    n_chunk_pages = C // page_size
     positions = start_pos + jnp.arange(C, dtype=jnp.int32)
     gather_table = block_table
     if live_pages is not None and live_pages < block_table.shape[0]:
@@ -127,9 +135,14 @@ def prefill_chunk(params, pages: dict, block_table, tokens, start_pos,
     ctx_live = ctx_pos < start_pos                      # [ctx]
     causal = jnp.arange(C)[:, None] >= jnp.arange(C)[None, :]
     kh, g = c.n_kv_heads, c.n_heads // c.n_kv_heads
-    # Pages this chunk writes: block_table[start_pos//page : +n_chunk_pages].
-    first = start_pos // page_size
-    write_ids = lax.dynamic_slice(block_table, (first,), (n_chunk_pages,))
+    # Row-granular write destinations: position p -> (its page, offset).
+    # The clamp keeps pad rows past the table in range; they land at
+    # future offsets of the last page, are masked (position > pos) until
+    # decode overwrites them, and the engine clamps chunks so real
+    # positions never exceed the table.
+    write_pages = block_table[jnp.minimum(positions // page_size,
+                                          block_table.shape[0] - 1)]  # [C]
+    write_offs = positions % page_size                                # [C]
 
     x0 = params["embed"][tokens][None].astype(c.dtype)   # [1, C, E]
 
@@ -176,14 +189,15 @@ def prefill_chunk(params, pages: dict, block_table, tokens, start_pos,
             out = out + lora_delta_single(
                 flat, lora["wo.A"], lora["wo.B"], l, lora_slot).astype(out.dtype)
         x2 = _mlp(x + out, layer, c)
-        # Scatter the chunk's K/V into its pages: [KH, C, D] ->
-        # [n_pages, KH, page, D] at distinct page ids (no conflicts).
-        k_pages = jnp.swapaxes(
-            k[0].reshape(kh, n_chunk_pages, page_size, c.head_dim), 0, 1)
-        v_pages = jnp.swapaxes(
-            v[0].reshape(kh, n_chunk_pages, page_size, c.head_dim), 0, 1)
-        kf = kf.at[l, write_ids].set(k_pages)
-        vf = vf.at[l, write_ids].set(v_pages)
+        # Row-granular scatter of the chunk's K/V: row j -> (page of
+        # position start+j, its offset). Distinct in-range positions give
+        # distinct (page, offset) pairs — no conflicts — and unlike the
+        # old whole-page write this supports a mid-page chunk start
+        # without clobbering a COW fork's copied prefix rows.
+        kf = kf.at[l, write_pages, :, write_offs, :].set(
+            jnp.swapaxes(k[0], 0, 1))
+        vf = vf.at[l, write_pages, :, write_offs, :].set(
+            jnp.swapaxes(v[0], 0, 1))
         return (x2, kf, vf), None
 
     (x, new_k, new_v), _ = lax.scan(
@@ -385,6 +399,21 @@ def commit_staging(pages: dict, stage, write_idx_steps, pos0, n_steps: int,
     new_v = pages["v"].at[:, widx, :, off, :].set(
         rows(v_stage).astype(pages["v"].dtype))
     return {"k": new_k, "v": new_v}
+
+
+@functools.partial(jax.jit, donate_argnames=("pages",))
+def copy_pages(pages: dict, src, dst):
+    """Copy-on-write fork: duplicate pages ``src`` into pages ``dst``
+    across every layer (one gather + one scatter on the donated pool —
+    page-granular, never pool-sized). The engine calls this when a slot
+    is about to WRITE into a shared prefix page: the fork gets the
+    shared page's rows, the slot's table swaps to the fork, and the
+    shared original stays immutable for its other readers.
+
+    src/dst: [m] int32 page ids (m is tiny — usually 1).
+    """
+    return {"k": pages["k"].at[:, dst].set(pages["k"][:, src]),
+            "v": pages["v"].at[:, dst].set(pages["v"][:, src])}
 
 
 @functools.wraps(_decode_logits)

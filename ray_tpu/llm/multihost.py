@@ -110,6 +110,14 @@ class EngineShardWorker:
         return bool(self.executor is not None
                     and self.executor.supports_mixed_dispatch)
 
+    def supports_cow(self) -> bool:
+        return bool(self.executor is not None
+                    and self.executor.supports_prefix_cow)
+
+    def copy_pages(self, src, dst) -> bool:
+        self.executor.copy_pages(src, dst)
+        return True
+
     def mixed(self, prefill_plans, block_tables, tokens, pos, temps, eos_ids,
               remaining, n_steps, lora_idx=None):
         return self.executor.mixed(
@@ -149,8 +157,10 @@ class ShardedEngineExecutor:
         self._loop_pending = 0    # loop results put but not yet consumed
         self.use_compiled_loop = use_compiled_loop
         # Set after build() by create_sharded_executor: whether every
-        # shard's local executor takes the fused mixed entry point.
+        # shard's local executor takes the fused mixed entry point /
+        # the COW prefix-sharing ops.
         self.supports_mixed_dispatch = False
+        self.supports_prefix_cow = False
 
     # ---------------------------------------------------- compiled loop
     def _ensure_loop(self):
@@ -221,6 +231,12 @@ class ShardedEngineExecutor:
 
     def drop_handle(self, handle) -> None:
         self._dispatch("drop_handle", handle)
+
+    def copy_pages(self, src, dst) -> None:
+        """COW fork fan-out: rides the ordered dispatch stream, so every
+        shard copies the page before the chunk that writes into it."""
+        self._dispatch("copy_pages",
+                       [int(s) for s in src], [int(d) for d in dst])
 
     def install_adapter(self, slot, arrays) -> None:
         """LoRA fan-out: the adapter's padded A/B arrays land on every
@@ -341,6 +357,8 @@ def create_sharded_executor(
         ], timeout=600)
         executor.supports_mixed_dispatch = bool(ray.get(
             shards[0].supports_mixed.remote(), timeout=60))
+        executor.supports_prefix_cow = bool(ray.get(
+            shards[0].supports_cow.remote(), timeout=60))
         if use_compiled_loop:
             # Install the resident tick executors NOW (one submit per
             # shard — the last tasks this executor ever submits).

@@ -17,6 +17,7 @@ import queue
 import threading
 import time
 import uuid
+from collections import OrderedDict
 
 from .engine import InferenceEngine, Request
 from .tokenizer import ByteTokenizer
@@ -170,6 +171,13 @@ class LLMDeployment:
                 f"with vocab_size >= {self.tokenizer.vocab_size}"
             )
         self.request_timeout_s = request_timeout_s
+        # Prefix-group residency: which affinity groups this replica's
+        # engine holds KV for, and how often their requests actually hit
+        # the prefix cache — reported to the controller through the
+        # replica's latency_snapshot probe (serve_prefix_residency row).
+        self._residency_lock = threading.Lock()
+        self._resident_groups: "OrderedDict[str, int]" = OrderedDict()
+        self._residency = {"requests": 0, "cache_hits": 0}
         # Completion waiters (blocking path) and per-request token queues
         # (streaming path), both fed by the engine loop.
         self._events: dict[str, threading.Event] = {}
@@ -220,9 +228,41 @@ class LLMDeployment:
             return None
         return model
 
+    def _note_residency(self, group: str, req: Request) -> None:
+        """Record that this replica now holds (or refreshed) KV for the
+        request's prefix group, and whether the request actually hit the
+        engine's prefix cache (the replica-local affinity outcome)."""
+        if not group:
+            return
+        with self._residency_lock:
+            self._resident_groups[group] = \
+                self._resident_groups.get(group, 0) + 1
+            self._resident_groups.move_to_end(group)
+            while len(self._resident_groups) > 512:
+                self._resident_groups.popitem(last=False)
+            self._residency["requests"] += 1
+            if req.cached_prefix_tokens > 0:
+                self._residency["cache_hits"] += 1
+
+    def prefix_residency(self) -> dict:
+        """Per-replica prefix-group residency (picked up by the replica
+        actor's ``latency_snapshot`` probe → controller app status)."""
+        with self._residency_lock:
+            return {"groups": len(self._resident_groups),
+                    "requests": self._residency["requests"],
+                    "cache_hits": self._residency["cache_hits"]}
+
+    @staticmethod
+    def _group_of(prompt: str, session_id: str | None) -> str:
+        from ..serve.router import prefix_group_key
+
+        return prefix_group_key(session_id=str(session_id or ""),
+                                text=prompt)
+
     # ------------------------------------------------------ blocking path
     def generate(self, prompt: str, max_new_tokens: int = 16,
-                 temperature: float = 0.0, model: str | None = None) -> dict:
+                 temperature: float = 0.0, model: str | None = None,
+                 session_id: str | None = None) -> dict:
         """Blocking completion; many calls run concurrently on replica
         threads and share the engine's decode batch. ``model`` other than
         the base model id selects a LoRA adapter."""
@@ -245,6 +285,7 @@ class LLMDeployment:
         else:
             finish = req.finish_reason
         _observe_ttft(req, _deployment_tag(self.model_id), self.engine)
+        self._note_residency(self._group_of(prompt, session_id), req)
         return {
             "request_id": rid,
             "text": self.tokenizer.decode(req.generated),
@@ -254,7 +295,7 @@ class LLMDeployment:
         }
 
     # ----------------------------------------------------- streaming path
-    def _stream_tokens(self, req: Request):
+    def _stream_tokens(self, req: Request, group: str = ""):
         """Yield engine events for one request as they are produced; on
         GeneratorExit (consumer gone) cancel the request so its pages and
         slot free immediately."""
@@ -280,6 +321,7 @@ class LLMDeployment:
                     first = False
                     _observe_ttft(req, _deployment_tag(self.model_id),
                                   self.engine)
+                    self._note_residency(group, req)
                 yield event
                 if event["done"]:
                     return
@@ -301,7 +343,8 @@ class LLMDeployment:
         created = int(time.time())
         if not body.get("stream"):
             out = self.generate(prompt, max_tokens, temperature,
-                                model=body.get("model"))
+                                model=body.get("model"),
+                                session_id=body.get("session_id"))
             return {
                 "id": cid, "object": "text_completion", "created": created,
                 "model": body.get("model", self.model_id),
@@ -328,7 +371,8 @@ class LLMDeployment:
             out = self.generate(
                 prompt, int(body.get("max_tokens", 16)),
                 float(body.get("temperature", 0.0)),
-                model=body.get("model"))
+                model=body.get("model"),
+                session_id=body.get("session_id"))
             return {
                 "id": cid, "object": "chat.completion", "created": created,
                 "model": body.get("model", self.model_id),
@@ -359,6 +403,7 @@ class LLMDeployment:
         req = Request(rid, ids, max_tokens, temperature,
                       eos_id=self.tokenizer.eos_id,
                       model=self._adapter_for(body.get("model")))
+        group = self._group_of(prompt, body.get("session_id"))
 
         def gen():
             yield {"__serve_response__": True, "content_type": "text/event-stream"}
@@ -367,7 +412,7 @@ class LLMDeployment:
                         "choices": [{"index": 0, "delta": {"role": "assistant"},
                                      "finish_reason": None}]}
                 yield f"data: {json.dumps(head)}\n\n"
-            for event in self._stream_tokens(req):
+            for event in self._stream_tokens(req, group):
                 text = self.tokenizer.decode([event["token"]])
                 if chat:
                     choice = {"index": 0, "delta": {"content": text},
@@ -391,6 +436,7 @@ class LLMDeployment:
     def engine_metrics(self) -> dict:
         return {**self.engine.metrics,
                 "prefix_cache_hit_rate": self.engine.prefix_cache_hit_rate,
+                "prefill_suffix_frac": self.engine.prefill_suffix_frac,
                 "mixed_dispatch_enabled": self.engine.mixed_dispatch_enabled}
 
     # ---------------------------------------------------------- HTTP entry

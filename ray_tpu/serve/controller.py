@@ -50,6 +50,10 @@ class _Replica:
         # preemption-eviction path needs replica -> node without an RPC
         # to the (possibly dying) replica itself.
         self.node_id = ""
+        # Last latency/residency probe (monotonic): outside latency_slo
+        # mode the snapshot is pulled at a relaxed cadence — residency
+        # doesn't need the every-round freshness autoscaling does.
+        self.last_latency_probe = 0.0
 
 
 class _DeploymentState:
@@ -84,6 +88,9 @@ class _DeploymentState:
         # (notice -> eviction+table push, chaos-clock) is the serve half
         # of the recovery SLO bench.
         self.preemption_evictions: list[dict] = []
+        # Aggregated prefix-group residency from the replicas' probe
+        # rows (affinity hit rates in status; empty = no LLM engines).
+        self.prefix_affinity: dict = {}
 
     @property
     def name(self) -> str:
@@ -181,6 +188,7 @@ class ServeController:
                     "autoscaling_mode": auto.get("mode") if auto else None,
                     "autoscale_events": list(state.scale_events[-10:]),
                     "preemption_evictions": list(state.preemption_evictions[-10:]),
+                    "prefix_affinity": dict(state.prefix_affinity),
                 }
             return out
 
@@ -310,8 +318,16 @@ class ServeController:
                     p["queue"] = ray.get(r.actor.get_queue_len.remote(), timeout=5)
                 except Exception:
                     p["queue"] = 0
+                # Probed in every mode (not only latency_slo): the same
+                # snapshot carries the serve_prefix_residency row that
+                # feeds the affinity hit rates in app status — but
+                # outside slo mode only every ~2 s, not every round.
                 auto = state.config.get("autoscaling") or {}
-                if p["alive"] and auto.get("mode") == "latency_slo":
+                now_m = time.monotonic()
+                want_latency = (auto.get("mode") == "latency_slo"
+                                or now_m - r.last_latency_probe >= 2.0)
+                if p["alive"] and want_latency:
+                    r.last_latency_probe = now_m
                     try:
                         p["latency"] = ray.get(
                             r.actor.latency_snapshot.remote(), timeout=5)
@@ -336,6 +352,7 @@ class ServeController:
         n_to_start = 0
         dirty = False
         with self._lock:
+            self._fold_prefix_residency(state, probes)
             self._autoscale_from_probes(state, probes)
             target = state.target_replicas
             for r in list(state.replicas):
@@ -456,6 +473,25 @@ class ServeController:
             with self._lock:
                 self._push_replica_table(state)
         return dirty
+
+    @staticmethod
+    def _fold_prefix_residency(state: _DeploymentState, probes: dict) -> None:
+        """Sum the replicas' ``serve_prefix_residency`` probe rows into
+        the deployment's affinity view: resident groups, requests, and
+        the replica-local prefix-cache hit rate (how often an affine
+        request found its KV where the router sent it)."""
+        agg = {"replicas": 0, "groups": 0, "requests": 0, "cache_hits": 0}
+        for p in probes.values():
+            for row in p.get("latency") or []:
+                if row.get("name") != "serve_prefix_residency":
+                    continue
+                agg["replicas"] += 1
+                for k in ("groups", "requests", "cache_hits"):
+                    agg[k] += int(row.get(k, 0) or 0)
+        if agg["replicas"]:
+            agg["hit_rate"] = (round(agg["cache_hits"] / agg["requests"], 4)
+                               if agg["requests"] else 0.0)
+            state.prefix_affinity = agg
 
     def _replica_alive(self, r: _Replica) -> bool:
         try:

@@ -20,7 +20,39 @@ from ..core import api as ray
 from ..observability import tracing
 from .long_poll import LongPollClient
 from .replica import Request
-from .router import CONTROLLER_NAME, DeploymentHandle
+from .router import CONTROLLER_NAME, DeploymentHandle, prefix_group_key
+
+
+def _request_prefix_group(request: Request) -> str:
+    """Prefix-group key for affinity routing, extracted at the front
+    door: an explicit ``x-raytpu-session`` header (multi-turn sessions)
+    beats the hash of the prompt's leading characters (shared system
+    prompts) parsed from OpenAI-style JSON bodies; non-LLM requests get
+    no key and route by pure load."""
+    session = request.headers.get("x-raytpu-session", "")
+    if session:
+        return prefix_group_key(session_id=session)
+    text = ""
+    if request.body and request.headers.get(
+            "content-type", "").startswith("application/json"):
+        try:
+            body = json.loads(request.body)
+            session = str(body.get("session_id") or "")
+            if session:
+                return prefix_group_key(session_id=session)
+            prompt = body.get("prompt", "")
+            if isinstance(prompt, list):
+                prompt = prompt[0] if prompt else ""
+            if not prompt and isinstance(body.get("messages"), list):
+                prompt = "\n".join(
+                    str(m.get("content", "")) for m in body["messages"]
+                    if isinstance(m, dict))
+            text = str(prompt or "")
+        except Exception:
+            return ""
+    elif request.query_params.get("prompt"):
+        text = str(request.query_params["prompt"])
+    return prefix_group_key(text=text)
 
 
 class ProxyActor:
@@ -184,6 +216,12 @@ class ProxyActor:
         model_id = request.headers.get("serve_multiplexed_model_id", "")
         if model_id:
             handle = handle.options(multiplexed_model_id=model_id)
+        # Prefix/session affinity: requests sharing a session id or a
+        # prompt prefix stick to the replica whose engine already holds
+        # their KV (the router spills off an overloaded one).
+        group = _request_prefix_group(request)
+        if group:
+            handle = handle.options(prefix_group=group)
         # Root span for the request (or a continuation of the client's
         # trace via the x-raytpu-trace header); everything downstream —
         # router queue, replica task, engine prefill/decode — chains

@@ -111,16 +111,27 @@ class ReplicaActor:
         (``serve_ttft_ms`` from an engine-hosting callable, plus any
         ``serve_queue_wait_ms`` observed locally), for the controller's
         latency-SLO autoscaler — pulled via the probe path so scaling
-        never waits on the ~5 s GCS metrics flush."""
+        never waits on the ~5 s GCS metrics flush. Callables exposing
+        ``prefix_residency()`` (the LLM deployment) piggyback a
+        ``serve_prefix_residency`` row — per-group KV residency counts
+        the controller folds into the app status's affinity hit rates."""
         from ..util.metrics import snapshot_all
 
         names = ("serve_ttft_ms", "serve_queue_wait_ms")
-        return [
+        rows = [
             m for m in snapshot_all()
             if m["name"] in names
             and m.get("tags", {}).get("deployment", "") in (
                 "", self._deployment_name)
         ]
+        residency = getattr(self._callable, "prefix_residency", None)
+        if residency is not None:
+            try:
+                rows.append({"name": "serve_prefix_residency",
+                             **(residency() or {})})
+            except Exception:
+                pass
+        return rows
 
     def reconfigure(self, user_config: Any) -> bool:
         fn = getattr(self._callable, "reconfigure", None)

@@ -11,8 +11,10 @@ controller, so scale-ups and rolling updates apply without polling.
 
 from __future__ import annotations
 
+import hashlib
 import random
 import threading
+from collections import OrderedDict
 from typing import Any
 
 from ..core import api as ray
@@ -33,7 +35,7 @@ _metrics: dict = {}
 def _serve_metrics():
     with _metrics_lock:
         if not _metrics:
-            from ..util.metrics import Counter, Histogram
+            from ..util.metrics import Counter, Gauge, Histogram
 
             _metrics["requests"] = Counter(
                 "serve_num_requests_total",
@@ -50,11 +52,52 @@ def _serve_metrics():
                 "serve_queue_wait_ms",
                 "Time a request waits in the router for a replica slot",
                 tag_keys=("deployment",))
+            _metrics["affinity_hits"] = Counter(
+                "serve_affinity_hits_total",
+                "Requests routed to their prefix group's affine replica",
+                tag_keys=("deployment",))
+            _metrics["affinity_misses"] = Counter(
+                "serve_affinity_misses_total",
+                "Prefix-group requests whose affine replica vanished "
+                "(died/removed) — the KV must cold-prefill elsewhere",
+                tag_keys=("deployment",))
+            _metrics["affinity_new_groups"] = Counter(
+                "serve_affinity_new_groups_total",
+                "First-seen prefix groups (not an affinity failure; "
+                "excluded from the hit rate)", tag_keys=("deployment",))
+            _metrics["affinity_spills"] = Counter(
+                "serve_affinity_spills_total",
+                "Prefix-group requests spilled off an overloaded affine "
+                "replica (load-aware spill)", tag_keys=("deployment",))
+            _metrics["affinity_hit_rate"] = Gauge(
+                "serve_prefix_affinity_hit_rate",
+                "Fraction of prefix-group requests that landed on their "
+                "affine replica (0-1, since router start)",
+                tag_keys=("deployment",))
         return _metrics
 
 
+def prefix_group_key(session_id: str = "", text: str = "",
+                     n_chars: int | None = None) -> str:
+    """Prefix-group key for affinity routing: an explicit session id
+    wins; otherwise the hash of the prompt's first ``n_chars`` characters
+    — under the byte tokenizer that IS the first token blocks, so
+    requests sharing a system prompt land in one group. Empty when
+    neither is present (no affinity)."""
+    if session_id:
+        return f"sess:{session_id}"
+    if not text:
+        return ""
+    if n_chars is None:
+        from ..core.config import get_config
+
+        n_chars = get_config().serve_prefix_group_chars
+    head = text[:n_chars].encode("utf-8", errors="ignore")
+    return "pfx:" + hashlib.sha1(head).hexdigest()[:16]
+
+
 def _assign_traced(router: "Router", metrics: dict, deployment: str,
-                   model_id: str) -> tuple[str, Any]:
+                   model_id: str, prefix_group: str = "") -> tuple[str, Any]:
     """Assign a replica, recording the router queue wait as both a
     histogram observation and (inside an active trace) a span."""
     import time as _time
@@ -63,7 +106,8 @@ def _assign_traced(router: "Router", metrics: dict, deployment: str,
 
     t0w, t0m = _time.time(), _time.monotonic()
     try:
-        replica_id, actor = router.assign_replica(model_id=model_id)
+        replica_id, actor = router.assign_replica(
+            model_id=model_id, prefix_group=prefix_group)
     finally:
         wait_ms = 1000 * (_time.monotonic() - t0m)
         metrics["queue_wait"].observe(wait_ms, tags={"deployment": deployment})
@@ -103,6 +147,12 @@ class Router:
         self._inflight: dict[str, int] = {}
         # multiplexing cache affinity: model_id -> last replica that served it
         self._model_affinity: dict[str, str] = {}
+        # Prefix/session affinity: group key -> replica whose engine holds
+        # that group's KV prefix (bounded LRU; load-aware spill keeps a
+        # hot replica from queue-blowing on affinity alone).
+        self._group_affinity: OrderedDict[str, str] = OrderedDict()
+        self.affinity_stats = {"hits": 0, "misses": 0, "spills": 0,
+                               "new_groups": 0}
         controller = ray.get_actor(CONTROLLER_NAME)
         self._long_poll = LongPollClient(controller, {self._key: self._update_replicas})
         # prime with the current table so the first request needn't wait a
@@ -132,21 +182,91 @@ class Router:
                     }
             self._replicas = fresh
             self._inflight = {rid: self._inflight.get(rid, 0) for rid in fresh}
+            self._purge_affinity_locked()
             self._cond.notify_all()
 
+    def _purge_affinity_locked(self) -> None:
+        """Drop affinity entries pointing at replicas no longer in the
+        table: a dead replica's KV died with it, so its groups must
+        cold-prefill wherever they land next — never wait for the corpse."""
+        for g, rid in list(self._group_affinity.items()):
+            if rid not in self._replicas:
+                del self._group_affinity[g]
+        for m, rid in list(self._model_affinity.items()):
+            if rid not in self._replicas:
+                del self._model_affinity[m]
+
+    def _affinity_pick(self, prefix_group: str, candidates: list[str],
+                       cfg, deployment: str) -> str | None:
+        """Prefix-group affinity with load-aware spill. A group's affine
+        replica is used while its in-flight load is within
+        ``serve_affinity_spill_margin`` of the coolest candidate;
+        otherwise the request spills to pow-2 choice and the group
+        REMAPS to the spill target — which is about to cold-prefill the
+        prefix and therefore holds the freshest copy of its KV."""
+        def note(kind: str) -> None:
+            self.affinity_stats[kind] += 1
+            try:
+                _serve_metrics()[f"affinity_{kind}"].inc(
+                    tags={"deployment": deployment})
+            except Exception:
+                pass
+
+        affine = self._group_affinity.get(prefix_group)
+        if affine is None:
+            note("new_groups")
+            return None
+        if affine not in candidates:
+            # Saturated or dead: dead replicas were purged already, a
+            # saturated one counts as a spill (never queue behind it).
+            if affine in self._replicas:
+                note("spills")
+            else:
+                self._group_affinity.pop(prefix_group, None)
+                note("misses")
+            return None
+        coolest = min(self._inflight.get(rid, 0) for rid in candidates)
+        if (self._inflight.get(affine, 0) - coolest
+                > cfg.serve_affinity_spill_margin):
+            note("spills")
+            return None
+        note("hits")
+        return affine
+
+    def _note_affinity(self, prefix_group: str, pick: str, cfg,
+                       deployment: str) -> None:
+        self._group_affinity[prefix_group] = pick
+        self._group_affinity.move_to_end(prefix_group)
+        while len(self._group_affinity) > max(1, cfg.serve_affinity_map_size):
+            self._group_affinity.popitem(last=False)
+        stats = self.affinity_stats
+        looked = stats["hits"] + stats["misses"] + stats["spills"]
+        if looked:
+            try:
+                _serve_metrics()["affinity_hit_rate"].set(
+                    stats["hits"] / looked, tags={"deployment": deployment})
+            except Exception:
+                pass
+
     def assign_replica(self, timeout: float | None = None,
-                       model_id: str = "") -> tuple[str, Any]:
+                       model_id: str = "",
+                       prefix_group: str = "") -> tuple[str, Any]:
         """Power-of-two choice among replicas below their cap; blocks while
         every replica is saturated (backpressure). With a multiplexed
         ``model_id``, replicas that served that model recently are
-        preferred (cache affinity — reference multiplex-aware routing)."""
+        preferred (cache affinity — reference multiplex-aware routing).
+        With a ``prefix_group`` key, requests stick to the replica whose
+        engine already holds the group's KV prefix, with load-aware
+        spill (``_affinity_pick``)."""
         import time
 
         from ..core.config import get_config
 
+        cfg = get_config()
         if timeout is None:
-            timeout = get_config().serve_router_assign_timeout_s
+            timeout = cfg.serve_router_assign_timeout_s
         deadline = time.monotonic() + timeout
+        deployment = self._key.rsplit("::", 1)[-1]
         with self._cond:
             while True:
                 candidates = [
@@ -155,7 +275,10 @@ class Router:
                 ]
                 if candidates:
                     pick = None
-                    if model_id:
+                    if prefix_group:
+                        pick = self._affinity_pick(prefix_group, candidates,
+                                                   cfg, deployment)
+                    if pick is None and model_id:
                         affine = self._model_affinity.get(model_id)
                         if affine in candidates:
                             pick = affine
@@ -169,6 +292,9 @@ class Router:
                         self._model_affinity[model_id] = pick
                         while len(self._model_affinity) > 1024:
                             self._model_affinity.pop(next(iter(self._model_affinity)))
+                    if prefix_group:
+                        self._note_affinity(prefix_group, pick, cfg,
+                                            deployment)
                     self._inflight[pick] = self._inflight.get(pick, 0) + 1
                     return pick, self._replicas[pick]["actor"]
                 remaining = deadline - time.monotonic()
@@ -192,6 +318,7 @@ class Router:
         with self._cond:
             self._replicas.pop(replica_id, None)
             self._inflight.pop(replica_id, None)
+            self._purge_affinity_locked()
             self._cond.notify_all()
 
     def shutdown(self) -> None:
@@ -343,12 +470,13 @@ class DeploymentHandle:
     """Client-side handle to a deployment (reference serve.handle.DeploymentHandle)."""
 
     def __init__(self, app_name: str, deployment_name: str, method_name: str = "",
-                 multiplexed_model_id: str = "",
+                 multiplexed_model_id: str = "", prefix_group: str = "",
                  _router_holder: dict | None = None):
         self.app_name = app_name
         self.deployment_name = deployment_name
         self._method_name = method_name
         self._multiplexed_model_id = multiplexed_model_id
+        self._prefix_group = prefix_group
         # Shared, mutable: every handle derived from this one (h.method)
         # must reuse ONE router — a router per derived handle would leak a
         # long-poll thread per request.
@@ -364,11 +492,13 @@ class DeploymentHandle:
             return self._router_holder["router"]
 
     def options(self, method_name: str = "",
-                multiplexed_model_id: str = "") -> "DeploymentHandle":
+                multiplexed_model_id: str = "",
+                prefix_group: str = "") -> "DeploymentHandle":
         return DeploymentHandle(
             self.app_name, self.deployment_name,
             method_name or self._method_name,
             multiplexed_model_id or self._multiplexed_model_id,
+            prefix_group or self._prefix_group,
             _router_holder=self._router_holder,
         )
 
@@ -388,7 +518,8 @@ class DeploymentHandle:
         metrics["requests"].inc(tags={"deployment": self.deployment_name})
         t0 = _time.monotonic()
         replica_id, actor = _assign_traced(
-            router, metrics, self.deployment_name, self._multiplexed_model_id)
+            router, metrics, self.deployment_name, self._multiplexed_model_id,
+            self._prefix_group)
         if self._multiplexed_model_id:
             kwargs[MULTIPLEXED_KWARG] = self._multiplexed_model_id
         try:
@@ -430,7 +561,8 @@ class DeploymentHandle:
         metrics["requests"].inc(tags={"deployment": self.deployment_name})
         t0 = _time.monotonic()
         replica_id, actor = _assign_traced(
-            router, metrics, self.deployment_name, self._multiplexed_model_id)
+            router, metrics, self.deployment_name, self._multiplexed_model_id,
+            self._prefix_group)
         if self._multiplexed_model_id:
             kwargs[MULTIPLEXED_KWARG] = self._multiplexed_model_id
         try:
@@ -456,4 +588,6 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (DeploymentHandle, (self.app_name, self.deployment_name,
-                                   self._method_name, self._multiplexed_model_id))
+                                   self._method_name,
+                                   self._multiplexed_model_id,
+                                   self._prefix_group))

@@ -171,6 +171,57 @@ def test_recovery_skip_markers_honored():
 def test_prefix_hit_rate_direction():
     # higher-better: more prompt pages served from the prefix cache
     assert bench_check._direction("serve_prefix_cache_hit_rate") == "up"
+    assert bench_check._direction("serve_prefix_affinity_hit_rate") == "up"
+    assert bench_check._direction("serve_prefill_suffix_frac") == "up"
+
+
+def test_hit_rate_and_frac_compare_in_points():
+    """ISSUE 10 satellite: 0-1 rate metrics (_hit_rate/_frac) compare
+    higher-better in POINTS — small absolute moves on a tiny base are
+    noise, big point drops fail, and a 0 -> positive move improves
+    (the relative path would have skipped ov == 0 entirely)."""
+    old = {"serve_prefix_cache_hit_rate": 0.02,
+           "serve_prefix_affinity_hit_rate": 0.90}
+    # 0.02 -> 0.01 is a -50% relative move but only -1 point: OK
+    result = bench_check.compare(
+        old, {"serve_prefix_cache_hit_rate": 0.01,
+              "serve_prefix_affinity_hit_rate": 0.89})
+    assert not result["regressions"] and not result["missing"]
+    # a real point collapse regresses
+    result = bench_check.compare(
+        old, {"serve_prefix_cache_hit_rate": 0.02,
+              "serve_prefix_affinity_hit_rate": 0.45})
+    assert [r["metric"] for r in result["regressions"]] == [
+        "serve_prefix_affinity_hit_rate"]
+    assert result["regressions"][0]["change"] == -0.45
+    # 0 -> 0.5 is an improvement, not an ov==0 skip
+    result = bench_check.compare({"serve_prefix_cache_hit_rate": 0.0},
+                                 {"serve_prefix_cache_hit_rate": 0.5})
+    assert [r["metric"] for r in result["improvements"]] == [
+        "serve_prefix_cache_hit_rate"]
+    # skip markers cover rates too
+    result = bench_check.compare(
+        old, {"serve_prefix_cache_hit_rate_skipped": True,
+              "serve_prefix_affinity_hit_rate": 0.9})
+    assert not result["missing"]
+    assert [r["metric"] for r in result["skipped"]] == [
+        "serve_prefix_cache_hit_rate"]
+
+
+def test_cached_cold_ttft_directions_and_markers():
+    """The cached/cold serve TTFT cells are _ms lower-better metrics and
+    honor their skip markers."""
+    assert bench_check._direction("serve_ttft_cached_ms") == "down"
+    assert bench_check._direction("serve_ttft_cold_ms") == "down"
+    old = {"serve_ttft_cached_ms": 80.0, "serve_ttft_cold_ms": 400.0}
+    result = bench_check.compare(old, {"serve_ttft_cached_ms": 300.0,
+                                       "serve_ttft_cold_ms": 410.0})
+    assert [r["metric"] for r in result["regressions"]] == [
+        "serve_ttft_cached_ms"]
+    result = bench_check.compare(old, {"serve_ttft_cached_skipped": True,
+                                       "serve_ttft_cold_skipped": True})
+    assert not result["missing"]
+    assert {r["metric"] for r in result["skipped"]} == set(old)
 
 
 def test_lower_better_regresses_up():

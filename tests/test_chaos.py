@@ -423,6 +423,63 @@ def test_serve_replica_kill_request_retried(chaos_cluster):
             pass
 
 
+def test_affinity_map_survives_replica_death(chaos_cluster):
+    """ISSUE 10: a prefix-group's affine replica SIGKILLed under it —
+    the router purges the corpse's groups, the retried request lands on
+    the replacement (riding the existing replica-death retry path), and
+    the group's state there is COLD (fresh instance, no carried KV)."""
+    import uuid as _uuid
+
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    class Sticky:
+        def __init__(self):
+            self.instance = _uuid.uuid4().hex
+            self.seen = 0
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def ask(self, x):
+            self.seen += 1
+            return {"instance": self.instance, "seen": self.seen,
+                    "answer": f"ok {x}"}
+
+    handle = serve.run(Sticky.bind(), name="affchaos", route_prefix=None,
+                       _blocking=False)
+    session = handle.options(prefix_group="sess:chaos")
+    try:
+        first = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and first is None:
+            try:
+                first = session.ask.remote("a").result(timeout=30)
+            except Exception:
+                time.sleep(0.5)
+        assert first and first["answer"] == "ok a"
+        router = handle._get_router()
+        affine = router._group_affinity.get("sess:chaos")
+        assert affine is not None
+        pid = session.pid.remote().result(timeout=30)
+        os.kill(pid, signal.SIGKILL)
+        # retried on the controller's replacement; the router must have
+        # purged the corpse's group before re-routing
+        second = session.ask.remote("b").result(timeout=90)
+        assert second["answer"] == "ok b"
+        assert second["instance"] != first["instance"]  # state died: cold
+        assert second["seen"] == 1
+        remapped = router._group_affinity.get("sess:chaos")
+        assert remapped is not None and remapped != affine
+    finally:
+        try:
+            serve.delete("affchaos")
+        except Exception:
+            pass
+
+
 def test_cli_doctor_reports_active_fault_plan(chaos_cluster, capsys):
     """Operators must be able to tell injected pain from real pain:
     `cli doctor` shows the registered FaultPlan while one is installed."""

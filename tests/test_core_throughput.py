@@ -360,6 +360,27 @@ def test_multiplexed_lease_recovers_from_dropped_reply(ray_cluster, _knobs):
         assert report["workload"] == list(range(24))
     finally:
         set_chaos(None)
+        # Drain the stranded un-acked leases NOW, while the orphan
+        # timeout is still 1 s: left behind, they age out ~10 s later
+        # inside whatever test shares the cluster next — the cross-file
+        # test_lease_wedge_watchdog_fires flake was exactly this test's
+        # strands meeting that test's injected wedge entries.
+        from ray_tpu.core import api as core_api
+
+        raylet = core_api._node.raylet
+
+        def _drained() -> bool:
+            stale = any(
+                w.state in ("leased", "dedicated") and not w.lease_acked
+                and not w.loop_pinned for w in raylet._workers.values())
+            waiting = any(not e["fut"].done()
+                          for e in raylet._admission_queue)
+            return not stale and not waiting
+
+        deadline = time.monotonic() + 30
+        while not _drained() and time.monotonic() < deadline:
+            time.sleep(0.2)
+        assert _drained(), "stranded un-acked leases were not reclaimed"
         cfg.lease_orphan_timeout_s = saved_orphan
 
 

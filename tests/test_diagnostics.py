@@ -124,6 +124,88 @@ def test_lease_wedge_watchdog_fires():
         cfg.lease_wedge_check_interval_s = old_int
 
 
+def test_lease_wedge_classification_robust_to_stale_leases():
+    """Back-to-back-cluster regression (test_core_throughput then this
+    file): an un-acked lease strand from a PREVIOUS workload being
+    orphan-reclaimed mid-test must not re-classify a queue entry that
+    could be granted from the free pool as "blocked behind an orphaned
+    lease grant" — that message is reserved for a head the reclaim
+    actually unblocks; a satisfiable entry keeps the watchdog's own
+    "matching resources are free" report."""
+    import asyncio
+
+    from ray_tpu.core import api as core_api
+    from ray_tpu.core.config import get_config
+    from ray_tpu.core.resources import ResourceSet
+    from ray_tpu import chaos as _chaos  # noqa: F401 (chaos clock import path)
+    from ray_tpu.chaos import clock as chaos_clock
+
+    cfg = get_config()
+    saved = (cfg.lease_wedge_threshold_s, cfg.lease_wedge_check_interval_s,
+             cfg.lease_orphan_timeout_s)
+    cfg.lease_wedge_threshold_s = 0.5
+    cfg.lease_wedge_check_interval_s = 0.2
+    cfg.lease_orphan_timeout_s = 1.0
+    node = core_api._node
+    raylet = node.raylet
+
+    # a couple of idle workers to lease without acking (the strand)
+    @ray_tpu.remote
+    def wedge_warm():
+        return None
+
+    ray_tpu.get([wedge_warm.remote() for _ in range(4)], timeout=60)
+    time.sleep(0.3)
+    injected = []
+    strand = {}
+
+    async def _inject():
+        loop = asyncio.get_running_loop()
+        spec = {"task_id": b"stale-strand", "name": "strand", "kind": 0,
+                "resources": {"CPU": 1.0}, "max_retries": 1}
+        reply = await raylet.handle_RequestWorkerLease({"spec": spec})
+        assert reply.get("granted"), reply
+        w = raylet._workers[reply["worker_id"]]
+        w.lease_granted_at = chaos_clock.now() - 60.0  # long-stranded
+        strand["worker_id"] = reply["worker_id"]
+        # A satisfiable entry aged past the threshold: plenty of CPU is
+        # still free, so its report must come from the watchdog loop.
+        stalled = {"prio": 1, "seq": 10**9, "request": ResourceSet({"CPU": 0.37}),
+                   "fut": loop.create_future(),
+                   "enqueued_at": time.monotonic() - 60.0}
+        raylet._admission_queue.append(stalled)
+        injected.append(stalled)
+
+    node.services_loop.run_sync(_inject())
+    try:
+        # the strand is reclaimed (two orphan-scan probes)...
+        orphans = _wait_for(
+            lambda: state.list_errors(error_type="lease_orphan", limit=1000),
+            timeout=30.0, interval=0.2)
+        assert orphans, "orphan reclaim never fired"
+        # ...and every wedge report for the satisfiable entry names the
+        # free resources; none blames the orphan for it.
+        wedges = _wait_for(lambda: [
+            e for e in state.list_errors(error_type="lease_wedge", limit=1000)
+            if "0.37" in e.get("message", "")
+        ], timeout=20.0, interval=0.2)
+        assert wedges, "watchdog never reported the stalled entry"
+        for e in wedges:
+            assert "free" in e["message"], e["message"]
+            assert "orphaned lease grant" not in e["message"], e["message"]
+    finally:
+        async def _cleanup():
+            for entry in injected:
+                if entry in raylet._admission_queue:
+                    raylet._admission_queue.remove(entry)
+                if not entry["fut"].done():
+                    entry["fut"].cancel()
+
+        node.services_loop.run_sync(_cleanup())
+        (cfg.lease_wedge_threshold_s, cfg.lease_wedge_check_interval_s,
+         cfg.lease_orphan_timeout_s) = saved
+
+
 def test_debug_state_dumps_written():
     """Raylet and GCS periodically write debug_state_*.txt snapshots into
     the session dir (reference: raylet debug_state.txt dumps)."""

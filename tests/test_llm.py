@@ -681,6 +681,203 @@ def test_page_allocator_alloc_under_pressure_prefers_free():
     assert third == a and alloc.lookup_prefix(b"ha") is None
 
 
+def test_page_allocator_cow_fork_refcount_roundtrip():
+    """ISSUE 10: share -> write forks EXACTLY one page. fork() allocates
+    one fresh refcount-1 page; the shared original keeps its refcount and
+    cache entries for its other readers, and releasing the reader's ref
+    returns it to cached-evictable, never the free list."""
+    from ray_tpu.llm.engine import PageAllocator
+
+    alloc = PageAllocator(4)
+    (pid,) = alloc.alloc(1)
+    alloc.register_partial(b"root", (7, 8, 9), pid)
+    alloc.release(pid)                      # cached partial, refcount 0
+    assert alloc.match_partial(b"root", (7, 8, 9, 1), cap=7) == (pid, 3)
+    alloc.share(pid)                        # reader A maps it
+    alloc.share(pid)                        # reader B maps it
+    free_before = len(alloc.free)
+    fork = alloc.fork(pid)
+    assert fork is not None and fork != pid
+    assert alloc.refcount[fork] == 1        # exactly one fresh page
+    assert alloc.refcount[pid] == 2         # original untouched
+    assert len(alloc.free) == free_before - 1
+    alloc.release(pid)                      # A swapped to its fork
+    alloc.release(pid)                      # B retired
+    assert alloc.refcount.get(pid, 0) == 0
+    assert pid not in alloc.free            # cached-evictable, not freed
+    assert alloc.match_partial(b"root", (7, 8, 9), cap=7) == (pid, 3)
+
+
+def test_page_allocator_shared_pin_survives_pressure():
+    """Shared pages (full-block AND partial-tail) are pinned: allocation
+    pressure may evict every refcount-0 cached page but never a pinned
+    one."""
+    from ray_tpu.llm.engine import PageAllocator
+
+    alloc = PageAllocator(3)
+    full, tail, spare = alloc.alloc(3)
+    alloc.register_prefix(full, b"chain0", b"root")
+    alloc.register_partial(b"chain0", (1, 2), tail)
+    alloc.release(full)
+    alloc.release(tail)
+    alloc.release(spare)
+    alloc.share(full)                       # pin both shared pages
+    alloc.share(tail)
+    assert alloc.available() == 1           # only the spare is claimable
+    assert alloc.alloc(2) is None           # pins hold under pressure
+    (got,) = alloc.alloc(1)
+    assert got == spare
+    assert alloc.lookup_prefix(b"chain0") == full
+    assert alloc.match_partial(b"chain0", (1, 2, 3), cap=7) == (tail, 2)
+
+
+def test_page_allocator_partial_match_boundaries():
+    """Trie match on partial-block boundaries: the match is the longest
+    common prefix of the cached tail and the request's remainder, capped
+    by the caller; a diverging first row or a wrong parent yields none;
+    the longest of several entries wins."""
+    from ray_tpu.llm.engine import PageAllocator
+
+    alloc = PageAllocator(4)
+    a, b = alloc.alloc(2)
+    alloc.register_partial(b"p", (5, 6, 7, 8), a)
+    alloc.register_partial(b"p", (5, 6), b)
+    # full 4-row entry matches but the cap clamps the usable rows
+    assert alloc.match_partial(b"p", (5, 6, 7, 8, 9), cap=3) == (a, 3)
+    # divergence mid-tail: only the common prefix is usable
+    assert alloc.match_partial(b"p", (5, 6, 99), cap=7) == (a, 2)
+    # first row diverges: no match at all
+    assert alloc.match_partial(b"p", (4, 6, 7), cap=7) is None
+    # parent scoping: same tokens under another chain never match
+    assert alloc.match_partial(b"q", (5, 6, 7), cap=7) is None
+
+
+def test_page_allocator_trie_eviction_unlinks_subtree():
+    """Evicting an interior chain node makes its cached descendants
+    unreachable: they are unlinked and returned to the free pool (leaf
+    entries are preferred victims, so this only happens once every leaf
+    is gone)."""
+    from ray_tpu.llm.engine import PageAllocator
+
+    alloc = PageAllocator(3)
+    p0, p1, tail = alloc.alloc(3)
+    alloc.register_prefix(p0, b"c0", b"root")
+    alloc.register_prefix(p1, b"c1", b"c0")
+    alloc.register_partial(b"c1", (3, 4), tail)
+    for pid in (p0, p1, tail):
+        alloc.release(pid)
+    assert alloc.available() == 3
+    # leaf-first: the partial tail (a leaf) goes before the chain nodes
+    (first,) = alloc.alloc(1)
+    assert first == tail
+    # evicting c0 (interior: c1 still hangs under it) unlinks c1 too
+    alloc.release(first)  # plain free page now
+    got = alloc.alloc(3)
+    assert got is not None and set(got) == {p0, p1, tail}
+    assert alloc.lookup_prefix(b"c0") is None
+    assert alloc.lookup_prefix(b"c1") is None
+    assert alloc.match_partial(b"c1", (3, 4), cap=7) is None
+
+    # CASCADE: an interior node evicted while its child is PINNED — the
+    # child loses its (unreachable) cache entry but stays allocated to
+    # its reader, and only frees on the reader's final release.
+    alloc2 = PageAllocator(2)
+    q0, q1 = alloc2.alloc(2)
+    alloc2.register_prefix(q0, b"d0", b"root")
+    alloc2.register_prefix(q1, b"d1", b"d0")
+    alloc2.release(q0)        # cached, refcount 0 — the only victim
+    alloc2.share(q1)
+    alloc2.release(q1)        # refcount 1: pinned by its reader
+    (got2,) = alloc2.alloc(1)
+    assert got2 == q0
+    assert alloc2.lookup_prefix(b"d1") is None   # unlinked with parent
+    alloc2.release(q1)
+    assert q1 in alloc2.free  # pinned child frees on final release
+
+
+def test_engine_cached_vs_cold_greedy_parity(small_model):
+    """ISSUE 10 acceptance: greedy decode is byte-identical between a
+    prefix-cached engine (full-block hits + a partial-tail COW fork,
+    including a mid-sequence divergence) and naive full recompute, on
+    uniform and mixed-batch workloads."""
+    cfg, params = small_model
+    eng = InferenceEngine(cfg, params, max_slots=4, max_len=64, page_size=8)
+    prompt_a = list(range(1, 20))           # 19 tokens: 2 full pages + 3
+    a = Request("a", list(prompt_a), max_new_tokens=4)
+    eng.add_request(a)
+    while not a.done:
+        eng.step()
+    assert a.generated == naive_greedy(params, cfg, prompt_a, 4)
+    # Retire registered pages 0,1 as full blocks and the partial tail
+    # (prompt rows 16-18 + generated rows) for COW sharing.
+
+    # Uniform resend: full hits + partial rows -> only the last prompt
+    # token is computed; the first suffix write forks the shared tail.
+    b = Request("b", list(prompt_a), max_new_tokens=4)
+    eng.add_request(b)
+    while not b.done:
+        eng.step()
+    assert b.generated == a.generated
+    assert b.cached_prefix_tokens == 18     # 2 pages + 2 partial rows
+    assert eng.metrics["cow_forks"] >= 1
+
+    # Mixed batch with a COW DIVERGENCE mid-sequence: two prompts share
+    # the cached chain but diverge inside the partial tail block; both
+    # map the shared page, each forks its own copy, and both decode
+    # byte-identically to full recompute.
+    forks_before = eng.metrics["cow_forks"]
+    prompt_c = prompt_a[:17] + [99, 98, 97]
+    prompt_d = prompt_a[:17] + [77, 76, 75, 74]
+    c = Request("c", list(prompt_c), max_new_tokens=5)
+    d = Request("d", list(prompt_d), max_new_tokens=5)
+    eng.add_request(c)
+    eng.add_request(d)
+    while not (c.done and d.done):
+        eng.step()
+    assert c.generated == naive_greedy(params, cfg, prompt_c, 5)
+    assert d.generated == naive_greedy(params, cfg, prompt_d, 5)
+    assert c.cached_prefix_tokens == 17 and d.cached_prefix_tokens == 17
+    assert eng.metrics["cow_forks"] >= forks_before + 2
+    assert eng.metrics["prefix_cached_tokens"] > 0
+    assert 0.0 < eng.prefill_suffix_frac < 1.0
+
+    # COLD control: identical workload on a cache-disabled engine.
+    cold = InferenceEngine(cfg, params, max_slots=4, max_len=64, page_size=8,
+                           enable_prefix_cache=False)
+    for rid, p, n in (("a2", prompt_a, 4), ("b2", prompt_a, 4),
+                      ("c2", prompt_c, 5), ("d2", prompt_d, 5)):
+        r = Request(rid, list(p), max_new_tokens=n)
+        cold.add_request(r)
+        while not r.done:
+            cold.step()
+        hot = {"a2": a, "b2": b, "c2": c, "d2": d}[rid]
+        assert r.generated == hot.generated, rid
+    assert cold.metrics["prefix_cached_tokens"] == 0
+
+
+def test_engine_multiturn_session_reuse(small_model):
+    """Multi-turn session: turn 2's prompt embeds turn 1's prompt AND
+    generated answer verbatim — generated-token pages registered at
+    retire make the whole previous exchange a cache hit."""
+    cfg, params = small_model
+    eng = InferenceEngine(cfg, params, max_slots=2, max_len=64, page_size=8)
+    turn1 = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5]       # 11 tokens
+    r1 = Request("t1", list(turn1), max_new_tokens=8)
+    eng.add_request(r1)
+    while not r1.done:
+        eng.step()
+    assert r1.generated == naive_greedy(params, cfg, turn1, 8)
+    follow = turn1 + r1.generated + [8, 8, 8]        # turn-2 prompt
+    r2 = Request("t2", list(follow), max_new_tokens=4)
+    eng.add_request(r2)
+    while not r2.done:
+        eng.step()
+    assert r2.generated == naive_greedy(params, cfg, follow, 4)
+    # 11 + 8 = 19 tokens of context; everything the engine wrote K/V
+    # for (up to the last generated token) is reusable.
+    assert r2.cached_prefix_tokens >= 16             # ≥ the 2 full pages
+
+
 def test_mixed_dispatch_bounds_inter_token_latency(small_model):
     """ISSUE 7 acceptance: with a 2k-ish prompt admitted mid-stream, the
     token-budget mixed schedule keeps every running stream's max
