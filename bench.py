@@ -422,6 +422,18 @@ def run_recovery_bench() -> dict:
     return _run()
 
 
+def run_migration_bench() -> dict:
+    """KV-migration cells (ROADMAP item 2): migrated vs cold TTFT at the
+    2k-prompt cell (`serve_ttft_migrated_ms` must be ≤ 0.7× the cold
+    cell) plus the raw page-transfer throughput `kv_migration_mb_s`,
+    with greedy byte parity asserted between the migrated and cold
+    serves. Implementation in ``ray_tpu/_migration_bench.py``;
+    standalone: ``python -m ray_tpu.cli bench migration``."""
+    from ray_tpu._migration_bench import run_migration_bench as _run
+
+    return _run()
+
+
 def run_serve_bench() -> dict:
     """Serve p50 TTFT north star (BASELINE.json): concurrent streaming
     completions through the REAL stack — HTTP proxy → pow-2 router →
@@ -830,6 +842,23 @@ def main() -> None:
                 ray_tpu.shutdown()
             except Exception:
                 pass
+    extra_migration: dict = {}
+    if os.environ.get("RAY_TPU_BENCH_SKIP_MIGRATION") != "1":
+        try:
+            extra_migration = run_migration_bench()
+        except Exception as e:
+            print(f"migration bench failed: {e}", file=sys.stderr)
+            extra_migration = {
+                "migration_bench_error": f"{type(e).__name__}: {e}",
+                "serve_ttft_migrated_skipped": True,
+                "kv_migration_mb_s_skipped": True,
+            }
+            try:
+                import ray_tpu
+
+                ray_tpu.shutdown()
+            except Exception:
+                pass
     value = fw["tokens_per_sec_per_chip"]
     baseline = None
     if os.path.exists("BENCH_BASELINE.json"):
@@ -855,6 +884,10 @@ def main() -> None:
         **extra_core,
         **extra_dag,
         **extra_recovery,
+        # Last: the migration bench's 2k-cell cold TTFT supersedes the
+        # serve bench's ~1.6k-prompt cold cell under the same key, so
+        # migrated-vs-cold always compares within ONE harness.
+        **extra_migration,
     }
     print(json.dumps(result))
     # Regression guard against the most recent recorded round: report-only

@@ -1,0 +1,205 @@
+"""KV-migration bench: migrated vs cold TTFT + transfer throughput.
+
+ISSUE 11 acceptance cells, runnable standalone (``python -m ray_tpu.cli
+bench migration``) or inside ``bench.py``:
+
+  * ``serve_ttft_cold_ms`` — TTFT of a never-seen ~2k-token prompt
+    through the real serve stack (proxyless driver handle → router →
+    replica → engine): the full cold prefill.
+  * ``serve_ttft_migrated_ms`` — TTFT of the SAME prompt after its
+    prefix group is forced to spill to the other replica with spill
+    migration on: the target pulls the hot KV pages from the previous
+    replica and prefills only the suffix. The acceptance bound is
+    migrated ≤ 0.7× cold at this 2k cell.
+  * ``kv_migration_parity`` — 1.0 iff the migrated request's greedy
+    bytes match the cold request's (must be 1.0).
+  * ``kv_migration_mb_s`` — raw page-transfer throughput of the
+    streaming path (TcpLoopServer wire + device copies), engine-level.
+
+CPU-sandbox friendly (debug preset engines); on chip boxes set
+``RAY_TPU_BENCH_SKIP_MIGRATION=1`` to leave ``*_skipped`` markers that
+``bench_check`` honors.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import threading
+import time
+
+SKIP_MARKERS = {
+    "serve_ttft_migrated_skipped": True,
+    "kv_migration_mb_s_skipped": True,
+}
+
+
+def _stream_ttft(handle, body: dict, timeout: float = 300.0):
+    """Drive one streaming completion through a DeploymentHandle and
+    return (ttft_s, text) from the SSE wire messages."""
+    import json
+
+    t0 = time.perf_counter()
+    stream = handle.remote_streaming(dict(body))
+    ttft = None
+    text = ""
+    try:
+        for msg in stream:
+            if msg.get("kind") != "chunk":
+                continue
+            for line in msg.get("data", b"").decode().splitlines():
+                line = line.strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                text += json.loads(line[6:])["choices"][0]["text"]
+    finally:
+        try:
+            stream.close()
+        except Exception:
+            pass
+    return ttft, text
+
+
+def _raw_transfer_mb_s(preset: str, prompt_tokens: int, page_size: int) -> float:
+    """Engine-level streaming transfer throughput: prime engine A, then
+    stream its pages to engine B over the real TCP loop channel."""
+    from ray_tpu.llm.engine import InferenceEngine, Request
+    from ray_tpu.llm.migration import KVMigrationSource, receive_kv_stream
+
+    max_len = prompt_tokens + 2 * page_size
+    a = InferenceEngine(preset, max_slots=2, max_len=max_len,
+                        page_size=page_size, prefill_chunk_size=4 * page_size)
+    prompt = [(7 + 13 * i) % 200 + 1 for i in range(prompt_tokens)]
+    r = Request("mig-src", list(prompt), max_new_tokens=1,
+                prefill_only=True, pin_for_export=True)
+    a.add_request(r)
+    while not r.done:
+        a.step()
+    # The request is already prefilled: the source streams every page at
+    # wire speed, so stats measure pure transfer (channel + device
+    # copies). Two rounds, best kept — the first pays the gather/scatter
+    # program compiles that steady-state migrations never see.
+    pages = list(r.export_pinned)
+    best = None
+    for _ in range(2):
+        with a._lock:  # re-pin: each source releases the pins when done
+            for pid in pages:
+                a.allocator.share(pid)
+        r.export_pinned = list(pages)
+        src = KVMigrationSource(a, r)
+        b = InferenceEngine(preset, max_slots=2, max_len=max_len,
+                            page_size=page_size,
+                            prefill_chunk_size=4 * page_size)
+        stats = receive_kv_stream(b, src.address, timeout_s=120.0)
+        src.close()
+        if not stats["complete"] or not stats["seconds"]:
+            raise RuntimeError(f"raw transfer failed: {stats}")
+        rate = stats["bytes"] / 1e6 / stats["seconds"]
+        best = rate if best is None else max(best, rate)
+    return best
+
+
+def run_migration_bench(samples: int | None = None) -> dict:
+    if os.environ.get("RAY_TPU_BENCH_SKIP_MIGRATION") == "1":
+        return dict(SKIP_MARKERS)
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.core.config import get_config
+    from ray_tpu.llm import build_llm_app
+
+    preset = os.environ.get("RAY_TPU_MIGRATION_PRESET", "debug-128")
+    samples = samples or int(os.environ.get("RAY_TPU_MIGRATION_SAMPLES", "3"))
+    page_size = 64
+    max_tokens = 8
+    # ~2k-token prompts under the byte tokenizer (the acceptance cell).
+    prefix_len = int(os.environ.get("RAY_TPU_MIGRATION_PROMPT", "2048")) - 64
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    app = build_llm_app(
+        preset, num_replicas=2, max_slots=8,
+        max_len=prefix_len + 64 + 4 * page_size, page_size=page_size,
+        prefill_chunk_size=256, max_ongoing_requests=32)
+    serve.run(app, name="llm-mig-bench", timeout_s=360.0)
+    out: dict = {}
+    try:
+        base = serve.get_app_handle("llm-mig-bench")
+        cfg = get_config()
+        # Warm the compile caches off the measurement.
+        warm = base.options(method_name="completions", prefix_group="mig-w")
+        _stream_ttft(warm, {"prompt": "w" * 300, "max_tokens": 4,
+                            "stream": True})
+        cold_ttfts: list[float] = []
+        mig_ttfts: list[float] = []
+        parity = 1.0
+        for i in range(-1, samples):
+            # i == -1 is an UNRECORDED warmup pair: it compiles the
+            # prefill buckets and the export/import gather/scatter
+            # programs on both replicas, so the timed cells measure
+            # steady-state migration, not first-touch XLA compiles.
+            group = f"mig-bench-{i}"
+            h = base.options(method_name="completions", prefix_group=group)
+            prompt = (f"[system prompt {i}] "
+                      + "You are a terse assistant. Answer carefully. "
+                      * (prefix_len // 47) + f" tail {i}: " + "wxyz" * 8)
+            body = {"prompt": prompt, "max_tokens": max_tokens,
+                    "stream": True}
+            t_cold, text_cold = _stream_ttft(h, body)
+            if t_cold is not None and i >= 0:
+                cold_ttfts.append(t_cold)
+            # Force the group to spill to the OTHER replica: run the
+            # affine replica's in-flight count past the spill margin, so
+            # the router ships a migrate-from source with the request.
+            router = h._get_router()
+            affine = router._group_affinity.get(group)
+            bump = cfg.serve_affinity_spill_margin + 1
+            if affine is not None:
+                with router._cond:
+                    router._inflight[affine] = \
+                        router._inflight.get(affine, 0) + bump
+            try:
+                t_mig, text_mig = _stream_ttft(h, body)
+            finally:
+                if affine is not None:
+                    with router._cond:
+                        router._inflight[affine] = max(
+                            0, router._inflight.get(affine, 0) - bump)
+            if i < 0:
+                continue
+            if t_mig is not None:
+                mig_ttfts.append(t_mig)
+            if text_mig != text_cold:
+                parity = 0.0
+        spill_migrations = router.spill_migrations
+        if cold_ttfts and mig_ttfts:
+            out["serve_ttft_cold_ms"] = round(
+                1000 * statistics.median(cold_ttfts), 1)
+            out["serve_ttft_migrated_ms"] = round(
+                1000 * statistics.median(mig_ttfts), 1)
+            out["kv_migration_parity"] = parity
+            out["serve_spill_migrations"] = spill_migrations
+        else:
+            out.update(SKIP_MARKERS)
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+    try:
+        out["kv_migration_mb_s"] = round(
+            _raw_transfer_mb_s(preset, 2048, page_size), 1)
+    except Exception as e:
+        out["kv_migration_mb_s_skipped"] = True
+        out["kv_migration_error"] = f"{type(e).__name__}: {e}"
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+    return out
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run_migration_bench()))

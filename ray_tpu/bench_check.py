@@ -35,8 +35,9 @@ _SKIP_EXACT = {
 _SKIP_SUBSTR = ("error", "preset", "metric", "unit", "cmd", "tail", "_cfg")
 # Throughput rates: ALWAYS higher-better, checked BEFORE the lower-better
 # suffixes — "core_tasks_per_s" ends in "_s" but a drop in it is the
-# regression, not an improvement.
-_HIGHER_BETTER_SUFFIX = ("_per_s", "_per_sec")
+# regression, not an improvement. "_mb_s": transfer throughput in MB/s
+# (kv_migration_mb_s), same shadowed-by-"_s" hazard.
+_HIGHER_BETTER_SUFFIX = ("_per_s", "_per_sec", "_mb_s")
 # 0-1 ratios (cache hit rates, affinity rates, fractions): higher-better
 # AND compared in POINTS like _pct — a hit rate sliding 0.90 -> 0.45 is
 # a 45-point collapse; 0.02 -> 0.01 is noise, not a 50% regression.

@@ -81,6 +81,13 @@ def _cmd_bench(args) -> int:
         ok = bool(result.get("recovery_train_resume_s") is not None
                   or result.get("recovery_serve_reroute_s") is not None)
         prefixes = ("recovery_",)
+    elif args.bench_cmd == "migration":
+        from ray_tpu._migration_bench import run_migration_bench
+
+        result = run_migration_bench(samples=args.samples)
+        ok = bool(result.get("serve_ttft_migrated_ms") is not None)
+        prefixes = ("serve_ttft_migrated", "serve_ttft_cold",
+                    "kv_migration_")
     else:
         from ray_tpu._core_bench import run_core_bench
 
@@ -191,6 +198,19 @@ def main(argv: list[str] | None = None) -> int:
                       help="preemption grace window in seconds (default "
                            "$RAY_TPU_RECOVERY_BENCH_GRACE_S or 0.5)")
     brec.add_argument("--check-against", default=None, metavar="BENCH_JSON",
+                      help="run ray_tpu.bench_check against a recorded "
+                           "BENCH_r*.json and exit non-zero on regression")
+    bmig = bench_sub.add_parser(
+        "migration", help="KV-migration cells: migrated vs cold TTFT at "
+                          "the 2k-prompt cell (serve_ttft_migrated_ms must "
+                          "beat 0.7x serve_ttft_cold_ms), greedy byte "
+                          "parity, and raw page-transfer throughput "
+                          "(kv_migration_mb_s); *_skipped markers where "
+                          "a cell can't run")
+    bmig.add_argument("--samples", type=int, default=None,
+                      help="cold/migrated prompt pairs (default "
+                           "$RAY_TPU_MIGRATION_SAMPLES or 3)")
+    bmig.add_argument("--check-against", default=None, metavar="BENCH_JSON",
                       help="run ray_tpu.bench_check against a recorded "
                            "BENCH_r*.json and exit non-zero on regression")
     serve_p = sub.add_parser(

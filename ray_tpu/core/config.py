@@ -121,6 +121,15 @@ class RayTpuConfig:
     # tests/benches shrink it. Read through the chaos clock, so a
     # VirtualClock replays the window in milliseconds.
     preempt_grace_s: float = 10.0
+    # GCE metadata-server preemption watcher: when enabled, every raylet
+    # polls the instance metadata `preempted` key (flips to TRUE ~30 s
+    # before a spot VM reclaim) and feeds the existing PreemptionNotice
+    # drain path the moment it fires — no RPC from the control plane
+    # needed. Off by default: only GCE instances have a metadata server.
+    preempt_metadata_watch: bool = False
+    preempt_metadata_url: str = ("http://metadata.google.internal/"
+                                 "computeMetadata/v1/instance/preempted")
+    preempt_metadata_poll_s: float = 1.0
     task_max_retries: int = 3
     actor_max_restarts: int = 0
     health_check_period_ms: int = 1000
@@ -251,6 +260,17 @@ class RayTpuConfig:
     serve_affinity_map_size: int = 2048
     serve_affinity_spill_margin: int = 4
     serve_prefix_group_chars: int = 256
+    # KV-page migration (disaggregated serving + spill migration): when a
+    # prefix-group request spills off its affine replica, the spill
+    # target pulls the group's hot KV pages from the previous replica
+    # instead of cold-prefilling (serve_spill_migration). Streamed
+    # migrations move kv_migration_chunk_pages pages per message over a
+    # credit-based TCP loop channel; an importer that cannot finish
+    # within kv_migration_timeout_s registers the contiguous prefix it
+    # received and cold-prefills the rest.
+    serve_spill_migration: bool = True
+    kv_migration_chunk_pages: int = 8
+    kv_migration_timeout_s: float = 60.0
 
     # --- data ----------------------------------------------------------------
     data_max_in_flight_tasks: int = 8

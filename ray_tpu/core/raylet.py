@@ -270,6 +270,9 @@ class Raylet:
         self._draining = False
         self._draining_since = 0.0
         self._drain_reason = ""
+        # GCE metadata preemption watcher (resilience/metadata_watcher),
+        # started in start() behind config preempt_metadata_watch.
+        self._metadata_watcher = None
         # Diagnostics counters (debug_state + the lease-wedge watchdog).
         self._wedge_events_total = 0
         self._oom_kills_total = 0
@@ -306,6 +309,20 @@ class Raylet:
         if get_config().log_to_driver:
             self._tasks.append(spawn(self._log_monitor_loop()))
         cfg = get_config()
+        if cfg.preempt_metadata_watch:
+            # GCE spot reclaim notice, straight from the node's own
+            # metadata server into the PreemptionNotice drain path —
+            # the watcher thread hops back onto the raylet loop.
+            from ..resilience.metadata_watcher import (
+                GceMetadataPreemptionWatcher)
+
+            loop = asyncio.get_running_loop()
+            self._metadata_watcher = GceMetadataPreemptionWatcher(
+                lambda reason: loop.call_soon_threadsafe(
+                    self.begin_draining, reason),
+                url=cfg.preempt_metadata_url,
+                poll_s=cfg.preempt_metadata_poll_s,
+            ).start()
         for _ in range(cfg.num_prestart_workers):
             self._start_worker()
 
@@ -315,6 +332,9 @@ class Raylet:
 
     async def stop(self, graceful: bool = True) -> None:
         self._shutdown = True
+        if self._metadata_watcher is not None:
+            self._metadata_watcher._stop.set()  # no join: its thread may
+            self._metadata_watcher = None       # be mid-poll; it's daemon
         for t in self._tasks:
             t.cancel()
         for w in self._workers.values():

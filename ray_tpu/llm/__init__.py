@@ -16,12 +16,15 @@ from .batch import LLMProcessorConfig, Processor, build_llm_processor
 from .engine import InferenceEngine, PageAllocator, Request
 from .executor import LocalEngineExecutor
 from .lora import LoRAServingConfig, save_adapter
+from .migration import KVMigrationSource, receive_kv_stream
 from .model import decode_step, init_pages, prefill_chunk
 from .multihost import EngineShardWorker, ShardedEngineExecutor, create_sharded_executor
 from .serving import LLMDeployment, build_llm_app
 from .tokenizer import ByteTokenizer
 
 __all__ = [
+    "KVMigrationSource",
+    "receive_kv_stream",
     "InferenceEngine",
     "LocalEngineExecutor",
     "EngineShardWorker",

@@ -22,7 +22,7 @@ import hashlib
 import itertools
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -69,6 +69,15 @@ class Request:
     shared_pages: int = 0
     partial_len: int = 0
     cow_page: int | None = None
+    # Disaggregated serving: a prefill-pool request computes its prompt's
+    # KV and retires WITHOUT sampling (finish_reason "prefilled") — the
+    # pages enter the prefix trie and ship to a decode replica instead.
+    # ``pin_for_export`` keeps the retired pages refcounted until the
+    # migration exporter releases them (``release_export_pins``), so
+    # pool pressure can never recycle a page mid-transfer.
+    prefill_only: bool = False
+    pin_for_export: bool = False
+    export_pinned: list[int] = field(default_factory=list)
     # Trace context ({"trace_id", "span_id"}) captured from the submitting
     # thread at add_request: the engine loop runs detached, so prefill/
     # decode spans parent onto this instead of any thread-local state.
@@ -116,6 +125,12 @@ class PageAllocator:
         # Partial tail blocks: parent chain hash -> {token tuple: page_id}
         self._partials: dict[bytes, dict[tuple, int]] = {}
         self._partial_pages: dict[int, tuple[bytes, tuple]] = {}
+        # Tiered-KV hook: called as ``on_evict(page_id, chain_hash)`` for
+        # every cached FULL-block page about to be recycled (the victim
+        # and its unreachable cached descendants), BEFORE its data is
+        # reused — the engine spills the page to host RAM keyed by its
+        # chain hash so a future match_prefix can restore it.
+        self.on_evict = None
 
     def available(self) -> int:
         return len(self.free) + sum(
@@ -185,6 +200,9 @@ class PageAllocator:
             if best is None or key < best[0]:
                 best = (key, p, None)
         _, victim, victim_hash = best
+        if self.on_evict is not None and victim_hash is not None:
+            # Spill BEFORE unlink/reuse: the page still holds valid K/V.
+            self.on_evict(victim, victim_hash)
         descendants = []
         if victim_hash is not None and victim_hash in self._children:
             stack = [victim_hash]
@@ -198,6 +216,8 @@ class PageAllocator:
                     p = self.prefix_map.pop(h, None)
                     self._parent.pop(h, None)
                     if p is not None:
+                        if self.on_evict is not None:
+                            self.on_evict(p, h)
                         self.page_hash.pop(p, None)
                         descendants.append(p)
         self._unlink(victim)
@@ -310,6 +330,7 @@ class InferenceEngine:
         prefill_token_budget: int | None = None,
         max_prefill_seqs_per_step: int = 2,
         decode_starvation_limit: int = 8,
+        host_kv_cache_pages: int = 0,
     ):
         self.config = PRESETS[config] if isinstance(config, str) else config
         self.mesh = mesh
@@ -390,6 +411,16 @@ class InferenceEngine:
         # page-aligned block sharing works everywhere.
         self._cow_enabled = (enable_prefix_cache and
                              getattr(executor, "supports_prefix_cow", False))
+        # Tiered KV (host-RAM spill tier under the device page pool):
+        # refcount-0 trie pages about to be evicted export to a bounded
+        # host cache keyed by chain hash, and a future match_prefix miss
+        # restores them into fresh pages instead of recomputing. 0
+        # disables the tier (evicted pages just die, as before).
+        self.host_kv_cache_pages = max(0, host_kv_cache_pages)
+        self._host_kv: "OrderedDict[bytes, dict]" = OrderedDict()
+        if self.host_kv_cache_pages and enable_prefix_cache and \
+                getattr(executor, "supports_kv_migration", False):
+            self.allocator.on_evict = self._spill_page_to_host
         self.metrics = {"prefix_hit_pages": 0, "prefix_lookup_pages": 0,
                         # True-reuse accounting: prompt tokens served from
                         # shared pages (full blocks + partial tails) vs
@@ -411,7 +442,19 @@ class InferenceEngine:
                         # compiled loop (dag/loop.py) instead of per-tick
                         # actor RPC — nonzero exactly when the executor
                         # drives a loop (sharded pp path).
-                        "dag_loop_ticks": 0}
+                        "dag_loop_ticks": 0,
+                        # KV-page migration (disaggregated serving / spill
+                        # migration): pages shipped out of / into this
+                        # engine's pool, migration round counts, import
+                        # bytes, and reservation failures that fell back
+                        # to a cold prefill.
+                        "kv_pages_exported": 0, "kv_pages_imported": 0,
+                        "kv_migrations_out": 0, "kv_migrations_in": 0,
+                        "kv_import_failures": 0, "kv_import_bytes": 0,
+                        # Tiered KV: evicted trie pages spilled to host
+                        # RAM and pages restored from it on a later hit.
+                        "host_kv_spilled_pages": 0,
+                        "host_kv_restored_pages": 0}
 
     @staticmethod
     def total_pages(max_slots: int, max_len: int, page_size: int,
@@ -493,6 +536,13 @@ class InferenceEngine:
             self.lora_manager.release(r.lora_slot)
             r.lora_slot = 0
         if r.block_table:
+            if r.pin_for_export and not r.export_pinned:
+                # Migration source: keep one ref per page past retire so
+                # the exporter can finish streaming them; released by
+                # release_export_pins once the transfer ends.
+                for pid in r.block_table:
+                    self.allocator.share(pid)
+                r.export_pinned = list(r.block_table)
             if self.enable_prefix_cache and r.finish_reason != "admission_failed":
                 # Register only pages whose K/V was actually COMPUTED: a
                 # cancel mid-prefill leaves later prompt pages holding
@@ -639,28 +689,25 @@ class InferenceEngine:
                 hits: list[int] = []
                 partial: tuple[int, int] | None = None
                 if self.enable_prefix_cache:
+                    # Hit pages (and the partial) arrive PINNED: refcounts
+                    # are bumped at match time, before any alloc can run —
+                    # alloc's LRU eviction only skips refcount>0 pages, so
+                    # an unpinned hit page could be evicted and handed
+                    # back as "fresh" (the same physical page at two
+                    # block-table positions: silent KV corruption), and
+                    # the host-tier restore path allocs mid-match.
                     hits, partial = self._prefix_hits(r)
                 # A partial hit does not shrink the reservation: the
                 # fresh allocation keeps one spare page as the reserved
                 # COW fork target, so the write-triggered fork can never
                 # fail under pressure mid-stream.
                 if self.allocator.available() < n_pages - len(hits):
+                    self._unpin_hits_locked(hits, partial)
                     break  # head-of-line: wait for pages to free
                 self._waiting.popleft()
-                # Bump hit refcounts BEFORE alloc: alloc's LRU eviction only
-                # skips refcount>0 pages, so an unshared hit page could be
-                # evicted and handed back as "fresh" — the same physical
-                # page at two block-table positions (silent KV corruption).
-                for pid in hits:
-                    self.allocator.share(pid)
-                if partial is not None:
-                    self.allocator.share(partial[0])
                 fresh = self.allocator.alloc(n_pages - len(hits))
                 if fresh is None:  # race-free under lock, but be safe
-                    for pid in hits:
-                        self.allocator.release(pid)
-                    if partial is not None:
-                        self.allocator.release(partial[0])
+                    self._unpin_hits_locked(hits, partial)
                     r.done, r.finish_reason = True, "admission_failed"
                     continue
                 if partial is not None:
@@ -742,19 +789,16 @@ class InferenceEngine:
         ps = self.page_size
         max_hit_pages = (len(r.prompt) - 1) // ps
         self.metrics["prefix_lookup_pages"] += max_hit_pages
-        h = hashlib.sha1()
-        h.update((r.model or "").encode())  # adapter-scoped prefix space
-        parent = h.digest()
-        hashes: list[bytes] = []
-        for i in range(max_hit_pages):
-            h.update(bytes(np.asarray(
-                r.prompt[i * ps:(i + 1) * ps], np.int32).tobytes()))
-            hashes.append(h.digest())
+        root, chain = self._chain_hashes(r.prompt, r.model)
+        hashes = chain[:max_hit_pages]
         hits = self.allocator.match_prefix(hashes)
+        for pid in hits:
+            self.allocator.share(pid)  # pin before anything can alloc
+        if self._host_kv and len(hits) < len(hashes):
+            hits = self._restore_host_hits(root, hashes, hits)
         partial = None
         if self._cow_enabled:
-            if hits:
-                parent = hashes[len(hits) - 1]
+            parent = hashes[len(hits) - 1] if hits else root
             remainder = r.prompt[len(hits) * ps:]
             # ≥1 computed token AND the matched rows must stay a strict
             # sub-page (a full page would be a full-block hit).
@@ -762,7 +806,81 @@ class InferenceEngine:
             if cap > 0:
                 partial = self.allocator.match_partial(
                     parent, tuple(int(t) for t in remainder), cap)
+                if partial is not None:
+                    self.allocator.share(partial[0])
         return hits, partial
+
+    def _unpin_hits_locked(self, hits: list[int],
+                           partial: tuple[int, int] | None) -> None:
+        """Drop the pins ``_prefix_hits`` took when admission cannot use
+        them (head-of-line wait, reservation failure) — the pages stay
+        cached for the retry."""
+        for pid in hits:
+            self.allocator.release(pid)
+        if partial is not None:
+            self.allocator.release(partial[0])
+
+    def _chain_hashes(self, tokens, model: str | None = None
+                      ) -> tuple[bytes, list[bytes]]:
+        """Adapter-scoped root hash plus the chain hash of every FULL
+        token block of ``tokens`` — the trie's path identities. Shared by
+        admission matching, page export, and import re-registration, so
+        a page migrated between engines lands under byte-identical
+        hashes on both sides."""
+        ps = self.page_size
+        h = hashlib.sha1()
+        h.update((model or "").encode())  # adapter-scoped prefix space
+        root = h.digest()
+        hashes: list[bytes] = []
+        for i in range(len(tokens) // ps):
+            h.update(bytes(np.asarray(
+                tokens[i * ps:(i + 1) * ps], np.int32).tobytes()))
+            hashes.append(h.digest())
+        return root, hashes
+
+    def _spill_page_to_host(self, page_id: int, chain_hash: bytes) -> None:
+        """Tiered-KV eviction hook (runs under the engine lock, inside
+        ``PageAllocator._evict_one``): pull the doomed page's K/V to host
+        RAM keyed by its chain hash, bounded LRU."""
+        try:
+            data = self.executor.export_pages([page_id])
+        except Exception:
+            return  # spill is best-effort; eviction proceeds regardless
+        self._host_kv[chain_hash] = data
+        self._host_kv.move_to_end(chain_hash)
+        while len(self._host_kv) > self.host_kv_cache_pages:
+            self._host_kv.popitem(last=False)
+        self.metrics["host_kv_spilled_pages"] += 1
+
+    def _restore_host_hits(self, root: bytes, hashes: list[bytes],
+                           hits: list[int]) -> list[int]:
+        """Extend a trie match with pages restored from the host-RAM
+        spill tier: each restored page is scattered back into a fresh
+        pool page and re-registered under its chain hash, so the suffix
+        prefill skips it exactly like a device-resident hit. The caller
+        pinned every prior hit, and each restored page keeps its alloc
+        ref (= the pin), so the LRU eviction a restore's alloc may
+        trigger can never recycle any page of this match."""
+        while len(hits) < len(hashes):
+            h = hashes[len(hits)]
+            data = self._host_kv.get(h)
+            if data is None:
+                break
+            got = self.allocator.alloc(1)
+            if got is None:
+                break
+            (pid,) = got
+            del self._host_kv[h]  # single copy: it lives on-device again
+            try:
+                self.executor.import_pages([pid], data)
+            except Exception:
+                self.allocator.release(pid)
+                break
+            parent = hashes[len(hits) - 1] if hits else root
+            self.allocator.register_prefix(pid, h, parent)
+            hits.append(pid)  # alloc ref doubles as the hit pin
+            self.metrics["host_kv_restored_pages"] += 1
+        return hits
 
     def _chunk_bucket(self, n: int) -> int:
         b = self.page_size
@@ -823,7 +941,8 @@ class InferenceEngine:
                 r.prompt[r.prefill_pos:r.prefill_pos + take],
                 np.int32).reshape(m, full)
             final = r.prefill_pos + take >= len(r.prompt)
-            handle = next(self._handle_counter) if final else None
+            handle = (next(self._handle_counter)
+                      if final and not r.prefill_only else None)
             self.executor.prefill_many(bt, tokens_m, r.prefill_pos, handle, full)
             self.metrics["prefill_chunks"] += m
             r.prefill_pos += take
@@ -836,7 +955,8 @@ class InferenceEngine:
             take = min(remaining, chunk)
             tokens[:take] = r.prompt[r.prefill_pos:r.prefill_pos + take]
             final = r.prefill_pos + take >= len(r.prompt)
-            handle = next(self._handle_counter) if final else None
+            handle = (next(self._handle_counter)
+                      if final and not r.prefill_only else None)
             self.executor.prefill(bt, tokens, r.prefill_pos, handle, take,
                                   lora_slot=r.lora_slot)
             self.metrics["prefill_chunks"] += 1
@@ -848,11 +968,21 @@ class InferenceEngine:
         # sampling — a burst of prefills costs one sampling sync total.
         with self._lock:
             if r.done:  # cancelled mid-prefill
-                self.executor.drop_handle(handle)
+                if handle is not None:
+                    self.executor.drop_handle(handle)
                 if self._prefilling and self._prefilling[0] is r:
                     self._prefilling.popleft()
                 return []
             self._prefilling.popleft()
+            if r.prefill_only:
+                # Disaggregated prefill: the prompt's KV is in the pool
+                # and (at retire) the prefix trie — nothing is sampled
+                # here; a decode replica imports the pages and samples.
+                r.done, r.finish_reason = True, "prefilled"
+                self._retire_locked(r)
+        if r.prefill_only:
+            return [{"request_id": r.request_id, "token": -1, "done": True,
+                     "finish_reason": "prefilled"}]
         self._pending_first.append((r, handle))
         return []
 
@@ -1008,7 +1138,8 @@ class InferenceEngine:
             plans.append({
                 "request": r, "block_table": bt, "tokens": tokens,
                 "start_pos": r.prefill_pos,
-                "handle": next(self._handle_counter) if final else None,
+                "handle": (next(self._handle_counter)
+                           if final and not r.prefill_only else None),
                 "take": take, "final": final,
             })
             budget -= chunk
@@ -1039,6 +1170,7 @@ class InferenceEngine:
         # Prefill bookkeeping AFTER the dispatch (mirrors
         # _prefill_chunk_one): advance positions, move finished prompts to
         # the batched first-token queue, drop handles of cancelled ones.
+        extra_events: list[dict] = []
         for p in plans:
             r = p["request"]
             self.metrics["prefill_chunks"] += 1
@@ -1051,10 +1183,18 @@ class InferenceEngine:
                 except ValueError:
                     pass  # cancel() already rebuilt the queue without it
                 if r.done:  # cancelled mid-dispatch
-                    self.executor.drop_handle(p["handle"])
+                    if p["handle"] is not None:
+                        self.executor.drop_handle(p["handle"])
+                    continue
+                if r.prefill_only:
+                    r.done, r.finish_reason = True, "prefilled"
+                    self._retire_locked(r)
+                    extra_events.append(
+                        {"request_id": r.request_id, "token": -1,
+                         "done": True, "finish_reason": "prefilled"})
                     continue
             self._pending_first.append((r, p["handle"]))
-        return self._emit_decode_events(active, tokens, K)
+        return self._emit_decode_events(active, tokens, K) + extra_events
 
     def _emit(self, r: Request, token: int) -> dict:
         r.generated.append(token)
@@ -1074,6 +1214,149 @@ class InferenceEngine:
             "done": r.done,
             "finish_reason": r.finish_reason,
         }
+
+    # ----------------------------------------------------------- KV migration
+    @property
+    def supports_kv_migration(self) -> bool:
+        """Page export/import between engines: needs the prefix trie (the
+        registration target) and an executor with the host gather/scatter
+        path (off pp; see ``LocalEngineExecutor.supports_kv_migration``)."""
+        return bool(self.enable_prefix_cache and
+                    getattr(self.executor, "supports_kv_migration", False))
+
+    def export_prefix_kv(self, prompt, model: str | None = None) -> dict | None:
+        """Export the cached KV covering ``prompt``'s longest prefix —
+        full trie blocks plus the best partial tail — as a host payload
+        an ``import_prefix_kv`` on another engine can adopt. The pages
+        are pinned across the device→host pull so pool pressure cannot
+        recycle them mid-export. Returns None when nothing is cached (or
+        migration is unsupported)."""
+        if not self.supports_kv_migration or len(prompt) < 2:
+            return None
+        ps = self.page_size
+        with self._lock:
+            root, chain = self._chain_hashes(prompt, model)
+            hashes = chain[:(len(prompt) - 1) // ps]
+            hits = self.allocator.match_prefix(hashes)
+            partial = None
+            if self._cow_enabled:
+                parent = hashes[len(hits) - 1] if hits else root
+                remainder = prompt[len(hits) * ps:]
+                cap = min(len(remainder) - 1, ps - 1)
+                if cap > 0:
+                    partial = self.allocator.match_partial(
+                        parent, tuple(int(t) for t in remainder), cap)
+            if not hits and partial is None:
+                return None
+            ids = list(hits) + ([partial[0]] if partial is not None else [])
+            for pid in ids:
+                self.allocator.share(pid)  # pin across the pull
+        try:
+            data = self.executor.export_pages(ids)
+        finally:
+            with self._lock:
+                for pid in ids:
+                    self.allocator.release(pid)
+        plen = partial[1] if partial is not None else 0
+        covered = len(hits) * ps + plen
+        self.metrics["kv_pages_exported"] += len(ids)
+        self.metrics["kv_migrations_out"] += 1
+        return {"page_size": ps, "model": model or "",
+                "tokens": [int(t) for t in prompt[:covered]],
+                "full_pages": len(hits), "partial_len": plen,
+                "k": data["k"], "v": data["v"]}
+
+    def import_prefix_kv(self, payload: dict | None) -> int:
+        """Adopt a migrated KV payload: reserve pages, scatter the data
+        in, and register the chain under the same hashes the source used
+        — a following ``add_request`` for the same prompt then maps the
+        pages as ordinary prefix hits and prefills only the cold suffix.
+        Returns the number of prompt tokens now servable from cache; 0
+        means clean fallback (pressure, geometry mismatch, unsupported)
+        and the caller simply cold-prefills."""
+        if not payload or not self.supports_kv_migration \
+                or payload.get("page_size") != self.page_size:
+            return 0
+        full_pages = int(payload.get("full_pages") or 0)
+        plen = int(payload.get("partial_len") or 0)
+        if not self._cow_enabled:
+            plen = 0  # partial tails need row-granular suffix starts
+        want = full_pages + (1 if plen else 0)
+        if want <= 0:
+            return 0
+        with self._lock:
+            pages = (self.allocator.alloc(want)
+                     if self.allocator.available() >= want else None)
+        if pages is None:
+            # Import under pressure: never evict live sequences' headroom
+            # for a cache import — the request cold-prefills instead.
+            self.metrics["kv_import_failures"] += 1
+            return 0
+        k = np.asarray(payload["k"])[:, :want]
+        v = np.asarray(payload["v"])[:, :want]
+        try:
+            self.executor.import_pages(pages, {"k": k, "v": v})
+        except Exception:
+            with self._lock:
+                for pid in pages:
+                    self.allocator.release(pid)
+            self.metrics["kv_import_failures"] += 1
+            return 0
+        return self.register_imported_chain(
+            pages, payload["tokens"], full_pages, plen,
+            model=payload.get("model") or None)
+
+    def register_imported_chain(self, page_ids: list[int], tokens,
+                                full_pages: int, partial_len: int,
+                                model: str | None = None) -> int:
+        """Register freshly imported pages in the prefix trie under the
+        chain hashes recomputed from their token ids (self-validating:
+        both engines derive identities from the data, not from trust in
+        the wire). Callers hold one alloc ref per page; registration
+        releases it, leaving the pages cached and immediately matchable.
+        A chain link that is ALREADY resident keeps the local page and
+        the duplicate import frees straight back to the pool. Returns
+        the prompt tokens covered by the (existing + new) chain."""
+        ps = self.page_size
+        with self._lock:
+            root, chain = self._chain_hashes(tokens, model)
+            parent = root
+            covered = 0
+            kept = 0
+            for i in range(min(full_pages, len(chain), len(page_ids))):
+                h, pid = chain[i], page_ids[i]
+                if self.allocator.lookup_prefix(h) is None:
+                    self.allocator.register_prefix(pid, h, parent)
+                    kept += 1
+                self.allocator.release(pid)  # cached if registered, else freed
+                parent = h
+                covered = (i + 1) * ps
+            if partial_len and len(page_ids) > full_pages:
+                pid = page_ids[full_pages]
+                tail = tuple(int(t) for t in
+                             tokens[full_pages * ps:full_pages * ps + partial_len])
+                if tail and self._cow_enabled:
+                    self.allocator.register_partial(parent, tail, pid)
+                self.allocator.release(pid)
+                if tail and self.allocator._partials.get(parent, {}) \
+                        .get(tail) is not None:
+                    # Registered now, or an equivalent entry already
+                    # resident — either way those rows are servable.
+                    covered += len(tail)
+                    if self.allocator._partials[parent][tail] == pid:
+                        kept += 1
+            self.metrics["kv_pages_imported"] += kept
+            if kept or covered:
+                self.metrics["kv_migrations_in"] += 1
+        return covered
+
+    def release_export_pins(self, r: Request) -> None:
+        """Drop the per-page refs ``pin_for_export`` took at retire; the
+        pages become ordinary evictable cache entries."""
+        with self._lock:
+            pins, r.export_pinned = r.export_pinned, []
+            for pid in pins:
+                self.allocator.release(pid)
 
     # ------------------------------------------------------------ conveniences
     def generate(self, prompt: list[int], max_new_tokens: int = 32,

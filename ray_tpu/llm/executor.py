@@ -24,6 +24,7 @@ split from vLLM's worker/executor architecture
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Any
 
 import jax
@@ -32,7 +33,7 @@ import numpy as np
 
 from ..models.llama import LlamaConfig, PRESETS, init_params
 from .model import (copy_pages, decode_loop, init_pages, mixed_dispatch,
-                    prefill_chunk, sample_first_batch)
+                    prefill_chunk, sample_first_batch, write_pages)
 
 # Backends with a real Mosaic compiler: the Pallas paged-attention kernel
 # runs native. "axon" is the remote-dispatch tunnel to the same chip.
@@ -211,6 +212,12 @@ class LocalEngineExecutor:
         self._key = jax.random.PRNGKey(seed ^ 0x5EED)
         # handle -> device hidden state [E] awaiting first-token sampling
         self._hidden: dict[int, Any] = {}
+        # Serializes every read/replace of self.pages: migration imports
+        # and exports run on REQUEST threads while the engine loop keeps
+        # dispatching (donating the pool buffer each step) — without the
+        # lock an exporter could np.asarray a just-donated (deleted)
+        # buffer, or an import could race a decode's donation.
+        self._pages_lock = threading.RLock()
 
         if self._pp > 1:
             # pp programs define their shardings via shard_map out_specs
@@ -227,6 +234,11 @@ class LocalEngineExecutor:
             # pp prefill requires page-aligned chunk starts (stage-local
             # whole-page writes), so partial-block COW sharing stays off.
             self._copy_pages = None
+            # pp pools shard layers across the pipeline's manual region;
+            # the host-array export/import path below assumes the whole
+            # [L, P, ...] pool is addressable — KV migration stays off
+            # (the one residue of this round, noted in ROADMAP).
+            self._write_pages = None
         elif self._replicated is not None:
             # Re-jit the model programs with EXPLICIT output shardings:
             # token/key/hidden outputs pinned replicated — on a
@@ -262,12 +274,16 @@ class LocalEngineExecutor:
             self._copy_pages = jax.jit(
                 copy_pages.__wrapped__, donate_argnames=("pages",),
                 out_shardings=pg)
+            self._write_pages = jax.jit(
+                write_pages.__wrapped__, donate_argnames=("pages",),
+                out_shardings=pg)
         else:
             self._decode_loop = decode_loop
             self._sample_first = sample_first_batch
             self._prefill = prefill_chunk
             self._mixed = mixed_dispatch
             self._copy_pages = copy_pages
+            self._write_pages = write_pages
 
     def _put(self, x: np.ndarray):
         """Host input -> device, replicated over the mesh when present (a
@@ -313,12 +329,14 @@ class LocalEngineExecutor:
             if self.lora_stack is not None:
                 kwargs["lora"] = self.lora_stack
                 kwargs["lora_slot"] = self._put(np.int32(lora_slot))
-        self.pages, hidden = self._prefill(
-            self.params, self.pages, self._put(block_table.astype(np.int32)),
-            self._put(tokens.astype(np.int32)),
-            self._put(np.int32(start_pos)),
-            config=self.config, page_size=self.page_size, **kwargs,
-        )
+        with self._pages_lock:
+            self.pages, hidden = self._prefill(
+                self.params, self.pages,
+                self._put(block_table.astype(np.int32)),
+                self._put(tokens.astype(np.int32)),
+                self._put(np.int32(start_pos)),
+                config=self.config, page_size=self.page_size, **kwargs,
+            )
         if handle is not None:  # final chunk: stash for first-token sampling
             self._hidden[handle] = hidden[take - 1]
 
@@ -336,12 +354,14 @@ class LocalEngineExecutor:
         chunk-pipelined dispatch (``pp_model.pp_prefill_chunks``); when
         ``handle`` is set, the LAST chunk's position ``take - 1`` hidden
         is stashed for first-token sampling."""
-        self.pages, hiddens = self._prefill_many(
-            self.params, self.pages, self._put(block_table.astype(np.int32)),
-            self._put(tokens_m.astype(np.int32)),
-            self._put(np.int32(start_pos)),
-            config=self.config, page_size=self.page_size,
-        )
+        with self._pages_lock:
+            self.pages, hiddens = self._prefill_many(
+                self.params, self.pages,
+                self._put(block_table.astype(np.int32)),
+                self._put(tokens_m.astype(np.int32)),
+                self._put(np.int32(start_pos)),
+                config=self.config, page_size=self.page_size,
+            )
         if handle is not None:
             self._hidden[handle] = hiddens[-1][take - 1]
 
@@ -409,15 +429,18 @@ class LocalEngineExecutor:
                      ).astype(np.int32))
         else:
             kwargs = self._decode_kwargs(pos, n_steps, block_tables, lora_idx)
-        toks, self._key, self.pages = self._decode_loop(
-            self.params, self.pages, self._put(block_tables.astype(np.int32)),
-            self._put(tokens.astype(np.int32)), self._put(pos.astype(np.int32)),
-            self._put(temps.astype(np.float32)),
-            self._put(eos_ids.astype(np.int32)),
-            self._put(remaining.astype(np.int32)),
-            self._key, config=self.config, page_size=self.page_size,
-            n_steps=n_steps, **kwargs,
-        )
+        with self._pages_lock:
+            toks, self._key, self.pages = self._decode_loop(
+                self.params, self.pages,
+                self._put(block_tables.astype(np.int32)),
+                self._put(tokens.astype(np.int32)),
+                self._put(pos.astype(np.int32)),
+                self._put(temps.astype(np.float32)),
+                self._put(eos_ids.astype(np.int32)),
+                self._put(remaining.astype(np.int32)),
+                self._key, config=self.config, page_size=self.page_size,
+                n_steps=n_steps, **kwargs,
+            )
         return np.asarray(toks)  # [n_steps, slots] — the one sync
 
     @property
@@ -433,9 +456,45 @@ class LocalEngineExecutor:
         (all layers, one dispatch). Ordered with the prefill/decode
         stream — the engine calls it immediately before the first chunk
         that writes into the fork."""
-        self.pages = self._copy_pages(
-            self.pages, self._put(np.asarray(src, np.int32)),
-            self._put(np.asarray(dst, np.int32)))
+        with self._pages_lock:
+            self.pages = self._copy_pages(
+                self.pages, self._put(np.asarray(src, np.int32)),
+                self._put(np.asarray(dst, np.int32)))
+
+    # --------------------------------------------------------- KV migration
+    @property
+    def supports_kv_migration(self) -> bool:
+        """Page export/import for KV migration (disaggregated serving,
+        spill migration, tiered host-RAM KV). Available off the pp path —
+        pp pools shard layers across the pipeline stages, so the
+        host-array gather/scatter below cannot address the whole pool."""
+        return self._write_pages is not None
+
+    def export_pages(self, page_ids) -> dict:
+        """Device→host gather of the named pages' K/V across every
+        layer: the wire payload of a KV migration chunk. The caller must
+        hold refcounts on the pages (the engine pins them) so the
+        allocator cannot recycle them mid-pull.
+
+        Returns ``{"k", "v"}`` host arrays of shape [L, m, KH, page, D].
+        """
+        ids = np.asarray(page_ids, np.int32)
+        with self._pages_lock:
+            k = self.pages["k"][:, ids]
+            v = self.pages["v"][:, ids]
+            return {"k": np.asarray(k), "v": np.asarray(v)}
+
+    def import_pages(self, page_ids, data) -> None:
+        """Host→device scatter of migrated page contents into freshly
+        reserved pages (one page-granular write on the donated pool —
+        never pool-sized). Thread-safe against the engine loop via the
+        pages lock; the destination pages are allocator-reserved, so the
+        write is disjoint from every live block table by construction."""
+        with self._pages_lock:
+            self.pages = self._write_pages(
+                self.pages, self._put(np.asarray(page_ids, np.int32)),
+                self._put(np.asarray(data["k"])),
+                self._put(np.asarray(data["v"])))
 
     @property
     def supports_mixed_dispatch(self) -> bool:
@@ -470,16 +529,18 @@ class LocalEngineExecutor:
             op_live.append(self._bucket_pages(
                 -(-int(p["start_pos"]) // self.page_size), bt.shape[0]))
         kwargs = self._decode_kwargs(pos, n_steps, block_tables, lora_idx)
-        toks, self._key, self.pages, hiddens = self._mixed(
-            self.params, self.pages, tuple(ops),
-            self._put(block_tables.astype(np.int32)),
-            self._put(tokens.astype(np.int32)), self._put(pos.astype(np.int32)),
-            self._put(temps.astype(np.float32)),
-            self._put(eos_ids.astype(np.int32)),
-            self._put(remaining.astype(np.int32)),
-            self._key, config=self.config, page_size=self.page_size,
-            n_steps=n_steps, prefill_live_pages=tuple(op_live), **kwargs,
-        )
+        with self._pages_lock:
+            toks, self._key, self.pages, hiddens = self._mixed(
+                self.params, self.pages, tuple(ops),
+                self._put(block_tables.astype(np.int32)),
+                self._put(tokens.astype(np.int32)),
+                self._put(pos.astype(np.int32)),
+                self._put(temps.astype(np.float32)),
+                self._put(eos_ids.astype(np.int32)),
+                self._put(remaining.astype(np.int32)),
+                self._key, config=self.config, page_size=self.page_size,
+                n_steps=n_steps, prefill_live_pages=tuple(op_live), **kwargs,
+            )
         for p, hidden in zip(prefill_plans, hiddens):
             if p.get("handle") is not None:
                 self._hidden[p["handle"]] = hidden[p["take"] - 1]

@@ -1,0 +1,253 @@
+"""Live KV-page migration between engines over a loop channel.
+
+The transfer half of disaggregated prefill/decode serving (the
+object-manager idea from PAPER.md §1 layer 4 applied to the KV cache):
+large immutable buffers — here, prefix-cache pages — MOVE between nodes
+instead of being recomputed. A prefill replica streams its request's
+pages over a credit-based ``TcpLoopServer`` (``dag/channel.py``) WHILE
+later chunks are still prefilling, and the decode replica imports each
+chunk as it arrives — so by the time the prompt finishes prefilling,
+most of its KV already sits in the decode replica's pool and handoff
+latency hides behind prefill compute.
+
+Wire protocol (pickled dicts, exactly-once, in order):
+
+    {"kind": "meta",  "page_size", "model", "prompt_len"}
+    {"kind": "pages", "tokens": [...], "k": np, "v": np}   # full blocks
+    {"kind": "tail",  "tokens": [...], "k": np, "v": np}   # partial tail
+    {"kind": "end"}                                        # complete
+    {"kind": "abort"}                                      # source failed
+
+Failure is graceful by construction: chunks arrive in chain order, so a
+source death / timeout / reservation failure mid-stream leaves the
+importer holding a contiguous PREFIX of the chain — a prefix of a valid
+chain is itself a valid chain, so it registers what it has and the
+request cold-prefills only the rest.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+
+import numpy as np
+
+from ..dag.channel import ChannelClosed, TcpLoopReader, TcpLoopServer
+
+
+def _config():
+    from ..core.config import get_config
+
+    return get_config()
+
+
+class KVMigrationSource:
+    """Prefill-side exporter: streams one (possibly still prefilling)
+    request's prefix pages as they complete.
+
+    The request must be admitted with ``pin_for_export=True`` so its
+    pages survive retire until the transfer finishes; pages exported
+    while the request is live are additionally pinned around each
+    device→host pull. One background thread per migration; the server
+    socket closes via :meth:`close` once the consumer is done (or on
+    garbage collection of the socket)."""
+
+    def __init__(self, engine, request, chunk_pages: int | None = None,
+                 advertise: str | None = None,
+                 _die_after_chunks: int | None = None):
+        assert request.pin_for_export, \
+            "migration sources require pin_for_export=True requests"
+        self.engine = engine
+        self.request = request
+        self.chunk_pages = max(1, chunk_pages
+                               or _config().kv_migration_chunk_pages)
+        self._server = TcpLoopServer(n_slots=8, n_readers=1,
+                                     advertise=advertise)
+        # Test/chaos hook: hard-kill the channel after N chunks, as a
+        # dead prefill replica would.
+        self._die_after = _die_after_chunks
+        self._killed = False
+        self.stats = {"pages": 0, "bytes": 0, "chunks": 0}
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="kv-migration-src")
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return self._server.address
+
+    def _send(self, msg: dict) -> None:
+        blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        self._server.write(blob, timeout=_config().kv_migration_timeout_s)
+        self.stats["bytes"] += len(blob)
+
+    def _export_pinned(self, page_ids: list[int]) -> dict:
+        """Pull pages with a transient extra pin: a live request's own
+        refcount usually covers them, but a cancel can retire mid-pull."""
+        eng = self.engine
+        with eng._lock:
+            for pid in page_ids:
+                eng.allocator.share(pid)
+        try:
+            return eng.executor.export_pages(page_ids)
+        finally:
+            with eng._lock:
+                for pid in page_ids:
+                    eng.allocator.release(pid)
+
+    def _run(self) -> None:
+        eng, r = self.engine, self.request
+        ps = eng.page_size
+        # The last prompt token's hidden state is always recomputed on
+        # the importer (it seeds sampling), so cap full blocks exactly
+        # like admission matching does.
+        cap_full = (len(r.prompt) - 1) // ps
+        sent = 0
+        try:
+            self._send({"kind": "meta", "page_size": ps,
+                        "model": r.model or "",
+                        "prompt_len": len(r.prompt)})
+            while True:
+                with eng._lock:
+                    done, reason = r.done, r.finish_reason
+                    pos = r.prefill_pos
+                    table = list(r.block_table) or list(r.export_pinned)
+                avail = min(pos // ps, cap_full)
+                while sent < avail:
+                    hi = min(sent + self.chunk_pages, avail)
+                    data = self._export_pinned(table[sent:hi])
+                    self._send({"kind": "pages",
+                                "tokens": [int(t) for t in
+                                           r.prompt[sent * ps:hi * ps]],
+                                "k": data["k"], "v": data["v"]})
+                    self.stats["pages"] += hi - sent
+                    self.stats["chunks"] += 1
+                    sent = hi
+                    if self._die_after is not None \
+                            and self.stats["chunks"] >= self._die_after:
+                        self._killed = True
+                        self._server.close()  # simulated source death
+                        return
+                if done:
+                    break
+                time.sleep(0.002)
+            if reason in ("prefilled", "stop", "length"):
+                plen = len(r.prompt) - cap_full * ps  # tail rows, 1..page
+                if plen > 0 and len(table) > cap_full:
+                    data = self._export_pinned([table[cap_full]])
+                    self._send({"kind": "tail",
+                                "tokens": [int(t) for t in
+                                           r.prompt[cap_full * ps:]],
+                                "k": data["k"], "v": data["v"]})
+                    self.stats["pages"] += 1
+                self._send({"kind": "end"})
+                eng.metrics["kv_pages_exported"] += self.stats["pages"]
+                eng.metrics["kv_migrations_out"] += 1
+            else:  # cancelled / admission_failed: nothing trustworthy
+                self._send({"kind": "abort"})
+        except Exception:
+            try:
+                self._send({"kind": "abort"})
+            except Exception:
+                pass
+        finally:
+            try:
+                # Close-after-drain: queued chunks (and the end marker)
+                # still reach the reader, then it sees ChannelClosed.
+                self._server.close_writer(timeout=5.0)
+            except Exception:
+                pass
+            eng.release_export_pins(r)
+
+    def join(self, timeout: float | None = 30.0) -> None:
+        self._thread.join(timeout)
+
+    def close(self) -> None:
+        """Release the server socket (after the consumer drained — the
+        STOP already queued by the exporter thread)."""
+        self._thread.join(timeout=5.0)
+        try:
+            self._server.close()
+        except Exception:
+            pass
+
+
+def receive_kv_stream(engine, address: str, timeout_s: float | None = None,
+                      connect_timeout: float = 10.0) -> dict:
+    """Decode-side importer: pull a migration stream into ``engine``'s
+    pool, chunk by chunk (overlapping the source's still-running
+    prefill), then register the received chain so the next admission of
+    the same prompt maps it as ordinary prefix hits.
+
+    Degrades, never fails: an incompatible geometry drops the stream, a
+    reservation failure or source death mid-stream registers the
+    contiguous prefix received so far, and the caller's request simply
+    cold-prefills whatever is left. Returns stats:
+    ``{"cached_tokens", "pages", "bytes", "seconds", "complete",
+    "status"}``."""
+    t0 = time.monotonic()
+    stats = {"cached_tokens": 0, "pages": 0, "bytes": 0, "seconds": 0.0,
+             "complete": False, "status": "ok"}
+    if timeout_s is None:
+        timeout_s = _config().kv_migration_timeout_s
+    page_ids: list[int] = []
+    tokens: list[int] = []
+    full_pages = 0
+    partial_len = 0
+    model = ""
+    reader = None
+    try:
+        reader = TcpLoopReader(address, connect_timeout=connect_timeout)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            blob = reader.read(
+                timeout=max(0.1, deadline - time.monotonic()))
+            stats["bytes"] += len(blob)
+            msg = pickle.loads(blob)
+            kind = msg.get("kind")
+            if kind == "meta":
+                if msg.get("page_size") != engine.page_size \
+                        or not engine.supports_kv_migration:
+                    stats["status"] = "incompatible"
+                    break
+                model = msg.get("model") or ""
+            elif kind in ("pages", "tail"):
+                n = int(np.asarray(msg["k"]).shape[1])
+                with engine._lock:
+                    ids = (engine.allocator.alloc(n)
+                           if engine.allocator.available() >= n else None)
+                if ids is None:
+                    # Pool pressure: keep the prefix already imported,
+                    # never evict live sequences' headroom for more.
+                    engine.metrics["kv_import_failures"] += 1
+                    stats["status"] = "pressure"
+                    break
+                engine.executor.import_pages(
+                    ids, {"k": msg["k"], "v": msg["v"]})
+                page_ids.extend(ids)
+                tokens.extend(int(t) for t in msg["tokens"])
+                stats["pages"] += n
+                if kind == "tail":
+                    partial_len = len(msg["tokens"])
+                else:
+                    full_pages += n
+            elif kind == "end":
+                stats["complete"] = True
+                break
+            elif kind == "abort":
+                stats["status"] = "aborted"
+                break
+    except (ChannelClosed, TimeoutError, ConnectionError, OSError,
+            EOFError, pickle.UnpicklingError) as e:
+        stats["status"] = type(e).__name__
+    finally:
+        if reader is not None:
+            reader.close()
+    if page_ids:
+        stats["cached_tokens"] = engine.register_imported_chain(
+            page_ids, tokens, full_pages, partial_len,
+            model=model or None)
+        engine.metrics["kv_import_bytes"] += stats["bytes"]
+    stats["seconds"] = round(time.monotonic() - t0, 6)
+    return stats

@@ -416,6 +416,22 @@ def copy_pages(pages: dict, src, dst):
             "v": pages["v"].at[:, dst].set(pages["v"][:, src])}
 
 
+@functools.partial(jax.jit, donate_argnames=("pages",))
+def write_pages(pages: dict, dst, k_rows, v_rows):
+    """KV-migration import: scatter transferred page contents into pages
+    ``dst`` across every layer — the inverse of the export gather, and
+    the device half of ``import_pages``. Like ``copy_pages`` this is
+    page-granular on the donated pool (one scatter, never pool-sized),
+    and the destination pages are freshly reserved by the allocator, so
+    the write can never alias a live sequence's pages.
+
+    dst: [m] int32 page ids; k_rows/v_rows: [L, m, KH, page, D] host
+    arrays (the wire format of a migration chunk).
+    """
+    return {"k": pages["k"].at[:, dst].set(k_rows.astype(pages["k"].dtype)),
+            "v": pages["v"].at[:, dst].set(v_rows.astype(pages["v"].dtype))}
+
+
 @functools.wraps(_decode_logits)
 def _decode_step(*args, **kwargs):
     logits, pages, _ = _decode_logits(*args, **kwargs)

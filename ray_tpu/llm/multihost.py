@@ -17,6 +17,7 @@ never leave the shards.
 
 from __future__ import annotations
 
+import threading
 from typing import Any
 
 import numpy as np
@@ -118,6 +119,22 @@ class EngineShardWorker:
         self.executor.copy_pages(src, dst)
         return True
 
+    def supports_migration(self) -> bool:
+        """KV page export/import. Single-host groups only for now: a
+        multi-process mesh shards the pool across hosts, so one shard
+        cannot materialize the full [L, m, ...] page payload (residue —
+        a per-shard chunked wire format would lift this)."""
+        return bool(self.executor is not None and self.world == 1
+                    and getattr(self.executor, "supports_kv_migration",
+                                False))
+
+    def export_pages(self, page_ids):
+        return self.executor.export_pages(page_ids)
+
+    def import_pages(self, page_ids, data) -> bool:
+        self.executor.import_pages(page_ids, data)
+        return True
+
     def mixed(self, prefill_plans, block_tables, tokens, pos, temps, eos_ids,
               remaining, n_steps, lora_idx=None):
         return self.executor.mixed(
@@ -158,9 +175,16 @@ class ShardedEngineExecutor:
         self.use_compiled_loop = use_compiled_loop
         # Set after build() by create_sharded_executor: whether every
         # shard's local executor takes the fused mixed entry point /
-        # the COW prefix-sharing ops.
+        # the COW prefix-sharing ops / the KV-migration page ops.
         self.supports_mixed_dispatch = False
         self.supports_prefix_cow = False
+        self.supports_kv_migration = False
+        # Serializes each operation's whole per-shard dispatch sequence:
+        # KV imports/exports arrive on REQUEST threads while the engine
+        # loop keeps fanning steps out, and an interleave inside one
+        # operation's shard sequence would break the SPMD program-order
+        # invariant (and corrupt the compiled loop's channel FIFO).
+        self._dispatch_lock = threading.RLock()
 
     # ---------------------------------------------------- compiled loop
     def _ensure_loop(self):
@@ -201,28 +225,31 @@ class ShardedEngineExecutor:
         LocalEngineExecutor's pure-dispatch prefill — one blocking round
         trip per CHUNK would wreck TTFT). Errors surface at the next
         sync point."""
-        if self.use_compiled_loop:
-            self._loop_put(method, *args)
-            return
-        self._pending.extend(
-            getattr(s, method).remote(*args) for s in self.shards)
+        with self._dispatch_lock:
+            if self.use_compiled_loop:
+                self._loop_put(method, *args)
+                return
+            self._pending.extend(
+                getattr(s, method).remote(*args) for s in self.shards)
 
     def _sync(self, timeout: float = 300.0) -> None:
-        if self.use_compiled_loop:
-            self._loop_drain(keep_last=False, timeout=timeout)
-            return
-        if self._pending:
-            pending, self._pending = self._pending, []
-            ray.get(pending, timeout=timeout)
+        with self._dispatch_lock:
+            if self.use_compiled_loop:
+                self._loop_drain(keep_last=False, timeout=timeout)
+                return
+            if self._pending:
+                pending, self._pending = self._pending, []
+                ray.get(pending, timeout=timeout)
 
     def _all(self, method: str, *args, timeout: float = 300.0):
-        if self.use_compiled_loop:
-            self._loop_drain(keep_last=False, timeout=timeout)
-            self._loop_put(method, *args)
-            return list(self._loop_drain(keep_last=True, timeout=timeout))
-        self._sync(timeout)
-        refs = [getattr(s, method).remote(*args) for s in self.shards]
-        return ray.get(refs, timeout=timeout)
+        with self._dispatch_lock:
+            if self.use_compiled_loop:
+                self._loop_drain(keep_last=False, timeout=timeout)
+                self._loop_put(method, *args)
+                return list(self._loop_drain(keep_last=True, timeout=timeout))
+            self._sync(timeout)
+            refs = [getattr(s, method).remote(*args) for s in self.shards]
+            return ray.get(refs, timeout=timeout)
 
     def prefill(self, block_table, tokens, start_pos, handle, take,
                 lora_slot: int = 0) -> None:
@@ -237,6 +264,18 @@ class ShardedEngineExecutor:
         shard copies the page before the chunk that writes into it."""
         self._dispatch("copy_pages",
                        [int(s) for s in src], [int(d) for d in dst])
+
+    def export_pages(self, page_ids) -> dict:
+        """KV-migration export: one shard's full-pool gather (single-host
+        groups — see ``EngineShardWorker.supports_migration``). Rides the
+        ordered stream so every prior prefill write is visible."""
+        return self._all("export_pages", [int(p) for p in page_ids])[0]
+
+    def import_pages(self, page_ids, data) -> None:
+        """KV-migration import fan-out, ordered with the dispatch stream
+        so no shard can read the pages before the scatter lands."""
+        self._dispatch("import_pages", [int(p) for p in page_ids],
+                       {k: np.asarray(v) for k, v in data.items()})
 
     def install_adapter(self, slot, arrays) -> None:
         """LoRA fan-out: the adapter's padded A/B arrays land on every
@@ -359,6 +398,8 @@ def create_sharded_executor(
             shards[0].supports_mixed.remote(), timeout=60))
         executor.supports_prefix_cow = bool(ray.get(
             shards[0].supports_cow.remote(), timeout=60))
+        executor.supports_kv_migration = bool(ray.get(
+            shards[0].supports_migration.remote(), timeout=60))
         if use_compiled_loop:
             # Install the resident tick executors NOW (one submit per
             # shard — the last tasks this executor ever submits).

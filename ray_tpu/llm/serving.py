@@ -100,6 +100,9 @@ class LLMDeployment:
         max_prefill_seqs_per_step: int = 2,
         decode_starvation_limit: int = 8,
         use_compiled_loop: bool | None = None,
+        role: str = "unified",
+        decode_handle=None,
+        host_kv_cache_pages: int = 0,
     ):
         mesh = None
         executor = None
@@ -161,7 +164,20 @@ class LLMDeployment:
             prefill_token_budget=prefill_token_budget,
             max_prefill_seqs_per_step=max_prefill_seqs_per_step,
             decode_starvation_limit=decode_starvation_limit,
+            host_kv_cache_pages=host_kv_cache_pages,
         )
+        # Disaggregated serving (DistServe-style prefill/decode split):
+        # a "prefill"-role replica chunk-prefills prompts locally, ships
+        # the KV pages to a decode replica over a migration stream, and
+        # relays the decode replica's token stream; "decode" replicas
+        # additionally accept migrated handoffs. "unified" (default) is
+        # the classic one-pool deployment.
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"unknown role {role!r}")
+        self._role = role
+        self._decode_handle = decode_handle
+        if role == "prefill" and decode_handle is None:
+            raise ValueError("role='prefill' needs a decode_handle")
         self.model_id = model_id or (preset if isinstance(preset, str) else "custom")
         self.tokenizer = ByteTokenizer()
         if self.tokenizer.vocab_size > self.engine.config.vocab_size:
@@ -266,6 +282,7 @@ class LLMDeployment:
         """Blocking completion; many calls run concurrently on replica
         threads and share the engine's decode batch. ``model`` other than
         the base model id selects a LoRA adapter."""
+        self._maybe_spill_migrate(prompt, model)
         ids = self.tokenizer.encode(prompt)
         rid = self._next_rid()
         req = Request(rid, ids, max_new_tokens, temperature,
@@ -333,18 +350,55 @@ class LLMDeployment:
     # ------------------------------------------------------- OpenAI routes
     def completions(self, body: dict):
         """POST /v1/completions (OpenAI-compatible; reference
-        ``routers/router.py:173``). ``"stream": true`` => SSE generator."""
+        ``routers/router.py:173``). ``"stream": true`` => SSE generator.
+        On a prefill-pool replica the request is prefilled locally and
+        handed off to a decode replica (``_disagg_request``)."""
         prompt = body.get("prompt", "")
         if isinstance(prompt, list):
             prompt = prompt[0] if prompt else ""
+        if self._role == "prefill" and self._decode_handle is not None:
+            return self._disagg_request(body, prompt, chat=False)
+        return self._local_completion(body, prompt, chat=False)
+
+    def chat_completions(self, body: dict):
+        """POST /v1/chat/completions: flatten messages with a minimal
+        template, then the completion path."""
+        prompt = _render_chat(body.get("messages", []))
+        if self._role == "prefill" and self._decode_handle is not None:
+            return self._disagg_request(body, prompt, chat=True)
+        return self._local_completion(body, prompt, chat=True)
+
+    def _local_completion(self, body: dict, prompt: str, chat: bool):
+        """Serve one completion on THIS replica's engine (the unified
+        path, and the decode half of a disaggregated handoff)."""
         max_tokens = int(body.get("max_tokens", 16))
         temperature = float(body.get("temperature", 0.0))
-        cid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        cid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
+               else f"cmpl-{uuid.uuid4().hex[:24]}")
         created = int(time.time())
         if not body.get("stream"):
             out = self.generate(prompt, max_tokens, temperature,
                                 model=body.get("model"),
                                 session_id=body.get("session_id"))
+            usage = {
+                "prompt_tokens": len(self.tokenizer.encode(prompt)),
+                "completion_tokens": out["num_generated"],
+                "total_tokens": len(self.tokenizer.encode(prompt))
+                + out["num_generated"],
+            }
+            if chat:
+                return {
+                    "id": cid, "object": "chat.completion",
+                    "created": created,
+                    "model": body.get("model", self.model_id),
+                    "choices": [{
+                        "index": 0,
+                        "message": {"role": "assistant",
+                                    "content": out["text"]},
+                        "finish_reason": _openai_finish(out["finish_reason"]),
+                    }],
+                    "usage": usage,
+                }
             return {
                 "id": cid, "object": "text_completion", "created": created,
                 "model": body.get("model", self.model_id),
@@ -353,41 +407,183 @@ class LLMDeployment:
                     "finish_reason": _openai_finish(out["finish_reason"]),
                     "logprobs": None,
                 }],
-                "usage": {
-                    "prompt_tokens": len(self.tokenizer.encode(prompt)),
-                    "completion_tokens": out["num_generated"],
-                    "total_tokens": len(self.tokenizer.encode(prompt)) + out["num_generated"],
-                },
+                "usage": usage,
             }
-        return self._sse_completion_stream(body, prompt, cid, created, chat=False)
+        return self._sse_completion_stream(body, prompt, cid, created,
+                                           chat=chat)
 
-    def chat_completions(self, body: dict):
-        """POST /v1/chat/completions: flatten messages with a minimal
-        template, then the completion path."""
-        prompt = _render_chat(body.get("messages", []))
-        cid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
-        created = int(time.time())
+    # -------------------------------------------- disaggregated serving
+    def migrated_completions(self, migration: dict, body: dict):
+        """Decode-pool entry point for a disaggregated handoff: pull the
+        prefill replica's KV pages over the migration stream (the import
+        overlaps the source's still-running prefill), register them, and
+        serve the request as an ordinary local completion — admission
+        maps the imported prefix, so only the final prompt token's
+        hidden state is computed here before decode begins."""
+        migration = migration or {}
+        chat = bool(migration.get("chat"))
+        if chat:
+            prompt = _render_chat(body.get("messages", []))
+        else:
+            prompt = body.get("prompt", "")
+            if isinstance(prompt, list):
+                prompt = prompt[0] if prompt else ""
+        self._import_migration(migration)
+        return self._local_completion(body, prompt, chat=chat)
+
+    def _import_migration(self, migration: dict) -> None:
+        addr = migration.get("kv_address")
+        if not addr or not self.engine.supports_kv_migration:
+            return
+        t0w = time.time()
+        try:
+            from .migration import receive_kv_stream
+
+            stats = receive_kv_stream(self.engine, addr)
+            attrs = {k: stats.get(k) for k in
+                     ("cached_tokens", "pages", "bytes", "seconds",
+                      "complete", "status")}
+        except Exception as e:  # never fail the request over a transfer
+            attrs = {"status": f"{type(e).__name__}: {e}",
+                     "complete": False}
+        attrs["kind"] = "disagg_handoff"
+        self._record_kv_migrate_span(t0w, attrs)
+
+    def _disagg_request(self, body: dict, prompt: str, chat: bool):
+        """Prefill-pool ingress (DistServe-style split): chunk-prefill
+        the prompt on THIS replica (``prefill_only`` — no token is
+        sampled), stream its KV pages to a decode replica WHILE later
+        chunks are still prefilling, and relay the decode replica's
+        response. TTFT-bound prefill and ITL-bound decode never share a
+        replica, and the handoff latency hides behind prefill compute.
+        If the decode pool is unreachable the request falls back to
+        local serving — the prefix just prefilled is cached, so the
+        fallback costs one suffix token."""
+        from .migration import KVMigrationSource
+
+        ids = self.tokenizer.encode(prompt)
+        migration: dict = {"chat": chat}
+        src = None
+        rid = None
+        if self.engine.supports_kv_migration and len(ids) > 1 \
+                and not body.get("model"):
+            rid = self._next_rid()
+            req = Request(rid, list(ids), max_new_tokens=1,
+                          prefill_only=True, pin_for_export=True)
+            # Invalid prompts raise here, exactly like the local path.
+            self.engine.add_request(req)
+            try:
+                src = KVMigrationSource(self.engine, req)
+                migration["kv_address"] = src.address
+                migration["prompt_len"] = len(ids)
+            except Exception:
+                self.engine.cancel(rid)
+                src = None
+        group = self._group_of(prompt, body.get("session_id"))
+        handle = self._decode_handle.options(
+            method_name="migrated_completions",
+            prefix_group=group or f"mig:{uuid.uuid4().hex[:8]}")
         if not body.get("stream"):
-            out = self.generate(
-                prompt, int(body.get("max_tokens", 16)),
-                float(body.get("temperature", 0.0)),
-                model=body.get("model"),
-                session_id=body.get("session_id"))
-            return {
-                "id": cid, "object": "chat.completion", "created": created,
-                "model": body.get("model", self.model_id),
-                "choices": [{
-                    "index": 0,
-                    "message": {"role": "assistant", "content": out["text"]},
-                    "finish_reason": _openai_finish(out["finish_reason"]),
-                }],
-                "usage": {
-                    "prompt_tokens": len(self.tokenizer.encode(prompt)),
-                    "completion_tokens": out["num_generated"],
-                    "total_tokens": len(self.tokenizer.encode(prompt)) + out["num_generated"],
-                },
-            }
-        return self._sse_completion_stream(body, prompt, cid, created, chat=True)
+            try:
+                out = handle.remote(migration, body).result(
+                    timeout=self.request_timeout_s)
+            except Exception:
+                out = None
+            finally:
+                if src is not None:
+                    src.close()
+            if out is not None:
+                return out
+            return self._local_completion(body, prompt, chat)
+        try:
+            stream = handle.remote_streaming(migration, body)
+        except Exception:
+            # decode pool unreachable: serve locally off the hot prefix
+            if rid is not None:
+                self.engine.cancel(rid)
+            if src is not None:
+                src.close()
+            return self._local_completion(body, prompt, chat)
+
+        def relay():
+            try:
+                for msg in stream:
+                    kind = msg.get("kind")
+                    if kind == "start":
+                        yield {"__serve_response__": True,
+                               "content_type": msg.get(
+                                   "content_type", "text/event-stream")}
+                    elif kind == "chunk":
+                        yield msg.get("data", b"")
+                    elif kind == "error":
+                        raise RuntimeError(msg.get("error", "decode failed"))
+                    elif kind == "full":
+                        yield json.dumps(msg.get("data")).encode()
+            finally:
+                try:
+                    stream.close()
+                except Exception:
+                    pass
+                if rid is not None:
+                    self.engine.cancel(rid)  # no-op once prefilled
+                if src is not None:
+                    src.close()
+
+        return relay()
+
+    def export_prefix_kv(self, prompt: str, model: str | None = None):
+        """Handle/actor entry point (spill migration): export this
+        replica's cached KV covering ``prompt``'s longest prefix, for a
+        spill target to import instead of recomputing."""
+        ids = self.tokenizer.encode(prompt)
+        return self.engine.export_prefix_kv(ids, self._adapter_for(model))
+
+    def _maybe_spill_migrate(self, prompt: str,
+                             model: str | None = None) -> None:
+        """An affinity spill used to throw the group's cached KV away
+        (PR-10 residue b): when the router ships the previous affine
+        replica's identity with a spilled request, pull the group's hot
+        pages from it and import them — migrate-instead-of-recompute,
+        with disaggregation on OR off. Failure of any step falls back to
+        the old behavior (cold prefill)."""
+        from ..serve.router import get_migration_source
+
+        src = get_migration_source()
+        if not src or not self.engine.supports_kv_migration:
+            return
+        from ..core.config import get_config
+
+        if not get_config().serve_spill_migration:
+            return
+        t0w = time.time()
+        attrs: dict = {"kind": "spill", "source": src.get("replica_id", "")}
+        try:
+            from ..core import api as ray
+            from ..core.api import ActorHandle
+
+            actor = ActorHandle(bytes.fromhex(src["actor_id"]))
+            payload = ray.get(
+                actor.handle_request.remote(
+                    "export_prefix_kv", (prompt, model), {}),
+                timeout=30)
+            attrs["cached_tokens"] = self.engine.import_prefix_kv(payload)
+        except Exception as e:
+            attrs["status"] = f"{type(e).__name__}: {e}"
+        self._record_kv_migrate_span(t0w, attrs)
+
+    def _record_kv_migrate_span(self, t0w: float, attrs: dict) -> None:
+        """One ``llm.kv_migrate`` span per migration (disagg handoff or
+        spill pull), chained under the request's trace context."""
+        try:
+            from ..observability import tracing
+
+            ctx = tracing.current()
+            tracing.record_span(tracing.make_span(
+                "llm.kv_migrate", "llm", t0w, time.time(),
+                ctx.trace_id if ctx else tracing.new_trace_id(),
+                ctx.span_id if ctx else "", attrs=attrs))
+        except Exception:
+            pass
 
     def _sse_completion_stream(self, body: dict, prompt: str, cid: str,
                                created: int, chat: bool):
@@ -406,6 +602,7 @@ class LLMDeployment:
         group = self._group_of(prompt, body.get("session_id"))
 
         def gen():
+            self._maybe_spill_migrate(prompt, body.get("model"))
             yield {"__serve_response__": True, "content_type": "text/event-stream"}
             if chat:
                 head = {"id": cid, "object": obj, "created": created, "model": model,
@@ -437,7 +634,9 @@ class LLMDeployment:
         return {**self.engine.metrics,
                 "prefix_cache_hit_rate": self.engine.prefix_cache_hit_rate,
                 "prefill_suffix_frac": self.engine.prefill_suffix_frac,
-                "mixed_dispatch_enabled": self.engine.mixed_dispatch_enabled}
+                "mixed_dispatch_enabled": self.engine.mixed_dispatch_enabled,
+                "role": self._role,
+                "supports_kv_migration": self.engine.supports_kv_migration}
 
     # ---------------------------------------------------------- HTTP entry
     def __call__(self, request):
@@ -490,7 +689,10 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
                   prefill_token_budget: int | None = None,
                   max_prefill_seqs_per_step: int = 2,
                   decode_starvation_limit: int = 8,
-                  use_compiled_loop: bool | None = None):
+                  use_compiled_loop: bool | None = None,
+                  serve_disaggregation: str | None = None,
+                  prefill_replicas: int = 1,
+                  host_kv_cache_pages: int = 0):
     """Build a Serve Application serving ``preset`` (serve.run-able).
     Pass ``ray_actor_options={"resources": {"TPU": 1}, ...}`` to pin each
     replica (engine) to a TPU chip. For an engine that SPANS hosts, set
@@ -498,25 +700,59 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
     ``{"TPU": 4, "CPU": 1}``) and optionally ``topology`` (slice type,
     claims the slice-head resource) — the replica then schedules requests
     while per-host shard actors execute the model SPMD over the joint
-    mesh (reference vllm_models.py:117-168)."""
+    mesh (reference vllm_models.py:117-168).
+
+    ``serve_disaggregation="prefill_decode"`` builds the DistServe-style
+    split instead of one replica pool: ``prefill_replicas`` ingress
+    replicas ("llm-prefill" pool) chunk-prefill prompts and live-migrate
+    the KV pages to the ``num_replicas`` decode replicas ("llm-decode"
+    pool), which own all token streaming — TTFT-bound and ITL-bound work
+    never compete for a replica, and an affinity spill inside either
+    pool migrates pages instead of recomputing them."""
     from ..serve import deployment
 
-    dep = deployment(
+    engine_kwargs = dict(
+        model_id=model_id, max_slots=max_slots, max_len=max_len,
+        page_size=page_size, prefill_chunk_size=prefill_chunk_size,
+        decode_steps_per_dispatch=decode_steps_per_dispatch,
+        tensor_parallel=tensor_parallel,
+        pipeline_parallel=pipeline_parallel, num_hosts=num_hosts,
+        shard_resources=shard_resources,
+        shard_runtime_env=shard_runtime_env, topology=topology,
+        attention_impl=attention_impl,
+        prefill_token_budget=prefill_token_budget,
+        max_prefill_seqs_per_step=max_prefill_seqs_per_step,
+        decode_starvation_limit=decode_starvation_limit,
+        use_compiled_loop=use_compiled_loop,
+        host_kv_cache_pages=host_kv_cache_pages)
+    if serve_disaggregation is None:
+        dep = deployment(
+            LLMDeployment,
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            autoscaling_config=autoscaling_config,
+            ray_actor_options=ray_actor_options,
+        )
+        return dep.bind(preset, **engine_kwargs)
+    if serve_disaggregation != "prefill_decode":
+        raise ValueError(
+            f"unknown serve_disaggregation {serve_disaggregation!r} "
+            "(use 'prefill_decode' or None)")
+    decode_app = deployment(
         LLMDeployment,
+        name="llm-decode",
         num_replicas=num_replicas,
         max_ongoing_requests=max_ongoing_requests,
         autoscaling_config=autoscaling_config,
         ray_actor_options=ray_actor_options,
-    )
-    return dep.bind(preset, model_id=model_id, max_slots=max_slots, max_len=max_len,
-                    page_size=page_size, prefill_chunk_size=prefill_chunk_size,
-                    decode_steps_per_dispatch=decode_steps_per_dispatch,
-                    tensor_parallel=tensor_parallel,
-                    pipeline_parallel=pipeline_parallel, num_hosts=num_hosts,
-                    shard_resources=shard_resources,
-                    shard_runtime_env=shard_runtime_env, topology=topology,
-                    attention_impl=attention_impl,
-                    prefill_token_budget=prefill_token_budget,
-                    max_prefill_seqs_per_step=max_prefill_seqs_per_step,
-                    decode_starvation_limit=decode_starvation_limit,
-                    use_compiled_loop=use_compiled_loop)
+        pool="decode",
+    ).bind(preset, role="decode", **engine_kwargs)
+    return deployment(
+        LLMDeployment,
+        name="llm-prefill",
+        num_replicas=max(1, prefill_replicas),
+        max_ongoing_requests=max_ongoing_requests,
+        ray_actor_options=ray_actor_options,
+        pool="prefill",
+    ).bind(preset, role="prefill", decode_handle=decode_app,
+           **engine_kwargs)

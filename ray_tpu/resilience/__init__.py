@@ -24,10 +24,12 @@ from .checkpoint import (
     load_checkpoint,
     register_latest,
 )
+from .metadata_watcher import GceMetadataPreemptionWatcher
 from .preemption import PreemptionNotice, hazard_nodes
 
 __all__ = [
     "AsyncCheckpointManager",
+    "GceMetadataPreemptionWatcher",
     "PreemptionNotice",
     "hazard_nodes",
     "latest_committed",

@@ -81,6 +81,7 @@ def _deployment_config(app: Application, app_name: str) -> dict:
         "num_replicas": d.num_replicas,
         "max_ongoing": d.max_ongoing_requests,
         "user_config": getattr(d, "user_config", None),
+        "pool": getattr(d, "pool", None),
         "ray_actor_options": d.ray_actor_options,
         "autoscaling": (
             {

@@ -182,6 +182,9 @@ class ServeController:
                     "target_replicas": state.target_replicas,
                     "running_replicas": len(running),
                     "version": state.version,
+                    # Disaggregated pool membership ("prefill"/"decode",
+                    # None for unified deployments).
+                    "pool": state.config.get("pool"),
                     "healthy": len(running) >= state.target_replicas,
                     "deleted": bool(state.config.get("deleted")),
                     "last_start_failure": state.last_start_failure,

@@ -57,6 +57,7 @@ class Deployment:
         autoscaling_config: AutoscalingConfig | dict | None = None,
         ray_actor_options: dict | None = None,
         user_config: Any = None,
+        pool: str | None = None,
     ):
         self.func_or_class = func_or_class
         self.name = name or getattr(func_or_class, "__name__", "deployment")
@@ -67,6 +68,10 @@ class Deployment:
         self.autoscaling_config = autoscaling_config
         self.ray_actor_options = ray_actor_options or {}
         self.user_config = user_config
+        # Pool membership label for disaggregated apps (e.g. "prefill" /
+        # "decode"): pure metadata, surfaced in serve.status() so pool
+        # topology is observable; routing never reads it.
+        self.pool = pool
 
     def options(self, **kwargs) -> "Deployment":
         merged = dict(
@@ -76,6 +81,7 @@ class Deployment:
             autoscaling_config=self.autoscaling_config,
             ray_actor_options=self.ray_actor_options,
             user_config=self.user_config,
+            pool=self.pool,
         )
         merged.update(kwargs)
         return Deployment(self.func_or_class, **merged)

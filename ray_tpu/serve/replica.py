@@ -141,8 +141,10 @@ class ReplicaActor:
 
     def handle_request(self, method_name: str, args: tuple, kwargs: dict):
         from .multiplex import MULTIPLEXED_KWARG, set_multiplexed_model_id
+        from .router import MIGRATE_FROM_KWARG, set_migration_source
 
         set_multiplexed_model_id(kwargs.pop(MULTIPLEXED_KWARG, ""))
+        set_migration_source(kwargs.pop(MIGRATE_FROM_KWARG, None))
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -179,8 +181,10 @@ class ReplicaActor:
         import json as _json
 
         from .multiplex import MULTIPLEXED_KWARG, set_multiplexed_model_id
+        from .router import MIGRATE_FROM_KWARG, set_migration_source
 
         set_multiplexed_model_id(kwargs.pop(MULTIPLEXED_KWARG, ""))
+        set_migration_source(kwargs.pop(MIGRATE_FROM_KWARG, None))
         with self._lock:
             self._ongoing += 1
             self._total += 1

@@ -25,6 +25,30 @@ HANDLE_MARKER = "__serve_handle_marker__"
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
+# Spill migration (KV migration, degenerate single-pool case): when a
+# prefix-group request spills off its affine replica, the router ships
+# the OLD replica's identity along with the request so the spill target
+# can pull the group's hot KV pages instead of cold-prefilling them.
+# Travels as a reserved kwarg (popped by the replica before the user
+# callable sees it) and surfaces through a thread-local, mirroring the
+# multiplexed-model-id plumbing.
+MIGRATE_FROM_KWARG = "_serve_migrate_from"
+
+_migration_context = threading.local()
+
+
+def set_migration_source(src: dict | None) -> None:
+    """Install the spill-migration source ({"replica_id", "actor_id"} or
+    None) for the current request thread (called by the replica before
+    invoking the user callable)."""
+    _migration_context.source = src
+
+
+def get_migration_source() -> dict | None:
+    """Inside a request: the replica this request spilled away from —
+    the one holding its prefix group's cached KV — or None."""
+    return getattr(_migration_context, "source", None)
+
 # Request metrics (reference: serve_num_router_requests /
 # serve_deployment_processing_latency_ms in serve/_private/router.py) —
 # lazily created so importing serve doesn't start the metrics flusher.
@@ -74,6 +98,12 @@ def _serve_metrics():
                 "Fraction of prefix-group requests that landed on their "
                 "affine replica (0-1, since router start)",
                 tag_keys=("deployment",))
+            _metrics["spill_migrations"] = Counter(
+                "serve_spill_migrations",
+                "Affinity spills shipped with a migrate-from source: the "
+                "spill target pulls the group's hot KV pages from the "
+                "previous replica instead of cold-prefilling",
+                tag_keys=("deployment",))
         return _metrics
 
 
@@ -97,7 +127,8 @@ def prefix_group_key(session_id: str = "", text: str = "",
 
 
 def _assign_traced(router: "Router", metrics: dict, deployment: str,
-                   model_id: str, prefix_group: str = "") -> tuple[str, Any]:
+                   model_id: str, prefix_group: str = "",
+                   spill_out: dict | None = None) -> tuple[str, Any]:
     """Assign a replica, recording the router queue wait as both a
     histogram observation and (inside an active trace) a span."""
     import time as _time
@@ -107,7 +138,8 @@ def _assign_traced(router: "Router", metrics: dict, deployment: str,
     t0w, t0m = _time.time(), _time.monotonic()
     try:
         replica_id, actor = router.assign_replica(
-            model_id=model_id, prefix_group=prefix_group)
+            model_id=model_id, prefix_group=prefix_group,
+            spill_out=spill_out)
     finally:
         wait_ms = 1000 * (_time.monotonic() - t0m)
         metrics["queue_wait"].observe(wait_ms, tags={"deployment": deployment})
@@ -153,6 +185,9 @@ class Router:
         self._group_affinity: OrderedDict[str, str] = OrderedDict()
         self.affinity_stats = {"hits": 0, "misses": 0, "spills": 0,
                                "new_groups": 0}
+        # Spills that shipped a migrate-from source with the request
+        # (the KV moved instead of being recomputed).
+        self.spill_migrations = 0
         controller = ray.get_actor(CONTROLLER_NAME)
         self._long_poll = LongPollClient(controller, {self._key: self._update_replicas})
         # prime with the current table so the first request needn't wait a
@@ -197,13 +232,16 @@ class Router:
                 del self._model_affinity[m]
 
     def _affinity_pick(self, prefix_group: str, candidates: list[str],
-                       cfg, deployment: str) -> str | None:
+                       cfg, deployment: str,
+                       spill_out: dict | None = None) -> str | None:
         """Prefix-group affinity with load-aware spill. A group's affine
         replica is used while its in-flight load is within
         ``serve_affinity_spill_margin`` of the coolest candidate;
         otherwise the request spills to pow-2 choice and the group
-        REMAPS to the spill target — which is about to cold-prefill the
-        prefix and therefore holds the freshest copy of its KV."""
+        REMAPS to the spill target. On a spill whose old replica is
+        still ALIVE, ``spill_out["migrate_from"]`` records it so the
+        spill target can MIGRATE the group's hot KV pages instead of
+        cold-prefilling them (PR-10 residue b closed)."""
         def note(kind: str) -> None:
             self.affinity_stats[kind] += 1
             try:
@@ -221,6 +259,8 @@ class Router:
             # saturated one counts as a spill (never queue behind it).
             if affine in self._replicas:
                 note("spills")
+                if spill_out is not None:
+                    spill_out["migrate_from"] = affine
             else:
                 self._group_affinity.pop(prefix_group, None)
                 note("misses")
@@ -229,6 +269,8 @@ class Router:
         if (self._inflight.get(affine, 0) - coolest
                 > cfg.serve_affinity_spill_margin):
             note("spills")
+            if spill_out is not None:
+                spill_out["migrate_from"] = affine
             return None
         note("hits")
         return affine
@@ -250,14 +292,18 @@ class Router:
 
     def assign_replica(self, timeout: float | None = None,
                        model_id: str = "",
-                       prefix_group: str = "") -> tuple[str, Any]:
+                       prefix_group: str = "",
+                       spill_out: dict | None = None) -> tuple[str, Any]:
         """Power-of-two choice among replicas below their cap; blocks while
         every replica is saturated (backpressure). With a multiplexed
         ``model_id``, replicas that served that model recently are
         preferred (cache affinity — reference multiplex-aware routing).
         With a ``prefix_group`` key, requests stick to the replica whose
         engine already holds the group's KV prefix, with load-aware
-        spill (``_affinity_pick``)."""
+        spill (``_affinity_pick``). ``spill_out`` (out-param) reports a
+        spill's still-alive previous replica as ``{"migrate_from",
+        "actor_id"}`` so the caller can ship a KV-migration source with
+        the request."""
         import time
 
         from ..core.config import get_config
@@ -277,7 +323,8 @@ class Router:
                     pick = None
                     if prefix_group:
                         pick = self._affinity_pick(prefix_group, candidates,
-                                                   cfg, deployment)
+                                                   cfg, deployment,
+                                                   spill_out=spill_out)
                     if pick is None and model_id:
                         affine = self._model_affinity.get(model_id)
                         if affine in candidates:
@@ -295,6 +342,16 @@ class Router:
                     if prefix_group:
                         self._note_affinity(prefix_group, pick, cfg,
                                             deployment)
+                    if spill_out is not None:
+                        src = spill_out.get("migrate_from")
+                        if src is None or src == pick \
+                                or src not in self._replicas:
+                            # pow-2 re-picked the affine replica (or it
+                            # vanished): nothing to migrate.
+                            spill_out.pop("migrate_from", None)
+                        else:
+                            spill_out["actor_id"] = \
+                                self._replicas[src]["actor"]._actor_id.hex()
                     self._inflight[pick] = self._inflight.get(pick, 0) + 1
                     return pick, self._replicas[pick]["actor"]
                 remaining = deadline - time.monotonic()
@@ -507,6 +564,27 @@ class DeploymentHandle:
             raise AttributeError(item)
         return self.options(method_name=item)
 
+    def _inject_migrate_from(self, router: Router, metrics: dict,
+                             spill_out: dict, kwargs: dict) -> None:
+        """Ship the spill's previous (still-alive) replica with the
+        request so the target migrates the prefix group's KV pages
+        instead of recomputing them (config ``serve_spill_migration``)."""
+        src = spill_out.get("migrate_from")
+        if not src or "actor_id" not in spill_out:
+            return
+        from ..core.config import get_config
+
+        if not get_config().serve_spill_migration:
+            return
+        kwargs[MIGRATE_FROM_KWARG] = {"replica_id": src,
+                                      "actor_id": spill_out["actor_id"]}
+        router.spill_migrations += 1
+        try:
+            metrics["spill_migrations"].inc(
+                tags={"deployment": self.deployment_name})
+        except Exception:
+            pass
+
     def remote(self, *args, _replica_death_retries: int = 1,
                **kwargs) -> DeploymentResponse:
         import time as _time
@@ -517,9 +595,11 @@ class DeploymentHandle:
         metrics = _serve_metrics()
         metrics["requests"].inc(tags={"deployment": self.deployment_name})
         t0 = _time.monotonic()
+        spill_out: dict = {}
         replica_id, actor = _assign_traced(
             router, metrics, self.deployment_name, self._multiplexed_model_id,
-            self._prefix_group)
+            self._prefix_group, spill_out=spill_out)
+        self._inject_migrate_from(router, metrics, spill_out, kwargs)
         if self._multiplexed_model_id:
             kwargs[MULTIPLEXED_KWARG] = self._multiplexed_model_id
         try:
@@ -560,9 +640,11 @@ class DeploymentHandle:
         metrics = _serve_metrics()
         metrics["requests"].inc(tags={"deployment": self.deployment_name})
         t0 = _time.monotonic()
+        spill_out: dict = {}
         replica_id, actor = _assign_traced(
             router, metrics, self.deployment_name, self._multiplexed_model_id,
-            self._prefix_group)
+            self._prefix_group, spill_out=spill_out)
+        self._inject_migrate_from(router, metrics, spill_out, kwargs)
         if self._multiplexed_model_id:
             kwargs[MULTIPLEXED_KWARG] = self._multiplexed_model_id
         try:

@@ -257,3 +257,32 @@ def test_latest_bench_json(tmp_path):
     (tmp_path / "BENCH_r05.json").write_text("{}")
     latest = bench_check.latest_bench_json(str(tmp_path))
     assert latest is not None and latest.endswith("BENCH_r05.json")
+
+
+def test_migration_metrics_directions_and_markers():
+    """Round-11 KV-migration cells: migrated TTFT is lower-better,
+    kv_migration_mb_s is a throughput (the `_mb_s` suffix must trump
+    the `_s` lower-better suffix), and the skip markers route chip-box
+    absences to the non-failing skipped bucket."""
+    assert bench_check._direction("serve_ttft_migrated_ms") == "down"
+    assert bench_check._direction("serve_ttft_cold_ms") == "down"
+    assert bench_check._direction("kv_migration_mb_s") == "up"
+    assert bench_check._direction("serve_spill_migrations") == "up"
+
+    old = {"serve_ttft_migrated_ms": 50.0, "serve_ttft_cold_ms": 300.0,
+           "kv_migration_mb_s": 60.0}
+    # regressions in the right directions
+    worse = {"serve_ttft_migrated_ms": 80.0, "serve_ttft_cold_ms": 310.0,
+             "kv_migration_mb_s": 20.0}
+    result = bench_check.compare(old, worse)
+    names = {r["metric"] for r in result["regressions"]}
+    assert "serve_ttft_migrated_ms" in names
+    assert "kv_migration_mb_s" in names
+    # skip markers: intentionally absent cells are not "missing"
+    skipped = {"serve_ttft_migrated_skipped": True,
+               "kv_migration_mb_s_skipped": True,
+               "serve_ttft_cold_ms": 290.0}
+    result = bench_check.compare(old, skipped)
+    assert not result["missing"]
+    assert {r["metric"] for r in result["skipped"]} == {
+        "serve_ttft_migrated_ms", "kv_migration_mb_s"}

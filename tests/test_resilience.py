@@ -506,3 +506,118 @@ def test_cli_chaos_run_preempt_slice_smoke(tmp_path, capsys):
             pass
         ray_tpu.shutdown()
         c.shutdown()
+
+
+# --------------------------------------------------------------------------
+# Round 11: GCE metadata-server preemption watcher (ROADMAP item 10a)
+
+
+class _FakeMetadataServer:
+    """Minimal GCE instance-metadata stand-in: serves the `preempted`
+    key, flipping FALSE -> TRUE after `flips_after` requests, and
+    records whether clients sent the required Metadata-Flavor header."""
+
+    def __init__(self, flips_after: int):
+        import http.server
+
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                server.requests += 1
+                server.flavors.append(
+                    self.headers.get("Metadata-Flavor", ""))
+                body = (b"TRUE" if server.requests > flips_after
+                        else b"FALSE")
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.requests = 0
+        self.flavors: list[str] = []
+        self._httpd = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        self.url = (f"http://127.0.0.1:{self._httpd.server_address[1]}"
+                    "/computeMetadata/v1/instance/preempted")
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def test_metadata_watcher_fires_once_on_preempted():
+    """The watcher polls the metadata `preempted` key with the
+    Metadata-Flavor header, ignores FALSE reads, fires the callback
+    EXACTLY once when it flips TRUE, then stops on its own."""
+    from ray_tpu.resilience import GceMetadataPreemptionWatcher
+
+    server = _FakeMetadataServer(flips_after=2)
+    fired: list[str] = []
+    try:
+        watcher = GceMetadataPreemptionWatcher(
+            fired.append, url=server.url, poll_s=0.05).start()
+        assert _wait_for(lambda: watcher.fired, timeout=10)
+        watcher._thread.join(timeout=5)          # one-shot: thread exits
+        assert not watcher._thread.is_alive()
+        assert fired == ["gce metadata: instance preempted"]
+        assert watcher.polls >= 3                # saw FALSE before TRUE
+        assert all(f == "Google" for f in server.flavors)
+    finally:
+        server.close()
+
+
+def test_metadata_watcher_errors_never_fire():
+    """An unreachable metadata server must never drain a healthy node:
+    errors count, the callback stays silent, stop() is clean."""
+    from ray_tpu.resilience import GceMetadataPreemptionWatcher
+
+    fired: list[str] = []
+    watcher = GceMetadataPreemptionWatcher(
+        fired.append, url="http://127.0.0.1:9/computeMetadata",
+        poll_s=0.05, timeout_s=0.2).start()
+    assert _wait_for(lambda: watcher.errors >= 2, timeout=10)
+    watcher.stop()
+    assert not fired and not watcher.fired
+
+
+def test_metadata_watcher_feeds_raylet_drain_path():
+    """Wired end-to-end: a raylet started with preempt_metadata_watch
+    polls the (fake) metadata endpoint and enters the SAME draining
+    path a PreemptionNotice RPC triggers — node flagged draining in the
+    GCS, node_preempted published, node DEAD after the grace window."""
+    server = _FakeMetadataServer(flips_after=1)
+    cfg = get_config()
+    saved = (cfg.preempt_metadata_watch, cfg.preempt_metadata_url,
+             cfg.preempt_metadata_poll_s, cfg.preempt_grace_s)
+    cfg.preempt_metadata_watch = True
+    cfg.preempt_metadata_url = server.url
+    cfg.preempt_metadata_poll_s = 0.05
+    cfg.preempt_grace_s = 1.0
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster()
+    try:
+        c.add_node(num_cpus=1)
+        ray_tpu.init(address=c.address)
+        n2 = c.add_node(num_cpus=1)  # watcher starts with the config on
+        assert _wait_for(
+            lambda: any(n["node_id"] == n2.node_id.hex()
+                        and (n.get("draining") or n["state"] == "DEAD")
+                        for n in state.list_nodes()), timeout=30), \
+            "metadata TRUE never reached the drain path"
+        assert _wait_for(
+            lambda: any(n["node_id"] == n2.node_id.hex()
+                        and n["state"] == "DEAD"
+                        for n in state.list_nodes()), timeout=30)
+    finally:
+        (cfg.preempt_metadata_watch, cfg.preempt_metadata_url,
+         cfg.preempt_metadata_poll_s, cfg.preempt_grace_s) = saved
+        server.close()
+        ray_tpu.shutdown()
+        c.shutdown()
