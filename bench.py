@@ -422,6 +422,20 @@ def run_recovery_bench() -> dict:
     return _run()
 
 
+def run_overload_bench() -> dict:
+    """Overload-protection cells (ISSUE 12): goodput under a 2×-capacity
+    thundering herd with protection ON (`serve_goodput_frac` — must
+    strictly beat the protection-OFF `serve_goodput_frac_unprotected`
+    baseline cell), the p95 time-to-503 of shed requests
+    (`serve_shed_fast_fail_p95_ms`), admitted-request TTFT p95, and
+    greedy byte parity of admitted reference prompts. Implementation in
+    ``ray_tpu/_overload_bench.py``; standalone: ``python -m ray_tpu.cli
+    bench overload``."""
+    from ray_tpu._overload_bench import run_overload_bench as _run
+
+    return _run()
+
+
 def run_migration_bench() -> dict:
     """KV-migration cells (ROADMAP item 2): migrated vs cold TTFT at the
     2k-prompt cell (`serve_ttft_migrated_ms` must be ≤ 0.7× the cold
@@ -842,6 +856,26 @@ def main() -> None:
                 ray_tpu.shutdown()
             except Exception:
                 pass
+    extra_overload: dict = {}
+    if os.environ.get("RAY_TPU_BENCH_SKIP_OVERLOAD") != "1":
+        try:
+            extra_overload = run_overload_bench()
+        except Exception as e:
+            print(f"overload bench failed: {e}", file=sys.stderr)
+            extra_overload = {
+                "overload_bench_error": f"{type(e).__name__}: {e}",
+                "serve_goodput_frac_skipped": True,
+                "serve_shed_fast_fail_p95_ms_skipped": True,
+                "serve_admitted_p95_ttft_ms_skipped": True,
+            }
+            try:
+                import ray_tpu
+                from ray_tpu import serve
+
+                serve.shutdown()
+                ray_tpu.shutdown()
+            except Exception:
+                pass
     extra_migration: dict = {}
     if os.environ.get("RAY_TPU_BENCH_SKIP_MIGRATION") != "1":
         try:
@@ -884,6 +918,7 @@ def main() -> None:
         **extra_core,
         **extra_dag,
         **extra_recovery,
+        **extra_overload,
         # Last: the migration bench's 2k-cell cold TTFT supersedes the
         # serve bench's ~1.6k-prompt cold cell under the same key, so
         # migrated-vs-cold always compares within ONE harness.

@@ -1,0 +1,282 @@
+"""Overload bench: goodput under 2× offered load, protection ON vs OFF.
+
+ISSUE 12 acceptance cells, runnable standalone (``python -m ray_tpu.cli
+bench overload``) or inside ``bench.py``:
+
+  * ``serve_goodput_frac`` — completed-within-deadline / offered at 2×
+    the measured capacity THROUGH the real stack (HTTP proxy → router →
+    replica → engine) with overload protection ON: request deadlines
+    (``x-raytpu-deadline-ms``) + a bounded per-replica admission queue.
+    Admitted work keeps a bounded TTFT; the rest fails fast and honest.
+  * ``serve_goodput_frac_unprotected`` — the SAME storm against an app
+    with no deadline and an unbounded queue: every request's TTFT blows
+    up together (the congestion collapse this PR prevents). The
+    acceptance bar is protection ON strictly above this baseline cell.
+  * ``serve_shed_fast_fail_p95_ms`` — p95 time-to-503 of a shed request
+    (bound ≤ 100 ms on the CPU sandbox: an honest rejection must be
+    cheap).
+  * ``serve_admitted_p95_ttft_ms`` — client TTFT p95 of ADMITTED
+    requests under the protected storm.
+  * ``serve_overload_parity`` — 1.0 iff every admitted re-issue of a
+    reference prompt returns byte-identical greedy text.
+
+CPU-sandbox friendly (debug preset engines); set
+``RAY_TPU_BENCH_SKIP_OVERLOAD=1`` to leave ``*_skipped`` markers that
+``bench_check`` honors.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+SKIP_MARKERS = {
+    "serve_goodput_frac_skipped": True,
+    "serve_shed_fast_fail_p95_ms_skipped": True,
+    "serve_admitted_p95_ttft_ms_skipped": True,
+}
+
+
+def _pct(sorted_vals: list[float], q: float) -> float:
+    return sorted_vals[max(0, int(len(sorted_vals) * q) - 1)]
+
+
+def _one_request(addr: str, route: str, prompt: str, max_tokens: int,
+                 deadline_ms: float | None, client_timeout: float) -> dict:
+    """Drive one streaming completion; returns {"status", "ttft_s",
+    "wall_s", "text", "finish", "retry_after"} — status is the HTTP code
+    ("200"/"503"/"504") or an exception name (client-side timeout =
+    abandoned, the open-loop client gave up)."""
+    body = json.dumps({"prompt": prompt, "max_tokens": max_tokens,
+                       "stream": True}).encode()
+    headers = {"Content-Type": "application/json"}
+    if deadline_ms:
+        headers["x-raytpu-deadline-ms"] = str(int(deadline_ms))
+    req = urllib.request.Request(addr + route + "/v1/completions",
+                                 data=body, headers=headers)
+    t0 = time.perf_counter()
+    out = {"status": "200", "ttft_s": None, "wall_s": None, "text": "",
+           "finish": "", "retry_after": None}
+    try:
+        with urllib.request.urlopen(req, timeout=client_timeout) as resp:
+            for line in resp:
+                line = line.decode().strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                choice = json.loads(line[6:])["choices"][0]
+                if out["ttft_s"] is None and choice.get("text"):
+                    # Only a real token counts as the first token: the
+                    # terminal deadline event carries no text.
+                    out["ttft_s"] = time.perf_counter() - t0
+                out["text"] += choice.get("text", "")
+                if choice.get("finish_reason"):
+                    out["finish"] = choice["finish_reason"]
+    except urllib.error.HTTPError as e:
+        out["status"] = str(e.code)
+        out["retry_after"] = e.headers.get("Retry-After")
+        try:
+            e.read()
+        except Exception:
+            pass
+    except Exception as e:
+        out["status"] = type(e).__name__
+    out["wall_s"] = time.perf_counter() - t0
+    return out
+
+
+def _storm(addr: str, route: str, schedule: list[tuple[float, str]],
+           max_tokens: int, deadline_ms: float | None,
+           client_timeout: float) -> list[dict]:
+    """Fire the deterministic open-loop arrival schedule: each request
+    launches at its offset regardless of how the previous ones fare —
+    offered load is independent of service rate (the thundering herd)."""
+    results: list[dict | None] = [None] * len(schedule)
+    t0 = time.perf_counter()
+
+    def fire(i: int, offset: float, prompt: str) -> None:
+        delay = t0 + offset - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        results[i] = _one_request(addr, route, prompt, max_tokens,
+                                  deadline_ms, client_timeout)
+
+    threads = [threading.Thread(target=fire, args=(i, off, p), daemon=True)
+               for i, (off, p) in enumerate(schedule)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=client_timeout + 60)
+    return [r or {"status": "Unjoined", "wall_s": None, "ttft_s": None,
+                  "text": "", "finish": ""} for r in results]
+
+
+def run_overload_bench(storm_s: float | None = None,
+                       deadline_ms: float | None = None) -> dict:
+    if os.environ.get("RAY_TPU_BENCH_SKIP_OVERLOAD") == "1":
+        return dict(SKIP_MARKERS)
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm import build_llm_app
+
+    preset = os.environ.get("RAY_TPU_OVERLOAD_PRESET", "debug-128")
+    storm_s = storm_s or float(os.environ.get("RAY_TPU_OVERLOAD_STORM_S", "8"))
+    deadline_ms = deadline_ms or float(
+        os.environ.get("RAY_TPU_OVERLOAD_DEADLINE_MS", "2500"))
+    calib_s = float(os.environ.get("RAY_TPU_OVERLOAD_CALIB_S", "4"))
+    max_tokens = 8
+    max_slots = 4
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    common = dict(max_slots=max_slots, max_len=256, page_size=16,
+                  prefill_chunk_size=64, num_replicas=2,
+                  max_ongoing_requests=64)
+    # Protection ON: bounded per-replica admission queue (+ the deadline
+    # each storm request carries). OFF: unbounded queue, no deadline —
+    # the classic collapse baseline.
+    serve.run(build_llm_app(preset, max_queued_requests=max_slots, **common),
+              name="ovl-on", route_prefix="/on", timeout_s=360.0)
+    serve.run(build_llm_app(preset, max_queued_requests=0, **common),
+              name="ovl-off", route_prefix="/off", timeout_s=360.0)
+    addr = serve.http_address()
+    out: dict = {}
+    try:
+        def prompt_for(tag: str, i: int) -> str:
+            return f"req {tag}-{i}: " + "abcdefgh" * (8 + i % 7)
+
+        # Warm BOTH apps with every storm prompt SHAPE (all 7 length
+        # variants hit every prefill bucket), concurrently enough that
+        # both replicas of each pool compile — the storm and the
+        # baseline cell must measure queueing, not first-touch XLA.
+        for route in ("/on", "/off"):
+            warm = [threading.Thread(
+                target=_one_request,
+                args=(addr, route, prompt_for("warm", i), max_tokens,
+                      None, 180.0), daemon=True) for i in range(14)]
+            for t in warm:
+                t.start()
+            for t in warm:
+                t.join(timeout=240)
+
+        # ---- capacity calibration: closed-loop at ~2x slot concurrency
+        # against the protected app (post-warm, so it measures service
+        # rate, not compiles).
+        done = {"n": 0}
+        lock = threading.Lock()
+        stop_at = time.perf_counter() + calib_s
+
+        def calib_client(cid: int) -> None:
+            j = 0
+            while time.perf_counter() < stop_at:
+                r = _one_request(addr, "/on", prompt_for(f"c{cid}", j),
+                                 max_tokens, None, 120.0)
+                j += 1
+                if r["status"] == "200":
+                    with lock:
+                        done["n"] += 1
+
+        cthreads = [threading.Thread(target=calib_client, args=(i,),
+                                     daemon=True)
+                    for i in range(4 * max_slots)]
+        t0 = time.perf_counter()
+        for t in cthreads:
+            t.start()
+        for t in cthreads:
+            t.join(timeout=calib_s + 120)
+        capacity_rps = done["n"] / max(1e-3, time.perf_counter() - t0)
+        if capacity_rps <= 0:
+            raise RuntimeError("capacity calibration served 0 requests")
+        offered_rps = 2.0 * capacity_rps
+        # Cap the herd so the baseline cell can't run away on a fast box
+        # (offered load, not thread count, is the variable under test).
+        n_offered = min(160, max(16, int(offered_rps * storm_s)))
+
+        # ---- parity references: unique prompts served UNLOADED; their
+        # storm re-issues must return byte-identical greedy text.
+        ref_prompts = [prompt_for("ref", i) for i in range(4)]
+        references = {}
+        for p in ref_prompts:
+            r = _one_request(addr, "/on", p, max_tokens, None, 120.0)
+            if r["status"] == "200":
+                references[p] = r["text"]
+
+        # Deterministic thundering-herd schedule: evenly spaced arrivals
+        # at 2× capacity; every 8th request re-issues a reference prompt.
+        def schedule_for(tag: str) -> list[tuple[float, str]]:
+            sched = []
+            for i in range(n_offered):
+                if i % 8 == 0 and ref_prompts:
+                    p = ref_prompts[(i // 8) % len(ref_prompts)]
+                else:
+                    p = prompt_for(tag, i)
+                sched.append((i / offered_rps, p))
+            return sched
+
+        budget_s = deadline_ms / 1000.0
+        client_timeout = budget_s * 4 + 10.0
+
+        # ---- protection ON storm (deadline header + bounded queues).
+        on = _storm(addr, "/on", schedule_for("on"), max_tokens,
+                    deadline_ms, client_timeout)
+        # ---- protection OFF baseline cell (same offered load, no
+        # protection): goodput judged against the SAME budget.
+        off = _storm(addr, "/off", schedule_for("off"), max_tokens,
+                     None, client_timeout)
+
+        def goodput(results: list[dict]) -> float:
+            ok = sum(1 for r in results
+                     if r["status"] == "200" and r["wall_s"] is not None
+                     and r["wall_s"] <= budget_s
+                     and r["finish"] not in ("deadline", "timeout"))
+            return ok / max(1, len(results))
+
+        sheds = [r for r in on if r["status"] == "503"]
+        expired = [r for r in on if r["status"] == "504"
+                   or r["finish"] == "deadline"]
+        admitted_ttfts = sorted(
+            r["ttft_s"] for r in on
+            if r["status"] == "200" and r["ttft_s"] is not None)
+        parity = 1.0
+        for results in (on,):
+            for (off_t, p), r in zip(schedule_for("on"), results):
+                if p in references and r["status"] == "200" \
+                        and r["finish"] not in ("deadline", "timeout") \
+                        and r["text"] != references[p]:
+                    parity = 0.0
+        out["serve_goodput_frac"] = round(goodput(on), 4)
+        out["serve_goodput_frac_unprotected"] = round(goodput(off), 4)
+        out["serve_overload_offered"] = n_offered
+        out["serve_overload_completed"] = sum(
+            1 for r in on if r["status"] == "200")
+        out["serve_shed_requests"] = len(sheds)
+        out["serve_deadline_expired"] = len(expired)
+        out["serve_capacity_rps_cfg"] = round(capacity_rps, 2)
+        out["serve_overload_parity"] = parity if references else None
+        if sheds:
+            fails = sorted(r["wall_s"] for r in sheds)
+            out["serve_shed_fast_fail_p95_ms"] = round(
+                1000 * _pct(fails, 0.95), 1)
+        else:
+            out["serve_shed_fast_fail_p95_ms_skipped"] = True
+        if admitted_ttfts:
+            out["serve_admitted_p95_ttft_ms"] = round(
+                1000 * _pct(admitted_ttfts, 0.95), 1)
+        else:
+            out["serve_admitted_p95_ttft_ms_skipped"] = True
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(run_overload_bench()))

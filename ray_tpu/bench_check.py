@@ -25,10 +25,16 @@ import json
 import os
 import sys
 
-# Metrics that describe the run, not its performance.
+# Metrics that describe the run, not its performance. Shed/offered
+# counts from the overload bench are bookkeeping: protection ON sheds
+# MORE than the unprotected baseline by design, so neither direction is
+# a regression — goodput_frac and the fast-fail latency are the guarded
+# numbers.
 _SKIP_EXACT = {
     "n", "rc", "vs_baseline", "loss", "serve_requests", "serve_concurrency",
     "serve_decode_steps_per_dispatch",
+    "serve_shed_requests", "serve_overload_offered", "serve_overload_completed",
+    "serve_deadline_expired",
 }
 # "_cfg": config echoes (core-bench phase sizes etc.) — sizes are inputs,
 # not results.
@@ -46,8 +52,10 @@ _POINTWISE_RATE_SUFFIX = ("_hit_rate", "_frac")
 # GROW >threshold (a quiet 2x pool blowup is exactly what they exist
 # to catch). "_lag_steps": checkpoint lag (steps replayed after a
 # preemption recovery) regresses UP — more lost work is worse.
+# "fast_fail": the time-to-503 of a shed request (overload bench) —
+# slower rejections are the regression the bound exists to prevent.
 _LOWER_BETTER_SUFFIX = ("_ms", "_us", "_pct", "_bytes", "_s", "_lag_steps")
-_LOWER_BETTER_SUBSTR = ("latency", "ttft", "overhead", "failed")
+_LOWER_BETTER_SUBSTR = ("latency", "ttft", "overhead", "failed", "fast_fail")
 
 
 def load_metrics(path: str) -> dict:

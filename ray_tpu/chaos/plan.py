@@ -35,10 +35,11 @@ KIND_GCS_BLACKOUT = "gcs_blackout"  # partition targeting the GCS endpoint
 KIND_HTTP_INGRESS = "http_ingress"  # drop/delay at the serve HTTP proxy
 KIND_KILL_LOOP = "kill_loop_stage"  # os._exit a loop stage at its Nth tick
 KIND_PREEMPT = "preempt_slice"      # GCE preemption notice at a node's Nth tick
+KIND_REPLICA_DELAY = "replica_delay"  # stall a serve replica's handles
 
 _COUNTED_KINDS = (KIND_RPC, KIND_KILL_WORKER, KIND_SPILL_ERROR,
                   KIND_STORE_FULL, KIND_HTTP_INGRESS, KIND_KILL_LOOP,
-                  KIND_PREEMPT)
+                  KIND_PREEMPT, KIND_REPLICA_DELAY)
 _WINDOW_KINDS = (KIND_PARTITION, KIND_GCS_BLACKOUT)
 
 # How many future calls a probabilistic rule pre-draws decisions for.
@@ -66,6 +67,10 @@ class FaultPlan:
                 if where not in ("request", "response", "client"):
                     raise FaultPlanError(
                         f"faults[{i}]: where must be request|response|client")
+            elif kind == KIND_REPLICA_DELAY:
+                if float(fault.get("delay_ms") or 0.0) <= 0:
+                    raise FaultPlanError(
+                        f"faults[{i}]: replica_delay needs delay_ms")
             elif kind in (KIND_KILL_WORKER, KIND_SPILL_ERROR, KIND_STORE_FULL,
                           KIND_KILL_LOOP, KIND_PREEMPT):
                 pass
@@ -309,6 +314,21 @@ class PlanChaos(RpcChaos):
                 return True
         return False
 
+    def replica_delay_s(self, replica_id: str = "") -> float:
+        """One serve-replica handle in this process: how long to stall
+        it. Rules target a replica-id prefix (``replica``, e.g.
+        "app#dep#2") or every replica when absent; ``nth: 1`` stalls
+        every handle — the deterministic stand-in for a replica gone
+        slow (the overload plan's delayed-replica fault)."""
+        for idx, rule in self._matching(KIND_REPLICA_DELAY):
+            if rule.get("replica") and \
+                    not replica_id.startswith(rule["replica"]):
+                continue
+            if self._take(idx, rule):
+                self._fire(idx, rule, "replica_delay", replica_id[:32])
+                return float(rule.get("delay_ms") or 0.0) / 1000.0
+        return 0.0
+
     def maybe_fail_spill(self) -> bool:
         for idx, rule in self._matching(KIND_SPILL_ERROR):
             if self._take(idx, rule):
@@ -394,6 +414,20 @@ BUILTIN_PLANS: dict[str, dict] = {
         "faults": [
             {"kind": "preempt_slice", "nth": 2, "max_injections": 1,
              "target": "node:1"},
+        ],
+    },
+    "overload-storm": {
+        "name": "overload-storm",
+        "description": "Overload chaos: every handle on replica #2 of "
+                       "the targeted deployment stalls 400 ms (a replica "
+                       "gone slow under a thundering herd). Driven with "
+                       "a deterministic burst arrival schedule + request "
+                       "deadlines, the system must shed/expire honestly "
+                       "and drain back to a verifier-green state with "
+                       "page-pool refcounts at baseline.",
+        "faults": [
+            {"kind": "replica_delay", "replica": "overload#LLMDeployment#2",
+             "nth": 1, "delay_ms": 400},
         ],
     },
     "mixed-seeded": {

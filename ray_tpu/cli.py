@@ -88,6 +88,19 @@ def _cmd_bench(args) -> int:
         ok = bool(result.get("serve_ttft_migrated_ms") is not None)
         prefixes = ("serve_ttft_migrated", "serve_ttft_cold",
                     "kv_migration_")
+    elif args.bench_cmd == "overload":
+        from ray_tpu._overload_bench import run_overload_bench
+
+        result = run_overload_bench(storm_s=args.storm,
+                                    deadline_ms=args.deadline_ms)
+        on = result.get("serve_goodput_frac")
+        off = result.get("serve_goodput_frac_unprotected")
+        # Acceptance: protection ON strictly beats the unprotected
+        # baseline cell, and admitted work keeps byte parity.
+        ok = bool(on is not None and off is not None and on > off
+                  and result.get("serve_overload_parity", 1.0) == 1.0)
+        prefixes = ("serve_goodput_", "serve_shed_", "serve_admitted_",
+                    "serve_overload_")
     else:
         from ray_tpu._core_bench import run_core_bench
 
@@ -211,6 +224,24 @@ def main(argv: list[str] | None = None) -> int:
                       help="cold/migrated prompt pairs (default "
                            "$RAY_TPU_MIGRATION_SAMPLES or 3)")
     bmig.add_argument("--check-against", default=None, metavar="BENCH_JSON",
+                      help="run ray_tpu.bench_check against a recorded "
+                           "BENCH_r*.json and exit non-zero on regression")
+    bovl = bench_sub.add_parser(
+        "overload", help="overload-protection cells: a 2x-capacity "
+                         "thundering herd with request deadlines + "
+                         "bounded queues vs an unprotected baseline "
+                         "(serve_goodput_frac must strictly beat "
+                         "serve_goodput_frac_unprotected; "
+                         "serve_shed_fast_fail_p95_ms is the time-to-503;"
+                         " admitted requests keep greedy byte parity)")
+    bovl.add_argument("--storm", type=float, default=None,
+                      help="storm window in seconds (default "
+                           "$RAY_TPU_OVERLOAD_STORM_S or 8)")
+    bovl.add_argument("--deadline-ms", type=float, default=None,
+                      help="per-request deadline in the protected phase "
+                           "(default $RAY_TPU_OVERLOAD_DEADLINE_MS or "
+                           "2500)")
+    bovl.add_argument("--check-against", default=None, metavar="BENCH_JSON",
                       help="run ray_tpu.bench_check against a recorded "
                            "BENCH_r*.json and exit non-zero on regression")
     serve_p = sub.add_parser(
@@ -385,6 +416,22 @@ def main(argv: list[str] | None = None) -> int:
                     line += (" last_start_failure="
                              + str(st["last_start_failure"]).splitlines()[0][:80])
                 print(line)
+                ovl = dict(st.get("overload") or {})
+                router_ovl = ovl.pop("router", None) or {}
+                parts = [f"{k}={v}" for k, v in sorted(ovl.items())
+                         if k != "replicas" and v]
+                shed = router_ovl.get("shed") or {}
+                parts += [f"shed_{k}={v}" for k, v in sorted(shed.items())]
+                if router_ovl.get("deadline_expired_queued"):
+                    parts.append("router_deadline_expired="
+                                 + str(router_ovl["deadline_expired_queued"]))
+                circuit = router_ovl.get("circuit") or {}
+                if router_ovl.get("circuit_opens"):
+                    parts.append(f"circuit_opens={router_ovl['circuit_opens']}")
+                for rid, cst in sorted(circuit.items()):
+                    parts.append(f"circuit[{rid}]={cst}")
+                if parts:
+                    print("  overload: " + " ".join(parts))
                 for e in st.get("autoscale_events") or []:
                     ts = datetime.datetime.fromtimestamp(e["ts"]).strftime(
                         "%H:%M:%S")

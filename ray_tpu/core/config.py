@@ -271,6 +271,41 @@ class RayTpuConfig:
     serve_spill_migration: bool = True
     kv_migration_chunk_pages: int = 8
     kv_migration_timeout_s: float = 60.0
+    # --- overload protection (graceful degradation under load spikes) ---
+    # Default end-to-end request deadline stamped at proxy ingress when the
+    # client sends neither an `x-raytpu-deadline-ms` header nor a
+    # `timeout_s` body field. A request that expires while still QUEUED
+    # (router wait or engine admission queue) fails fast without touching
+    # the engine; one that expires mid-decode has its slot aborted and its
+    # pages freed the same tick. 0 disables the default (requests without
+    # an explicit deadline never expire).
+    serve_default_deadline_s: float = 0.0
+    # Router-level queue bound: max requests allowed to WAIT for a replica
+    # slot per (process, deployment) router when every replica is at its
+    # max_ongoing cap. Over the bound the request is shed with a 503 +
+    # Retry-After derived from the observed per-replica service rate
+    # (reference Serve's max_queued_requests ingress backpressure).
+    # 0 = unbounded (legacy blocking behavior).
+    serve_max_queued_requests: int = 64
+    # Shed policy over the bound: "cost" prefers shedding the request with
+    # the largest cold suffix — a request whose prefix group maps to a
+    # live replica is cheap (its KV is cached) and may preempt an
+    # expensive (cold) waiter's queue slot; "fifo" always sheds the
+    # incoming request.
+    serve_shed_policy: str = "cost"
+    # Replica circuit breaker: a replica that times out this many
+    # CONSECUTIVE handles is marked open in the router and excluded from
+    # routing; after the cooldown one half-open probe request is allowed
+    # through — success closes the circuit, failure re-opens it.
+    # 0 disables the breaker.
+    serve_circuit_breaker_failures: int = 3
+    serve_circuit_breaker_cooldown_s: float = 5.0
+    # Extra free-page headroom the engine keeps when admitting new slots
+    # (on top of the worst-case per-request reservation admission already
+    # takes): admission refuses — and counts `admission_rejects`, leaving
+    # the request in the queue — while free pages are below the reserve,
+    # so in-flight KV migrations/imports never race running slots.
+    serve_admission_watermark_pages: int = 0
 
     # --- data ----------------------------------------------------------------
     data_max_in_flight_tasks: int = 8

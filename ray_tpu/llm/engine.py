@@ -78,10 +78,30 @@ class Request:
     prefill_only: bool = False
     pin_for_export: bool = False
     export_pinned: list[int] = field(default_factory=list)
+    # End-to-end deadline (absolute wall clock, ``time.time()`` scale),
+    # threaded from the serve proxy: a request that expires while still
+    # WAITING fails fast without ever touching the engine; one that
+    # expires mid-prefill/mid-decode is aborted and its pages freed the
+    # same tick. None = never expires.
+    deadline: float | None = None
     # Trace context ({"trace_id", "span_id"}) captured from the submitting
     # thread at add_request: the engine loop runs detached, so prefill/
     # decode spans parent onto this instead of any thread-local state.
     trace: dict | None = None
+
+
+class QueueFullError(RuntimeError):
+    """The engine's bounded admission queue (``max_queued_requests``)
+    refused the request — overload protection's per-replica backpressure.
+    Carries the HTTP shape the serve proxy answers with (503 +
+    Retry-After) so the shed is honest and fast."""
+
+    http_status = "503 Service Unavailable"
+    reason = "replica_queue_full"
+
+    def __init__(self, message: str, retry_after: int = 1):
+        super().__init__(message)
+        self.retry_after = max(1, int(retry_after))
 
 
 class PageAllocator:
@@ -331,6 +351,8 @@ class InferenceEngine:
         max_prefill_seqs_per_step: int = 2,
         decode_starvation_limit: int = 8,
         host_kv_cache_pages: int = 0,
+        max_queued_requests: int = 0,
+        admission_watermark_pages: int | None = None,
     ):
         self.config = PRESETS[config] if isinstance(config, str) else config
         self.mesh = mesh
@@ -390,6 +412,22 @@ class InferenceEngine:
         for s in range(max_slots):
             self.allocator.free.remove(s)
         self._free_slots = list(range(max_slots))
+        # Overload protection: bound on requests WAITING for admission
+        # (0 = unbounded) — over it add_request sheds with QueueFullError
+        # instead of letting the queue (and every waiter's TTFT) grow
+        # without limit; and the admission watermark — extra free-page
+        # headroom admission preserves on top of each request's
+        # worst-case reservation (admission reserves prompt+max_tokens
+        # growth up front, so a RUNNING slot can never hit a mid-decode
+        # allocation failure; the watermark additionally keeps headroom
+        # for in-flight KV imports/migrations).
+        self.max_queued_requests = max(0, max_queued_requests)
+        if admission_watermark_pages is None:
+            from ..core.config import get_config
+
+            admission_watermark_pages = \
+                get_config().serve_admission_watermark_pages
+        self.admission_watermark_pages = max(0, admission_watermark_pages)
         self._active: dict[int, Request] = {}       # decoding
         self._prefilling: deque[Request] = deque()  # admitted, chunks pending
         # Prefilled requests awaiting their (batched) first-token sample:
@@ -454,7 +492,17 @@ class InferenceEngine:
                         # Tiered KV: evicted trie pages spilled to host
                         # RAM and pages restored from it on a later hit.
                         "host_kv_spilled_pages": 0,
-                        "host_kv_restored_pages": 0}
+                        "host_kv_restored_pages": 0,
+                        # Overload protection: deadline expiries by where
+                        # the request was (queued = never touched the
+                        # engine; running = aborted mid-prefill/decode,
+                        # pages freed the same tick), bounded-queue sheds,
+                        # and admission-watermark refusals (the request
+                        # stays queued, never bounces to the client).
+                        "deadline_expired_queued": 0,
+                        "deadline_expired_running": 0,
+                        "queue_rejects": 0,
+                        "admission_rejects": 0}
 
     @staticmethod
     def total_pages(max_slots: int, max_len: int, page_size: int,
@@ -479,7 +527,22 @@ class InferenceEngine:
 
             request.trace = tracing.current_wire()
         with self._lock:
+            if self.max_queued_requests and \
+                    len(self._waiting) >= self.max_queued_requests:
+                self.metrics["queue_rejects"] += 1
+                raise QueueFullError(
+                    f"engine admission queue is full "
+                    f"({len(self._waiting)} waiting, bound "
+                    f"{self.max_queued_requests})",
+                    retry_after=self._queue_retry_after_locked())
             self._waiting.append(request)
+
+    def _queue_retry_after_locked(self) -> int:
+        """Retry-After for a replica-queue shed: the waiting backlog over
+        the concurrency the engine actually serves (its slots)."""
+        backlog = len(self._waiting) + len(self._prefilling) + \
+            len(self._active) + 1
+        return max(1, min(60, -(-backlog // max(1, self.max_slots))))
 
     def cancel(self, request_id: str) -> None:
         with self._lock:
@@ -634,6 +697,68 @@ class InferenceEngine:
 
         Returns emission events ``{"request_id", "token", "done",
         "finish_reason"}``."""
+        expired = self._expire_deadlines()
+        if expired:
+            return expired + self._step_scheduled()
+        return self._step_scheduled()
+
+    def _expire_deadlines(self) -> list[dict]:
+        """Overload protection: sweep expired request deadlines at the
+        tick boundary. A request that expires while still WAITING never
+        touches the engine (no slot, no pages, no prefill) — counter
+        ``deadline_expired_queued``; one that expires mid-prefill /
+        mid-decode / awaiting its first sample is aborted and retired
+        THIS tick, returning its slot, pages, and trie pins to the pool
+        — counter ``deadline_expired_running``. Emits a terminal event
+        per expiry so streams end promptly with finish_reason
+        "deadline"."""
+        events: list[dict] = []
+        now = time.time()
+        with self._lock:
+            if self._waiting and any(
+                    r.deadline is not None and now >= r.deadline
+                    for r in self._waiting):
+                keep: deque[Request] = deque()
+                for r in self._waiting:
+                    if r.deadline is not None and now >= r.deadline:
+                        r.done, r.finish_reason = True, "deadline"
+                        self.metrics["deadline_expired_queued"] += 1
+                        events.append({"request_id": r.request_id,
+                                       "token": -1, "done": True,
+                                       "finish_reason": "deadline"})
+                    else:
+                        keep.append(r)
+                self._waiting = keep
+            expired: list[Request] = []
+            if any(r.deadline is not None and now >= r.deadline
+                   for r in self._prefilling):
+                keep = deque()
+                for r in self._prefilling:
+                    if r.deadline is not None and now >= r.deadline \
+                            and not r.done:
+                        expired.append(r)
+                    else:
+                        keep.append(r)
+                self._prefilling = keep
+            for r in list(self._active.values()):
+                if r.deadline is not None and now >= r.deadline \
+                        and not r.done:
+                    expired.append(r)
+            for r, _h in self._pending_first:
+                if r.deadline is not None and now >= r.deadline \
+                        and not r.done:
+                    expired.append(r)  # the flush drops its handle
+            for r in expired:
+                r.done, r.finish_reason = True, "deadline"
+                self._retire_locked(r)
+                self.metrics["deadline_expired_running"] += 1
+                events.append({"request_id": r.request_id, "token": -1,
+                               "done": True, "finish_reason": "deadline"})
+        for r in expired:
+            self._record_decode_span(r)
+        return events
+
+    def _step_scheduled(self) -> list[dict]:
         self._admit()
         mix = self.metrics["engine_step_mix"]
         with self._lock:
@@ -701,8 +826,17 @@ class InferenceEngine:
                 # fresh allocation keeps one spare page as the reserved
                 # COW fork target, so the write-triggered fork can never
                 # fail under pressure mid-stream.
-                if self.allocator.available() < n_pages - len(hits):
+                #
+                # Admission watermark: the worst-case reservation taken
+                # HERE is what guarantees a running slot never hits a
+                # mid-decode allocation failure because of a newly
+                # admitted one — refusing (and counting) below the
+                # free-page watermark keeps the request IN the queue
+                # (head-of-line wait), never bouncing it to the client.
+                if self.allocator.available() < \
+                        n_pages - len(hits) + self.admission_watermark_pages:
                     self._unpin_hits_locked(hits, partial)
+                    self.metrics["admission_rejects"] += 1
                     break  # head-of-line: wait for pages to free
                 self._waiting.popleft()
                 fresh = self.allocator.alloc(n_pages - len(hits))
@@ -1215,6 +1349,28 @@ class InferenceEngine:
             "finish_reason": r.finish_reason,
         }
 
+    def pool_stats(self) -> dict:
+        """Page-pool accounting snapshot: free pages, cached (trie)
+        pages, and pages still PINNED (refcount > 0, i.e. held by a live
+        slot, an export pin, or a prefix-hit pin). After every request
+        settles — including mid-decode deadline aborts — ``pinned`` must
+        return to 0 and ``active_slots`` to 0: the chaos overload plan's
+        refcounts-at-baseline invariant."""
+        with self._lock:
+            cached = len(self.allocator.page_hash) + \
+                len(self.allocator._partial_pages)
+            pinned = sum(1 for _p, c in self.allocator.refcount.items()
+                         if c > 0)
+            return {
+                "num_pages": self.num_pages,
+                "free": len(self.allocator.free),
+                "cached": cached,
+                "pinned": pinned,
+                "active_slots": len(self._active),
+                "prefilling": len(self._prefilling),
+                "waiting": len(self._waiting),
+            }
+
     # ----------------------------------------------------------- KV migration
     @property
     def supports_kv_migration(self) -> bool:
@@ -1224,12 +1380,14 @@ class InferenceEngine:
         return bool(self.enable_prefix_cache and
                     getattr(self.executor, "supports_kv_migration", False))
 
-    def export_prefix_kv(self, prompt, model: str | None = None) -> dict | None:
-        """Export the cached KV covering ``prompt``'s longest prefix —
-        full trie blocks plus the best partial tail — as a host payload
-        an ``import_prefix_kv`` on another engine can adopt. The pages
-        are pinned across the device→host pull so pool pressure cannot
-        recycle them mid-export. Returns None when nothing is cached (or
+    def pin_prefix_for_export(self, prompt,
+                              model: str | None = None) -> dict | None:
+        """Match ``prompt``'s longest cached chain — full trie blocks
+        plus the best partial tail — and PIN its pages for export: one
+        extra refcount per page so pool pressure cannot recycle them
+        mid-transfer. Returns the export plan ``{"page_ids", "tokens",
+        "full_pages", "partial_len", "model"}`` (release with
+        ``release_export_pages``), or None when nothing is cached (or
         migration is unsupported)."""
         if not self.supports_kv_migration or len(prompt) < 2:
             return None
@@ -1250,20 +1408,44 @@ class InferenceEngine:
                 return None
             ids = list(hits) + ([partial[0]] if partial is not None else [])
             for pid in ids:
-                self.allocator.share(pid)  # pin across the pull
+                self.allocator.share(pid)  # pinned until released
+        plen = partial[1] if partial is not None else 0
+        covered = len(hits) * ps + plen
+        return {"page_ids": ids,
+                "tokens": [int(t) for t in prompt[:covered]],
+                "full_pages": len(hits), "partial_len": plen,
+                "model": model or ""}
+
+    def release_export_pages(self, page_ids: list[int]) -> None:
+        """Drop the per-page export pins ``pin_prefix_for_export`` took;
+        the pages become ordinary evictable cache entries again."""
+        with self._lock:
+            for pid in page_ids:
+                self.allocator.release(pid)
+
+    def export_prefix_kv(self, prompt, model: str | None = None) -> dict | None:
+        """Export the cached KV covering ``prompt``'s longest prefix —
+        full trie blocks plus the best partial tail — as a host payload
+        an ``import_prefix_kv`` on another engine can adopt, in ONE
+        blocking pull (the chunked alternative is a
+        ``KVMigrationSource.for_cached_prefix`` stream). The pages are
+        pinned across the device→host pull so pool pressure cannot
+        recycle them mid-export. Returns None when nothing is cached (or
+        migration is unsupported)."""
+        plan = self.pin_prefix_for_export(prompt, model)
+        if plan is None:
+            return None
+        ids = plan["page_ids"]
         try:
             data = self.executor.export_pages(ids)
         finally:
-            with self._lock:
-                for pid in ids:
-                    self.allocator.release(pid)
-        plen = partial[1] if partial is not None else 0
-        covered = len(hits) * ps + plen
+            self.release_export_pages(ids)
         self.metrics["kv_pages_exported"] += len(ids)
         self.metrics["kv_migrations_out"] += 1
-        return {"page_size": ps, "model": model or "",
-                "tokens": [int(t) for t in prompt[:covered]],
-                "full_pages": len(hits), "partial_len": plen,
+        return {"page_size": self.page_size, "model": plan["model"],
+                "tokens": plan["tokens"],
+                "full_pages": plan["full_pages"],
+                "partial_len": plan["partial_len"],
                 "k": data["k"], "v": data["v"]}
 
     def import_prefix_kv(self, payload: dict | None) -> int:

@@ -44,22 +44,30 @@ def _config():
 
 class KVMigrationSource:
     """Prefill-side exporter: streams one (possibly still prefilling)
-    request's prefix pages as they complete.
+    request's prefix pages as they complete — or, in STATIC mode
+    (:meth:`for_cached_prefix`), a prompt's already-cached trie pages,
+    which is how an affinity spill's target pulls the group's hot KV:
+    the same chunked wire as the disaggregation handoff, so a slow or
+    dying source degrades to the received prefix identically.
 
-    The request must be admitted with ``pin_for_export=True`` so its
-    pages survive retire until the transfer finishes; pages exported
-    while the request is live are additionally pinned around each
+    A live request must be admitted with ``pin_for_export=True`` so its
+    pages survive retire until the transfer finishes; static plans pin
+    their pages via ``engine.pin_prefix_for_export``. Pages exported
+    while a request is live are additionally pinned around each
     device→host pull. One background thread per migration; the server
     socket closes via :meth:`close` once the consumer is done (or on
     garbage collection of the socket)."""
 
     def __init__(self, engine, request, chunk_pages: int | None = None,
                  advertise: str | None = None,
-                 _die_after_chunks: int | None = None):
-        assert request.pin_for_export, \
-            "migration sources require pin_for_export=True requests"
+                 _die_after_chunks: int | None = None,
+                 static_plan: dict | None = None):
+        if static_plan is None:
+            assert request.pin_for_export, \
+                "migration sources require pin_for_export=True requests"
         self.engine = engine
         self.request = request
+        self._static_plan = static_plan
         self.chunk_pages = max(1, chunk_pages
                                or _config().kv_migration_chunk_pages)
         self._server = TcpLoopServer(n_slots=8, n_readers=1,
@@ -70,8 +78,26 @@ class KVMigrationSource:
         self._killed = False
         self.stats = {"pages": 0, "bytes": 0, "chunks": 0}
         self._thread = threading.Thread(
-            target=self._run, daemon=True, name="kv-migration-src")
+            target=self._run_static if static_plan is not None else self._run,
+            daemon=True, name="kv-migration-src")
         self._thread.start()
+
+    @classmethod
+    def for_cached_prefix(cls, engine, prompt_ids, model: str | None = None,
+                          chunk_pages: int | None = None,
+                          advertise: str | None = None,
+                          _die_after_chunks: int | None = None
+                          ) -> "KVMigrationSource | None":
+        """Open a migration stream over the engine's CACHED pages
+        covering ``prompt_ids``'s longest prefix (the spill-migration
+        export). Returns None when nothing is cached — the caller just
+        cold-prefills."""
+        plan = engine.pin_prefix_for_export(prompt_ids, model)
+        if plan is None:
+            return None
+        return cls(engine, None, chunk_pages=chunk_pages,
+                   advertise=advertise, _die_after_chunks=_die_after_chunks,
+                   static_plan=plan)
 
     @property
     def address(self) -> str:
@@ -159,6 +185,57 @@ class KVMigrationSource:
             except Exception:
                 pass
             eng.release_export_pins(r)
+
+    def _run_static(self) -> None:
+        """Stream an already-cached prefix (pinned by the plan): full
+        trie blocks chunk-by-chunk, then the partial tail, then end —
+        the exact wire shape of the live path, so the importer's
+        degrade-to-received-prefix semantics are identical."""
+        eng, plan = self.engine, self._static_plan
+        ps = eng.page_size
+        ids = plan["page_ids"]
+        full = plan["full_pages"]
+        tokens = plan["tokens"]
+        try:
+            self._send({"kind": "meta", "page_size": ps,
+                        "model": plan["model"] or "",
+                        "prompt_len": len(tokens)})
+            sent = 0
+            while sent < full:
+                hi = min(sent + self.chunk_pages, full)
+                data = self._export_pinned(ids[sent:hi])
+                self._send({"kind": "pages",
+                            "tokens": tokens[sent * ps:hi * ps],
+                            "k": data["k"], "v": data["v"]})
+                self.stats["pages"] += hi - sent
+                self.stats["chunks"] += 1
+                sent = hi
+                if self._die_after is not None \
+                        and self.stats["chunks"] >= self._die_after:
+                    self._killed = True
+                    self._server.close()  # simulated source death
+                    return
+            if plan["partial_len"] and len(ids) > full:
+                data = self._export_pinned([ids[full]])
+                self._send({"kind": "tail",
+                            "tokens": tokens[full * ps:],
+                            "k": data["k"], "v": data["v"]})
+                self.stats["pages"] += 1
+                self.stats["chunks"] += 1
+            self._send({"kind": "end"})
+            eng.metrics["kv_pages_exported"] += self.stats["pages"]
+            eng.metrics["kv_migrations_out"] += 1
+        except Exception:
+            try:
+                self._send({"kind": "abort"})
+            except Exception:
+                pass
+        finally:
+            try:
+                self._server.close_writer(timeout=5.0)
+            except Exception:
+                pass
+            eng.release_export_pages(ids)
 
     def join(self, timeout: float | None = 30.0) -> None:
         self._thread.join(timeout)

@@ -103,6 +103,8 @@ class LLMDeployment:
         role: str = "unified",
         decode_handle=None,
         host_kv_cache_pages: int = 0,
+        max_queued_requests: int = 0,
+        admission_watermark_pages: int | None = None,
     ):
         mesh = None
         executor = None
@@ -165,6 +167,8 @@ class LLMDeployment:
             max_prefill_seqs_per_step=max_prefill_seqs_per_step,
             decode_starvation_limit=decode_starvation_limit,
             host_kv_cache_pages=host_kv_cache_pages,
+            max_queued_requests=max_queued_requests,
+            admission_watermark_pages=admission_watermark_pages,
         )
         # Disaggregated serving (DistServe-style prefill/decode split):
         # a "prefill"-role replica chunk-prefills prompts locally, ships
@@ -200,6 +204,9 @@ class LLMDeployment:
         self._token_queues: dict[str, queue.Queue] = {}
         self._counter = 0
         self._lock = threading.Lock()
+        # Spill-migration exporters opened FOR remote pullers (reaped as
+        # their streams drain — see _track_spill_source).
+        self._spill_sources: list = []
         self._running = True
         self._loop_thread = threading.Thread(target=self._engine_loop, daemon=True)
         self._loop_thread.start()
@@ -275,19 +282,44 @@ class LLMDeployment:
         return prefix_group_key(session_id=str(session_id or ""),
                                 text=prompt)
 
+    @staticmethod
+    def _effective_deadline(body: dict | None = None) -> float | None:
+        """The request's absolute wall-clock deadline: the proxy-stamped
+        value riding the replica thread-local, tightened by a
+        ``timeout_s`` body field when the request arrived by handle
+        (no proxy hop to stamp it)."""
+        from ..serve.router import get_request_deadline
+
+        deadline = get_request_deadline()
+        t = (body or {}).get("timeout_s")
+        if t is not None:
+            try:
+                local = time.time() + max(0.0, float(t))
+                deadline = local if deadline is None else min(deadline, local)
+            except (TypeError, ValueError):
+                pass
+        return deadline
+
     # ------------------------------------------------------ blocking path
     def generate(self, prompt: str, max_new_tokens: int = 16,
                  temperature: float = 0.0, model: str | None = None,
-                 session_id: str | None = None) -> dict:
+                 session_id: str | None = None,
+                 deadline: float | None = None) -> dict:
         """Blocking completion; many calls run concurrently on replica
         threads and share the engine's decode batch. ``model`` other than
-        the base model id selects a LoRA adapter."""
+        the base model id selects a LoRA adapter. ``deadline`` (absolute
+        wall clock; defaults to the proxy-stamped request deadline)
+        bounds the request end to end — expiry in the engine queue fails
+        fast, expiry mid-decode aborts the slot."""
         self._maybe_spill_migrate(prompt, model)
+        if deadline is None:
+            deadline = self._effective_deadline()
         ids = self.tokenizer.encode(prompt)
         rid = self._next_rid()
         req = Request(rid, ids, max_new_tokens, temperature,
                       eos_id=self.tokenizer.eos_id,
-                      model=self._adapter_for(model))
+                      model=self._adapter_for(model),
+                      deadline=deadline)
         done = threading.Event()
         self._events[rid] = done  # before add: the engine may finish fast
         try:
@@ -295,10 +327,21 @@ class LLMDeployment:
         except ValueError:
             self._events.pop(rid, None)
             raise
-        if not done.wait(timeout=self.request_timeout_s):
-            self.engine.cancel(rid)
+        except Exception:
             self._events.pop(rid, None)
-            finish = "timeout"
+            raise  # QueueFullError: the proxy answers 503 + Retry-After
+        timeout = self.request_timeout_s
+        if deadline is not None:
+            # The engine sweeps expired deadlines each tick; the extra
+            # slack only covers the tick boundary.
+            timeout = max(0.05, min(timeout, deadline - time.time() + 1.0))
+        if not done.wait(timeout=timeout):
+            if req.done and req.finish_reason:
+                finish = req.finish_reason  # engine settled it (deadline)
+            else:
+                self.engine.cancel(rid)
+                finish = "timeout"
+            self._events.pop(rid, None)
         else:
             finish = req.finish_reason
         _observe_ttft(req, _deployment_tag(self.model_id), self.engine)
@@ -312,17 +355,27 @@ class LLMDeployment:
         }
 
     # ----------------------------------------------------- streaming path
-    def _stream_tokens(self, req: Request, group: str = ""):
-        """Yield engine events for one request as they are produced; on
-        GeneratorExit (consumer gone) cancel the request so its pages and
-        slot free immediately."""
+    def _admit_streaming(self, req: Request) -> queue.Queue:
+        """Register the token queue and admit ``req``. Split from
+        ``_stream_tokens`` so admission — and its QueueFullError shed —
+        happens BEFORE the SSE response head is yielded: the proxy can
+        then still answer a clean 503 + Retry-After status line."""
         q: queue.Queue = queue.Queue()
         self._token_queues[req.request_id] = q
         try:
             self.engine.add_request(req)
-        except ValueError:
+        except Exception:
             self._token_queues.pop(req.request_id, None)
             raise
+        return q
+
+    def _stream_tokens(self, req: Request, group: str = "",
+                       q: queue.Queue | None = None):
+        """Yield engine events for one request as they are produced; on
+        GeneratorExit (consumer gone) cancel the request so its pages and
+        slot free immediately."""
+        if q is None:
+            q = self._admit_streaming(req)
         deadline = time.monotonic() + self.request_timeout_s
         first = True
         try:
@@ -379,7 +432,8 @@ class LLMDeployment:
         if not body.get("stream"):
             out = self.generate(prompt, max_tokens, temperature,
                                 model=body.get("model"),
-                                session_id=body.get("session_id"))
+                                session_id=body.get("session_id"),
+                                deadline=self._effective_deadline(body))
             usage = {
                 "prompt_tokens": len(self.tokenizer.encode(prompt)),
                 "completion_tokens": out["num_generated"],
@@ -482,7 +536,8 @@ class LLMDeployment:
         group = self._group_of(prompt, body.get("session_id"))
         handle = self._decode_handle.options(
             method_name="migrated_completions",
-            prefix_group=group or f"mig:{uuid.uuid4().hex[:8]}")
+            prefix_group=group or f"mig:{uuid.uuid4().hex[:8]}",
+            deadline=self._effective_deadline(body))
         if not body.get("stream"):
             try:
                 out = handle.remote(migration, body).result(
@@ -532,20 +587,60 @@ class LLMDeployment:
         return relay()
 
     def export_prefix_kv(self, prompt: str, model: str | None = None):
-        """Handle/actor entry point (spill migration): export this
-        replica's cached KV covering ``prompt``'s longest prefix, for a
-        spill target to import instead of recomputing."""
+        """Handle/actor entry point: export this replica's cached KV
+        covering ``prompt``'s longest prefix as ONE blocking payload
+        (``open_prefix_kv_stream`` is the chunked streaming form the
+        spill pull uses)."""
         ids = self.tokenizer.encode(prompt)
         return self.engine.export_prefix_kv(ids, self._adapter_for(model))
+
+    def open_prefix_kv_stream(self, prompt: str,
+                              model: str | None = None) -> dict | None:
+        """Handle/actor entry point (spill migration): open a chunked
+        ``KVMigrationSource`` stream over this replica's cached KV
+        covering ``prompt``'s longest prefix, so the spill target
+        imports chunk-by-chunk — a slow or dying source degrades to the
+        received prefix exactly like the disaggregation handoff.
+        Returns ``{"kv_address": ...}`` or None when nothing is cached."""
+        from .migration import KVMigrationSource
+
+        ids = self.tokenizer.encode(prompt)
+        src = KVMigrationSource.for_cached_prefix(
+            self.engine, ids, self._adapter_for(model))
+        if src is None:
+            return None
+        self._track_spill_source(src)
+        return {"kv_address": src.address}
+
+    def _track_spill_source(self, src) -> None:
+        """Keep remotely-opened spill exporters until their streams
+        drain, reaping finished ones (and force-closing the oldest past
+        the cap) on each new open — the server socket outlives the
+        exporter thread until close()."""
+        with self._lock:
+            sources = getattr(self, "_spill_sources", [])
+            keep = []
+            for s in sources:
+                if s._thread.is_alive() and len(keep) < 7:
+                    keep.append(s)
+                else:
+                    try:
+                        s.close()
+                    except Exception:
+                        pass
+            keep.append(src)
+            self._spill_sources = keep
 
     def _maybe_spill_migrate(self, prompt: str,
                              model: str | None = None) -> None:
         """An affinity spill used to throw the group's cached KV away
         (PR-10 residue b): when the router ships the previous affine
         replica's identity with a spilled request, pull the group's hot
-        pages from it and import them — migrate-instead-of-recompute,
-        with disaggregation on OR off. Failure of any step falls back to
-        the old behavior (cold prefill)."""
+        pages from it over the CHUNKED migration stream and import them
+        as they arrive — migrate-instead-of-recompute, with
+        disaggregation on OR off, degrading to the received prefix when
+        the source slows or dies mid-pull. Failure of any step falls
+        back to the old behavior (cold prefill)."""
         from ..serve.router import get_migration_source
 
         src = get_migration_source()
@@ -561,12 +656,21 @@ class LLMDeployment:
             from ..core import api as ray
             from ..core.api import ActorHandle
 
+            from .migration import receive_kv_stream
+
             actor = ActorHandle(bytes.fromhex(src["actor_id"]))
-            payload = ray.get(
+            reply = ray.get(
                 actor.handle_request.remote(
-                    "export_prefix_kv", (prompt, model), {}),
+                    "open_prefix_kv_stream", (prompt, model), {}),
                 timeout=30)
-            attrs["cached_tokens"] = self.engine.import_prefix_kv(payload)
+            addr = (reply or {}).get("kv_address")
+            if addr:
+                stats = receive_kv_stream(self.engine, addr)
+                attrs.update({k: stats.get(k) for k in
+                              ("cached_tokens", "pages", "bytes",
+                               "seconds", "complete", "status")})
+            else:
+                attrs["status"] = "nothing cached"
         except Exception as e:
             attrs["status"] = f"{type(e).__name__}: {e}"
         self._record_kv_migrate_span(t0w, attrs)
@@ -598,19 +702,27 @@ class LLMDeployment:
         rid = self._next_rid()
         req = Request(rid, ids, max_tokens, temperature,
                       eos_id=self.tokenizer.eos_id,
-                      model=self._adapter_for(body.get("model")))
+                      model=self._adapter_for(body.get("model")),
+                      deadline=self._effective_deadline(body))
         group = self._group_of(prompt, body.get("session_id"))
 
         def gen():
             self._maybe_spill_migrate(prompt, body.get("model"))
+            # Admit BEFORE the response head: a bounded-queue shed (or an
+            # invalid prompt) surfaces on a clean error status instead of
+            # a truncated 200 stream.
+            q = self._admit_streaming(req)
             yield {"__serve_response__": True, "content_type": "text/event-stream"}
             if chat:
                 head = {"id": cid, "object": obj, "created": created, "model": model,
                         "choices": [{"index": 0, "delta": {"role": "assistant"},
                                      "finish_reason": None}]}
                 yield f"data: {json.dumps(head)}\n\n"
-            for event in self._stream_tokens(req, group):
-                text = self.tokenizer.decode([event["token"]])
+            for event in self._stream_tokens(req, group, q=q):
+                # Terminal-only events (deadline expiry) carry token -1:
+                # no text, just the finish_reason.
+                text = (self.tokenizer.decode([event["token"]])
+                        if event["token"] >= 0 else "")
                 if chat:
                     choice = {"index": 0, "delta": {"content": text},
                               "finish_reason": _openai_finish(event["finish_reason"]) if event["done"] else None}
@@ -637,6 +749,20 @@ class LLMDeployment:
                 "mixed_dispatch_enabled": self.engine.mixed_dispatch_enabled,
                 "role": self._role,
                 "supports_kv_migration": self.engine.supports_kv_migration}
+
+    def overload_stats(self) -> dict:
+        """Engine-side overload counters, picked up by the replica
+        actor's ``latency_snapshot`` probe (``serve_overload`` row) and
+        folded into ``serve.status()`` per deployment."""
+        m = self.engine.metrics
+        return {"deadline_expired_queued": m["deadline_expired_queued"],
+                "deadline_expired_running": m["deadline_expired_running"],
+                "queue_rejects": m["queue_rejects"],
+                "admission_rejects": m["admission_rejects"]}
+
+    def pool_stats(self) -> dict:
+        """Engine page-pool accounting (chaos invariant surface)."""
+        return self.engine.pool_stats()
 
     # ---------------------------------------------------------- HTTP entry
     def __call__(self, request):
@@ -692,7 +818,9 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
                   use_compiled_loop: bool | None = None,
                   serve_disaggregation: str | None = None,
                   prefill_replicas: int = 1,
-                  host_kv_cache_pages: int = 0):
+                  host_kv_cache_pages: int = 0,
+                  max_queued_requests: int = 0,
+                  admission_watermark_pages: int | None = None):
     """Build a Serve Application serving ``preset`` (serve.run-able).
     Pass ``ray_actor_options={"resources": {"TPU": 1}, ...}`` to pin each
     replica (engine) to a TPU chip. For an engine that SPANS hosts, set
@@ -724,7 +852,9 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
         max_prefill_seqs_per_step=max_prefill_seqs_per_step,
         decode_starvation_limit=decode_starvation_limit,
         use_compiled_loop=use_compiled_loop,
-        host_kv_cache_pages=host_kv_cache_pages)
+        host_kv_cache_pages=host_kv_cache_pages,
+        max_queued_requests=max_queued_requests,
+        admission_watermark_pages=admission_watermark_pages)
     if serve_disaggregation is None:
         dep = deployment(
             LLMDeployment,

@@ -23,15 +23,25 @@ from .grpc_proxy import start_grpc
 from .deployment import Application, AutoscalingConfig, Deployment, deployment
 from .multiplex import get_multiplexed_model_id, multiplexed
 from .replica import Request
-from .router import DeploymentHandle, DeploymentResponse, DeploymentStreamingResponse
+from .router import (
+    DeadlineExceeded,
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentStreamingResponse,
+    RequestShed,
+    get_request_deadline,
+)
 
 __all__ = [
     "Application",
     "AutoscalingConfig",
+    "DeadlineExceeded",
     "Deployment",
     "DeploymentHandle",
     "DeploymentResponse",
     "DeploymentStreamingResponse",
+    "RequestShed",
+    "get_request_deadline",
     "Request",
     "batch",
     "build_app_from_spec",

@@ -157,9 +157,23 @@ def get_deployment_handle(deployment_name: str, app_name: str = "default") -> De
 def status() -> dict:
     controller = ray.get_actor(CONTROLLER_NAME)
     deps = ray.get(controller.list_deployments.remote(), timeout=30)
-    return {
+    out = {
         app: ray.get(controller.get_app_status.remote(app), timeout=30) for app in deps
     }
+    # Fold in the HTTP proxy's router-side overload view (front-door
+    # sheds by reason, router-queue deadline expiries, circuit states):
+    # the replica probes only see requests that reached a replica.
+    try:
+        proxy = ray.get_actor(_PROXY_NAME)
+        stats = ray.get(proxy.overload_stats.remote(), timeout=10)
+        for app, dep_stats in (stats or {}).items():
+            for dep, snap in dep_stats.items():
+                slot = out.get(app, {}).get(dep)
+                if slot is not None:
+                    slot.setdefault("overload", {})["router"] = snap
+    except Exception:
+        pass
+    return out
 
 
 def delete(name: str) -> None:

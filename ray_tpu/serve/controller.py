@@ -91,6 +91,9 @@ class _DeploymentState:
         # Aggregated prefix-group residency from the replicas' probe
         # rows (affinity hit rates in status; empty = no LLM engines).
         self.prefix_affinity: dict = {}
+        # Aggregated overload counters from the replicas' probe rows
+        # (deadline expiries, engine-queue sheds, admission rejects).
+        self.overload: dict = {}
 
     @property
     def name(self) -> str:
@@ -192,6 +195,7 @@ class ServeController:
                     "autoscale_events": list(state.scale_events[-10:]),
                     "preemption_evictions": list(state.preemption_evictions[-10:]),
                     "prefix_affinity": dict(state.prefix_affinity),
+                    "overload": dict(state.overload),
                 }
             return out
 
@@ -356,6 +360,7 @@ class ServeController:
         dirty = False
         with self._lock:
             self._fold_prefix_residency(state, probes)
+            self._fold_overload(state, probes)
             self._autoscale_from_probes(state, probes)
             target = state.target_replicas
             for r in list(state.replicas):
@@ -496,6 +501,26 @@ class ServeController:
                                if agg["requests"] else 0.0)
             state.prefix_affinity = agg
 
+    @staticmethod
+    def _fold_overload(state: _DeploymentState, probes: dict) -> None:
+        """Sum the replicas' ``serve_overload`` probe rows (engine-side
+        deadline expiries, queue sheds, admission-watermark rejects)
+        into the deployment's overload view for ``serve.status()``."""
+        keys = ("deadline_expired_queued", "deadline_expired_running",
+                "queue_rejects", "admission_rejects")
+        agg = {k: 0 for k in keys}
+        replicas = 0
+        for p in probes.values():
+            for row in p.get("latency") or []:
+                if row.get("name") != "serve_overload":
+                    continue
+                replicas += 1
+                for k in keys:
+                    agg[k] += int(row.get(k, 0) or 0)
+        if replicas:
+            agg["replicas"] = replicas
+            state.overload = agg
+
     def _replica_alive(self, r: _Replica) -> bool:
         try:
             ray.get(r.actor.check_health.remote(), timeout=10)
@@ -520,7 +545,7 @@ class ServeController:
             max_concurrency=cfg["max_ongoing"] + 8, **actor_options
         ).remote(
             cfg["serialized_callable"], cfg["init_args"], cfg["init_kwargs"],
-            cfg.get("user_config"), state.name, state.app_name,
+            cfg.get("user_config"), state.name, state.app_name, replica_id,
         )
         r = _Replica(replica_id, version, handle, handle._actor_id)
         r.applied_user_config = cfg.get("user_config")

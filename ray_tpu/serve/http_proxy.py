@@ -20,7 +20,33 @@ from ..core import api as ray
 from ..observability import tracing
 from .long_poll import LongPollClient
 from .replica import Request
-from .router import CONTROLLER_NAME, DeploymentHandle, prefix_group_key
+from .router import (CONTROLLER_NAME, DeadlineExceeded, DeploymentHandle,
+                     RequestShed, prefix_group_key)
+
+
+def _request_deadline_budget(request: Request) -> float:
+    """End-to-end deadline budget (seconds) for one request, resolved at
+    the front door: the ``x-raytpu-deadline-ms`` header beats a
+    ``timeout_s`` JSON body field beats the ``serve_default_deadline_s``
+    config. 0 = no deadline (the request may wait forever)."""
+    header = request.headers.get("x-raytpu-deadline-ms", "")
+    if header:
+        try:
+            return max(0.0, float(header) / 1000.0)
+        except ValueError:
+            pass
+    if request.body and request.headers.get(
+            "content-type", "").startswith("application/json"):
+        try:
+            body = json.loads(request.body)
+            t = body.get("timeout_s")
+            if t is not None:
+                return max(0.0, float(t))
+        except Exception:
+            pass
+    from ..core.config import get_config
+
+    return max(0.0, get_config().serve_default_deadline_s)
 
 
 def _request_prefix_group(request: Request) -> str:
@@ -120,6 +146,18 @@ class ProxyActor:
         self._check_started()
         return True
 
+    def overload_stats(self) -> dict:
+        """Per-deployment overload counters from this proxy's routers
+        (sheds by reason, router-queue deadline expiries, circuit
+        states) — merged into ``serve.status()`` by the API layer."""
+        out: dict = {}
+        for (app, dep), handle in list(self._handles.items()):
+            router = handle._router_holder.get("router")
+            if router is None:
+                continue
+            out.setdefault(app, {})[dep] = router.overload_snapshot()
+        return out
+
     # ------------------------------------------------------------- http core
     async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:
@@ -140,8 +178,10 @@ class ProxyActor:
 
     @staticmethod
     def _write_full(writer, status: str, body: bytes, content_type: str = "application/json",
-                    trace_id: str = ""):
+                    trace_id: str = "", extra_headers: dict | None = None):
         extra = f"x-raytpu-trace-id: {trace_id}\r\n" if trace_id else ""
+        for k, v in (extra_headers or {}).items():
+            extra += f"{k}: {v}\r\n"
         writer.write((
             f"HTTP/1.1 {status}\r\nContent-Type: {content_type}\r\n"
             f"Content-Length: {len(body)}\r\n{extra}Connection: keep-alive\r\n\r\n"
@@ -222,6 +262,13 @@ class ProxyActor:
         group = _request_prefix_group(request)
         if group:
             handle = handle.options(prefix_group=group)
+        # End-to-end deadline, stamped HERE (ingress) as an absolute wall
+        # clock and threaded router → replica → engine: expiry anywhere
+        # downstream fails fast instead of burning capacity.
+        budget = _request_deadline_budget(request)
+        deadline = time.time() + budget if budget else None
+        if deadline is not None:
+            handle = handle.options(deadline=deadline)
         # Root span for the request (or a continuation of the client's
         # trace via the x-raytpu-trace header); everything downstream —
         # router queue, replica task, engine prefill/decode — chains
@@ -230,6 +277,22 @@ class ProxyActor:
         ctx = tracing.context_from_headers(request.headers)
         t0 = time.time()
         status = "200"
+
+        def _shed_span(reason: str) -> None:
+            # One `llm.shed` span per refused request: the trace-store
+            # view of overload protection, tagged with WHY it was shed.
+            tracing.record_span(tracing.make_span(
+                "llm.shed", "serve", t0, time.time(),
+                ctx.trace_id, ctx.parent_id, attrs={
+                    "reason": reason, "app": route["app"],
+                    "deployment": route["deployment"]}))
+
+        def _retry_after_hint() -> int:
+            try:
+                return handle._get_router().retry_after_hint()
+            except Exception:
+                return 1
+
         try:
             loop = asyncio.get_running_loop()
             stream = None
@@ -246,19 +309,64 @@ class ProxyActor:
                                  trace_id=ctx.trace_id)
                 await writer.drain()
                 return True
-            except TimeoutError as e:
+            except RequestShed as e:
+                # Overload protection refused the request: an honest,
+                # FAST 503 with a Retry-After derived from the observed
+                # service rate — the client backs off instead of piling
+                # onto a collapsing queue.
                 status = "503"
                 if stream is not None:
-                    stream.close()  # release the router slot, cancel the replica
-                self._write_full(writer, "503 Service Unavailable",
+                    stream.close()
+                _shed_span(e.reason)
+                self._write_full(
+                    writer, "503 Service Unavailable",
+                    json.dumps({"error": str(e), "reason": e.reason}).encode(),
+                    trace_id=ctx.trace_id,
+                    extra_headers={"Retry-After": e.retry_after})
+                await writer.drain()
+                return True
+            except DeadlineExceeded as e:
+                status = "504"
+                if stream is not None:
+                    stream.close()
+                _shed_span("deadline")
+                self._write_full(writer, "504 Gateway Timeout",
                                  json.dumps({"error": str(e)}).encode(),
                                  trace_id=ctx.trace_id)
                 await writer.drain()
                 return True
+            except TimeoutError as e:
+                status = "503"
+                if stream is not None:
+                    stream.close()  # release the router slot, cancel the replica
+                _shed_span("saturated")
+                self._write_full(
+                    writer, "503 Service Unavailable",
+                    json.dumps({"error": str(e)}).encode(),
+                    trace_id=ctx.trace_id,
+                    extra_headers={"Retry-After": _retry_after_hint()})
+                await writer.drain()
+                return True
             except Exception as e:
-                status = "500"
+                from ..core.status import ActorDiedError
+
                 if stream is not None:
                     stream.close()
+                if isinstance(e, ActorDiedError):
+                    # Replica-death retries exhausted (or death before the
+                    # replacement is up): the controller is already
+                    # replacing it — tell the client when to come back
+                    # instead of a bare 500.
+                    status = "503"
+                    _shed_span("replica_death")
+                    self._write_full(
+                        writer, "503 Service Unavailable",
+                        json.dumps({"error": f"{type(e).__name__}: {e}"}).encode(),
+                        trace_id=ctx.trace_id,
+                        extra_headers={"Retry-After": _retry_after_hint()})
+                    await writer.drain()
+                    return True
+                status = "500"
                 self._write_full(writer, "500 Internal Server Error",
                                  json.dumps({"error": f"{type(e).__name__}: {e}"}).encode(),
                                  trace_id=ctx.trace_id)
@@ -266,11 +374,18 @@ class ProxyActor:
                 return True
 
             if head.get("kind") == "error":
-                status = "500"
                 stream.close()  # settle the router slot
-                self._write_full(writer, "500 Internal Server Error",
+                # Replica-side sheds (engine queue bound) arrive as error
+                # messages carrying their own status + Retry-After.
+                head_status = head.get("status") or "500 Internal Server Error"
+                status = head_status.split()[0]
+                extra = None
+                if head.get("retry_after") is not None:
+                    extra = {"Retry-After": head["retry_after"]}
+                    _shed_span(head.get("reason", "overload"))
+                self._write_full(writer, head_status,
                                  json.dumps({"error": head["error"]}).encode(),
-                                 trace_id=ctx.trace_id)
+                                 trace_id=ctx.trace_id, extra_headers=extra)
                 await writer.drain()
                 return True
             if head.get("kind") == "full":

@@ -52,16 +52,19 @@ class ReplicaActor:
     user class so replicas never re-import application modules."""
 
     def __init__(self, serialized_callable: bytes, init_args: tuple, init_kwargs: dict,
-                 user_config: Any = None, deployment_name: str = "", app_name: str = ""):
+                 user_config: Any = None, deployment_name: str = "", app_name: str = "",
+                 replica_id: str = ""):
         from .router import resolve_handle_markers
 
         global _REPLICA_CONTEXT
-        _REPLICA_CONTEXT = {"app": app_name, "deployment": deployment_name}
+        _REPLICA_CONTEXT = {"app": app_name, "deployment": deployment_name,
+                            "replica_id": replica_id}
         self._lock = threading.Lock()
         self._ongoing = 0
         self._total = 0
         self._deployment_name = deployment_name
         self._app_name = app_name
+        self._replica_id = replica_id
         try:
             func_or_class = cloudpickle.loads(serialized_callable)
             init_args = resolve_handle_markers(init_args)
@@ -131,6 +134,16 @@ class ReplicaActor:
                              **(residency() or {})})
             except Exception:
                 pass
+        # Overload counters (deadline expiries, engine queue sheds,
+        # admission-watermark rejects) piggyback the same probe for the
+        # controller's per-deployment status aggregation.
+        overload = getattr(self._callable, "overload_stats", None)
+        if overload is not None:
+            try:
+                rows.append({"name": "serve_overload",
+                             **(overload() or {})})
+            except Exception:
+                pass
         return rows
 
     def reconfigure(self, user_config: Any) -> bool:
@@ -139,12 +152,35 @@ class ReplicaActor:
             fn(user_config)
         return True
 
+    def _chaos_delay(self) -> None:
+        """Chaos injection point: per-replica handle delays (the
+        ``replica_delay`` FaultPlan kind) — a deterministic stand-in for
+        a replica gone slow, used to exercise the deadline/circuit paths
+        under the overload chaos plan."""
+        from ..core.rpc import get_chaos
+
+        chaos = get_chaos()
+        fn = getattr(chaos, "replica_delay_s", None)
+        if fn is None:
+            return
+        try:
+            delay = fn(self._replica_id)
+        except Exception:
+            return
+        if delay > 0:
+            import time
+
+            time.sleep(delay)
+
     def handle_request(self, method_name: str, args: tuple, kwargs: dict):
         from .multiplex import MULTIPLEXED_KWARG, set_multiplexed_model_id
-        from .router import MIGRATE_FROM_KWARG, set_migration_source
+        from .router import (DEADLINE_KWARG, MIGRATE_FROM_KWARG,
+                             set_migration_source, set_request_deadline)
 
         set_multiplexed_model_id(kwargs.pop(MULTIPLEXED_KWARG, ""))
         set_migration_source(kwargs.pop(MIGRATE_FROM_KWARG, None))
+        set_request_deadline(kwargs.pop(DEADLINE_KWARG, None))
+        self._chaos_delay()
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -181,10 +217,13 @@ class ReplicaActor:
         import json as _json
 
         from .multiplex import MULTIPLEXED_KWARG, set_multiplexed_model_id
-        from .router import MIGRATE_FROM_KWARG, set_migration_source
+        from .router import (DEADLINE_KWARG, MIGRATE_FROM_KWARG,
+                             set_migration_source, set_request_deadline)
 
         set_multiplexed_model_id(kwargs.pop(MULTIPLEXED_KWARG, ""))
         set_migration_source(kwargs.pop(MIGRATE_FROM_KWARG, None))
+        set_request_deadline(kwargs.pop(DEADLINE_KWARG, None))
+        self._chaos_delay()
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -217,7 +256,16 @@ class ReplicaActor:
                     data = _json.dumps(item).encode() + b"\n"
                 yield {"kind": "chunk", "data": data}
         except Exception as e:
-            yield {"kind": "error", "error": f"{type(e).__name__}: {e}"}
+            # Overload sheds (engine queue full, admission refused) carry
+            # an http_status/retry_after so the proxy can answer an
+            # honest 503 + Retry-After instead of a bare 500.
+            msg = {"kind": "error", "error": f"{type(e).__name__}: {e}"}
+            status = getattr(e, "http_status", None)
+            if status:
+                msg["status"] = status
+                msg["retry_after"] = getattr(e, "retry_after", 1)
+                msg["reason"] = getattr(e, "reason", "overload")
+            yield msg
         finally:
             with self._lock:
                 self._ongoing -= 1

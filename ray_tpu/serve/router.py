@@ -34,7 +34,52 @@ CONTROLLER_NAME = "SERVE_CONTROLLER"
 # multiplexed-model-id plumbing.
 MIGRATE_FROM_KWARG = "_serve_migrate_from"
 
+# End-to-end request deadline (overload protection): an absolute wall
+# clock (time.time()) stamped at proxy ingress from the
+# `x-raytpu-deadline-ms` header / `timeout_s` body field /
+# `serve_default_deadline_s` config, threaded router → replica queue →
+# engine admission → mid-stream decode. Travels as a reserved kwarg
+# (popped by the replica before the user callable sees it) and surfaces
+# through a thread-local, exactly like the multiplexed-model-id.
+DEADLINE_KWARG = "_serve_deadline"
+
 _migration_context = threading.local()
+_deadline_context = threading.local()
+
+
+def set_request_deadline(deadline: float | None) -> None:
+    """Install the current request's absolute wall-clock deadline for
+    this request thread (called by the replica before invoking the user
+    callable); None = no deadline."""
+    _deadline_context.deadline = deadline
+
+
+def get_request_deadline() -> float | None:
+    """Inside a request: the absolute ``time.time()`` deadline the proxy
+    stamped at ingress, or None when the request carries none."""
+    return getattr(_deadline_context, "deadline", None)
+
+
+class RequestShed(RuntimeError):
+    """The request was refused by overload protection (bounded queue,
+    circuit breaker, replica exhaustion) — an honest fast 503, not a
+    failure of the request itself. ``retry_after`` derives from the
+    observed per-replica service rate."""
+
+    http_status = "503 Service Unavailable"
+
+    def __init__(self, message: str, reason: str = "overload",
+                 retry_after: int = 1):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after = max(1, int(retry_after))
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's end-to-end deadline expired (here: while still
+    queued in the router, before any replica was touched)."""
+
+    http_status = "504 Gateway Timeout"
 
 
 def set_migration_source(src: dict | None) -> None:
@@ -104,6 +149,21 @@ def _serve_metrics():
                 "spill target pulls the group's hot KV pages from the "
                 "previous replica instead of cold-prefilling",
                 tag_keys=("deployment",))
+            _metrics["shed"] = Counter(
+                "serve_shed_requests",
+                "Requests shed by overload protection (bounded router "
+                "queue, circuit breaker, replica exhaustion) — fast "
+                "honest 503s instead of queue collapse",
+                tag_keys=("deployment", "reason"))
+            _metrics["deadline_expired"] = Counter(
+                "serve_deadline_expired",
+                "Requests whose end-to-end deadline expired, by where "
+                "they were when it did (queued = never touched a "
+                "replica)", tag_keys=("deployment", "where"))
+            _metrics["circuit_open"] = Counter(
+                "serve_circuit_open_total",
+                "Replica circuit-breaker open transitions (N consecutive "
+                "handle timeouts)", tag_keys=("deployment",))
         return _metrics
 
 
@@ -128,7 +188,8 @@ def prefix_group_key(session_id: str = "", text: str = "",
 
 def _assign_traced(router: "Router", metrics: dict, deployment: str,
                    model_id: str, prefix_group: str = "",
-                   spill_out: dict | None = None) -> tuple[str, Any]:
+                   spill_out: dict | None = None,
+                   deadline: float | None = None) -> tuple[str, Any]:
     """Assign a replica, recording the router queue wait as both a
     histogram observation and (inside an active trace) a span."""
     import time as _time
@@ -139,7 +200,7 @@ def _assign_traced(router: "Router", metrics: dict, deployment: str,
     try:
         replica_id, actor = router.assign_replica(
             model_id=model_id, prefix_group=prefix_group,
-            spill_out=spill_out)
+            spill_out=spill_out, deadline=deadline)
     finally:
         wait_ms = 1000 * (_time.monotonic() - t0m)
         metrics["queue_wait"].observe(wait_ms, tags={"deployment": deployment})
@@ -188,6 +249,7 @@ class Router:
         # Spills that shipped a migrate-from source with the request
         # (the KV moved instead of being recomputed).
         self.spill_migrations = 0
+        self._init_overload_state()
         controller = ray.get_actor(CONTROLLER_NAME)
         self._long_poll = LongPollClient(controller, {self._key: self._update_replicas})
         # prime with the current table so the first request needn't wait a
@@ -198,6 +260,28 @@ class Router:
                 self._update_replicas(snap)
         except Exception:
             pass
+
+    def _init_overload_state(self) -> None:
+        """Overload-protection state (split out so the bare-router test
+        skeleton shares it): bounded wait queue with cost-aware shedding,
+        per-replica circuit breaker, and the completion-rate window the
+        503 Retry-After derives from."""
+        from collections import deque as _deque
+
+        # Requests currently blocked waiting for a replica slot:
+        # [{"cheap": bool, "shed": bool}] in arrival order. Over the
+        # serve_max_queued_requests bound, new arrivals are shed — unless
+        # cost-aware shedding lets a cheap (KV-cached) request preempt the
+        # queue slot of an expensive (cold-suffix) waiter.
+        self._waiters: list[dict] = []
+        # replica_id -> {"state": "closed"|"open"|"half_open",
+        #                "failures": consecutive timeouts, "opened_at"}
+        self._circuit: dict[str, dict] = {}
+        # monotonic stamps of recent request completions (release()):
+        # the observed service rate behind Retry-After.
+        self._completions: "_deque[float]" = _deque()
+        self.overload_stats = {"shed": {}, "deadline_expired_queued": 0,
+                               "circuit_opens": 0}
 
     def _update_replicas(self, table: Any) -> None:
         from ..core.api import ActorHandle
@@ -230,6 +314,9 @@ class Router:
         for m, rid in list(self._model_affinity.items()):
             if rid not in self._replicas:
                 del self._model_affinity[m]
+        for rid in list(self._circuit):
+            if rid not in self._replicas:
+                del self._circuit[rid]
 
     def _affinity_pick(self, prefix_group: str, candidates: list[str],
                        cfg, deployment: str,
@@ -290,12 +377,156 @@ class Router:
             except Exception:
                 pass
 
+    # ------------------------------------------------------ overload hooks
+    def _candidates_locked(self, cfg) -> tuple[list[str], int]:
+        """Replicas eligible for a new request: below their max_ongoing
+        cap and not circuit-blocked. An open circuit past its cooldown
+        flips to half_open, where the replica admits ONE probe request at
+        a time (eligible only while idle). Returns (candidates,
+        circuit_blocked_count)."""
+        import time
+
+        now = time.monotonic()
+        out: list[str] = []
+        blocked = 0
+        for rid, r in self._replicas.items():
+            st = self._circuit.get(rid)
+            if st is not None and st["state"] == "open":
+                if now - st["opened_at"] >= \
+                        cfg.serve_circuit_breaker_cooldown_s:
+                    st["state"] = "half_open"
+                else:
+                    blocked += 1
+                    continue
+            if st is not None and st["state"] == "half_open" \
+                    and self._inflight.get(rid, 0) > 0:
+                blocked += 1  # probe already in flight
+                continue
+            if self._inflight.get(rid, 0) < r["max_ongoing"]:
+                out.append(rid)
+        return out, blocked
+
+    def note_request_failure(self, replica_id: str,
+                             timeout: bool = False) -> None:
+        """A handle to ``replica_id`` failed. Consecutive TIMEOUTS trip
+        the circuit breaker (``serve_circuit_breaker_failures``); a
+        failed half-open probe re-opens immediately."""
+        if not timeout:
+            return
+        from ..core.config import get_config
+
+        import time
+
+        n = get_config().serve_circuit_breaker_failures
+        if not n:
+            return
+        deployment = self._key.rsplit("::", 1)[-1]
+        with self._cond:
+            if replica_id not in self._replicas:
+                return
+            st = self._circuit.setdefault(
+                replica_id, {"state": "closed", "failures": 0,
+                             "opened_at": 0.0})
+            st["failures"] += 1
+            if st["state"] == "half_open" or st["failures"] >= n:
+                if st["state"] != "open":
+                    self.overload_stats["circuit_opens"] += 1
+                    try:
+                        _serve_metrics()["circuit_open"].inc(
+                            tags={"deployment": deployment})
+                    except Exception:
+                        pass
+                st["state"] = "open"
+                st["opened_at"] = time.monotonic()
+                st["failures"] = 0
+            self._cond.notify_all()
+
+    def note_request_success(self, replica_id: str) -> None:
+        """A handle to ``replica_id`` completed cleanly: reset its
+        failure streak; a successful half-open probe closes the circuit
+        and restores the replica to full routing."""
+        with self._cond:
+            st = self._circuit.get(replica_id)
+            if st is None:
+                return
+            if st["state"] != "closed" or st["failures"]:
+                st["state"] = "closed"
+                st["failures"] = 0
+                self._cond.notify_all()
+
+    def circuit_state(self, replica_id: str) -> str:
+        with self._cond:
+            st = self._circuit.get(replica_id)
+            return st["state"] if st is not None else "closed"
+
+    def _service_rate_locked(self, window_s: float = 30.0) -> float:
+        """Observed request completions/sec across this router's replicas
+        over the trailing window (0.0 = nothing completed yet)."""
+        import time
+
+        now = time.monotonic()
+        while self._completions and now - self._completions[0] > window_s:
+            self._completions.popleft()
+        if not self._completions:
+            return 0.0
+        return len(self._completions) / max(1e-3, now - self._completions[0])
+
+    def _retry_after_locked(self) -> int:
+        """Retry-After for a shed request: the backlog ahead of it (every
+        waiter + everything in flight) divided by the observed service
+        rate, clamped to [1, 60] seconds."""
+        import math
+
+        rate = self._service_rate_locked()
+        backlog = len(self._waiters) + sum(self._inflight.values()) + 1
+        if rate <= 0.0:
+            return 1
+        return max(1, min(60, int(math.ceil(backlog / rate))))
+
+    def retry_after_hint(self) -> int:
+        with self._cond:
+            return self._retry_after_locked()
+
+    def _note_shed_locked(self, deployment: str, reason: str) -> None:
+        shed = self.overload_stats["shed"]
+        shed[reason] = shed.get(reason, 0) + 1
+        try:
+            _serve_metrics()["shed"].inc(
+                tags={"deployment": deployment, "reason": reason})
+        except Exception:
+            pass
+
+    def overload_snapshot(self) -> dict:
+        """Shed/deadline/circuit counters + live circuit states, for
+        ``serve.status()`` / ``cli serve status``."""
+        with self._cond:
+            return {
+                "shed": dict(self.overload_stats["shed"]),
+                "deadline_expired_queued":
+                    self.overload_stats["deadline_expired_queued"],
+                "circuit_opens": self.overload_stats["circuit_opens"],
+                "circuit": {rid: st["state"]
+                            for rid, st in self._circuit.items()
+                            if st["state"] != "closed"},
+                "queued": len(self._waiters),
+            }
+
     def assign_replica(self, timeout: float | None = None,
                        model_id: str = "",
                        prefix_group: str = "",
-                       spill_out: dict | None = None) -> tuple[str, Any]:
+                       spill_out: dict | None = None,
+                       deadline: float | None = None) -> tuple[str, Any]:
         """Power-of-two choice among replicas below their cap; blocks while
-        every replica is saturated (backpressure). With a multiplexed
+        every replica is saturated (backpressure) — but only up to the
+        ``serve_max_queued_requests`` bound: over it the request is SHED
+        with a fast ``RequestShed`` (503 + Retry-After) instead of
+        joining a collapse, preferring (``serve_shed_policy="cost"``) to
+        shed requests with the largest cold suffix — a request whose
+        prefix group's KV is resident is cheap and may preempt a cold
+        waiter's queue slot. A wall-clock ``deadline`` caps the wait:
+        expiry raises ``DeadlineExceeded`` without ever touching a
+        replica. Replicas tripped by the circuit breaker are excluded
+        until their half-open probe succeeds. With a multiplexed
         ``model_id``, replicas that served that model recently are
         preferred (cache affinity — reference multiplex-aware routing).
         With a ``prefix_group`` key, requests stick to the replica whose
@@ -311,61 +542,141 @@ class Router:
         cfg = get_config()
         if timeout is None:
             timeout = cfg.serve_router_assign_timeout_s
-        deadline = time.monotonic() + timeout
+        wait_deadline = time.monotonic() + timeout
         deployment = self._key.rsplit("::", 1)[-1]
+        entry: dict | None = None
         with self._cond:
-            while True:
-                candidates = [
-                    rid for rid, r in self._replicas.items()
-                    if self._inflight.get(rid, 0) < r["max_ongoing"]
-                ]
-                if candidates:
-                    pick = None
-                    if prefix_group:
-                        pick = self._affinity_pick(prefix_group, candidates,
-                                                   cfg, deployment,
-                                                   spill_out=spill_out)
-                    if pick is None and model_id:
-                        affine = self._model_affinity.get(model_id)
-                        if affine in candidates:
-                            pick = affine
-                    if pick is None:
-                        if len(candidates) == 1:
-                            pick = candidates[0]
-                        else:
-                            a, b = random.sample(candidates, 2)
-                            pick = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
-                    if model_id:
-                        self._model_affinity[model_id] = pick
-                        while len(self._model_affinity) > 1024:
-                            self._model_affinity.pop(next(iter(self._model_affinity)))
-                    if prefix_group:
-                        self._note_affinity(prefix_group, pick, cfg,
-                                            deployment)
-                    if spill_out is not None:
-                        src = spill_out.get("migrate_from")
-                        if src is None or src == pick \
-                                or src not in self._replicas:
-                            # pow-2 re-picked the affine replica (or it
-                            # vanished): nothing to migrate.
-                            spill_out.pop("migrate_from", None)
-                        else:
-                            spill_out["actor_id"] = \
-                                self._replicas[src]["actor"]._actor_id.hex()
-                    self._inflight[pick] = self._inflight.get(pick, 0) + 1
-                    return pick, self._replicas[pick]["actor"]
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise TimeoutError(
-                        f"No replica available for {self._key} within {timeout}s "
-                        f"({len(self._replicas)} replicas, all saturated)"
-                    )
-                self._cond.wait(min(remaining, 1.0))
+            try:
+                while True:
+                    candidates, circuit_blocked = \
+                        self._candidates_locked(cfg)
+                    if candidates:
+                        return self._pick_locked(
+                            candidates, cfg, deployment, model_id,
+                            prefix_group, spill_out)
+                    if deadline is not None and time.time() >= deadline:
+                        self.overload_stats["deadline_expired_queued"] += 1
+                        try:
+                            _serve_metrics()["deadline_expired"].inc(
+                                tags={"deployment": deployment,
+                                      "where": "queued"})
+                        except Exception:
+                            pass
+                        raise DeadlineExceeded(
+                            f"request deadline expired before a replica "
+                            f"slot freed for {self._key}")
+                    if self._replicas and circuit_blocked and \
+                            circuit_blocked >= len(self._replicas):
+                        # Every replica's circuit is open (and still
+                        # cooling): fail fast, never queue for a corpse.
+                        self._note_shed_locked(deployment, "circuit_open")
+                        raise RequestShed(
+                            f"all {len(self._replicas)} replicas of "
+                            f"{self._key} are circuit-open",
+                            reason="circuit_open",
+                            retry_after=self._retry_after_locked())
+                    if entry is None:
+                        entry = self._enqueue_waiter_locked(
+                            cfg, deployment, prefix_group)
+                    elif entry.get("shed"):
+                        self._note_shed_locked(deployment, "preempted")
+                        raise RequestShed(
+                            "queue slot preempted by a cached (cheap) "
+                            "request under overload",
+                            reason="preempted",
+                            retry_after=self._retry_after_locked())
+                    remaining = wait_deadline - time.monotonic()
+                    if deadline is not None:
+                        remaining = min(remaining, deadline - time.time())
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"No replica available for {self._key} within "
+                            f"{timeout}s ({len(self._replicas)} replicas, "
+                            "all saturated)")
+                    self._cond.wait(min(remaining, 1.0))
+            finally:
+                if entry is not None:
+                    try:
+                        self._waiters.remove(entry)
+                    except ValueError:
+                        pass
+
+    def _enqueue_waiter_locked(self, cfg, deployment: str,
+                               prefix_group: str) -> dict:
+        """Join the router wait queue, enforcing the bound. A cheap
+        request (prefix group resident on a live replica → small cold
+        suffix) over the bound preempts the oldest expensive waiter's
+        slot under the "cost" policy; otherwise the incoming request is
+        shed."""
+        bound = cfg.serve_max_queued_requests
+        cheap = bool(prefix_group
+                     and self._group_affinity.get(prefix_group)
+                     in self._replicas)
+        live = [e for e in self._waiters if not e.get("shed")]
+        if bound and self._replicas and len(live) >= bound:
+            victim = None
+            if cfg.serve_shed_policy == "cost" and cheap:
+                victim = next((e for e in live if not e["cheap"]), None)
+            if victim is None:
+                self._note_shed_locked(deployment, "queue_full")
+                raise RequestShed(
+                    f"router queue for {self._key} is full "
+                    f"({len(live)} waiting, bound {bound})",
+                    reason="queue_full",
+                    retry_after=self._retry_after_locked())
+            victim["shed"] = True
+            self._cond.notify_all()
+        entry = {"cheap": cheap, "shed": False}
+        self._waiters.append(entry)
+        return entry
+
+    def _pick_locked(self, candidates: list[str], cfg, deployment: str,
+                     model_id: str, prefix_group: str,
+                     spill_out: dict | None) -> tuple[str, Any]:
+        pick = None
+        if prefix_group:
+            pick = self._affinity_pick(prefix_group, candidates,
+                                       cfg, deployment,
+                                       spill_out=spill_out)
+        if pick is None and model_id:
+            affine = self._model_affinity.get(model_id)
+            if affine in candidates:
+                pick = affine
+        if pick is None:
+            if len(candidates) == 1:
+                pick = candidates[0]
+            else:
+                a, b = random.sample(candidates, 2)
+                pick = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+        if model_id:
+            self._model_affinity[model_id] = pick
+            while len(self._model_affinity) > 1024:
+                self._model_affinity.pop(next(iter(self._model_affinity)))
+        if prefix_group:
+            self._note_affinity(prefix_group, pick, cfg,
+                                deployment)
+        if spill_out is not None:
+            src = spill_out.get("migrate_from")
+            if src is None or src == pick \
+                    or src not in self._replicas:
+                # pow-2 re-picked the affine replica (or it
+                # vanished): nothing to migrate.
+                spill_out.pop("migrate_from", None)
+            else:
+                spill_out["actor_id"] = \
+                    self._replicas[src]["actor"]._actor_id.hex()
+        self._inflight[pick] = self._inflight.get(pick, 0) + 1
+        return pick, self._replicas[pick]["actor"]
 
     def release(self, replica_id: str) -> None:
+        import time
+
         with self._cond:
             if replica_id in self._inflight:
                 self._inflight[replica_id] = max(0, self._inflight[replica_id] - 1)
+            self._completions.append(time.monotonic())
+            while len(self._completions) > 4096:
+                self._completions.popleft()
             self._cond.notify_all()
 
     def remove_replica(self, replica_id: str) -> None:
@@ -457,11 +768,20 @@ class DeploymentResponse:
 class DeploymentStreamingResponse:
     """Iterable over a replica's streamed results (reference
     DeploymentResponseGenerator): wraps the core ObjectRefGenerator;
-    the router slot is released when the stream ends or is closed."""
+    the router slot is released when the stream ends or is closed.
+    Outcomes feed the router's circuit breaker: a clean end notes
+    success, an item timeout notes a (breaker-counted) failure, and a
+    replica death purges the corpse from the local view. ``deadline``
+    (absolute wall clock) caps each item wait — a stream whose next
+    token cannot arrive inside the request deadline fails fast."""
 
-    def __init__(self, gen, on_done):
+    def __init__(self, gen, on_done, router: "Router | None" = None,
+                 replica_id: str = "", deadline: float | None = None):
         self._gen = gen
         self._on_done = on_done
+        self._router = router
+        self._replica_id = replica_id
+        self._deadline = deadline
         self._settle_lock = threading.Lock()
         self._settled = False
 
@@ -475,6 +795,36 @@ class DeploymentStreamingResponse:
         except Exception:
             pass
 
+    def _item_timeout(self, base: float) -> float:
+        if self._deadline is not None:
+            import time as _time
+
+            return max(0.05, min(base, self._deadline - _time.time()))
+        return base
+
+    def _note_outcome(self, ok: bool, timeout: bool = False,
+                      died: bool = False) -> None:
+        if self._router is None or not self._replica_id:
+            return
+        try:
+            if died:
+                self._router.remove_replica(self._replica_id)
+            elif ok:
+                self._router.note_request_success(self._replica_id)
+            else:
+                self._router.note_request_failure(self._replica_id,
+                                                  timeout=timeout)
+        except Exception:
+            pass
+
+    def _classify(self, e: BaseException) -> None:
+        from ..core.status import ActorDiedError
+
+        if isinstance(e, ActorDiedError):
+            self._note_outcome(False, died=True)
+        elif isinstance(e, TimeoutError):
+            self._note_outcome(False, timeout=True)
+
     def __iter__(self):
         return self
 
@@ -483,11 +833,14 @@ class DeploymentStreamingResponse:
 
         try:
             ref = next(self._gen)
-            return ray.get(ref, timeout=get_config().serve_stream_item_timeout_s)
+            return ray.get(ref, timeout=self._item_timeout(
+                get_config().serve_stream_item_timeout_s))
         except StopIteration:
+            self._note_outcome(True)
             self._settle()
             raise
-        except BaseException:
+        except BaseException as e:
+            self._classify(e)
             self._settle()  # a failed get must still release the slot
             raise
 
@@ -498,17 +851,21 @@ class DeploymentStreamingResponse:
             if entry is not None and not entry.in_plasma:
                 # Just-reported inline item: the get is a dict lookup — run
                 # it on the loop rather than burning an executor hop.
-                return ray.get(ref, timeout=120)
+                return ray.get(ref, timeout=self._item_timeout(120))
             # Plasma-backed (large) item: the shm fetch + raylet RPC would
             # block the proxy loop and stall every other connection.
             import asyncio
 
             loop = asyncio.get_running_loop()
-            return await loop.run_in_executor(None, lambda: ray.get(ref, timeout=120))
+            timeout = self._item_timeout(120)
+            return await loop.run_in_executor(
+                None, lambda: ray.get(ref, timeout=timeout))
         except StopAsyncIteration:
+            self._note_outcome(True)
             self._settle()
             raise
-        except BaseException:
+        except BaseException as e:
+            self._classify(e)
             self._settle()
             raise
 
@@ -528,12 +885,17 @@ class DeploymentHandle:
 
     def __init__(self, app_name: str, deployment_name: str, method_name: str = "",
                  multiplexed_model_id: str = "", prefix_group: str = "",
+                 deadline: float | None = None,
                  _router_holder: dict | None = None):
         self.app_name = app_name
         self.deployment_name = deployment_name
         self._method_name = method_name
         self._multiplexed_model_id = multiplexed_model_id
         self._prefix_group = prefix_group
+        # Absolute wall-clock request deadline (overload protection):
+        # caps the router wait, rides the request to the replica, and
+        # bounds engine admission/decode.
+        self._deadline = deadline
         # Shared, mutable: every handle derived from this one (h.method)
         # must reuse ONE router — a router per derived handle would leak a
         # long-poll thread per request.
@@ -550,12 +912,14 @@ class DeploymentHandle:
 
     def options(self, method_name: str = "",
                 multiplexed_model_id: str = "",
-                prefix_group: str = "") -> "DeploymentHandle":
+                prefix_group: str = "",
+                deadline: float | None = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.app_name, self.deployment_name,
             method_name or self._method_name,
             multiplexed_model_id or self._multiplexed_model_id,
             prefix_group or self._prefix_group,
+            deadline if deadline is not None else self._deadline,
             _router_holder=self._router_holder,
         )
 
@@ -598,10 +962,13 @@ class DeploymentHandle:
         spill_out: dict = {}
         replica_id, actor = _assign_traced(
             router, metrics, self.deployment_name, self._multiplexed_model_id,
-            self._prefix_group, spill_out=spill_out)
+            self._prefix_group, spill_out=spill_out,
+            deadline=self._deadline)
         self._inject_migrate_from(router, metrics, spill_out, kwargs)
         if self._multiplexed_model_id:
             kwargs[MULTIPLEXED_KWARG] = self._multiplexed_model_id
+        if self._deadline is not None:
+            kwargs[DEADLINE_KWARG] = self._deadline
         try:
             ref = actor.handle_request.remote(self._method_name, args, kwargs)
         except Exception:
@@ -611,6 +978,7 @@ class DeploymentHandle:
 
         def _done():
             router.release(replica_id)
+            router.note_request_success(replica_id)
             metrics["latency"].observe(
                 1000 * (_time.monotonic() - t0),
                 tags={"deployment": self.deployment_name})
@@ -643,10 +1011,13 @@ class DeploymentHandle:
         spill_out: dict = {}
         replica_id, actor = _assign_traced(
             router, metrics, self.deployment_name, self._multiplexed_model_id,
-            self._prefix_group, spill_out=spill_out)
+            self._prefix_group, spill_out=spill_out,
+            deadline=self._deadline)
         self._inject_migrate_from(router, metrics, spill_out, kwargs)
         if self._multiplexed_model_id:
             kwargs[MULTIPLEXED_KWARG] = self._multiplexed_model_id
+        if self._deadline is not None:
+            kwargs[DEADLINE_KWARG] = self._deadline
         try:
             from ..core.config import get_config
 
@@ -666,10 +1037,13 @@ class DeploymentHandle:
                 1000 * (_time.monotonic() - t0),
                 tags={"deployment": self.deployment_name})
 
-        return DeploymentStreamingResponse(gen, on_done=_done)
+        return DeploymentStreamingResponse(
+            gen, on_done=_done, router=router, replica_id=replica_id,
+            deadline=self._deadline)
 
     def __reduce__(self):
         return (DeploymentHandle, (self.app_name, self.deployment_name,
                                    self._method_name,
                                    self._multiplexed_model_id,
-                                   self._prefix_group))
+                                   self._prefix_group,
+                                   self._deadline))

@@ -286,3 +286,51 @@ def test_migration_metrics_directions_and_markers():
     assert not result["missing"]
     assert {r["metric"] for r in result["skipped"]} == {
         "serve_ttft_migrated_ms", "kv_migration_mb_s"}
+
+
+def test_overload_metrics_directions_and_markers():
+    """Round-12 overload cells (ISSUE 12 satellite): goodput fractions
+    compare higher-better in POINTS (the `_frac` suffix), the shed
+    fast-fail latency is lower-better (the `fast_fail` substring — an
+    honest rejection must stay cheap), and the shed/expired COUNTS are
+    bookkeeping (protection ON sheds more than the unprotected baseline
+    by design, so neither direction is a regression)."""
+    assert bench_check._direction("serve_goodput_frac") == "up"
+    assert bench_check._direction("serve_goodput_frac_unprotected") == "up"
+    assert bench_check._direction("serve_shed_fast_fail_p95_ms") == "down"
+    assert bench_check._direction("serve_admitted_p95_ttft_ms") == "down"
+    assert not bench_check._tracked("serve_shed_requests", 12)
+    assert not bench_check._tracked("serve_deadline_expired", 3)
+    assert not bench_check._tracked("serve_overload_offered", 160)
+    assert not bench_check._tracked("serve_overload_completed", 80)
+    assert not bench_check._tracked("serve_capacity_rps_cfg", 9.5)
+
+    old = {"serve_goodput_frac": 0.62, "serve_shed_fast_fail_p95_ms": 40.0,
+           "serve_admitted_p95_ttft_ms": 600.0, "serve_shed_requests": 50}
+    # goodput collapse is a POINTS regression; slow sheds regress UP
+    worse = {"serve_goodput_frac": 0.31,
+             "serve_shed_fast_fail_p95_ms": 400.0,
+             "serve_admitted_p95_ttft_ms": 2500.0,
+             "serve_shed_requests": 5}
+    result = bench_check.compare(old, worse)
+    names = {r["metric"] for r in result["regressions"]}
+    assert names == {"serve_goodput_frac", "serve_shed_fast_fail_p95_ms",
+                     "serve_admitted_p95_ttft_ms"}
+    # a goodput wobble inside the point budget is noise, not a 10%+ move
+    result = bench_check.compare({"serve_goodput_frac": 0.62},
+                                 {"serve_goodput_frac": 0.55})
+    assert not result["regressions"]
+
+
+def test_overload_skip_markers_honored():
+    """RAY_TPU_BENCH_SKIP_OVERLOAD leaves `*_skipped` markers: the
+    overload cells read as intentionally skipped, never as silently
+    vanished."""
+    from ray_tpu._overload_bench import SKIP_MARKERS
+
+    old = {"serve_goodput_frac": 0.62, "serve_goodput_frac_unprotected": 0.2,
+           "serve_shed_fast_fail_p95_ms": 40.0,
+           "serve_admitted_p95_ttft_ms": 600.0}
+    result = bench_check.compare(old, dict(SKIP_MARKERS))
+    assert not result["missing"], result["missing"]
+    assert {r["metric"] for r in result["skipped"]} == set(old)

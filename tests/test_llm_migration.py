@@ -204,6 +204,70 @@ def test_source_death_mid_migration_imports_prefix(small_model):
     assert not r.export_pinned
 
 
+def test_spill_stream_exports_cached_prefix(small_model):
+    """PR-11 residue (b) closed: the spill pull rides the CHUNKED
+    migration stream — a static KVMigrationSource over already-cached
+    trie pages, wire-identical to the live handoff (full blocks, tail,
+    end), with the pins released when the stream drains."""
+    cfg, params = small_model
+    prompt = list(range(1, 40))  # 4 full pages + 7-row tail
+    a = InferenceEngine(cfg, params, max_slots=2, max_len=64, page_size=8)
+    r = Request("prime", list(prompt), max_new_tokens=1)
+    a.add_request(r)
+    _drain(a, r)  # retire registers the chain in the trie
+    src = KVMigrationSource.for_cached_prefix(a, prompt, chunk_pages=1)
+    assert src is not None
+    b = InferenceEngine(cfg, params, max_slots=2, max_len=64, page_size=8)
+    stats = receive_kv_stream(b, src.address, timeout_s=30)
+    src.close()
+    assert stats["complete"], stats
+    # the trie match caps at len-1 (the last token's hidden state seeds
+    # sampling), so 4 full pages + a 6-row tail = 38 tokens travel
+    assert stats["cached_tokens"] == 38, stats
+    rb = Request("b", list(prompt), max_new_tokens=4)
+    b.add_request(rb)
+    _drain(b, rb)
+    assert rb.cached_prefix_tokens == 38
+    assert rb.generated == naive_greedy(params, cfg, prompt, 4)
+    # pins released: every exported page is refcount-0 cached again
+    assert all(a.allocator.refcount.get(p, 0) == 0
+               for p in a.allocator.page_hash)
+    # nothing cached for an unknown prompt -> no stream
+    assert KVMigrationSource.for_cached_prefix(a, [99, 98, 97]) is None
+
+
+def test_spill_stream_source_death_serves_partial_plus_cold(small_model):
+    """Regression (ISSUE 12 satellite): source death mid-SPILL-pull
+    degrades exactly like the disaggregation path — the target keeps the
+    contiguous prefix received, cold-prefills the suffix, and the
+    output is byte-identical to a full recompute."""
+    cfg, params = small_model
+    prompt = list(range(1, 40))
+    a = InferenceEngine(cfg, params, max_slots=2, max_len=64, page_size=8)
+    r = Request("prime", list(prompt), max_new_tokens=1)
+    a.add_request(r)
+    _drain(a, r)
+    src = KVMigrationSource.for_cached_prefix(a, prompt, chunk_pages=1,
+                                              _die_after_chunks=2)
+    c = InferenceEngine(cfg, params, max_slots=2, max_len=64, page_size=8)
+    stats = receive_kv_stream(c, src.address, timeout_s=10)
+    assert not stats["complete"]
+    assert 0 < stats["cached_tokens"] < 38, stats
+    rc = Request("c", list(prompt), max_new_tokens=4)
+    c.add_request(rc)
+    _drain(c, rc)
+    assert rc.cached_prefix_tokens == stats["cached_tokens"]
+    assert rc.generated == naive_greedy(params, cfg, prompt, 4)
+    # the dying source still released its export pins
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and any(
+            a.allocator.refcount.get(p, 0)
+            for p in a.allocator.page_hash):
+        time.sleep(0.05)
+    assert all(a.allocator.refcount.get(p, 0) == 0
+               for p in a.allocator.page_hash)
+
+
 def test_tiered_kv_host_spill_and_restore(small_model):
     """Stretch (d): refcount-0 trie pages evicted under pressure spill
     to host RAM keyed by chain hash and restore on a later match_prefix
@@ -262,6 +326,7 @@ def test_router_ships_migrate_from_on_spill():
         router.affinity_stats = {"hits": 0, "misses": 0, "spills": 0,
                                  "new_groups": 0}
         router.spill_migrations = 0
+        router._init_overload_state()
         spill = {}
         first, _ = router.assign_replica(prefix_group="g", spill_out=spill)
         assert "migrate_from" not in spill  # new group: nothing to migrate

@@ -699,6 +699,7 @@ def _bare_router(replicas: dict[str, int]):
     r._group_affinity = OrderedDict()
     r.affinity_stats = {"hits": 0, "misses": 0, "spills": 0,
                         "new_groups": 0}
+    r._init_overload_state()
     return r
 
 
