@@ -893,6 +893,24 @@ def main() -> None:
                 ray_tpu.shutdown()
             except Exception:
                 pass
+    extra_speculative: dict = {}
+    try:
+        from ray_tpu._speculative_bench import run_speculative_bench
+
+        # Returns *_skipped markers itself when
+        # RAY_TPU_BENCH_SKIP_SPECULATIVE=1, so skipped cells are always
+        # declared rather than silently vanishing.
+        extra_speculative = run_speculative_bench()
+    except Exception as e:
+        print(f"speculative bench failed: {e}", file=sys.stderr)
+        extra_speculative = {
+            "speculative_bench_error": f"{type(e).__name__}: {e}",
+            "decode_tok_s_plain_skipped": True,
+            "decode_tok_s_speculative_skipped": True,
+            "spec_accept_rate_skipped": True,
+            "spec_tokens_per_dispatch_skipped": True,
+            "spec_parity_skipped": True,
+        }
     value = fw["tokens_per_sec_per_chip"]
     baseline = None
     if os.path.exists("BENCH_BASELINE.json"):
@@ -919,6 +937,7 @@ def main() -> None:
         **extra_dag,
         **extra_recovery,
         **extra_overload,
+        **extra_speculative,
         # Last: the migration bench's 2k-cell cold TTFT supersedes the
         # serve bench's ~1.6k-prompt cold cell under the same key, so
         # migrated-vs-cold always compares within ONE harness.

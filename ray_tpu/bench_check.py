@@ -35,6 +35,10 @@ _SKIP_EXACT = {
     "serve_decode_steps_per_dispatch",
     "serve_shed_requests", "serve_overload_offered", "serve_overload_completed",
     "serve_deadline_expired",
+    # Speculative-bench bookkeeping: draft volume and dispatch counts
+    # describe the run; accept_rate / tokens_per_dispatch / tok_s are
+    # the guarded numbers.
+    "spec_drafted_tokens", "spec_dispatches",
 }
 # "_cfg": config echoes (core-bench phase sizes etc.) — sizes are inputs,
 # not results.
@@ -42,12 +46,19 @@ _SKIP_SUBSTR = ("error", "preset", "metric", "unit", "cmd", "tail", "_cfg")
 # Throughput rates: ALWAYS higher-better, checked BEFORE the lower-better
 # suffixes — "core_tasks_per_s" ends in "_s" but a drop in it is the
 # regression, not an improvement. "_mb_s": transfer throughput in MB/s
-# (kv_migration_mb_s), same shadowed-by-"_s" hazard.
-_HIGHER_BETTER_SUFFIX = ("_per_s", "_per_sec", "_mb_s")
-# 0-1 ratios (cache hit rates, affinity rates, fractions): higher-better
+# (kv_migration_mb_s), same shadowed-by-"_s" hazard. "_tok_s": token
+# throughput — round-13 audit found a bare "..._tok_s" metric would be
+# shadowed by the lower-better "_s" exactly like "_mb_s" was before
+# PR 11 (existing names only dodge it by suffixing the cell, e.g.
+# decode_tok_s_plain). "_tokens_per_dispatch": speculative-decoding
+# amortization (emitted tokens per slot per verify forward).
+_HIGHER_BETTER_SUFFIX = ("_per_s", "_per_sec", "_mb_s", "_tok_s",
+                         "_tokens_per_dispatch")
+# 0-1 ratios (cache hit rates, accept rates, fractions): higher-better
 # AND compared in POINTS like _pct — a hit rate sliding 0.90 -> 0.45 is
 # a 45-point collapse; 0.02 -> 0.01 is noise, not a 50% regression.
-_POINTWISE_RATE_SUFFIX = ("_hit_rate", "_frac")
+# "_accept_rate": the speculative drafter's 0-1 accept fraction.
+_POINTWISE_RATE_SUFFIX = ("_hit_rate", "_accept_rate", "_frac")
 # Lower is better. Peak-memory gauges count as regressions when they
 # GROW >threshold (a quiet 2x pool blowup is exactly what they exist
 # to catch). "_lag_steps": checkpoint lag (steps replayed after a

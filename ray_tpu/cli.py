@@ -101,6 +101,18 @@ def _cmd_bench(args) -> int:
                   and result.get("serve_overload_parity", 1.0) == 1.0)
         prefixes = ("serve_goodput_", "serve_shed_", "serve_admitted_",
                     "serve_overload_")
+    elif args.bench_cmd == "speculative":
+        from ray_tpu._speculative_bench import run_speculative_bench
+
+        result = run_speculative_bench(slots=args.slots,
+                                       max_new=args.new_tokens,
+                                       draft_k=args.draft_k)
+        # Acceptance: speculation amortizes target forwards (> 1 token
+        # per slot per verify dispatch) AND stays lossless.
+        ok = bool(result.get("spec_tokens_per_dispatch", 0) > 1.0
+                  and result.get("spec_parity", 1.0) == 1.0) \
+            or bool(result.get("decode_tok_s_speculative_skipped"))
+        prefixes = ("decode_tok_s_", "spec_")
     else:
         from ray_tpu._core_bench import run_core_bench
 
@@ -244,6 +256,27 @@ def main(argv: list[str] | None = None) -> int:
     bovl.add_argument("--check-against", default=None, metavar="BENCH_JSON",
                       help="run ray_tpu.bench_check against a recorded "
                            "BENCH_r*.json and exit non-zero on regression")
+    bspec = bench_sub.add_parser(
+        "speculative", help="speculative-decoding cells: plain vs "
+                            "draft-K/verify decode tok/s on repetitive "
+                            "traffic (decode_tok_s_{plain,speculative}), "
+                            "n-gram drafter accept rate, tokens per slot "
+                            "per verify dispatch (must beat 1.0), and "
+                            "greedy byte parity (spec_parity must be "
+                            "1.0); *_skipped markers via "
+                            "RAY_TPU_BENCH_SKIP_SPECULATIVE=1")
+    bspec.add_argument("--slots", type=int, default=None,
+                       help="batch slots (default $RAY_TPU_SPEC_BENCH_SLOTS "
+                            "or 8)")
+    bspec.add_argument("--new-tokens", type=int, default=None,
+                       help="generated tokens per request (default "
+                            "$RAY_TPU_SPEC_BENCH_NEW or 96)")
+    bspec.add_argument("--draft-k", type=int, default=None,
+                       help="drafted tokens per verify dispatch (default "
+                            "$RAY_TPU_SPEC_BENCH_K or 6)")
+    bspec.add_argument("--check-against", default=None, metavar="BENCH_JSON",
+                       help="run ray_tpu.bench_check against a recorded "
+                            "BENCH_r*.json and exit non-zero on regression")
     serve_p = sub.add_parser(
         "serve", help="Serve control-plane inspection")
     serve_sub = serve_p.add_subparsers(dest="serve_cmd", required=True)

@@ -20,6 +20,7 @@ from .migration import KVMigrationSource, receive_kv_stream
 from .model import decode_step, init_pages, prefill_chunk
 from .multihost import EngineShardWorker, ShardedEngineExecutor, create_sharded_executor
 from .serving import LLMDeployment, build_llm_app
+from .speculative import Drafter, NgramDrafter, SpeculationConfig
 from .tokenizer import ByteTokenizer
 
 __all__ = [
@@ -42,5 +43,8 @@ __all__ = [
     "decode_step",
     "LLMDeployment",
     "build_llm_app",
+    "Drafter",
+    "NgramDrafter",
+    "SpeculationConfig",
     "ByteTokenizer",
 ]

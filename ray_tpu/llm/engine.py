@@ -88,6 +88,12 @@ class Request:
     # thread at add_request: the engine loop runs detached, so prefill/
     # decode spans parent onto this instead of any thread-local state.
     trace: dict | None = None
+    # Speculative-decoding accounting (drafted tokens verified for this
+    # request, drafts accepted, verify rounds that rolled a draft back)
+    # — the per-request view behind the llm.speculate span.
+    spec_drafted: int = 0
+    spec_accepted: int = 0
+    spec_rollbacks: int = 0
 
 
 class QueueFullError(RuntimeError):
@@ -353,6 +359,7 @@ class InferenceEngine:
         host_kv_cache_pages: int = 0,
         max_queued_requests: int = 0,
         admission_watermark_pages: int | None = None,
+        speculation_config=None,
     ):
         self.config = PRESETS[config] if isinstance(config, str) else config
         self.mesh = mesh
@@ -400,6 +407,15 @@ class InferenceEngine:
         # dispatch boundary; "dense" = bucketed gather). "auto" resolves
         # per backend/mesh in executor.resolve_attention_impl.
         self.attention_impl = getattr(executor, "attention_impl", "dense")
+        # Speculative decoding (ROADMAP 5): a host-side drafter proposes
+        # K tokens per active slot each decode tick and ONE verify
+        # dispatch scores all K+1 positions (model.verify_block). None =
+        # plain decode, bit-for-bit the pre-speculation path.
+        from .speculative import SpeculationConfig
+
+        self.speculation = SpeculationConfig.normalize(speculation_config)
+        self._drafter = (self.speculation.build_drafter()
+                         if self.speculation is not None else None)
         self.lora_manager = None
         if lora_config is not None:
             from .lora import LoRAManager
@@ -502,7 +518,24 @@ class InferenceEngine:
                         "deadline_expired_queued": 0,
                         "deadline_expired_running": 0,
                         "queue_rejects": 0,
-                        "admission_rejects": 0}
+                        "admission_rejects": 0,
+                        # Speculative decoding: drafted tokens sent to
+                        # verification, drafts the target accepted,
+                        # tokens emitted by verify dispatches, verify
+                        # dispatch count, and slot-rounds that discarded
+                        # at least one drafted token (the rollback — its
+                        # staged K/V committed to the trash page, never
+                        # a pool page).
+                        "spec_drafted_tokens": 0,
+                        "spec_accepted_tokens": 0,
+                        "spec_emitted_tokens": 0,
+                        "spec_dispatches": 0,
+                        # (dispatch, active slot) pairs — the
+                        # denominator of spec_tokens_per_dispatch, so
+                        # the ratio is per-sequence per-forward (1.0 =
+                        # plain decode), independent of batch size.
+                        "spec_slot_rounds": 0,
+                        "spec_rollbacks": 0}
 
     @staticmethod
     def total_pages(max_slots: int, max_len: int, page_size: int,
@@ -678,6 +711,34 @@ class InferenceEngine:
         if not total:
             return 1.0
         return 1.0 - self.metrics["prefix_cached_tokens"] / total
+
+    @property
+    def speculation_enabled(self) -> bool:
+        """True when decode ticks run draft + verify: a speculation
+        config is set AND the executor has the verify entry point (off
+        pp / LoRA — those paths decode plain, exactly as before)."""
+        return (self.speculation is not None
+                and self._drafter is not None
+                and self.lora_manager is None
+                and getattr(self.executor, "supports_speculation", False))
+
+    @property
+    def spec_accept_rate(self) -> float:
+        """Fraction of drafted tokens the target model accepted (0-1
+        since engine start). The n-gram drafter's number is traffic-
+        dependent: repetitive/multi-turn prompts accept high."""
+        drafted = self.metrics.get("spec_drafted_tokens", 0)
+        return (self.metrics["spec_accepted_tokens"] / drafted
+                if drafted else 0.0)
+
+    @property
+    def spec_tokens_per_dispatch(self) -> float:
+        """Tokens emitted per slot per verify dispatch — 1.0 is exactly
+        what one plain decode forward yields per sequence, so > 1.0
+        means speculation is amortizing target-model forwards. The
+        accept-0 floor guarantees it never drops below 1.0."""
+        n = self.metrics.get("spec_slot_rounds", 0)
+        return self.metrics["spec_emitted_tokens"] / n if n else 0.0
 
     def step(self) -> list[dict]:
         """Advance the engine one tick: admit waiting requests while slots
@@ -1167,12 +1228,24 @@ class InferenceEngine:
             return
         from ..observability import tracing
 
+        now = time.time()
         tracing.record_span(tracing.make_span(
-            "llm.decode", "llm", r.first_token_wall or time.time(), time.time(),
+            "llm.decode", "llm", r.first_token_wall or now, now,
             r.trace.get("trace_id", ""), r.trace.get("span_id", ""),
             attrs={"request_id": r.request_id,
                    "generated_tokens": len(r.generated),
                    "finish_reason": r.finish_reason}))
+        if r.spec_drafted or r.spec_rollbacks:
+            # One llm.speculate span per request that speculation
+            # touched: how much the drafter proposed, how much the
+            # target accepted, and how many rounds rolled back.
+            tracing.record_span(tracing.make_span(
+                "llm.speculate", "llm", r.first_token_wall or now, now,
+                r.trace.get("trace_id", ""), r.trace.get("span_id", ""),
+                attrs={"request_id": r.request_id,
+                       "drafted_tokens": r.spec_drafted,
+                       "accepted_tokens": r.spec_accepted,
+                       "rollbacks": r.spec_rollbacks}))
 
     def _decode_batch_args(self, active: dict):
         """Fill the host mirrors for one decode burst over ``active`` and
@@ -1205,6 +1278,12 @@ class InferenceEngine:
         return events
 
     def _decode_all(self) -> list[dict]:
+        if self.speculation_enabled:
+            events = self._speculative_decode()
+            if events is not None:
+                return events
+            # no slot produced a draft this round: the plain fused burst
+            # below is strictly better than an all-rejected verify
         with self._lock:
             active = dict(self._active)
         if not active:
@@ -1224,6 +1303,73 @@ class InferenceEngine:
         self.metrics["decode_dispatches"] += 1
         self._note_loop_ticks()
         return self._emit_decode_events(active, tokens, K)
+
+    def _speculative_decode(self) -> list[dict] | None:
+        """One speculation round: draft K tokens per active slot on the
+        host (n-gram lookup over each request's own token history — no
+        model cost), then ONE verify dispatch scores all K+1 positions
+        per slot and emits the accepted run plus one corrected/bonus
+        token. Per-slot accept lengths vary freely inside the batch; a
+        slot whose draft is fully rejected still advances one token, so
+        a verify never emits less per slot than a single decode step.
+        Returns None when no slot drafted anything — the caller falls
+        back to the plain fused decode burst for this tick."""
+        with self._lock:
+            active = dict(self._active)
+        if not active:
+            return []
+        K = self.speculation.num_draft_tokens
+        temps, eos_ids, remaining = self._decode_batch_args(active)
+        tok_mat = np.full((self.max_slots, K + 1), -1, np.int32)
+        tok_mat[:, 0] = self._tokens
+        drafted: dict[int, int] = {}
+        for slot, r in active.items():
+            d = self._drafter.draft(list(r.prompt) + list(r.generated), K)
+            if d:
+                d = d[:K]
+                tok_mat[slot, 1:1 + len(d)] = d
+                drafted[slot] = len(d)
+        if not drafted:
+            return None
+        toks, live = self.executor.verify(
+            self._block_tables, tok_mat, self._pos, temps, eos_ids,
+            remaining)  # [K+1, slots] each
+        self.metrics["spec_dispatches"] += 1
+        self.metrics["decode_dispatches"] += 1
+        self._note_loop_ticks()
+        return self._emit_speculative_events(active, toks, live, drafted)
+
+    def _emit_speculative_events(self, active: dict, toks, live,
+                                 drafted: dict) -> list[dict]:
+        """Emit each slot's verified run in step order (mirrors
+        ``_emit_decode_events``): rows stop at the slot's first non-live
+        step, and host-side terminators (stop_ids via ``_emit``) discard
+        any surplus device rows exactly like the plain decode loop."""
+        events: list[dict] = []
+        S = toks.shape[0]
+        for slot, r in active.items():
+            emitted = 0
+            for j in range(S):
+                if r.done or not live[j, slot]:
+                    break
+                r.pos += 1
+                if r.first_token_at is None:
+                    r.first_token_at = time.monotonic()
+                    r.first_token_wall = time.time()
+                events.append(self._emit(r, int(toks[j, slot])))
+                emitted += 1
+            dr = drafted.get(slot, 0)
+            accepted = min(max(0, emitted - 1), dr)
+            r.spec_drafted += dr
+            r.spec_accepted += accepted
+            self.metrics["spec_drafted_tokens"] += dr
+            self.metrics["spec_accepted_tokens"] += accepted
+            self.metrics["spec_emitted_tokens"] += emitted
+            self.metrics["spec_slot_rounds"] += 1
+            if dr and accepted < dr:
+                r.spec_rollbacks += 1
+                self.metrics["spec_rollbacks"] += 1
+        return events
 
     def _note_loop_ticks(self) -> None:
         """Mirror the executor's compiled-loop tick count (zero-RPC

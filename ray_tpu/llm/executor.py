@@ -33,7 +33,8 @@ import numpy as np
 
 from ..models.llama import LlamaConfig, PRESETS, init_params
 from .model import (copy_pages, decode_loop, init_pages, mixed_dispatch,
-                    prefill_chunk, sample_first_batch, write_pages)
+                    prefill_chunk, sample_first_batch, verify_block,
+                    write_pages)
 
 # Backends with a real Mosaic compiler: the Pallas paged-attention kernel
 # runs native. "axon" is the remote-dispatch tunnel to the same chip.
@@ -239,6 +240,10 @@ class LocalEngineExecutor:
             # [L, P, ...] pool is addressable — KV migration stays off
             # (the one residue of this round, noted in ROADMAP).
             self._write_pages = None
+            # Speculative verify doesn't thread the pp tick loop yet
+            # (the staged-per-stage carry would need a per-stage verify
+            # program) — pp engines decode plain.
+            self._verify = None
         elif self._replicated is not None:
             # Re-jit the model programs with EXPLICIT output shardings:
             # token/key/hidden outputs pinned replicated — on a
@@ -277,6 +282,13 @@ class LocalEngineExecutor:
             self._write_pages = jax.jit(
                 write_pages.__wrapped__, donate_argnames=("pages",),
                 out_shardings=pg)
+            self._verify = jax.jit(
+                verify_block.__wrapped__,
+                static_argnames=("config", "page_size", "n_draft", "paged",
+                                 "live_pages", "attn_mesh"),
+                donate_argnames=("pages",),
+                out_shardings=(rep, rep, rep, pg),
+            )
         else:
             self._decode_loop = decode_loop
             self._sample_first = sample_first_batch
@@ -284,6 +296,7 @@ class LocalEngineExecutor:
             self._mixed = mixed_dispatch
             self._copy_pages = copy_pages
             self._write_pages = write_pages
+            self._verify = verify_block
 
     def _put(self, x: np.ndarray):
         """Host input -> device, replicated over the mesh when present (a
@@ -442,6 +455,46 @@ class LocalEngineExecutor:
                 n_steps=n_steps, **kwargs,
             )
         return np.asarray(toks)  # [n_steps, slots] — the one sync
+
+    @property
+    def supports_speculation(self) -> bool:
+        """Speculative verify dispatch (``model.verify_block``): off the
+        pp path (the per-stage tick loop doesn't thread the verify
+        program yet) and without a LoRA stack (the chunk forward doesn't
+        carry per-slot adapter deltas — those slots decode plain)."""
+        return self._verify is not None and self.lora_stack is None
+
+    def verify(self, block_tables: np.ndarray, tokens_mat: np.ndarray,
+               pos: np.ndarray, temps: np.ndarray, eos_ids: np.ndarray,
+               remaining: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Score one drafted continuation per slot in ONE dispatch.
+
+        tokens_mat: [slots, K+1] int32 — column 0 the current token,
+        columns 1..K the draft (-1 pads). Returns ``(tokens [K+1,
+        slots], live [K+1, slots])`` — the emitted-token matrix and its
+        per-step liveness mask (see ``model.verify_block``)."""
+        assert self.supports_speculation
+        n_draft = int(tokens_mat.shape[1]) - 1
+        # The verify forward reads POOL context [0, pos) only — chunk
+        # tokens ride the staging carry — so the page bound ignores the
+        # draft depth, like the paged decode bound.
+        needed = max(1, (int(pos.max()) + self.page_size - 1)
+                     // self.page_size)
+        with self._pages_lock:
+            toks, live, self._key, self.pages = self._verify(
+                self.params, self.pages,
+                self._put(block_tables.astype(np.int32)),
+                self._put(tokens_mat.astype(np.int32)),
+                self._put(pos.astype(np.int32)),
+                self._put(temps.astype(np.float32)),
+                self._put(eos_ids.astype(np.int32)),
+                self._put(remaining.astype(np.int32)),
+                self._key, config=self.config, page_size=self.page_size,
+                n_draft=n_draft, paged=self.paged_attention,
+                live_pages=self._bucket_pages(needed, block_tables.shape[1]),
+                attn_mesh=self._attn_mesh,
+            )
+        return np.asarray(toks), np.asarray(live)
 
     @property
     def supports_prefix_cow(self) -> bool:

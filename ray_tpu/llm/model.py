@@ -628,3 +628,177 @@ def decode_loop(params, pages: dict, block_tables, tokens, pos, temps, eos_ids,
         # READ the pool, so the donated buffer updates in place here.
         pages = commit_staging(pages, stage, widx, pos, n_steps, page_size)
     return toks, key, pages
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("config", "page_size", "n_draft", "paged", "live_pages",
+                     "attn_mesh"),
+    donate_argnames=("pages",))
+def verify_block(params, pages: dict, block_tables, tokens_mat, pos, temps,
+                 eos_ids, remaining, key, config: LlamaConfig,
+                 page_size: int, n_draft: int, paged: bool = False,
+                 live_pages: int | None = None, attn_mesh=None):
+    """Speculative verify: score all ``n_draft + 1`` positions of every
+    slot's drafted continuation in ONE dispatch — the ``decode_and_sample``
+    sibling the speculation stage rides.
+
+    tokens_mat: [slots, S] int32, S = n_draft + 1 — column 0 is each
+                slot's current token (the one plain decode would feed at
+                ``pos``), columns 1..K its drafted continuation; -1 pads
+                a short draft (auto-rejected, never emitted).
+    pos:        [slots] int32 — the pool holds K/V for [0, pos) per slot
+                (identical precondition to a plain decode step).
+
+    The forward is a tiny batched prefill chunk: every slot's S tokens
+    attend over its POOL context [0, pos) plus themselves (causal), so
+    one model pass produces the target logits at all S positions. The
+    chunk's K/V never touches the pool mid-pass — it accumulates in the
+    v2 STAGING carry (the decode_loop machinery, [L, slots, KH, SC, D]),
+    the paged kernel folds staged rows [0, j] as position j's second KV
+    source, and the dense path masks the pool gather strictly below
+    ``pos``. Acceptance then runs on device:
+
+      * greedy (temp <= 0): position j's output is ``argmax(p_j)``;
+        draft j+1 is accepted iff it EQUALS that argmax — so every
+        emitted token is the argmax the plain decode path would have
+        produced, byte for byte.
+      * temp > 0: speculative REJECTION sampling — draft d is accepted
+        with probability ``p_j(d)`` (the one-hot-proposal case of
+        min(1, p/q)); on rejection the emission resamples from the
+        residual ``norm(p_j - onehot(d))``, and the position after the
+        last accepted draft samples from ``p_j`` directly. The emitted
+        distribution is exactly the target's (Leviathan et al. 2023).
+
+    ``live[j, s]`` marks step j of slot s emitted: live_0 = remaining>0,
+    live_{j+1} = live_j & accept & no-EOS & within ``remaining``. The
+    dispatch-boundary ``commit_staging`` scatter redirects every
+    NON-live row to the slot's private trash page — a rejected branch
+    (or pad row) never dirties pool pages, so rollback is free and
+    shared/COW prefix pages stay byte-stable for their other readers.
+    A slot that accepts 0 drafts still emits position 0's token: one
+    verify never yields fewer tokens per slot than one decode step.
+
+    Returns ``(tokens [S, slots] int32, live [S, slots] bool, key,
+    pages)``.
+    """
+    c = config
+    n, S = tokens_mat.shape
+    assert S == n_draft + 1
+    kh, g = c.n_kv_heads, c.n_heads // c.n_kv_heads
+    steps = jnp.arange(S, dtype=jnp.int32)
+    positions = pos[:, None] + steps[None, :]              # [n, S]
+    x0 = params["embed"][jnp.maximum(tokens_mat, 0)].astype(c.dtype)
+    sc = stage_rows(S)
+    stage_shape = (c.n_layers, n, kh, sc, c.head_dim)
+    ks0 = jnp.zeros(stage_shape, pages["k"].dtype)
+    vs0 = jnp.zeros(stage_shape, pages["v"].dtype)
+    gather_tables = block_tables
+    if not paged and live_pages is not None \
+            and live_pages < block_tables.shape[1]:
+        gather_tables = block_tables[:, :live_pages]
+    max_ctx = gather_tables.shape[1] * page_size
+    ctx_live = jnp.arange(max_ctx)[None, :] < pos[:, None]   # [n, ctx]
+    causal = steps[:, None] >= steps[None, :]                # [S, S]
+
+    def body(carry, xs):
+        x, kf, vf, ks, vs = carry
+        layer, l = xs
+        h = rms_norm(x, layer["attn_norm"], eps=c.norm_eps)
+        q, k, v = _project_qkv(h, layer)                # [n, H|KH, S, D]
+        q = apply_rope(q, positions, theta=c.rope_theta)
+        k = apply_rope(k, positions, theta=c.rope_theta)
+        # Stage ALL S rows (accept/reject is decided after the forward);
+        # the commit scatter, not the stage, is what gates the pool.
+        ks = ks.at[l, :, :, :S, :].set(k.astype(ks.dtype))
+        vs = vs.at[l, :, :, :S, :].set(v.astype(vs.dtype))
+        qg = q.reshape(n, kh, g, S, c.head_dim)
+        if paged:
+            # One kernel call per chunk position: position j folds
+            # staged rows [0, j] (its own causal prefix) on top of the
+            # pool pages — the exact schedule decode_loop's step j uses,
+            # so paged verify logits match paged decode bit for bit.
+            outs = []
+            for j in range(S):
+                outs.append(paged_decode_attention(
+                    qg[:, :, :, j], kf, vf, block_tables, pos + j,
+                    page_size=page_size, live_pages=live_pages, layer=l,
+                    k_stage=ks, v_stage=vs, stage_idx=j, mesh=attn_mesh))
+            attn = jnp.stack(outs, axis=3)              # [n, KH, G, S, D]
+        else:
+            ck = _gather_ctx(kf, l, gather_tables)      # [n, KH, ctx, D]
+            cv = _gather_ctx(vf, l, gather_tables)
+            scale = c.head_dim ** -0.5
+            s_ctx = jnp.einsum("nkgsd,nktd->nkgst", qg, ck
+                               ).astype(jnp.float32)
+            s_self = jnp.einsum("nkgsd,nktd->nkgst", qg, k
+                                ).astype(jnp.float32)
+            s_ctx = jnp.where(ctx_live[:, None, None, None],
+                              s_ctx * scale, -jnp.inf)
+            s_self = jnp.where(causal[None, None, None],
+                               s_self * scale, -jnp.inf)
+            probs = jax.nn.softmax(
+                jnp.concatenate([s_ctx, s_self], axis=-1), axis=-1)
+            p_ctx = probs[..., :max_ctx].astype(c.dtype)
+            p_self = probs[..., max_ctx:].astype(c.dtype)
+            attn = jnp.einsum("nkgst,nktd->nkgsd", p_ctx, cv) + \
+                jnp.einsum("nkgst,nktd->nkgsd", p_self, v)
+        attn = attn.reshape(n, c.n_heads, S, c.head_dim)
+        flat = jnp.swapaxes(attn, 1, 2).reshape(n, S, -1)
+        out = jnp.einsum("nsf,fe->nse", flat,
+                         layer["wo"].reshape(c.n_heads * c.head_dim,
+                                             c.hidden))
+        return (_mlp(x + out, layer, c), kf, vf, ks, vs), None
+
+    (x, kf, vf, ks, vs), _ = lax.scan(
+        body, (x0, pages["k"], pages["v"], ks0, vs0),
+        (params["layers"], jnp.arange(c.n_layers)))
+    hidden = rms_norm(x, params["final_norm"], eps=c.norm_eps)  # [n, S, E]
+    logits = jnp.einsum("nse,ev->nsv", hidden,
+                        params["lm_head"]).astype(jnp.float32)
+
+    # ----- acceptance + emission, all on device (one sync total) -----
+    vocab = logits.shape[-1]
+    # Draft considered AT step j is tokens_mat[:, j + 1]; the last step
+    # has none (-1) — its emission is the bonus/fresh sample.
+    d_ext = jnp.concatenate(
+        [tokens_mat[:, 1:], jnp.full((n, 1), -1, jnp.int32)], axis=1)
+    valid = d_ext >= 0
+    d_clip = jnp.maximum(d_ext, 0)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [n, S]
+    p = jax.nn.softmax(
+        logits / jnp.maximum(temps, 1e-6)[:, None, None], axis=-1)
+    p_draft = jnp.take_along_axis(p, d_clip[..., None], axis=-1)[..., 0]
+    key, ku, kr = jax.random.split(key, 3)
+    u = jax.random.uniform(ku, p_draft.shape)
+    accept_sampled = valid & (u < p_draft)
+    # Residual distribution norm(max(p - q, 0)) for a one-hot proposal:
+    # zero the draft index, renormalize (categorical normalizes).
+    padj = p * (1.0 - jax.nn.one_hot(d_clip, vocab, dtype=p.dtype)
+                * valid[..., None].astype(p.dtype))
+    resample = jax.random.categorical(
+        kr, jnp.log(padj + 1e-30)).astype(jnp.int32)
+    o_sampled = jnp.where(accept_sampled, d_clip, resample)
+    sampled_on = (temps > 0.0)[:, None]
+    o = jnp.where(sampled_on, o_sampled, greedy).astype(jnp.int32)
+    accept = jnp.where(sampled_on, accept_sampled,
+                       valid & (greedy == d_clip))
+    cont = accept & (o != eos_ids[:, None]) \
+        & (remaining[:, None] > steps[None, :] + 1)
+    live = jnp.concatenate(
+        [jnp.ones((n, 1), bool),
+         jnp.cumprod(cont[:, :-1].astype(jnp.int32), axis=1).astype(bool)],
+        axis=1) & (remaining > 0)[:, None]                   # [n, S]
+
+    # Dispatch-boundary commit: live rows land at their real (page,
+    # offset); rejected/pad rows go to the slot's trash page — the pool
+    # only ever sees ACCEPTED K/V, so a rolled-back branch is free.
+    page_of = jnp.take_along_axis(
+        block_tables,
+        jnp.minimum(positions // page_size, block_tables.shape[1] - 1),
+        axis=1)
+    trash = jnp.arange(n, dtype=jnp.int32)
+    widx = jnp.where(live, page_of, trash[:, None])          # [n, S]
+    pages = commit_staging({"k": kf, "v": vf}, (ks, vs),
+                           jnp.swapaxes(widx, 0, 1), pos, S, page_size)
+    return jnp.swapaxes(o, 0, 1), jnp.swapaxes(live, 0, 1), key, pages

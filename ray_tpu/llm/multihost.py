@@ -111,6 +111,18 @@ class EngineShardWorker:
         return bool(self.executor is not None
                     and self.executor.supports_mixed_dispatch)
 
+    def supports_spec(self) -> bool:
+        return bool(self.executor is not None
+                    and self.executor.supports_speculation)
+
+    def verify(self, block_tables, tokens_mat, pos, temps, eos_ids,
+               remaining):
+        """Speculative verify on this shard: every shard scores the same
+        drafted batch (SPMD), so the fan-out's first result is the
+        group's answer."""
+        return self.executor.verify(block_tables, tokens_mat, pos, temps,
+                                    eos_ids, remaining)
+
     def supports_cow(self) -> bool:
         return bool(self.executor is not None
                     and self.executor.supports_prefix_cow)
@@ -179,6 +191,7 @@ class ShardedEngineExecutor:
         self.supports_mixed_dispatch = False
         self.supports_prefix_cow = False
         self.supports_kv_migration = False
+        self.supports_speculation = False
         # Serializes each operation's whole per-shard dispatch sequence:
         # KV imports/exports arrive on REQUEST threads while the engine
         # loop keeps fanning steps out, and an interleave inside one
@@ -294,6 +307,16 @@ class ShardedEngineExecutor:
             "decode", block_tables, tokens, pos, temps, eos_ids, remaining,
             n_steps, lora_idx)[0]
 
+    def verify(self, block_tables, tokens_mat, pos, temps, eos_ids,
+               remaining):
+        """Speculative verify fan-out: every shard runs the SAME verify
+        program in sequence with the rest of the dispatch stream (SPMD
+        invariant), over actor calls or the compiled loop's channel
+        identically; shard 0's (tokens, live) is the group's result."""
+        return self._all(
+            "verify", block_tables, tokens_mat, pos, temps, eos_ids,
+            remaining)[0]
+
     def mixed(self, prefill_plans, block_tables, tokens, pos, temps, eos_ids,
               remaining, n_steps, lora_idx=None) -> np.ndarray:
         """Fused prefill+decode step on every shard: each shard stashes
@@ -400,6 +423,8 @@ def create_sharded_executor(
             shards[0].supports_cow.remote(), timeout=60))
         executor.supports_kv_migration = bool(ray.get(
             shards[0].supports_migration.remote(), timeout=60))
+        executor.supports_speculation = bool(ray.get(
+            shards[0].supports_spec.remote(), timeout=60))
         if use_compiled_loop:
             # Install the resident tick executors NOW (one submit per
             # shard — the last tasks this executor ever submits).

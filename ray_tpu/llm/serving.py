@@ -105,6 +105,7 @@ class LLMDeployment:
         host_kv_cache_pages: int = 0,
         max_queued_requests: int = 0,
         admission_watermark_pages: int | None = None,
+        speculation_config=None,
     ):
         mesh = None
         executor = None
@@ -169,6 +170,7 @@ class LLMDeployment:
             host_kv_cache_pages=host_kv_cache_pages,
             max_queued_requests=max_queued_requests,
             admission_watermark_pages=admission_watermark_pages,
+            speculation_config=speculation_config,
         )
         # Disaggregated serving (DistServe-style prefill/decode split):
         # a "prefill"-role replica chunk-prefills prompts locally, ships
@@ -747,6 +749,10 @@ class LLMDeployment:
                 "prefix_cache_hit_rate": self.engine.prefix_cache_hit_rate,
                 "prefill_suffix_frac": self.engine.prefill_suffix_frac,
                 "mixed_dispatch_enabled": self.engine.mixed_dispatch_enabled,
+                "speculation_enabled": self.engine.speculation_enabled,
+                "spec_accept_rate": self.engine.spec_accept_rate,
+                "spec_tokens_per_dispatch":
+                    self.engine.spec_tokens_per_dispatch,
                 "role": self._role,
                 "supports_kv_migration": self.engine.supports_kv_migration}
 
@@ -820,7 +826,8 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
                   prefill_replicas: int = 1,
                   host_kv_cache_pages: int = 0,
                   max_queued_requests: int = 0,
-                  admission_watermark_pages: int | None = None):
+                  admission_watermark_pages: int | None = None,
+                  speculation_config=None):
     """Build a Serve Application serving ``preset`` (serve.run-able).
     Pass ``ray_actor_options={"resources": {"TPU": 1}, ...}`` to pin each
     replica (engine) to a TPU chip. For an engine that SPANS hosts, set
@@ -854,7 +861,8 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
         use_compiled_loop=use_compiled_loop,
         host_kv_cache_pages=host_kv_cache_pages,
         max_queued_requests=max_queued_requests,
-        admission_watermark_pages=admission_watermark_pages)
+        admission_watermark_pages=admission_watermark_pages,
+        speculation_config=speculation_config)
     if serve_disaggregation is None:
         dep = deployment(
             LLMDeployment,

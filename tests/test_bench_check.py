@@ -334,3 +334,61 @@ def test_overload_skip_markers_honored():
     result = bench_check.compare(old, dict(SKIP_MARKERS))
     assert not result["missing"], result["missing"]
     assert {r["metric"] for r in result["skipped"]} == set(old)
+
+
+def test_speculative_metrics_directions():
+    """Round-13 cells: decode tok/s higher-better, the accept rate is a
+    pointwise 0-1 rate, and tokens-per-dispatch (amortized forwards)
+    regresses DOWN — plus the audited "_tok_s" shadow: a bare token-
+    throughput suffix must not fall into the lower-better "_s" bucket
+    (the exact trap _mb_s hit before PR 11)."""
+    assert bench_check._direction("decode_tok_s_plain") == "up"
+    assert bench_check._direction("decode_tok_s_speculative") == "up"
+    assert bench_check._direction("spec_tokens_per_dispatch") == "up"
+    assert bench_check._direction("spec_accept_rate") == "up"
+    assert bench_check._direction("spec_parity") == "up"
+    # the audit find: metrics literally ending in _tok_s were shadowed
+    assert bench_check._direction("pp_decode_tok_s") == "up"
+    assert bench_check._direction("train_tok_s") == "up"
+    # a tokens-per-dispatch slide is a regression, not an improvement
+    old = {"spec_tokens_per_dispatch": 2.0, "decode_tok_s_speculative": 400.0}
+    new = {"spec_tokens_per_dispatch": 1.1, "decode_tok_s_speculative": 430.0}
+    result = bench_check.compare(old, new)
+    assert {r["metric"] for r in result["regressions"]} == {
+        "spec_tokens_per_dispatch"}
+
+
+def test_spec_accept_rate_compares_in_points():
+    """A 0.9 -> 0.45 accept-rate collapse is a 45-point regression; a
+    0.02 -> 0.01 wiggle is noise, not a 50% drop."""
+    result = bench_check.compare({"spec_accept_rate": 0.9},
+                                 {"spec_accept_rate": 0.45})
+    assert [r["metric"] for r in result["regressions"]] == [
+        "spec_accept_rate"]
+    result2 = bench_check.compare({"spec_accept_rate": 0.02},
+                                  {"spec_accept_rate": 0.01})
+    assert not result2["regressions"]
+    # and 0 -> 0.5 counts as an improvement instead of an ov==0 skip
+    result3 = bench_check.compare({"spec_accept_rate": 0.0},
+                                  {"spec_accept_rate": 0.5})
+    assert [r["metric"] for r in result3["improvements"]] == [
+        "spec_accept_rate"]
+
+
+def test_speculative_skip_markers_honored():
+    """RAY_TPU_BENCH_SKIP_SPECULATIVE=1 leaves *_skipped markers: the
+    absent cells land in the skipped bucket, never in missing; draft
+    volume / dispatch counts are untracked bookkeeping."""
+    old = {"decode_tok_s_plain": 600.0, "decode_tok_s_speculative": 380.0,
+           "spec_accept_rate": 0.25, "spec_tokens_per_dispatch": 1.6,
+           "spec_drafted_tokens": 1100, "spec_dispatches": 60,
+           "spec_draft_k_cfg": 6}
+    new = {"decode_tok_s_plain_skipped": True,
+           "decode_tok_s_speculative_skipped": True,
+           "spec_accept_rate_skipped": True,
+           "spec_tokens_per_dispatch_skipped": True}
+    result = bench_check.compare(old, new)
+    assert not result["missing"] and not result["regressions"]
+    assert {r["metric"] for r in result["skipped"]} == {
+        "decode_tok_s_plain", "decode_tok_s_speculative",
+        "spec_accept_rate", "spec_tokens_per_dispatch"}

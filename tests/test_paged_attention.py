@@ -19,6 +19,7 @@ import pytest
 from ray_tpu.llm.engine import InferenceEngine, Request
 from ray_tpu.llm.executor import resolve_attention_impl
 from ray_tpu.models.llama import PRESETS, init_params
+from conftest import HAS_SHARD_MAP, requires_shard_map
 
 
 @pytest.fixture(scope="module")
@@ -273,8 +274,7 @@ def test_resolve_attention_impl():
     assert resolve_attention_impl() == "dense"
 
 
-@pytest.mark.skipif(not hasattr(jax, "shard_map"),
-                    reason="jax.shard_map (>= 0.6) required for tp paged")
+@requires_shard_map
 def test_tensor_parallel_paged_parity(small_model):
     """attention_impl='paged' over a tp mesh (kernel shard_mapped over
     the KV-head axis) decodes token-identically to the single-device
@@ -292,8 +292,7 @@ def test_tensor_parallel_paged_parity(small_model):
     assert eng.generate(list(prompt), max_new_tokens=6) == expected
 
 
-@pytest.mark.skipif(not hasattr(jax, "shard_map"),
-                    reason="jax.shard_map (>= 0.6) required for pp paged")
+@requires_shard_map
 def test_pipeline_parallel_paged_parity(small_model):
     """attention_impl='paged' over a pp mesh: the v2 staging carry rides
     the pipeline tick loop (per-stage local-layer staging + one
@@ -327,7 +326,7 @@ def test_paged_refused_over_pp_tp_mesh(small_model):
     must refuse 'paged' loudly (the kernel's tp shard_map cannot nest
     inside the pp manual region) and resolve 'auto' to dense."""
     pytest.importorskip("jax", reason="jax required")
-    if not hasattr(jax, "shard_map"):
+    if not HAS_SHARD_MAP:
         pytest.skip("pp engine needs jax.shard_map")
     from ray_tpu.parallel import MeshConfig, create_mesh
 
