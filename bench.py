@@ -825,6 +825,28 @@ def main() -> None:
                 ray_tpu.shutdown()
             except Exception:
                 pass
+    extra_core_scale: dict = {}
+    if os.environ.get("RAY_TPU_BENCH_SKIP_CORE_SCALE") == "1":
+        # Declared skip: bench_check reports the core_scale_* cells as
+        # intentionally skipped instead of silently vanished.
+        extra_core_scale = {"core_scale_skipped": True}
+    else:
+        try:
+            from ray_tpu._core_scale_bench import run_core_scale_bench
+
+            extra_core_scale = run_core_scale_bench(chaos=True)
+        except Exception as e:
+            print(f"core scale bench failed: {e}", file=sys.stderr)
+            extra_core_scale = {
+                "core_scale_bench_error": f"{type(e).__name__}: {e}",
+                "core_scale_skipped": True,
+            }
+            try:
+                import ray_tpu
+
+                ray_tpu.shutdown()
+            except Exception:
+                pass
     extra_dag: dict = {}
     if os.environ.get("RAY_TPU_BENCH_SKIP_DAG") != "1":
         try:
@@ -934,6 +956,7 @@ def main() -> None:
         **extra_longctx,
         **extra_paged,
         **extra_core,
+        **extra_core_scale,
         **extra_dag,
         **extra_recovery,
         **extra_overload,

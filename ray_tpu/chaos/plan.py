@@ -430,6 +430,22 @@ BUILTIN_PLANS: dict[str, dict] = {
              "nth": 1, "delay_ms": 400},
         ],
     },
+    "actor-storm": {
+        "name": "actor-storm",
+        "description": "Actor-creation storm chaos: while a storm drives "
+                       "hundreds of dedicated leases, SIGKILL the worker "
+                       "of every 40th lease (3x) and deliver a GCE-style "
+                       "preemption notice to the 2nd alive node mid-storm."
+                       " Actor restarts must absorb the kills, survivors "
+                       "must re-place off the draining node, and the "
+                       "zygote pools must drain/refill to baseline. "
+                       "No-ops the preempt rule on single-node clusters.",
+        "faults": [
+            {"kind": "kill_worker", "nth_lease": 40, "max_injections": 3},
+            {"kind": "preempt_slice", "nth": 3, "max_injections": 1,
+             "target": "node:1"},
+        ],
+    },
     "mixed-seeded": {
         "name": "mixed-seeded",
         "description": "Seeded probabilistic mix for randomized sweeps: "

@@ -113,6 +113,24 @@ def _cmd_bench(args) -> int:
                   and result.get("spec_parity", 1.0) == 1.0) \
             or bool(result.get("decode_tok_s_speculative_skipped"))
         prefixes = ("decode_tok_s_", "spec_")
+    elif args.bench_cmd == "core" and getattr(args, "scale", False):
+        import os
+
+        prefixes = ("core_scale_",)
+        if os.environ.get("RAY_TPU_BENCH_SKIP_CORE_SCALE") == "1":
+            # Declared skip: bench_check reports the cells as
+            # intentionally skipped instead of silently vanished.
+            result = {"core_scale_skipped": True}
+            ok = True
+        else:
+            from ray_tpu._core_scale_bench import run_core_scale_bench
+
+            result = run_core_scale_bench(raylets=args.raylets,
+                                          num_tasks=args.tasks,
+                                          num_actors=args.actors,
+                                          chaos=args.chaos)
+            ok = bool(result.get("core_scale_tasks_per_s")) and \
+                result.get("core_scale_chaos_verify_ok", 1.0) == 1.0
     else:
         from ray_tpu._core_bench import run_core_bench
 
@@ -191,6 +209,20 @@ def main(argv: list[str] | None = None) -> int:
                        help="calls per actor (default 100)")
     bcore.add_argument("--objects", type=int, default=None,
                        help="put/get round trips (default 10000)")
+    bcore.add_argument("--scale", action="store_true",
+                       help="run the MANY-RAYLET scale harness instead: "
+                            "N in-process raylets, a cross-node task storm "
+                            "and a 1k-actor creation storm on zygote pools "
+                            "(records core_scale_*; "
+                            "RAY_TPU_BENCH_SKIP_CORE_SCALE=1 emits the "
+                            "core_scale_skipped marker)")
+    bcore.add_argument("--raylets", type=int, default=None,
+                       help="scale-harness raylet count (default "
+                            "$RAY_TPU_CORE_SCALE_RAYLETS or 8)")
+    bcore.add_argument("--chaos", action="store_true",
+                       help="with --scale: also run the bundled "
+                            "`actor-storm` FaultPlan against a reduced "
+                            "storm and record core_scale_chaos_verify_ok")
     bcore.add_argument("--check-against", default=None, metavar="BENCH_JSON",
                        help="run ray_tpu.bench_check against a recorded "
                             "BENCH_r*.json and exit non-zero on regression")

@@ -55,11 +55,21 @@ class RayTpuConfig:
     # sync submit->get->submit loop re-pushes on the SAME lease instead of
     # paying acquire+return RPCs per task (reference: worker lease reuse).
     lease_idle_grace_ms: int = 20
+    # How long a pipeline parks on a sibling's in-flight coalesced lease
+    # RPC before de-coalescing and issuing its own (the stuck-leader
+    # degrade: a leader wedged on a dropped reply or slow spawn must not
+    # hold every pipeline hostage for its full RPC timeout). Read through
+    # the chaos clock, so VirtualClock replays degrade deterministically.
+    lease_coalesce_degrade_ms: float = 500.0
 
     # --- worker pool ---------------------------------------------------------
     num_prestart_workers: int = 2
     worker_register_timeout_s: float = 30.0
-    idle_worker_killing_time_threshold_ms: int = 1000
+    # Idle pool shrink: a worker idle this long while its env key's pool
+    # is over target is reaped (re-spawning later is a ~ms zygote fork).
+    # Generous default: sub-second reaping made burst-heavy suites churn
+    # kill/re-fork between back-to-back workloads. 0 disables shrink.
+    idle_worker_killing_time_threshold_ms: int = 2500
     maximum_startup_concurrency: int = 4
     # Max normal-task specs pushed to a leased worker in ONE RPC: the
     # batch-submit path is RPC/handoff-bound, not execution-bound.
@@ -71,9 +81,35 @@ class RayTpuConfig:
     # are best-effort — the raylet only adds workers that are idle and
     # admissible right now. 1 = the legacy one-lease-per-RPC protocol.
     lease_grant_batch_size: int = 4
-    # Fork default-env workers from a warm pre-imported zygote process
-    # instead of paying interpreter boot + imports per worker.
+    # Fork workers from a warm pre-imported zygote process instead of
+    # paying interpreter boot + imports per worker. Zygotes are
+    # runtime-env-KEYED: the first worker of an env (env_vars /
+    # working_dir / py_modules / pip) boots a zygote with that env baked
+    # into its image, and every later worker of the same env hash forks
+    # from it in milliseconds. Interpreter-level envs (conda /
+    # py_executable / container / image_uri) can never fork — those
+    # always cold-spawn (the PR 1 enforcement path).
     enable_worker_zygote: bool = True
+    # Pre-forked idle workers kept warm PER runtime-env key (the zygote
+    # pool): an actor-creation lease binds a pooled registered process
+    # instead of paying fork+register inline. The default env's target is
+    # max(num_prestart_workers, zygote_pool_size). 0 disables keyed
+    # pooling (default-env prestart still applies).
+    zygote_pool_size: int = 2
+    # Max pool spawns kicked per maintenance tick per env key (refill
+    # rate bound — a drained pool refills over a few ticks instead of
+    # fork-storming the node).
+    zygote_pool_refill_batch: int = 2
+    # Distinct non-default runtime-env keys kept warm at once. Over the
+    # cap the least-recently-leased key is evicted: its zygote dies and
+    # its idle pooled workers are killed (env-mismatch eviction).
+    zygote_pool_max_keys: int = 4
+    # Concurrent in-flight spawns allowed when the env's zygote is LIVE
+    # (forks are ~ms and pay no import cost — the lower
+    # maximum_startup_concurrency bound exists to protect cold spawns'
+    # interpreter-boot storms, and throttling a 1k-actor creation storm
+    # to 4 concurrent ms-scale forks was pure queueing delay).
+    zygote_max_fork_concurrency: int = 16
     # Ray Client sessions: the client pings every interval; the proxy
     # reaps sessions silent for the timeout (kills session-owned actors,
     # drops refs/streams, finishes the client job) — crash cleanup for
@@ -169,6 +205,21 @@ class RayTpuConfig:
     # --- GCS -----------------------------------------------------------------
     gcs_pubsub_poll_timeout_s: float = 30.0
     gcs_storage_backend: str = "memory"  # "memory" | "file"
+    # Store shards for the GCS control-plane tables (task events, KV,
+    # actor records — the reference's store_client/ split): one lock per
+    # shard so N raylets' concurrent flushes ingest in parallel instead
+    # of convoying; reads stay linearizable per key. 1 = legacy single
+    # lock.
+    gcs_store_shards: int = 8
+    # Pub/sub fan-out batching: publishes within this window share ONE
+    # subscriber wake-up instead of each notifying every long-poller (1k
+    # actors churning used to mean 1k wakes × N subscribers per flush).
+    # 0 = notify per publish (legacy).
+    gcs_pubsub_batch_window_ms: float = 2.0
+    # Max messages one long-poll reply carries per channel; a backlogged
+    # subscriber drains the rest on its next poll (bounds reply size and
+    # serialization time under churn storms).
+    gcs_pubsub_max_batch_msgs: int = 1000
 
     # --- task events / observability ----------------------------------------
     task_events_buffer_size: int = 10000

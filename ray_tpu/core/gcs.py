@@ -41,37 +41,78 @@ DEAD = "DEAD"
 
 
 class Publisher:
-    """Per-channel sequenced message log with long-poll subscribers."""
+    """Per-channel sequenced message log with long-poll subscribers.
+
+    Fan-out is BATCHED (reference ``pubsub/publisher.h`` buffered
+    per-subscriber mailboxes): a publish appends to the channel log and
+    schedules ONE deferred wake covering every publish that lands within
+    ``gcs_pubsub_batch_window_ms`` — so 1k actor-state churns per flush
+    cost one ``notify_all`` instead of 1k, and each woken subscriber
+    drains everything past its cursor in one bounded reply
+    (``gcs_pubsub_max_batch_msgs`` per channel). Cursor scans are O(new
+    messages): sequences are contiguous per channel, so the resume point
+    is index arithmetic, not a filter over the whole buffer."""
 
     def __init__(self, max_buffer: int = 10000):
         self._channels: dict[str, list[tuple[int, Any]]] = {}
         self._seqs: dict[str, int] = {}
         self._cond = asyncio.Condition()
         self._max_buffer = max_buffer
+        self._notify_scheduled = False
+        # Fan-out evidence (GCS debug_state): wake batching ratio.
+        self.publishes_total = 0
+        self.notify_batches_total = 0
 
     async def publish(self, channel: str, message: Any) -> None:
+        # Single-loop store: the append is atomic on the event loop; only
+        # the wake needs the condition's lock (taken in _notify_waiters).
+        seq = self._seqs.get(channel, 0) + 1
+        self._seqs[channel] = seq
+        buf = self._channels.setdefault(channel, [])
+        buf.append((seq, message))
+        self.publishes_total += 1
+        if len(buf) > self._max_buffer:
+            del buf[: len(buf) // 2]
+        window_s = get_config().gcs_pubsub_batch_window_ms / 1000.0
+        if window_s <= 0:
+            await self._notify_waiters()
+        elif not self._notify_scheduled:
+            self._notify_scheduled = True
+            loop = asyncio.get_running_loop()
+            loop.call_later(
+                window_s,
+                lambda: loop.create_task(self._notify_waiters()))
+
+    async def _notify_waiters(self) -> None:
+        self._notify_scheduled = False
+        self.notify_batches_total += 1
         async with self._cond:
-            seq = self._seqs.get(channel, 0) + 1
-            self._seqs[channel] = seq
-            buf = self._channels.setdefault(channel, [])
-            buf.append((seq, message))
-            if len(buf) > self._max_buffer:
-                del buf[: len(buf) // 2]
             self._cond.notify_all()
 
     def current_seq(self, channel: str) -> int:
         return self._seqs.get(channel, 0)
 
+    def _pending(self, cursors: dict[str, int],
+                 max_msgs: int) -> dict[str, list]:
+        out: dict[str, list] = {}
+        for channel, cursor in cursors.items():
+            buf = self._channels.get(channel)
+            if not buf:
+                continue
+            # Sequences are contiguous within the buffer: resume index is
+            # arithmetic off the head's seq (O(1)), not a full scan.
+            start = max(0, cursor - buf[0][0] + 1) if cursor >= buf[0][0] else 0
+            if start < len(buf):
+                out[channel] = buf[start:start + max_msgs]
+        return out
+
     async def poll(self, cursors: dict[str, int], timeout: float) -> dict[str, list]:
         """Long-poll: block until any channel has messages past its cursor."""
         deadline = time.monotonic() + timeout
+        max_msgs = max(1, get_config().gcs_pubsub_max_batch_msgs)
         async with self._cond:
             while True:
-                out: dict[str, list] = {}
-                for channel, cursor in cursors.items():
-                    msgs = [(s, m) for s, m in self._channels.get(channel, []) if s > cursor]
-                    if msgs:
-                        out[channel] = msgs
+                out = self._pending(cursors, max_msgs)
                 if out:
                     return out
                 remaining = deadline - time.monotonic()
@@ -106,12 +147,19 @@ class GcsServer:
         #                  last_heartbeat}
         self._nodes: dict[str, dict] = {}
         self._raylet_clients: dict[str, RpcClient] = {}
+        # Durable tables ride the sharded store client (one lock per key
+        # shard — the reference's store_client/ split) so writes from
+        # off-loop ingest threads and the event loop never convoy on one
+        # table lock and stay linearizable per key.
+        from .store_client import ShardedKv
+
+        shards = get_config().gcs_store_shards
         # actor_id(hex) -> record
-        self._actors: dict[str, dict] = {}
+        self._actors: ShardedKv = ShardedKv(shards)
         self._named_actors: dict[str, str] = {}  # name -> actor_id hex
         self._jobs: dict[str, dict] = {}
         self._next_job = 1
-        self._kv: dict[str, bytes] = {}
+        self._kv: ShardedKv = ShardedKv(shards)
         self._health_task: asyncio.Task | None = None
         self._placement_groups: dict[str, dict] = {}
         # Observability: task-event ring (gcs_task_manager.h) + per-worker
@@ -199,10 +247,10 @@ class GcsServer:
     # -------------------------------------------------------- fault tolerance
     def _tables(self) -> dict:
         return {
-            "kv": self._kv,
+            "kv": self._kv.to_dict(),
             "jobs": self._jobs,
             "next_job": self._next_job,
-            "actors": self._actors,
+            "actors": self._actors.to_dict(),
             "named_actors": self._named_actors,
             "placement_groups": self._placement_groups,
         }
@@ -227,7 +275,10 @@ class GcsServer:
         tables = self._storage.load()
         if not tables:
             return
-        self._kv = tables.get("kv", {})
+        from .store_client import ShardedKv
+
+        shards = get_config().gcs_store_shards
+        self._kv = ShardedKv(shards, tables.get("kv", {}))
         self._jobs = tables.get("jobs", {})
         self._next_job = tables.get("next_job", 1)
         self._named_actors = tables.get("named_actors", {})
@@ -237,7 +288,7 @@ class GcsServer:
         # were mid-creation or mid-restart lost their coroutine with the
         # old GCS; their specs are durable, so creation is re-driven
         # (reference gcs_actor_manager reconstruction on restart).
-        self._actors = tables.get("actors", {})
+        self._actors = ShardedKv(shards, tables.get("actors", {}))
         for record in self._actors.values():
             if record["state"] in (PENDING_CREATION, RESTARTING):
                 self._spawn(self._create_actor(record))
@@ -464,8 +515,7 @@ class GcsServer:
         return {"deleted": existed}
 
     async def handle_KvKeys(self, p: dict) -> dict:
-        prefix = p.get("prefix", "")
-        return {"keys": [k for k in self._kv if k.startswith(prefix)]}
+        return {"keys": self._kv.keys_with_prefix(p.get("prefix", ""))}
 
     # --------------------------------------------------------- observability
     async def handle_AddTaskEvents(self, p: dict) -> dict:
@@ -494,7 +544,16 @@ class GcsServer:
                 task_events.append(e)
         if spans:
             self.span_store.add(spans)
-        self.task_events.add_events(task_events, p.get("dropped", 0))
+        dropped = p.get("dropped", 0)
+        if task_events or dropped:
+            # Ingest OFF the event loop: a 100k-task bench flushes tens
+            # of thousands of events per interval, and chewing them
+            # inline blocked every other RPC (heartbeats, leases) for the
+            # duration. The store is sharded with per-shard locks, so
+            # flush batches from N raylets ingest concurrently in
+            # executor threads.
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.task_events.add_events, task_events, dropped)
         return {}
 
     async def handle_ListTaskEvents(self, p: dict) -> dict:
@@ -616,6 +675,8 @@ class GcsServer:
             "memory_reports": self.memory_store.size(),
             "memory_leaks_flagged_total": self.memory_store.leaks_flagged_total,
             "profiles_registered": len(self._profiles),
+            "pubsub_publishes_total": self.publisher.publishes_total,
+            "pubsub_notify_batches_total": self.publisher.notify_batches_total,
         }
 
     async def handle_GetDebugState(self, p: dict) -> dict:
@@ -897,8 +958,10 @@ class GcsServer:
             async def _return_lease(kill: bool) -> None:
                 try:
                     await client.call("ReturnWorker", {"worker_id": worker_id, "kill": kill}, timeout=10.0)
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.warning(
+                        "actor %s: returning dedicated lease %s failed (%s)",
+                        record["actor_id"][:8], worker_id[:8], e)
 
             logger.info("Actor %s: pushing creation task to %s", record["actor_id"][:8], worker_addr)
             try:
@@ -940,7 +1003,21 @@ class GcsServer:
     def _select_node(self, resources: dict, strategy: dict | None = None) -> str | None:
         from .scheduling import select_node_for_resources
 
-        return select_node_for_resources(self._nodes, resources, strategy or {})
+        node_id = select_node_for_resources(self._nodes, resources,
+                                            strategy or {})
+        if node_id is not None:
+            # Optimistic bookkeeping (reference GcsActorScheduler): deduct
+            # the selection from the cached availability view NOW, so a
+            # 1k-actor creation storm spreads across raylets instead of
+            # every coroutine picking the same node off the same stale
+            # heartbeat snapshot and convoying in one admission queue.
+            # The next heartbeat overwrites the view with ground truth.
+            avail = (self._nodes[node_id].get("resources") or {}).get(
+                "available") or {}
+            for k, v in (resources or {}).items():
+                if k in avail:
+                    avail[k] = avail[k] - float(v)
+        return node_id
 
     async def _publish_actor(self, record: dict) -> None:
         await self.publisher.publish(
@@ -990,6 +1067,21 @@ class GcsServer:
         record = self._actors.get(p["actor_id"])
         if record is None or record["state"] == DEAD:
             return {}
+        if record["state"] in (RESTARTING, PENDING_CREATION):
+            # A restart/creation is already in flight for this actor —
+            # this report describes the SAME death that triggered it (the
+            # preempted node's drain kill races its own worker-monitor
+            # report). Spawning a second _create_actor here double-created
+            # the actor: two dedicated leases, one leaked forever.
+            # Failures of the in-flight creation surface through its own
+            # push path, never through this report.
+            return {}
+        if p.get("worker_id") and record.get("worker_id") \
+                and p["worker_id"] != record["worker_id"]:
+            # Stale report about a PREVIOUS incarnation's worker arriving
+            # after the restarted actor went ALIVE: must not kill the
+            # live incarnation.
+            return {}
         await self._restart_or_kill_actor(record, p.get("reason", "worker died"))
         return {}
 
@@ -1004,6 +1096,19 @@ class GcsServer:
                 w = RpcClient(record["address"])
                 await w.call("Exit", {}, timeout=2.0)
                 await w.close()
+            except Exception:
+                pass
+        if node is not None and record.get("worker_id"):
+            # Belt and braces through the RAYLET: the Exit RPC above is
+            # best-effort against the worker's own loop — under a storm
+            # it can time out and the dedicated worker (plus its CPU
+            # lease) leaked forever. ReturnWorker(kill) is idempotent if
+            # the Exit already landed.
+            try:
+                await node.call(
+                    "ReturnWorker",
+                    {"worker_id": record["worker_id"], "kill": True},
+                    timeout=5.0)
             except Exception:
                 pass
         record["state"] = DEAD

@@ -28,6 +28,7 @@ import logging
 import os
 import subprocess
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -103,6 +104,37 @@ class PidHandle:
 
 
 @dataclass
+class ZygoteHandle:
+    """One runtime-env-keyed forkserver (worker_zygote.py): the process,
+    its boot state, and the lock serializing fork-protocol framing."""
+
+    renv: dict | None = None
+    proc: subprocess.Popen | None = None
+    booting: bool = False
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+# Spawn-latency evidence for the zygote pool (mode "pooled" = forked from
+# a warm zygote image; "cold" = direct Popen paying interpreter boot +
+# imports). Module-level: many in-process raylets (the Cluster harness)
+# share one registry entry instead of each registering a duplicate.
+_SPAWN_HIST: "object | None" = None
+
+
+def _spawn_hist():
+    global _SPAWN_HIST
+    if _SPAWN_HIST is None:
+        from ..util.metrics import Histogram
+
+        _SPAWN_HIST = Histogram(
+            "ray_tpu_worker_spawn_ms",
+            "Worker spawn-to-register latency by spawn mode "
+            "(cold Popen vs zygote-pool fork)",
+            tag_keys=("mode",))
+    return _SPAWN_HIST
+
+
+@dataclass
 class WorkerHandle:
     worker_id: str
     address: str = ""
@@ -110,6 +142,12 @@ class WorkerHandle:
     proc: subprocess.Popen | None = None
     state: str = "starting"  # starting | idle | leased | dedicated | dead
     actor_id: str = ""
+    # How this process came to be: "pooled" = forked from a warm zygote
+    # image (~ms), "cold" = direct Popen (interpreter boot + imports).
+    spawn_mode: str = "cold"
+    # monotonic stamp at spawn, cleared once the register latency has
+    # been observed into ray_tpu_worker_spawn_ms.
+    spawn_started_at: float = 0.0
     # Hash of the runtime env this worker was started with ("" = default);
     # leases only match workers with the same env (worker_pool.h:524
     # runtime-env-hash matching).
@@ -247,9 +285,18 @@ class Raylet:
         # lease_resources (spawn in progress): the grant fence must not
         # probe the device lock against these legitimate holders.
         self._tpu_grants_inflight: int = 0
-        # Forkserver for default-env workers (worker_zygote.py).
-        self._zygote_proc: subprocess.Popen | None = None
-        self._zygote_booting = False
+        # Runtime-env-keyed forkservers (worker_zygote.py): env hash ->
+        # zygote. Key "" (default env) is warmed at start; other keys
+        # boot on first use and are LRU-bounded via _pool_keys.
+        self._zygotes: dict[str, ZygoteHandle] = {}
+        # Zygote-pool hot keys: env hash -> {"renv", "last_used"} in LRU
+        # order (insertion order, re-inserted on touch). The maintenance
+        # loop keeps zygote_pool_size idle workers per hot key; over
+        # zygote_pool_max_keys the coldest key is evicted (zygote killed,
+        # idle pooled workers of that env killed).
+        self._pool_keys: dict[str, dict] = {}
+        # Spawn-mode counters (debug_state + the pool smoke tests).
+        self._spawn_stats = {"cold": 0, "pooled": 0}
         # --- object manager: push + prioritized pull admission ---------
         # In-progress inbound pushes: oid -> {offset, received, total,
         # data_size, meta_size} (receiver side of PushObject).
@@ -299,7 +346,7 @@ class Raylet:
             },
         )
         if get_config().enable_worker_zygote:
-            self._kick_zygote()  # warm the forkserver off-path
+            self._kick_zygote("")  # warm the default-env forkserver off-path
         self._tasks.append(spawn(self._heartbeat_loop()))
         self._tasks.append(spawn(self._worker_monitor_loop()))
         self._tasks.append(spawn(self._memory_monitor_loop()))
@@ -364,13 +411,15 @@ class Raylet:
                 w.proc.wait(timeout=2)
             except Exception:
                 pass
-        if self._zygote_proc is not None:
-            try:
-                self._zygote_proc.kill()
-                self._zygote_proc.wait(timeout=2)
-            except Exception:
-                pass
-            self._zygote_proc = None
+        for zh in self._zygotes.values():
+            if zh.proc is not None:
+                try:
+                    zh.proc.kill()
+                    zh.proc.wait(timeout=2)
+                except Exception:
+                    pass
+                zh.proc = None
+        self._zygotes.clear()
         await self._server.stop(grace=0.5 if graceful else 0.0)
         self.store.close()
 
@@ -487,25 +536,14 @@ class Raylet:
         cfg = get_config()
         while True:
             await asyncio.sleep(0.2)
-            # Prestart-pool maintenance (reference worker_pool prestart):
-            # keep `num_prestart_workers` DEFAULT-env workers idle at all
-            # times so actor creation and task bursts claim a ready worker
-            # instead of paying the ~2s spawn+import+register cold start.
-            idle_default = sum(
-                1 for wid in self._idle
-                if (w := self._workers.get(wid)) and w.env_hash == ""
-            )
-            starting = sum(
-                1 for w in self._workers.values()
-                if w.state == "starting" and w.env_hash == ""
-            )
-            if (not self._shutdown and not self._draining
-                    and idle_default + starting < cfg.num_prestart_workers
-                    and starting < cfg.maximum_startup_concurrency):
-                try:
-                    self._start_worker()
-                except Exception:
-                    pass
+            # Zygote-pool maintenance (reference worker_pool prestart,
+            # extended to runtime-env keys): keep a target of pre-forked
+            # idle workers per hot env key so actor creation and task
+            # bursts bind a ready, already-registered process instead of
+            # paying spawn+register inline. The default env is always
+            # hot; non-default keys are LRU-tracked in _pool_keys.
+            if not self._shutdown and not self._draining:
+                self._maintain_worker_pools(cfg)
             for w in list(self._workers.values()):
                 # Drivers register without a proc handle but always live on
                 # this host: poll their pid so a driver that exits with
@@ -526,6 +564,11 @@ class Raylet:
                     if prev_state == "dedicated" and w.actor_id:
                         pending_deaths.append({
                             "actor_id": w.actor_id,
+                            # Incarnation identity: the GCS drops reports
+                            # about a worker that is no longer the
+                            # actor's current one (stale death after a
+                            # restart already replaced it).
+                            "worker_id": w.worker_id,
                             "reason": f"worker process exited with code {w.proc.returncode}",
                         })
             still_pending = []
@@ -550,6 +593,73 @@ class Raylet:
                     self._object_meta.pop(oid, None)
                     logger.warning("reclaimed abandoned partial push of %s",
                                    oid.hex()[:12])
+
+    def _pool_counts(self, env_hash: str) -> tuple[int, int]:
+        """(idle, starting) workers of one env key."""
+        idle = sum(
+            1 for wid in self._idle
+            if (w := self._workers.get(wid)) and w.env_hash == env_hash)
+        starting = sum(
+            1 for w in self._workers.values()
+            if w.state == "starting" and w.env_hash == env_hash)
+        return idle, starting
+
+    def _maintain_worker_pools(self, cfg) -> None:
+        """One maintenance tick: top idle pools up toward their targets.
+        Refill rate is bounded per key (zygote_pool_refill_batch) and
+        globally by the spawn-concurrency caps; never runs while
+        draining (begin_draining stops the tick upstream) so a
+        preempted node doesn't refill workers it is about to kill."""
+        pool_size = cfg.zygote_pool_size if cfg.enable_worker_zygote else 0
+        targets: list[tuple[str, dict | None, int]] = [
+            ("", None, max(cfg.num_prestart_workers, pool_size))]
+        for key, info in list(self._pool_keys.items()):
+            targets.append((key, info.get("renv"), pool_size))
+        for env_hash, renv, target in targets:
+            if target <= 0:
+                continue
+            idle, starting = self._pool_counts(env_hash)
+            cap = (max(cfg.maximum_startup_concurrency,
+                       cfg.zygote_max_fork_concurrency)
+                   if self._zygote_live(env_hash)
+                   else cfg.maximum_startup_concurrency)
+            want = min(target - idle - starting,
+                       max(1, cfg.zygote_pool_refill_batch),
+                       cap - starting)
+            for _ in range(max(0, want)):
+                try:
+                    self._start_worker(renv)
+                except Exception:
+                    break
+        self._shrink_idle_pools(cfg, {k: t for k, _r, t in targets})
+
+    def _shrink_idle_pools(self, cfg, targets: dict[str, int]) -> None:
+        """Idle worker killing (reference worker_pool
+        ``idle_worker_killing_time_threshold_ms``): once a key's idle
+        count exceeds its pool target, the LRU excess is reaped after
+        the idle threshold — a burst that ballooned the pool must not
+        leave hundreds of resident interpreters competing for CPU/RAM
+        forever; re-spawning later is a ~ms zygote fork. 0 disables."""
+        threshold_s = cfg.idle_worker_killing_time_threshold_ms / 1000.0
+        if threshold_s <= 0:
+            return
+        now = time.monotonic()
+        by_key: dict[str, list[WorkerHandle]] = {}
+        for wid in self._idle:  # append-ordered: oldest idle first
+            w = self._workers.get(wid)
+            if w is not None:
+                by_key.setdefault(w.env_hash, []).append(w)
+        for key, idle_list in by_key.items():
+            excess = len(idle_list) - targets.get(key, 0)
+            for w in idle_list:
+                if excess <= 0:
+                    break
+                if now - w.last_idle_time < threshold_s:
+                    continue
+                if w.proc is not None:
+                    w.proc.terminate()
+                self._on_worker_dead(w)
+                excess -= 1
 
     def _release_lease(self, w: WorkerHandle) -> bool:
         """Release a worker's lease reservation. Returns True if a TPU
@@ -739,106 +849,19 @@ class Raylet:
 
     # ------------------------------------------------------ worker zygote
     def _default_worker_env(self) -> dict:
-        """The environment default-env workers run with (also the zygote's
-        own env, so its pre-imported image matches its children)."""
+        """The environment default-env workers run with (also the default
+        zygote's own env, so its pre-imported image matches its children)."""
         env = dict(os.environ)
         env["PYTHONUNBUFFERED"] = "1"
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)
         return env
 
-    def _boot_zygote(self) -> None:
-        """Spawn the zygote and wait for its post-import handshake.
-        BLOCKING (interpreter boot + imports) — runs in an executor
-        thread, never on the event loop; `_zygote_proc` is published only
-        once the handshake arrives, so spawns before that fall back to
-        direct Popen."""
-        import json
-
-        try:
-            z = subprocess.Popen(
-                [
-                    sys.executable, "-m", "ray_tpu.core.worker_zygote",
-                    "--raylet-address", self.address,
-                    "--gcs-address", self.gcs_address,
-                    "--node-id", self.node_id.hex(),
-                    "--store-path", self.store_path,
-                    "--store-capacity", str(self.object_store_capacity),
-                ],
-                env=self._default_worker_env(),
-                stdin=subprocess.PIPE,
-                stdout=subprocess.PIPE,
-                stderr=open(os.path.join(
-                    self._session_dir,
-                    f"zygote-{self.node_id.hex()[:12]}.err"), "ab"),
-            )
-            ready = json.loads(z.stdout.readline())
-            if not ready.get("ready"):
-                raise RuntimeError(f"unexpected zygote handshake {ready!r}")
-            self._zygote_proc = z
-        except Exception as e:
-            logger.warning("worker zygote unavailable (%s); using direct spawn", e)
-        finally:
-            self._zygote_booting = False
-
-    def _kick_zygote(self) -> None:
-        """(Re)boot the zygote off the event loop if it isn't running."""
-        if self._zygote_booting:
-            return
-        if self._zygote_proc is not None and self._zygote_proc.poll() is None:
-            return
-        self._zygote_proc = None
-        self._zygote_booting = True
-        if _in_loop():
-            asyncio.get_running_loop().run_in_executor(None, self._boot_zygote)
-        else:
-            self._boot_zygote()
-
-    def _spawn_via_zygote(self, worker_id: str, log_path: str) -> int | None:
-        import json
-        import select
-
-        z = self._zygote_proc
-        if z is None or z.poll() is not None:
-            self._kick_zygote()  # warms up in the background
-            return None  # this spawn goes direct
-        req = {"worker_id": worker_id, "log": log_path,
-               "env": {"RAY_TPU_WORKER_ID": worker_id}}
-        try:
-            z.stdin.write((json.dumps(req) + "\n").encode())
-            z.stdin.flush()
-            # Bounded wait: a wedged zygote must not stall the event loop
-            # (fork replies normally arrive in single-digit ms).
-            ready, _, _ = select.select([z.stdout], [], [], 5.0)
-            if not ready:
-                raise TimeoutError("zygote fork reply timed out")
-            reply = json.loads(z.stdout.readline())
-            return int(reply["pid"])
-        except Exception as e:
-            logger.warning("zygote fork failed (%s); using direct spawn", e)
-            try:
-                z.kill()
-            except Exception:
-                pass
-            self._zygote_proc = None
-            return None
-
-    def _start_worker(self, runtime_env: dict | None = None) -> WorkerHandle:
-        worker_id = WorkerID.from_random().hex()
-        log_path = os.path.join(self._session_dir, f"worker-{worker_id[:12]}.out")
-        if not runtime_env and get_config().enable_worker_zygote:
-            # Default-env workers fork from the warm zygote image (~ms)
-            # instead of paying interpreter boot + imports per process.
-            pid = self._spawn_via_zygote(worker_id, log_path)
-            if pid is not None:
-                handle = WorkerHandle(worker_id=worker_id, pid=pid,
-                                      proc=PidHandle(pid), env_hash="")
-                handle.registered = (
-                    asyncio.get_running_loop().create_future() if _in_loop() else None)
-                self._workers[worker_id] = handle
-                return handle
+    def _worker_env(self, runtime_env: dict | None) -> tuple[dict, str | None]:
+        """(env, working_dir) a worker with ``runtime_env`` runs under —
+        shared by direct spawns and env-keyed zygote boots so the zygote's
+        pre-imported image is byte-equivalent to a cold spawn's."""
         env = dict(os.environ)
-        env["RAY_TPU_WORKER_ID"] = worker_id
         # Worker stdout goes to a file the log monitor tails; without this
         # it would be 8KB block-buffered and prints from long-lived workers
         # would never reach the driver.
@@ -873,6 +896,172 @@ class Raylet:
             # error is visible, a leaked reservation is not.
             logger.warning("runtime_env working_dir %s does not exist; ignoring", working_dir)
             working_dir = None
+        return env, working_dir
+
+    @staticmethod
+    def _zygote_eligible(runtime_env: dict | None) -> bool:
+        """True when workers of this env may fork from an env-keyed
+        zygote. Interpreter-level plugins can NEVER fork (a fork keeps
+        this interpreter; conda/py_executable pick another binary and
+        container wraps the whole command) — those envs always pay the
+        cold spawn, the PR 1 enforcement path."""
+        renv = runtime_env or {}
+        return not any(renv.get(k) for k in
+                       ("py_executable", "conda", "container", "image_uri"))
+
+    def _boot_zygote(self, key: str) -> None:
+        """Spawn the zygote for env ``key`` and wait for its post-import
+        handshake. BLOCKING (interpreter boot + imports + runtime_env
+        preparation) — runs in an executor thread, never on the event
+        loop; ``zh.proc`` is published only once the handshake arrives,
+        so spawns before that fall back to direct Popen."""
+        import json
+
+        zh = self._zygotes.get(key)
+        if zh is None:
+            return
+        try:
+            env, working_dir = self._worker_env(zh.renv)
+            z = subprocess.Popen(
+                [
+                    sys.executable, "-m", "ray_tpu.core.worker_zygote",
+                    "--raylet-address", self.address,
+                    "--gcs-address", self.gcs_address,
+                    "--node-id", self.node_id.hex(),
+                    "--store-path", self.store_path,
+                    "--store-capacity", str(self.object_store_capacity),
+                ],
+                env=env,
+                cwd=working_dir,
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=open(os.path.join(
+                    self._session_dir,
+                    f"zygote-{self.node_id.hex()[:12]}"
+                    f"{'-' + key[:8] if key else ''}.err"), "ab"),
+            )
+            ready = json.loads(z.stdout.readline())
+            if not ready.get("ready"):
+                raise RuntimeError(f"unexpected zygote handshake {ready!r}")
+            zh.proc = z
+        except Exception as e:
+            logger.warning("worker zygote (env %s) unavailable (%s); "
+                           "using direct spawn", key or "default", e)
+        finally:
+            zh.booting = False
+
+    def _kick_zygote(self, key: str, runtime_env: dict | None = None) -> None:
+        """(Re)boot the zygote for env ``key`` off the event loop if it
+        isn't running."""
+        zh = self._zygotes.get(key)
+        if zh is None:
+            zh = self._zygotes[key] = ZygoteHandle(renv=runtime_env)
+        if zh.booting:
+            return
+        if zh.proc is not None and zh.proc.poll() is None:
+            return
+        zh.proc = None
+        zh.booting = True
+        if _in_loop():
+            asyncio.get_running_loop().run_in_executor(
+                None, self._boot_zygote, key)
+        else:
+            self._boot_zygote(key)
+
+    def _spawn_via_zygote(self, key: str, worker_id: str, log_path: str,
+                          runtime_env: dict | None = None) -> int | None:
+        import json
+        import select
+
+        zh = self._zygotes.get(key)
+        if zh is None or zh.proc is None or zh.proc.poll() is not None:
+            self._kick_zygote(key, runtime_env)  # warms up in the background
+            return None  # this spawn goes direct
+        req = {"worker_id": worker_id, "log": log_path,
+               "env": {"RAY_TPU_WORKER_ID": worker_id}}
+        z = zh.proc
+        try:
+            # The protocol lock serializes request/reply framing: pool
+            # refills running in executor threads must not interleave
+            # writes with a lease-path fork on the raylet loop.
+            with zh.lock:
+                z.stdin.write((json.dumps(req) + "\n").encode())
+                z.stdin.flush()
+                # Bounded wait: a wedged zygote must not stall the caller
+                # (fork replies normally arrive in single-digit ms).
+                ready, _, _ = select.select([z.stdout], [], [], 5.0)
+                if not ready:
+                    raise TimeoutError("zygote fork reply timed out")
+                reply = json.loads(z.stdout.readline())
+            return int(reply["pid"])
+        except Exception as e:
+            logger.warning("zygote fork failed (%s); using direct spawn", e)
+            try:
+                z.kill()
+            except Exception:
+                pass
+            zh.proc = None
+            return None
+
+    def _touch_pool_key(self, env_hash: str, runtime_env: dict | None) -> None:
+        """LRU-touch a non-default env key in the zygote pool: the
+        maintenance loop keeps zygote_pool_size idle workers per hot key;
+        over zygote_pool_max_keys the coldest key is evicted."""
+        cfg = get_config()
+        if (not env_hash or cfg.zygote_pool_size <= 0
+                or not cfg.enable_worker_zygote
+                or not self._zygote_eligible(runtime_env)):
+            return
+        self._pool_keys.pop(env_hash, None)
+        self._pool_keys[env_hash] = {"renv": runtime_env,
+                                     "last_used": time.monotonic()}
+        while len(self._pool_keys) > max(1, cfg.zygote_pool_max_keys):
+            self._evict_pool_key(next(iter(self._pool_keys)))
+
+    def _evict_pool_key(self, env_hash: str) -> None:
+        """Evict one env key from the pool: its zygote dies and its idle
+        pooled workers are killed — a pooled worker is only ever handed
+        to a lease with the SAME env hash, so mismatched residue is pure
+        memory cost."""
+        self._pool_keys.pop(env_hash, None)
+        zh = self._zygotes.pop(env_hash, None)
+        if zh is not None and zh.proc is not None:
+            try:
+                zh.proc.kill()
+            except Exception:
+                pass
+        for wid in list(self._idle):
+            w = self._workers.get(wid)
+            if w is not None and w.env_hash == env_hash:
+                if w.proc is not None:
+                    w.proc.terminate()
+                self._on_worker_dead(w)
+        logger.info("zygote pool evicted env key %s", env_hash[:8])
+
+    def _start_worker(self, runtime_env: dict | None = None) -> WorkerHandle:
+        worker_id = WorkerID.from_random().hex()
+        log_path = os.path.join(self._session_dir, f"worker-{worker_id[:12]}.out")
+        env_hash = self._env_hash(runtime_env)
+        if get_config().enable_worker_zygote and self._zygote_eligible(runtime_env):
+            # Fork from the env-keyed warm zygote image (~ms) instead of
+            # paying interpreter boot + imports per process. First use of
+            # an env key boots its zygote in the background and this
+            # spawn falls through to the direct (cold) path. (LRU touch
+            # happens on the LEASE path, not here — pool refills must not
+            # keep their own key artificially hot.)
+            pid = self._spawn_via_zygote(env_hash, worker_id, log_path,
+                                         runtime_env)
+            if pid is not None:
+                handle = WorkerHandle(worker_id=worker_id, pid=pid,
+                                      proc=PidHandle(pid), env_hash=env_hash,
+                                      spawn_mode="pooled",
+                                      spawn_started_at=time.monotonic())
+                handle.registered = (
+                    asyncio.get_running_loop().create_future() if _in_loop() else None)
+                self._workers[worker_id] = handle
+                return handle
+        env, working_dir = self._worker_env(runtime_env)
+        env["RAY_TPU_WORKER_ID"] = worker_id
         from .runtime_env import resolve_python_executable, wrap_worker_command
 
         # Interpreter-level plugins: py_executable / conda pick the
@@ -908,7 +1097,8 @@ class Raylet:
             stderr=subprocess.STDOUT,
         )
         handle = WorkerHandle(worker_id=worker_id, pid=proc.pid, proc=proc,
-                              env_hash=self._env_hash(runtime_env))
+                              env_hash=env_hash, spawn_mode="cold",
+                              spawn_started_at=time.monotonic())
         handle.registered = asyncio.get_running_loop().create_future() if _in_loop() else None
         self._workers[worker_id] = handle
         return handle
@@ -928,15 +1118,30 @@ class Raylet:
             w.state = "idle"
             w.last_idle_time = time.monotonic()
             self._idle.append(w.worker_id)
+            if w.spawn_started_at:
+                # Spawn-to-register latency, the zygote pool's evidence
+                # trail (cold Popen vs warm-image fork).
+                _spawn_hist().observe(
+                    (time.monotonic() - w.spawn_started_at) * 1000.0,
+                    {"mode": w.spawn_mode})
+                self._spawn_stats[w.spawn_mode] = (
+                    self._spawn_stats.get(w.spawn_mode, 0) + 1)
+                w.spawn_started_at = 0.0
         if w.registered is not None and not w.registered.done():
             w.registered.set_result(True)
         self._wake_lease_waiters()
         return {"node_id": self.node_id.hex()}
 
+    def _zygote_live(self, env_hash: str) -> bool:
+        zh = self._zygotes.get(env_hash)
+        return (zh is not None and zh.proc is not None
+                and zh.proc.poll() is None)
+
     async def _get_idle_worker(self, timeout: float, runtime_env: dict | None = None) -> WorkerHandle | None:
         """Pop an idle registered worker whose env matches, starting one if
         needed (reference: worker_pool runtime-env-hash matching)."""
         want = self._env_hash(runtime_env)
+        self._touch_pool_key(want, runtime_env)
         deadline = time.monotonic() + timeout
         while True:
             for wid in list(self._idle):
@@ -956,7 +1161,15 @@ class Raylet:
                 1 for w in self._workers.values()
                 if w.state == "starting" and w.env_hash == want
             )
-            if starting < get_config().maximum_startup_concurrency:
+            cfg = get_config()
+            # A live zygote makes spawns ~ms forks with no import storm:
+            # allow a wider in-flight bound so a creation storm drains at
+            # fork speed instead of queueing behind the cold-spawn cap.
+            startup_cap = (max(cfg.maximum_startup_concurrency,
+                               cfg.zygote_max_fork_concurrency)
+                           if self._zygote_live(want)
+                           else cfg.maximum_startup_concurrency)
+            if starting < startup_cap:
                 self._start_worker(runtime_env)
             fut: asyncio.Future = asyncio.get_running_loop().create_future()
             self._lease_waiters.append(fut)
@@ -2461,6 +2674,13 @@ class Raylet:
             "worker_rss_bytes": {
                 wid[:12]: rss for wid, rss in self._worker_rss().items()},
             "transfer_stats": dict(self.transfer_stats),
+            "worker_spawns": dict(self._spawn_stats),
+            "zygote_pool": {
+                (key or "default"): dict(zip(("idle", "starting"),
+                                             self._pool_counts(key)))
+                for key in ["", *self._pool_keys]
+            },
+            "zygote_keys": [k for k in self._pool_keys],
             "draining": self._draining,
             "drain_reason": self._drain_reason,
             "oom_kills_total": self._oom_kills_total,

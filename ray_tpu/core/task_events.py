@@ -11,6 +11,7 @@ reference ``python/ray/_private/state.py:965``).
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
@@ -193,37 +194,63 @@ class TaskEventBuffer:
         return events, dropped
 
 
+class _TaskShard:
+    __slots__ = ("lock", "tasks")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        # dict insertion order IS the per-shard ring order; records carry
+        # a global "_seq" stamp so merged listings reconstruct the exact
+        # 1-shard insertion order.
+        self.tasks: dict[str, dict] = {}
+
+
 class GcsTaskEventStore:
     """GCS-side bounded event log + per-task aggregation
-    (reference ``gcs_task_manager.h``)."""
+    (reference ``gcs_task_manager.h``), SHARDED by task-id hash (the
+    ``store_client/`` treatment): each shard has its own lock, so N
+    raylets' flush batches ingest concurrently instead of convoying on
+    one store lock, while per-task reads/writes stay linearizable (a
+    task id always lands in exactly one shard). Listings merge across
+    shards by global sequence stamp — byte-identical to the 1-shard
+    store for the same input order."""
 
-    def __init__(self, max_tasks: int = 100_000, on_stage=None):
-        self._lock = threading.Lock()
-        # dict insertion order IS the ring order: eviction pops the oldest
-        # key in O(1) instead of shifting a list under the lock
-        self._tasks: dict[str, dict] = {}
+    def __init__(self, max_tasks: int = 100_000, on_stage=None,
+                 shards: int | None = None):
+        if shards is None:
+            from .config import get_config
+
+            shards = get_config().gcs_store_shards
+        from .store_client import shard_index
+
+        self._shard_index = shard_index
+        self._n = max(1, int(shards))
+        self._shards = [_TaskShard() for _ in range(self._n)]
+        self._seq = itertools.count(1)
         self._max = max_tasks
         self.num_dropped = 0
+        self._dropped_lock = threading.Lock()
         # Optional (stage, duration_ms, node_id) observer fed at ingest:
         # backs the per-raylet lease-stage histograms without a second
         # pass over the event log.
         self._on_stage = on_stage
 
     def add_events(self, events: list[dict], dropped: int = 0) -> None:
-        # ONE lock acquisition per wire batch; coalesced events expand to
-        # their individual transitions here, applied in recorded order, so
-        # the store (and the stage observer) sees exactly the sequence the
-        # unbatched path would have delivered.
-        with self._lock:
-            self.num_dropped += dropped
-            for wire in events:
-                if wire.get("transitions"):
-                    for ev in expand_event(wire):
-                        self._ingest_locked(ev)
-                else:
-                    self._ingest_locked(wire)
+        # Coalesced events expand to their individual transitions here,
+        # applied in recorded order, so the store (and the stage
+        # observer) sees exactly the sequence the unbatched path would
+        # have delivered. Each event takes only its own shard's lock.
+        if dropped:
+            with self._dropped_lock:
+                self.num_dropped += dropped
+        for wire in events:
+            if wire.get("transitions"):
+                for ev in expand_event(wire):
+                    self._ingest(ev)
+            else:
+                self._ingest(wire)
 
-    def _ingest_locked(self, ev: dict) -> None:
+    def _ingest(self, ev: dict) -> None:
         tid = ev["task_id"]
         if isinstance(tid, bytes):
             # Normalize at ingest: every reporter (worker buffer,
@@ -232,28 +259,50 @@ class GcsTaskEventStore:
             tid = tid.hex()
         status = ev["status"]
         ts = ev["ts"]
-        rec = self._tasks.get(tid)
-        if rec is None:
-            if len(self._tasks) >= self._max:
-                self._tasks.pop(next(iter(self._tasks)), None)
-            rec = self._tasks[tid] = {
-                "task_id": tid,
-                "name": ev.get("name", ""),
-                "kind": ev.get("kind", 0),
-                "events": {},
-            }
-        self._observe_stages(rec, ev, status, ts)
-        if status == LEASED:
-            # Both the raylet (at grant) and the owner (at
-            # dispatch) report LEASED: keep the earliest — the
-            # actual grant time.
-            rec["events"].setdefault(status, ts)
-        else:
-            rec["events"][status] = ts
-        rec["name"] = ev.get("name") or rec["name"]
-        for key in ("worker_id", "node_id", "error", "trace_id"):
-            if ev.get(key):
-                rec[key] = ev[key]
+        shard = self._shards[self._shard_index(tid, self._n)]
+        with shard.lock:
+            rec = shard.tasks.get(tid)
+            if rec is None:
+                rec = shard.tasks[tid] = {
+                    "task_id": tid,
+                    "name": ev.get("name", ""),
+                    "kind": ev.get("kind", 0),
+                    "events": {},
+                    "_seq": next(self._seq),
+                }
+            self._observe_stages(rec, ev, status, ts)
+            if status == LEASED:
+                # Both the raylet (at grant) and the owner (at
+                # dispatch) report LEASED: keep the earliest — the
+                # actual grant time.
+                rec["events"].setdefault(status, ts)
+            else:
+                rec["events"][status] = ts
+            rec["name"] = ev.get("name") or rec["name"]
+            for key in ("worker_id", "node_id", "error", "trace_id"):
+                if ev.get(key):
+                    rec[key] = ev[key]
+        self._maybe_evict()
+
+    def _maybe_evict(self) -> None:
+        """Evict the globally-oldest record once over capacity — the same
+        record the 1-shard ring would pop (its _seq is the global
+        insertion order), found by peeking each shard's own oldest."""
+        while sum(len(s.tasks) for s in self._shards) > self._max:
+            oldest: tuple[int, _TaskShard, str] | None = None
+            for shard in self._shards:
+                with shard.lock:
+                    head = next(iter(shard.tasks), None)
+                    if head is None:
+                        continue
+                    seq = shard.tasks[head]["_seq"]
+                if oldest is None or seq < oldest[0]:
+                    oldest = (seq, shard, head)
+            if oldest is None:
+                return
+            _, shard, tid = oldest
+            with shard.lock:
+                shard.tasks.pop(tid, None)
 
     def _observe_stages(self, rec: dict, ev: dict, status: str, ts: float) -> None:
         if self._on_stage is None:
@@ -271,32 +320,38 @@ class GcsTaskEventStore:
             self._on_stage("lease_to_run", (ts - events[LEASED]) * 1000.0, node)
 
     def list_tasks(self, limit: int = 1000) -> list[dict]:
-        with self._lock:
-            out = []
-            for tid in list(self._tasks)[-limit:]:
-                rec = self._tasks[tid]
-                events = rec["events"]
-                out.append({
-                    "task_id": tid,
-                    "name": rec["name"],
-                    "state": _resolve_state(events),
-                    "kind": rec.get("kind", 0),
-                    "worker_id": rec.get("worker_id", ""),
-                    "node_id": rec.get("node_id", ""),
-                    "error": rec.get("error", ""),
-                    "trace_id": rec.get("trace_id", ""),
-                    "events": dict(events),
-                })
-            return out
+        # Merge shards by global sequence stamp: the exact insertion
+        # order the 1-shard ring would have listed.
+        rows: list[tuple[int, dict]] = []
+        for shard in self._shards:
+            with shard.lock:
+                rows.extend((rec["_seq"], rec) for rec in shard.tasks.values())
+        rows.sort(key=lambda r: r[0])
+        out = []
+        for _, rec in rows[-limit:] if limit else rows:
+            events = rec["events"]
+            out.append({
+                "task_id": rec["task_id"],
+                "name": rec["name"],
+                "state": _resolve_state(events),
+                "kind": rec.get("kind", 0),
+                "worker_id": rec.get("worker_id", ""),
+                "node_id": rec.get("node_id", ""),
+                "error": rec.get("error", ""),
+                "trace_id": rec.get("trace_id", ""),
+                "events": dict(events),
+            })
+        return out
 
     def count_by_state(self) -> dict[str, int]:
         """State tallies without materializing record copies (metrics
         scrapes poll this every few seconds)."""
         out: dict[str, int] = {}
-        with self._lock:
-            for rec in self._tasks.values():
-                state = _resolve_state(rec["events"])
-                out[state] = out.get(state, 0) + 1
+        for shard in self._shards:
+            with shard.lock:
+                for rec in shard.tasks.values():
+                    state = _resolve_state(rec["events"])
+                    out[state] = out.get(state, 0) + 1
         return out
 
     def chrome_trace(self) -> list[dict]:

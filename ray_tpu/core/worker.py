@@ -214,6 +214,14 @@ class _ActorState:
         self.actor_id = actor_id
         self.address = ""
         self.state = "PENDING_CREATION"
+        # Pipelined RegisterActor in flight (unnamed actors): resolution
+        # tolerates a GCS "not found" until this lands — a 1k-actor storm
+        # must not pay one serial GCS round trip per registration.
+        self.register_future = None
+        # Set by the actor-channel watcher whenever the GCS publishes a
+        # state transition for this actor: resolution parks on it instead
+        # of re-polling GetActorInfo on a fixed cadence.
+        self.changed = None
         self.seq_no = 0
         # Bumped on each detected death: sequence numbers are scoped to one
         # actor incarnation (the restarted executor expects seq 0).
@@ -289,6 +297,7 @@ class CoreWorker:
         self._spread_salt = 0
         self._queue_lock = threading.Lock()
         self._actors: dict[bytes, _ActorState] = {}
+        self._actor_watch_started = False
         # Actor-call submit fast path: specs queue here and the io loop is
         # woken ONCE per burst — run_coroutine_threadsafe's self-pipe
         # write per call is ~0.4 ms of pure syscall, the single biggest
@@ -1123,12 +1132,41 @@ class CoreWorker:
         return max(1, min(cap, max(1 + extra_waiters, queued)))
 
     # How long a pipeline parks on a sibling's in-flight lease RPC before
-    # de-coalescing and issuing its own. Fast-path replies land in
-    # milliseconds, so coalescing keeps its win there; a leader stuck on a
-    # dropped reply or a slow worker spawn must NOT hold every other
-    # pipeline hostage for its full RPC timeout — under faults the owner
-    # degrades to the old one-RPC-per-pipeline concurrency.
-    _LEASE_GATE_WAIT_S = 0.5
+    # de-coalescing and issuing its own: config lease_coalesce_degrade_ms.
+    # Fast-path replies land in milliseconds, so coalescing keeps its win
+    # there; a leader stuck on a dropped reply or a slow worker spawn must
+    # NOT hold every other pipeline hostage for its full RPC timeout —
+    # under faults the owner degrades to the old one-RPC-per-pipeline
+    # concurrency. The deadline runs on the chaos clock, so a VirtualClock
+    # chaos replay fires the degrade deterministically (frozen clock =
+    # never; an explicit advance() = exactly then).
+
+    @staticmethod
+    async def _await_gate_with_degrade(fut: "asyncio.Future"):
+        """Await a lease-gate future up to the coalesce-degrade window,
+        measured on the chaos clock. Raises asyncio.TimeoutError when the
+        window elapses (virtual or wall) before the leader resolves."""
+        import asyncio
+
+        from ..chaos import clock as chaos_clock
+
+        wait_s = get_config().lease_coalesce_degrade_ms / 1000.0
+        clk = chaos_clock.get_clock()
+        deadline = clk.now() + wait_s
+        # Wall clock: one wait_for covers the window. Virtual clock:
+        # poll in small real slices so explicit advance() calls (and
+        # rate-scaled time) are observed without wall-time coupling.
+        slice_s = wait_s if isinstance(clk, chaos_clock.WallClock) else 0.02
+        while True:
+            remaining = deadline - clk.now()
+            if remaining <= 0:
+                raise asyncio.TimeoutError
+            try:
+                return await asyncio.wait_for(
+                    asyncio.shield(fut), min(slice_s, max(remaining, 0.001))
+                    if slice_s != wait_s else remaining)
+            except asyncio.TimeoutError:
+                continue
 
     async def _acquire_lease_shared(self, key: tuple, spec: TaskSpec):
         """Coalesce same-shape lease acquisition across this owner's
@@ -1150,8 +1188,7 @@ class CoreWorker:
                 fut: asyncio.Future = asyncio.get_running_loop().create_future()
                 gate["waiters"].append(fut)
                 try:
-                    outcome, value = await asyncio.wait_for(
-                        asyncio.shield(fut), self._LEASE_GATE_WAIT_S)
+                    outcome, value = await self._await_gate_with_degrade(fut)
                 except asyncio.TimeoutError:
                     if fut in gate["waiters"]:
                         gate["waiters"].remove(fut)
@@ -1428,6 +1465,14 @@ class CoreWorker:
         self.task_events.record(spec.task_id, spec.name, "LEASED",
                                 kind=spec.kind, extra={"worker_id": worker_id})
         self._dispatched_to[spec.task_id] = worker.address
+        if spec.task_id in self._cancelled_tasks:
+            # Cancel raced the pop->dispatch window: the marker was set
+            # after the queue scan missed this spec but before the
+            # dispatch address was published — honoring it here (AFTER
+            # publishing the address) closes the silent no-op window.
+            self._dispatched_to.pop(spec.task_id, None)
+            self._fail_task(spec, TaskCancelledError(spec.task_id.hex()[:12]))
+            return True
         try:
             reply = await worker.call("PushTask", {"spec": spec.to_wire()}, timeout=None)
         except RpcError as e:
@@ -1461,6 +1506,18 @@ class CoreWorker:
             self.task_events.record(spec.task_id, spec.name, "LEASED",
                                     kind=spec.kind, extra={"worker_id": worker_id})
             self._dispatched_to[spec.task_id] = worker.address
+        live = []
+        for spec in specs:
+            # Same cancel-raced-the-dispatch window as the single-task
+            # path: honor markers set during the pop->dispatch gap.
+            if spec.task_id in self._cancelled_tasks:
+                self._dispatched_to.pop(spec.task_id, None)
+                self._fail_task(spec, TaskCancelledError(spec.task_id.hex()[:12]))
+            else:
+                live.append(spec)
+        specs = live
+        if not specs:
+            return True
         try:
             reply = await worker.call(
                 "PushTasks", {"specs": [s.to_wire() for s in specs]}, timeout=None)
@@ -1642,16 +1699,26 @@ class CoreWorker:
             runtime_env=self._accelerator_runtime_env(res, runtime_env),
         )
         self._attach_trace(spec)
-        reply = self._gcs_call(
-            "RegisterActor",
-            {"spec": spec.to_wire(), "name": name, "detached": detached},
-        )
-        if reply.get("error"):
-            raise RayTpuError(reply["error"])
+        payload = {"spec": spec.to_wire(), "name": name, "detached": detached}
         state = _ActorState(actor_id.binary())
         state.serialized = (max_concurrency <= 1
                             and not spec.concurrency_groups)
         self._actors[actor_id.binary()] = state
+        if name or detached:
+            # Named/detached registration stays synchronous: the
+            # name-taken error must surface from .remote() itself.
+            reply = self._gcs_call("RegisterActor", payload)
+            if reply.get("error"):
+                self._actors.pop(actor_id.binary(), None)
+                raise RayTpuError(reply["error"])
+        else:
+            # PIPELINED registration: unnamed actors cannot fail
+            # RegisterActor (only name conflicts error), so a creation
+            # storm fires the RPCs back-to-back instead of paying one
+            # serial GCS round trip each — resolution and kill both wait
+            # on register_future before trusting a GCS "not found".
+            state.register_future = self.io.run_coro(
+                self.gcs.call("RegisterActor", payload, 30.0))
         return actor_id.binary()
 
     def _actor_state(self, actor_id: bytes) -> _ActorState:
@@ -1848,15 +1915,74 @@ class CoreWorker:
                 spec, ActorDiedError(spec.actor_id.hex(), f"actor died while executing {spec.name}: {e}")
             )
 
+    def _ensure_actor_watcher(self) -> None:
+        """Start the actor-channel subscriber once (on first resolve):
+        one long-poll on the GCS "actor" pub/sub channel replaces N
+        pending actors x 10 GetActorInfo polls per second — during a
+        creation storm the polling alone was a GCS-loop DoS, and the
+        channel's batched fan-out delivers every transition in one wake."""
+        if self._actor_watch_started:
+            return
+        self._actor_watch_started = True
+        self.io.run_coro(self._actor_state_poller())
+
+    async def _actor_state_poller(self) -> None:
+        import asyncio
+
+        cursor = 0  # replay is cheap (skips untracked actors) and has no
+        # staleness hole for actors that settled before we subscribed
+        while True:
+            try:
+                reply = await self.gcs.call(
+                    "SubscribePoll",
+                    {"cursors": {"actor": cursor}, "timeout": 30.0},
+                    timeout=45.0)
+            except Exception:
+                await asyncio.sleep(1.0)
+                continue
+            msgs = (reply.get("messages") or {}).get("actor", [])
+            for seq, msg in msgs:
+                cursor = max(cursor, seq)
+                try:
+                    aid = bytes.fromhex(msg.get("actor_id", ""))
+                except ValueError:
+                    continue
+                state = self._actors.get(aid)
+                if state is None:
+                    continue
+                # Just signal: _resolve_actor re-reads authoritative
+                # state via GetActorInfo, so every transition semantic
+                # (ALIVE address, DEAD cause, RESTARTING) stays in one
+                # place and a lost message only costs the safety re-poll.
+                ev = state.changed
+                if ev is not None:
+                    ev.set()
+
     async def _resolve_actor(self, state: _ActorState) -> str:
-        """Resolve the actor's current address, polling the GCS through
-        PENDING/RESTARTING states."""
+        """Resolve the actor's current address: one authoritative
+        GetActorInfo per state transition, parked on the actor-channel
+        watcher between transitions (plus a 5s safety re-poll)."""
+        import asyncio
+
         if state.address:
             return state.address
+        self._ensure_actor_watcher()
         deadline = time.monotonic() + get_config().actor_resolve_timeout_s
         while time.monotonic() < deadline:
+            if state.address:
+                return state.address
+            ev = state.changed
+            if ev is None:
+                ev = state.changed = asyncio.Event()
+            ev.clear()
             reply = await self.gcs.call("GetActorInfo", {"actor_id": state.actor_id.hex()}, timeout=10.0)
             if not reply.get("found"):
+                if state.register_future is not None \
+                        and not state.register_future.done():
+                    # Pipelined RegisterActor still in flight: "not
+                    # found" just means our registration hasn't landed.
+                    await asyncio_sleep(0.02)
+                    continue
                 raise ActorDiedError(state.actor_id.hex(), "actor not registered")
             if reply["state"] == "ALIVE" and reply["address"]:
                 state.address = reply["address"]
@@ -1865,11 +1991,29 @@ class CoreWorker:
             if reply["state"] == "DEAD":
                 state.state = "DEAD"
                 raise ActorDiedError(state.actor_id.hex(), reply.get("death_cause", ""))
-            await asyncio_sleep(0.1)
+            remaining = deadline - time.monotonic()
+            try:
+                await asyncio.wait_for(ev.wait(), min(max(remaining, 0.01), 5.0))
+            except asyncio.TimeoutError:
+                pass
         raise ActorDiedError(state.actor_id.hex(), "timed out resolving actor address")
 
     def kill_actor(self, actor_id: bytes) -> None:
+        self._await_registered(actor_id)
         self._gcs_call("KillActor", {"actor_id": actor_id.hex()})
+
+    def _await_registered(self, actor_id: bytes, timeout: float = 30.0) -> None:
+        """Ensure a pipelined RegisterActor has landed before a kill: a
+        KillActor racing ahead of its registration would no-op and leak
+        the actor once the register arrives."""
+        state = self._actors.get(actor_id)
+        fut = getattr(state, "register_future", None) if state else None
+        if fut is not None:
+            try:
+                fut.result(timeout)
+            except Exception:
+                pass
+            state.register_future = None
 
     def register_actor_handle(self, actor_id: bytes, owned: bool) -> None:
         with self._counter_lock:
@@ -1886,7 +2030,20 @@ class CoreWorker:
                 self._owned_actors.discard(actor_id)
         if should_kill:
             try:
-                self.io.run_coro(self.gcs.call("KillActor", {"actor_id": actor_id.hex()}, 10.0))
+                state = self._actors.get(actor_id)
+                reg = getattr(state, "register_future", None) if state else None
+
+                async def _kill():
+                    import asyncio
+
+                    if reg is not None and not reg.done():
+                        # A GC-kill racing ahead of the pipelined
+                        # registration would no-op and leak the actor.
+                        await asyncio.wrap_future(reg)
+                    await self.gcs.call(
+                        "KillActor", {"actor_id": actor_id.hex()}, 10.0)
+
+                self.io.run_coro(_kill())
             except Exception:
                 pass
 

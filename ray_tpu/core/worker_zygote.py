@@ -3,18 +3,23 @@
 The dominant cost of starting a worker is interpreter boot + the
 framework import graph (~0.25 s with a pruned env; multiple seconds when
 sitecustomize hooks an accelerator-plugin registration). The zygote pays
-that ONCE: the raylet spawns it with the default worker environment, it
-imports ``worker_main`` and then serves fork requests over stdin/stdout —
-each new worker is an ``os.fork`` (~ms) of the warm image (the
-reference's prestarted-worker pool amortizes the same cost only to its
-pool depth; a forkserver amortizes it for every worker).
+that ONCE: the raylet spawns it with a worker environment, it imports
+``worker_main`` and then serves fork requests over stdin/stdout — each
+new worker is an ``os.fork`` (~ms) of the warm image (the reference's
+prestarted-worker pool amortizes the same cost only to its pool depth; a
+forkserver amortizes it for every worker).
+
+Zygotes are runtime-env-KEYED: the raylet boots one zygote per env hash,
+with that env's variables / PYTHONPATH / working_dir applied to the
+zygote process itself — so import-time env vars (JAX_PLATFORMS, plugin
+gates) are baked into the forked image exactly as a cold spawn with that
+runtime_env would see them. Interpreter-level envs (conda /
+py_executable / container) can never fork from a zygote of this
+interpreter; the raylet always cold-spawns those.
 
 Safety: the zygote is strictly single-threaded and starts no event loop,
 so forking is well-defined; the child applies its per-worker env, detaches
 its stdio to the worker log, and runs the normal ``worker_main`` entry.
-Runtime-env workers (different env hash — possibly import-time env vars
-like JAX_PLATFORMS) do NOT go through the zygote; the raylet spawns those
-directly.
 
 Protocol (line-delimited JSON):
   zygote -> raylet:  {"ready": true}                 (after imports)

@@ -64,6 +64,45 @@ def test_core_metrics_guarded():
         "core_tasks_per_s", "core_actor_calls_per_s"}
 
 
+def test_core_scale_metric_directions():
+    """ISSUE 14 recurring audit: the new creation/scale rates must never
+    fall into the lower-better `_s` suffix (they end in `_per_s`), the
+    pooled-spawn fraction is a pointwise higher-better rate, and the
+    harness-size echoes (`_cfg`) are never tracked."""
+    assert bench_check._direction("core_actor_creations_per_s") == "up"
+    assert bench_check._direction("core_scale_tasks_per_s") == "up"
+    assert bench_check._direction("core_scale_actor_creations_per_s") == "up"
+    assert bench_check._direction("core_scale_pooled_spawn_frac") == "up"
+    # spawn latencies stay lower-better
+    assert bench_check._direction("core_lease_worker_spawn_p50_ms") == "down"
+    for echo in ("core_scale_raylets_cfg", "core_scale_tasks_cfg",
+                 "core_scale_actors_cfg", "core_zygote_pool_cfg",
+                 "core_scale_pool_cfg", "core_scale_chaos_storm_cfg"):
+        assert not bench_check._tracked(echo, 8)
+    # ... and a real drop in the new rates is flagged as a regression
+    old = {"core_actor_creations_per_s": 80.0, "core_scale_tasks_per_s": 2000.0}
+    new = {"core_actor_creations_per_s": 40.0, "core_scale_tasks_per_s": 2100.0}
+    result = bench_check.compare(old, new)
+    assert {r["metric"] for r in result["regressions"]} == {
+        "core_actor_creations_per_s"}
+
+
+def test_core_scale_skip_marker():
+    """`core_scale_skipped: true` (the 1-core-sandbox escape hatch)
+    routes every absent core_scale_* cell to the non-failing skipped
+    bucket instead of `missing`."""
+    old = {"core_scale_tasks_per_s": 2372.8,
+           "core_scale_actor_creations_per_s": 22.8,
+           "core_scale_pooled_spawn_frac": 1.0,
+           "core_tasks_per_s": 2000.0}
+    new = {"core_scale_skipped": True, "core_tasks_per_s": 2100.0}
+    result = bench_check.compare(old, new)
+    assert not result["missing"]
+    assert {r["metric"] for r in result["skipped"]} == {
+        "core_scale_tasks_per_s", "core_scale_actor_creations_per_s",
+        "core_scale_pooled_spawn_frac"}
+
+
 def test_compare_flags_drops_and_missing():
     old = {"flash_fwdbwd_tflops_s4096": 26.16, "serve_p50_ttft_ms": 272.1,
            "value": 11363.9, "serve_preset": "llama3-1b", "n": 4}

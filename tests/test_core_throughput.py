@@ -384,6 +384,92 @@ def test_multiplexed_lease_recovers_from_dropped_reply(ray_cluster, _knobs):
         cfg.lease_orphan_timeout_s = saved_orphan
 
 
+def test_lease_coalesce_degrade_is_config_knob(ray_cluster, _knobs):
+    """ISSUE 14 small fix: the stuck-leader de-coalesce window is the
+    `lease_coalesce_degrade_ms` config entry (was a hard-coded 0.5 s) —
+    a follower parked on a wedged leader's gate must degrade to its own
+    lease RPC after the configured window."""
+    cfg = get_config()
+    saved = cfg.lease_coalesce_degrade_ms
+    cfg.lease_coalesce_degrade_ms = 120.0
+    w = global_worker()
+    key = ("degrade-test", 0)
+    acquires: list[int] = []
+
+    async def scenario():
+        # A leader holds the gate and NEVER resolves its waiters (the
+        # stuck-leader shape: dropped reply / wedged spawn).
+        w._lease_gates[key] = {"waiters": []}
+        real_acquire = w._acquire_lease
+
+        async def stub_acquire(spec, num_workers=1):
+            acquires.append(num_workers)
+            return None, "stub-denied"
+
+        w._acquire_lease = stub_acquire
+        try:
+            t0 = time.monotonic()
+            leases, reason = await w._acquire_lease_shared(key, _spec("d"))
+            waited = time.monotonic() - t0
+        finally:
+            w._acquire_lease = real_acquire
+            w._lease_gates.pop(key, None)
+        return leases, reason, waited
+
+    leases, reason, waited = w.io.run_sync(scenario(), timeout=30)
+    # degraded: issued its OWN acquire after ~the configured window, not
+    # the old 0.5 s constant and not the full RPC timeout
+    assert leases is None and reason == "stub-denied"
+    assert acquires, "follower never de-coalesced"
+    assert 0.08 <= waited < 0.45, waited
+    cfg.lease_coalesce_degrade_ms = saved
+
+
+def test_lease_coalesce_degrade_reads_chaos_clock(ray_cluster, _knobs):
+    """The degrade deadline rides the chaos clock: under a FROZEN
+    VirtualClock the follower never degrades on wall time alone; an
+    explicit advance() past the window fires it deterministically."""
+    from ray_tpu.chaos import clock as chaos_clock
+
+    cfg = get_config()
+    saved = cfg.lease_coalesce_degrade_ms
+    cfg.lease_coalesce_degrade_ms = 1000.0
+    w = global_worker()
+    key = ("degrade-vclock", 0)
+    vclock = chaos_clock.VirtualClock(rate=0.0)  # frozen: manual advance only
+
+    async def scenario():
+        w._lease_gates[key] = {"waiters": []}
+        real_acquire = w._acquire_lease
+        degraded = asyncio.Event()
+
+        async def stub_acquire(spec, num_workers=1):
+            degraded.set()
+            return None, "vclock-denied"
+
+        w._acquire_lease = stub_acquire
+        chaos_clock.set_clock(vclock)
+        try:
+            waiter = asyncio.ensure_future(
+                w._acquire_lease_shared(key, _spec("v")))
+            # Frozen clock: 0.4 real seconds (wall would NOT have degraded
+            # yet anyway at 1000 ms — but virtual time hasn't moved at all).
+            await asyncio.sleep(0.4)
+            assert not degraded.is_set()
+            vclock.advance(2.0)  # virtual 2 s > the 1 s window
+            await asyncio.wait_for(degraded.wait(), timeout=10.0)
+            leases, reason = await asyncio.wait_for(waiter, timeout=10.0)
+            return leases, reason
+        finally:
+            chaos_clock.set_clock(None)
+            w._acquire_lease = real_acquire
+            w._lease_gates.pop(key, None)
+
+    leases, reason = w.io.run_sync(scenario(), timeout=60)
+    assert leases is None and reason == "vclock-denied"
+    cfg.lease_coalesce_degrade_ms = saved
+
+
 def test_node_table_refresh_is_shared(ray_cluster):
     """Concurrent refreshers ride one in-flight GetAllNodes, and a
     max_age hit skips the RPC entirely."""
