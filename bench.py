@@ -915,6 +915,28 @@ def main() -> None:
                 ray_tpu.shutdown()
             except Exception:
                 pass
+    extra_train_loop: dict = {}
+    try:
+        from ray_tpu._train_loop_bench import run_train_loop_bench
+
+        # Emits its own *_skipped markers under
+        # RAY_TPU_BENCH_SKIP_TRAIN_LOOP=1, so skipped cells are always
+        # declared rather than silently vanishing.
+        extra_train_loop = run_train_loop_bench()
+    except Exception as e:
+        print(f"train loop bench failed: {e}", file=sys.stderr)
+        extra_train_loop = {
+            "train_loop_bench_error": f"{type(e).__name__}: {e}",
+            "train_mfu_skipped": True,
+            "train_step_dispatch_overhead_skipped": True,
+            "train_ckpt_overlap_frac_skipped": True,
+        }
+        try:
+            import ray_tpu
+
+            ray_tpu.shutdown()
+        except Exception:
+            pass
     extra_speculative: dict = {}
     try:
         from ray_tpu._speculative_bench import run_speculative_bench
@@ -960,6 +982,7 @@ def main() -> None:
         **extra_dag,
         **extra_recovery,
         **extra_overload,
+        **extra_train_loop,
         **extra_speculative,
         # Last: the migration bench's 2k-cell cold TTFT supersedes the
         # serve bench's ~1.6k-prompt cold cell under the same key, so

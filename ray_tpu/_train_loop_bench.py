@@ -1,0 +1,271 @@
+"""Train compiled-loop suite (ROADMAP item 6).
+
+Measures what parking the train step on the persistent compiled loop
+(``train/loop.py``) buys over per-step dynamic dispatch — the train-side
+mirror of the dag bench's dynamic-vs-compiled cells:
+
+  * **Step dispatch overhead** — a NO-OP structured step driven (a)
+    eagerly (one ``.remote()`` chain per step: the submit→lease→push
+    path every iteration) and (b) through the compiled loop (channel
+    write + read, zero task submission):
+
+      - ``train_step_dispatch_overhead_eager_us`` — eager per-step µs
+      - ``train_step_dispatch_overhead_us``       — compiled per-step µs
+        (acceptance: ≥ 5× below eager on the CPU sandbox)
+
+  * **Train MFU, eager vs loop** — a real (small-model) jax train step
+    with async checkpoint snapshots every N steps, driven both ways
+    through the SAME stage actors (byte-identical math — the parity
+    contract is tested in tests/test_train_loop.py):
+
+      - ``train_mfu_eager`` / ``train_mfu_loop`` — loop must be ≥ eager
+        (the loop removes per-step dispatch AND overlaps the commit)
+      - ``train_ckpt_overlap_frac`` — fraction of checkpoint-commit
+        wall time that overlapped step compute in loop mode
+        (acceptance: > 0.5; structurally 0 in eager mode)
+      - ``train_loop_ckpt_save_block_ms`` — max snapshot block inside
+        the step stage (must stay flat vs eager: the step never waits
+        for the writer)
+
+``RAY_TPU_BENCH_SKIP_TRAIN_LOOP=1`` records ``*_skipped`` markers
+instead (bench_check treats the absence as intentional). Sizes are
+env-tunable via ``RAY_TPU_TRAIN_LOOP_BENCH_{TICKS,STEPS}``. Run
+standalone via ``python -m ray_tpu.cli bench train --loop`` or as part
+of ``bench.py``.
+
+CPU-sandbox honesty: the MFU cells here use the debug-128 model against
+the v5e peak, so their absolute values are tiny — the guarded signal is
+the eager↔loop RATIO and the overlap fraction; on-chip absolute cells
+ride the next BENCH (ROADMAP item 1b).
+"""
+
+from __future__ import annotations
+
+import os
+
+PEAK_FLOPS = 197e12  # same denominator as bench.py / session gauges
+
+_SKIP_MARKERS = {
+    "train_mfu_skipped": True,
+    "train_step_dispatch_overhead_skipped": True,
+    "train_ckpt_overlap_frac_skipped": True,
+}
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+def _noop_spec(num_steps: int):
+    from ray_tpu.train import TrainLoopConfig
+
+    def init_fn(config):
+        return {"count": 0}
+
+    def step_fn(state, batch):
+        c = state["count"] + 1
+        return {"count": c}, {"count": c}
+
+    return TrainLoopConfig(step_fn=step_fn, init_fn=init_fn,
+                           num_steps=num_steps, snapshot_every=0, credits=4)
+
+
+def _model_spec(num_steps: int, batch: int, seq: int, snapshot_every: int,
+                preset: str):
+    """A real forward+backward SGD step on the debug llama config; the
+    jitted step is cached in a closure cell so it compiles once per
+    stage actor, not once per tick."""
+    from ray_tpu.train import TrainLoopConfig
+
+    cache: dict = {}
+
+    def init_fn(config):
+        import jax
+
+        from ray_tpu.models.llama import PRESETS, init_params
+
+        return {"params": init_params(PRESETS[preset],
+                                      jax.random.PRNGKey(0)),
+                "count": 0}
+
+    def data_fn(config):
+        import numpy as np
+
+        from ray_tpu.models.llama import PRESETS
+
+        vocab = PRESETS[preset].vocab_size
+
+        def gen():
+            rng = np.random.default_rng(0)
+            while True:
+                yield rng.integers(0, vocab, (batch, seq + 1),
+                                   dtype=np.int32)
+        return gen()
+
+    def step_fn(state, tokens):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.llama import PRESETS, forward
+
+        if "step" not in cache:
+            cfg = PRESETS[preset]
+
+            def loss_fn(params, x, y):
+                logits = forward(params, x, cfg).astype(jnp.float32)
+                logp = jax.nn.log_softmax(logits)
+                return -jnp.take_along_axis(
+                    logp, y[..., None], axis=-1).mean()
+
+            @jax.jit
+            def sgd(params, x, y):
+                loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+                new = jax.tree.map(lambda p, g: p - 1e-3 * g, params, grads)
+                return new, loss
+
+            cache["step"] = sgd
+        params, loss = cache["step"](state["params"],
+                                     tokens[:, :-1], tokens[:, 1:])
+        c = state["count"] + 1
+        return ({"params": params, "count": c},
+                {"loss": float(loss), "count": c})
+
+    # credits 8: the step stage must be able to run a full
+    # snapshot interval ahead while the committer works, or the ring
+    # backpressure serializes exactly the overlap this mode exists for.
+    return TrainLoopConfig(step_fn=step_fn, init_fn=init_fn, data_fn=data_fn,
+                           num_steps=num_steps,
+                           snapshot_every=snapshot_every, credits=8,
+                           channel_capacity=8 << 20)
+
+
+def _overlap_spec(num_steps: int, snapshot_every: int):
+    """Device-proxy step for the overlap cell: the step WAITS (as a TPU
+    train step does from the host's perspective — compute runs on the
+    chip) while carrying a real few-MB state, so the checkpoint stage's
+    commit can genuinely run during it. On the 1-core sandbox a
+    CPU-saturating step and the commit cannot physically overlap — the
+    MFU phase covers that contention case; this phase measures the
+    MECHANISM the mode exists for (host commit under device compute)."""
+    from ray_tpu.train import TrainLoopConfig
+
+    def init_fn(config):
+        import numpy as np
+
+        return {"w": np.zeros(1 << 18), "count": 0}
+
+    def step_fn(state, batch):
+        import time as _t
+
+        _t.sleep(0.25)
+        c = state["count"] + 1
+        return {"w": state["w"] + 1.0, "count": c}, {"count": c}
+
+    return TrainLoopConfig(step_fn=step_fn, init_fn=init_fn,
+                           num_steps=num_steps,
+                           snapshot_every=snapshot_every, credits=4,
+                           channel_capacity=8 << 20)
+
+
+def _fit(spec, name: str, use_loop: bool, storage: str):
+    from ray_tpu.train import (DataParallelTrainer, RunConfig,
+                               ScalingConfig)
+
+    trainer = DataParallelTrainer(
+        spec,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name=name, storage_path=storage),
+        use_compiled_loop=use_loop,
+    )
+    result = trainer.fit()
+    if result.error is not None:
+        raise RuntimeError(f"train-loop bench run {name!r} failed: "
+                           f"{result.error}")
+    return result.loop_stats
+
+
+def run_train_loop_bench(*, ticks: int | None = None,
+                         steps: int | None = None,
+                         connect: bool = True) -> dict:
+    """Run both phases and return the metrics dict (or the ``*_skipped``
+    markers under ``RAY_TPU_BENCH_SKIP_TRAIN_LOOP=1``)."""
+    if os.environ.get("RAY_TPU_BENCH_SKIP_TRAIN_LOOP") == "1":
+        return dict(_SKIP_MARKERS)
+    import tempfile
+
+    import ray_tpu
+
+    ticks = ticks or _env_int("RAY_TPU_TRAIN_LOOP_BENCH_TICKS", 150)
+    steps = steps or _env_int("RAY_TPU_TRAIN_LOOP_BENCH_STEPS", 24)
+    batch, seq, preset = 2, 64, "debug-128"
+    out: dict = {}
+    if connect:
+        ray_tpu.init(num_cpus=max(8, os.cpu_count() or 8),
+                     ignore_reinit_error=True)
+    storage = tempfile.mkdtemp(prefix="raytpu_train_loop_bench_")
+    try:
+        # Phase 1: dispatch overhead, no-op step (the step cost is the
+        # drive path itself). Steady-state per-step wall (end of step 0
+        # → end of the last step) keeps actor spawn, first-call export
+        # and the loop's one-time channel setup off the measurement —
+        # the dag bench's warm-then-time discipline.
+        eager = _fit(_noop_spec(ticks), "tlb_dispatch_eager", False, storage)
+        loop = _fit(_noop_spec(ticks), "tlb_dispatch_loop", True, storage)
+        out["train_step_dispatch_overhead_eager_us"] = \
+            eager["steady_step_wall_us"]
+        out["train_step_dispatch_overhead_us"] = loop["steady_step_wall_us"]
+
+        # Phase 2: real-model MFU cells, steady window again (the first
+        # step's jit compile would otherwise dominate a CPU-sandbox run
+        # in both modes). Snapshots are OFF here so the pair isolates
+        # the per-step DRIVE delta — the checkpoint dimension has its
+        # own phase below; folding a ±300 ms orbax commit into a 30 ms
+        # step measurement buries the guarded signal in commit noise.
+        from ray_tpu.models.llama import PRESETS, train_flops_per_token
+
+        flops_tok = train_flops_per_token(PRESETS[preset], seq)
+
+        def tok_s(stats) -> float:
+            return (batch * seq * stats["steady_steps"]
+                    / max(stats["steady_wall_s"], 1e-9))
+
+        def mfu(stats) -> float:
+            return round(tok_s(stats) * flops_tok / PEAK_FLOPS, 8)
+
+        e_stats = _fit(_model_spec(steps, batch, seq, 0, preset),
+                       "tlb_mfu_eager", False, storage)
+        l_stats = _fit(_model_spec(steps, batch, seq, 0, preset),
+                       "tlb_mfu_loop", True, storage)
+        out["train_mfu_eager"] = mfu(e_stats)
+        out["train_mfu_loop"] = mfu(l_stats)
+        out["train_eager_tok_s"] = round(tok_s(e_stats), 1)
+        out["train_loop_tok_s"] = round(tok_s(l_stats), 1)
+
+        # Phase 3: checkpoint-commit cells under a device-proxy step
+        # (see _overlap_spec — the host-side commit must ride UNDER the
+        # step, which on a chip runs on the device). Both drive modes on
+        # the identical workload: the loop's overlap fraction is the
+        # guarded cell, and the step-side snapshot block must stay flat
+        # across modes (the step never waits for the writer).
+        o_eager = _fit(_overlap_spec(16, 4), "tlb_overlap_eager", False,
+                       storage)
+        o_loop = _fit(_overlap_spec(16, 4), "tlb_overlap_loop", True,
+                      storage)
+        out["train_ckpt_overlap_frac"] = o_loop["train_ckpt_overlap_frac"]
+        out["train_loop_ckpt_save_block_ms"] = o_loop["ckpt_save_block_ms"]
+        out["train_eager_ckpt_save_block_ms"] = o_eager["ckpt_save_block_ms"]
+        out["train_loop_bench_ticks_cfg"] = ticks
+        out["train_loop_bench_steps_cfg"] = steps
+    finally:
+        if connect:
+            try:
+                ray_tpu.shutdown()
+            except Exception:
+                pass
+    return out
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run_train_loop_bench(), indent=2))

@@ -58,7 +58,17 @@ _HIGHER_BETTER_SUFFIX = ("_per_s", "_per_sec", "_mb_s", "_tok_s",
 # AND compared in POINTS like _pct — a hit rate sliding 0.90 -> 0.45 is
 # a 45-point collapse; 0.02 -> 0.01 is noise, not a 50% regression.
 # "_accept_rate": the speculative drafter's 0-1 accept fraction.
+# "_frac" covers train_ckpt_overlap_frac (round 15) alongside the
+# serve goodput/suffix fractions.
 _POINTWISE_RATE_SUFFIX = ("_hit_rate", "_accept_rate", "_frac")
+# MFU is a 0-1 fraction too, but its cell tag often FOLLOWS the unit
+# ("mfu", "mfu_8b_proxy", "train_mfu_eager", "train_mfu_loop",
+# "train_mfu_1b_seq8k"), so it is matched by substring, not suffix.
+# Round-15 audit note: none of the mfu cells end in "_s"/"_ms", so the
+# lower-better suffix table cannot shadow them (the pre-PR-11 "_mb_s"
+# hazard) — but a relative compare would still flag a 0.0002-point CPU
+# wiggle as a regression; points are the right scale.
+_POINTWISE_RATE_SUBSTR = ("mfu",)
 # Lower is better. Peak-memory gauges count as regressions when they
 # GROW >threshold (a quiet 2x pool blowup is exactly what they exist
 # to catch). "_lag_steps": checkpoint lag (steps replayed after a
@@ -79,10 +89,15 @@ def load_metrics(path: str) -> dict:
     return data
 
 
+def _pointwise(name: str) -> bool:
+    """0-1 fraction metrics compared in points (higher-better)."""
+    return name.endswith(_POINTWISE_RATE_SUFFIX) or any(
+        s in name for s in _POINTWISE_RATE_SUBSTR)
+
+
 def _direction(name: str) -> str:
     """'up' = larger is better, 'down' = smaller is better."""
-    if name.endswith(_HIGHER_BETTER_SUFFIX) or \
-            name.endswith(_POINTWISE_RATE_SUFFIX):
+    if name.endswith(_HIGHER_BETTER_SUFFIX) or _pointwise(name):
         return "up"
     if name.endswith(_LOWER_BETTER_SUFFIX) or any(
             s in name for s in _LOWER_BETTER_SUBSTR):
@@ -126,7 +141,7 @@ def compare(old: dict, new: dict, threshold: float = 0.10) -> dict:
             # this guard exists for
             out["missing"].append({"metric": name, "old": ov, "new": None})
             continue
-        if name.endswith(_POINTWISE_RATE_SUFFIX):
+        if _pointwise(name):
             # 0-1 rates compare in POINTS, higher-better: the threshold
             # is a point budget on the 0-1 scale (0.10 = 10 points).
             better = round(nv - ov, 4)

@@ -101,6 +101,23 @@ def _cmd_bench(args) -> int:
                   and result.get("serve_overload_parity", 1.0) == 1.0)
         prefixes = ("serve_goodput_", "serve_shed_", "serve_admitted_",
                     "serve_overload_")
+    elif args.bench_cmd == "train":
+        from ray_tpu._train_loop_bench import run_train_loop_bench
+
+        result = run_train_loop_bench(ticks=args.ticks, steps=args.steps)
+        # Acceptance: the compiled loop kills ≥ 5x of the eager per-step
+        # dispatch, keeps MFU no worse, and genuinely overlaps the
+        # checkpoint commit with step compute.
+        eager_us = result.get("train_step_dispatch_overhead_eager_us")
+        loop_us = result.get("train_step_dispatch_overhead_us")
+        ok = bool(
+            eager_us and loop_us and eager_us >= 5.0 * loop_us
+            and result.get("train_mfu_loop", 0)
+            >= 0.95 * result.get("train_mfu_eager", 0)
+            and (result.get("train_ckpt_overlap_frac") or 0) > 0.5
+        ) or bool(result.get("train_mfu_skipped"))
+        prefixes = ("train_mfu", "train_step_dispatch_", "train_ckpt_",
+                    "train_loop_", "train_eager_")
     elif args.bench_cmd == "speculative":
         from ray_tpu._speculative_bench import run_speculative_bench
 
@@ -288,6 +305,29 @@ def main(argv: list[str] | None = None) -> int:
     bovl.add_argument("--check-against", default=None, metavar="BENCH_JSON",
                       help="run ray_tpu.bench_check against a recorded "
                            "BENCH_r*.json and exit non-zero on regression")
+    btrain = bench_sub.add_parser(
+        "train", help="train compiled-loop cells: per-step dispatch "
+                      "overhead eager vs compiled "
+                      "(train_step_dispatch_overhead{_eager,}_us, "
+                      "compiled must be ≥ 5x lower), real-step MFU both "
+                      "ways (train_mfu_{eager,loop}, loop ≥ eager), and "
+                      "the checkpoint-commit overlap fraction "
+                      "(train_ckpt_overlap_frac > 0.5); "
+                      "RAY_TPU_BENCH_SKIP_TRAIN_LOOP=1 emits *_skipped "
+                      "markers")
+    btrain.add_argument("--loop", action="store_true",
+                        help="run the compiled-loop suite (the default — "
+                             "the suite always measures BOTH drive modes; "
+                             "the flag documents intent)")
+    btrain.add_argument("--ticks", type=int, default=None,
+                        help="dispatch-overhead steps per mode (default "
+                             "$RAY_TPU_TRAIN_LOOP_BENCH_TICKS or 150)")
+    btrain.add_argument("--steps", type=int, default=None,
+                        help="MFU-phase train steps per mode (default "
+                             "$RAY_TPU_TRAIN_LOOP_BENCH_STEPS or 24)")
+    btrain.add_argument("--check-against", default=None, metavar="BENCH_JSON",
+                        help="run ray_tpu.bench_check against a recorded "
+                             "BENCH_r*.json and exit non-zero on regression")
     bspec = bench_sub.add_parser(
         "speculative", help="speculative-decoding cells: plain vs "
                             "draft-K/verify decode tok/s on repetitive "

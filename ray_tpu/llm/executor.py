@@ -48,20 +48,18 @@ def resolve_attention_impl(attention_impl: str = "auto", mesh=None,
     ``"auto"`` picks the v2 staging-buffer Pallas kernel (``"paged"``)
     whenever a TPU backend is present — per-slot-proportional HBM traffic
     is the point of the paged design — and falls back to the bucketed
-    dense gather (``"dense"``) when:
+    dense gather (``"dense"``) only when the backend is not a TPU
+    (interpret-mode decode is far slower than the dense gather on CPU —
+    tests force ``"paged"`` explicitly to exercise the kernel).
 
-      * the backend is not a TPU (interpret-mode decode is far slower
-        than the dense gather on CPU — tests force ``"paged"`` explicitly
-        to exercise the kernel), or
-      * the mesh BOTH pipelines layers and shards heads (``pp`` > 1 AND
-        ``tp`` > 1): the kernel's tp shard_map cannot nest inside the pp
-        pipeline's manual region yet (the one residue of ROADMAP item 4).
-
-    Tensor-parallel meshes take the kernel (shard_mapped over the
-    KV-head axis), and since round 8 PIPELINE meshes do too: the pp tick
-    loop threads the v2 staging carry per stage
-    (``pp_model.pp_decode_loop``), so the lifted refusal covers pure-pp
-    meshes of any depth.
+    Every mesh shape takes the kernel: tensor-parallel meshes
+    shard_map it over the KV-head axis (round 5), pure-pp meshes thread
+    the v2 staging carry per stage (round 8,
+    ``pp_model.pp_decode_loop``), and composed pp×tp meshes (round 15)
+    run the decode loop as ONE flattened manual region over both axes —
+    pp manual on layers, tp manual on KV heads — so the kernel runs on
+    each shard's local heads and the old "resolves dense on exactly the
+    mesh a real v5p slice uses" cliff is gone.
     """
     if attention_impl not in ("auto", "paged", "dense"):
         raise ValueError(f"unknown attention_impl {attention_impl!r}")
@@ -70,9 +68,6 @@ def resolve_attention_impl(attention_impl: str = "auto", mesh=None,
     if backend is None:
         backend = jax.default_backend()
     if backend not in _TPU_BACKENDS:
-        return "dense"
-    if (mesh is not None and mesh.shape.get("pp", 1) > 1
-            and mesh.shape.get("tp", 1) > 1):
         return "dense"
     return "paged"
 
@@ -109,23 +104,15 @@ class LocalEngineExecutor:
         # gather (cost tracks the batch-MAX live context); "auto" =
         # paged on TPU backends, dense elsewhere (resolve_attention_impl).
         self.attention_impl = resolve_attention_impl(attention_impl, mesh)
-        if self.attention_impl == "paged" and mesh is not None \
-                and mesh.shape.get("pp", 1) > 1 \
-                and mesh.shape.get("tp", 1) > 1:
-            # The round-8 residue: the kernel's tp shard_map cannot nest
-            # inside the pp pipeline's manual region. Pure pp takes the
-            # kernel (staging carry threaded per stage); pure tp always
-            # did; the 3-way composition stays dense for now.
-            raise ValueError(
-                "attention_impl='paged' does not compose pp x tp yet; "
-                "use 'dense' or 'auto' (pure pp and pure tp both take "
-                "the kernel)")
         self.paged_attention = self.attention_impl == "paged"
         # shard_map the kernel over tp when the pool is head-sharded;
-        # single-axis (dp-only) meshes keep the plain call. (pp paged
-        # runs tp=1, so the kernel is called per stage, unsharded.)
+        # single-axis (dp-only) meshes keep the plain call. pp meshes
+        # (pure OR composed with tp) never use it: the pp decode loop is
+        # itself the manual region — flattened over {"pp","tp"} when tp
+        # composes (round 15) — and calls the kernel on local arrays.
         self._attn_mesh = (
             mesh if self.paged_attention and mesh is not None
+            and mesh.shape.get("pp", 1) == 1
             and mesh.shape.get("tp", 1) > 1 else None)
         pages = init_pages(self.config, num_pages, page_size)
         self._replicated = None
@@ -134,10 +121,12 @@ class LocalEngineExecutor:
             # Pipeline-parallel: layers (params AND page pool) shard over
             # the pp axis; shard_map programs in pp_model.py rotate
             # activations stage->stage (ref vllm_models.py:117-168 PP).
-            # tp COMPOSES inside the stages: the shard_map is manual over
-            # pp only (axis_names={"pp"}), tp stays an auto axis XLA
-            # partitions from the params' shardings — the reference runs
-            # TP x PP engines the same way via vLLM (vllm_models.py:117).
+            # tp COMPOSES inside the stages: dense programs stay manual
+            # over pp only (tp auto — XLA partitions from the params'
+            # shardings), while the PAGED decode loop flattens to one
+            # manual region over {"pp","tp"} (round 15) because the
+            # Pallas kernel cannot sit under an auto-tp partition — the
+            # reference runs TP x PP engines via vLLM (vllm_models.py:117).
             from jax.sharding import NamedSharding, PartitionSpec
 
             from ..models.llama import param_axes
@@ -232,9 +221,14 @@ class LocalEngineExecutor:
             self._sample_first = jax.jit(
                 sample_first_batch.__wrapped__,
                 out_shardings=(self._replicated, self._replicated))
-            # pp prefill requires page-aligned chunk starts (stage-local
-            # whole-page writes), so partial-block COW sharing stays off.
-            self._copy_pages = None
+            # pp prefill scatters rows at (page, offset) granularity
+            # since round 15, so partial-block COW sharing works here
+            # too: the fork copy is a page-axis gather/scatter XLA
+            # partitions per layer shard without any manual region.
+            pg = {"k": self._pages_sharding, "v": self._pages_sharding}
+            self._copy_pages = jax.jit(
+                copy_pages.__wrapped__, donate_argnames=("pages",),
+                out_shardings=pg)
             # pp pools shard layers across the pipeline's manual region;
             # the host-array export/import path below assumes the whole
             # [L, P, ...] pool is addressable — KV migration stays off
@@ -499,9 +493,10 @@ class LocalEngineExecutor:
     @property
     def supports_prefix_cow(self) -> bool:
         """Copy-on-write prefix sharing: needs ``copy_pages`` plus the
-        row-granular prefill scatter (mid-page suffix starts) — both
-        available off the pp path (pp prefill writes whole pages per
-        stage, so partial-block sharing would clobber fork rows)."""
+        row-granular prefill scatter (mid-page suffix starts). Both hold
+        on every path since round 15 — pp prefill writes rows at
+        ``(page, offset)`` granularity now, so a mid-page suffix start
+        no longer clobbers a COW fork's copied prefix rows."""
         return self._copy_pages is not None
 
     def copy_pages(self, src, dst) -> None:

@@ -81,12 +81,20 @@ def _project_qkv(h, layer):
     return q, k, v
 
 
-def _mlp(x, layer, c: LlamaConfig):
+def _mlp(x, layer, c: LlamaConfig, tp_axis: str | None = None):
+    """SwiGLU MLP. ``tp_axis`` names a MANUAL mesh axis the mlp dim is
+    sharded over (the flattened pp×tp region in ``pp_model``): gate/up
+    are column-parallel (local), down is row-parallel — its partial
+    output psums over the axis before rejoining the replicated residual.
+    ``None`` (every auto-partitioned caller) is the unchanged path."""
     h = rms_norm(x, layer["mlp_norm"], eps=c.norm_eps)
     gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"])
     up = jnp.einsum("bse,em->bsm", h, layer["w_up"])
     ff = jax.nn.silu(gate.astype(jnp.float32)).astype(c.dtype) * up
-    return x + jnp.einsum("bsm,me->bse", ff, layer["w_down"])
+    down = jnp.einsum("bsm,me->bse", ff, layer["w_down"])
+    if tp_axis is not None:
+        down = lax.psum(down, tp_axis)
+    return x + down
 
 
 def _gather_ctx(pool, l, tables):
@@ -211,7 +219,7 @@ def decode_block(x, layer, kf, vf, l, block_tables, pos, write_idx,
                  c: LlamaConfig, page_size: int, paged: bool = False,
                  live_pages: int | None = None, lora=None, lora_idx=None,
                  stage=None, stage_step=None, stage_live=None,
-                 attn_mesh=None):
+                 attn_mesh=None, tp_axis: str | None = None):
     """One decoder block for a [n, 1, E] single-token batch against the
     FULL page pool (kf/vf: [L, P, KH, page, D]; ``l`` is this layer's
     index into it — traced, so the pool is only touched at gather/scatter
@@ -239,9 +247,16 @@ def decode_block(x, layer, kf, vf, l, block_tables, pos, write_idx,
     ``paged=False`` is the dense gather — width capped by ``live_pages``
     — kept as the CPU/test default and the numerical ground truth.
     ``attn_mesh`` (static) shard_maps the kernel over the mesh's tp axis
-    (KV heads)."""
+    (KV heads). ``tp_axis`` instead names a tp axis this block is ALREADY
+    manual over (the flattened pp×tp region in ``pp_model``): the head
+    dims of q/k/v/pool arrive pre-sharded, attention runs on the local
+    heads with no collective, and the row-parallel ``wo`` output psums
+    over the axis — so the KV-head count is read from the pool shard,
+    never from the (global) config."""
     n = x.shape[0]
-    kh, g = c.n_kv_heads, c.n_heads // c.n_kv_heads
+    # Local KV heads from the pool shard (== c.n_kv_heads everywhere
+    # except inside a manual-tp region); the GQA ratio is tp-invariant.
+    kh, g = kf.shape[2], c.n_heads // c.n_kv_heads
     offset = pos % page_size
     h = rms_norm(x, layer["attn_norm"], eps=c.norm_eps)
     q, k, v = _project_qkv(h, layer)                   # [n, H|KH, 1, D]
@@ -291,7 +306,7 @@ def decode_block(x, layer, kf, vf, l, block_tables, pos, write_idx,
             # cost as the dense path, in place on the donated pool.
             kf = kf.at[l, write_idx, :, offset, :].set(k_tok)
             vf = vf.at[l, write_idx, :, offset, :].set(v_tok)
-        attn = attn.reshape(n, 1, c.n_heads * c.head_dim)
+        attn = attn.reshape(n, 1, kh * g * c.head_dim)
     else:
         # Write each slot's new K/V at (its current page, offset), then
         # attend over the gathered context [0, pos]. Distinct slots own
@@ -310,15 +325,19 @@ def decode_block(x, layer, kf, vf, l, block_tables, pos, write_idx,
         scores = jnp.where(live[:, None, None], scores, -jnp.inf)
         probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
         attn = jnp.einsum("nkgt,nktd->nkgd", probs, cv).reshape(
-            n, 1, c.n_heads * c.head_dim)
-    out = jnp.einsum("bsf,fe->bse", attn,
-                     layer["wo"].reshape(c.n_heads * c.head_dim, c.hidden))
+            n, 1, kh * g * c.head_dim)
+    # reshape(-1, hidden): wo's head axis may be a LOCAL tp shard.
+    out = jnp.einsum("bsf,fe->bse", attn, layer["wo"].reshape(-1, c.hidden))
     if lora is not None:
         from .lora import lora_delta
 
         out = out + lora_delta(attn, lora["wo.A"], lora["wo.B"],
                                l, lora_idx).astype(out.dtype)
-    return _mlp(x + out, layer, c), kf, vf, stage
+    if tp_axis is not None:
+        # Row-parallel wo: each shard's local-head contribution is a
+        # partial sum over the (sharded) head axis.
+        out = lax.psum(out, tp_axis)
+    return _mlp(x + out, layer, c, tp_axis=tp_axis), kf, vf, stage
 
 
 def _decode_logits(params, pages: dict, block_tables, tokens, pos,

@@ -51,10 +51,12 @@ class EngineShardWorker:
         stage layers across hosts instead.
         ``attention_impl="auto"`` resolves per shard exactly as on a
         single host: the paged kernel shard_maps over the kv-head/tp
-        axis and rides the pp tick loop's staging carry; only the
-        pp x tp composition stays dense. ``lora_config`` builds the
-        device-resident adapter stacks on every shard (pp-sharded over
-        the layer axis on pipeline meshes)."""
+        axis, rides the pp tick loop's staging carry, and on composed
+        pp x tp meshes runs inside the flattened {"pp","tp"} manual
+        region (round 15) — no mesh shape resolves dense on a TPU
+        backend anymore. ``lora_config`` builds the device-resident
+        adapter stacks on every shard (pp-sharded over the layer axis
+        on pipeline meshes)."""
         import jax
 
         from ..parallel import MeshConfig, create_mesh

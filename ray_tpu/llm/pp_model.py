@@ -28,10 +28,25 @@ activation, so every stage sees the group's current round state without
 host synchronization, and finished slots redirect their KV writes to
 their private trash page exactly as the unpipelined ``decode_loop`` does.
 
-TP composes inside the stages: the shard_map is manual over ``pp``
-only (``axis_names={"pp"}``) — ``tp`` remains an auto axis that XLA
-partitions from the params'/pool's shardings, inserting the ICI
-collectives per stage.
+TP composes inside the stages, two ways:
+
+  * **Dense decode / prefill**: the shard_map is manual over ``pp`` only
+    (``axis_names={"pp"}``) — ``tp`` remains an auto axis XLA partitions
+    from the params'/pool's shardings, inserting the ICI collectives per
+    stage.
+  * **Paged decode** (round 15): the Pallas kernel is an opaque custom
+    call XLA cannot auto-partition over tp, so nesting it under an
+    auto-tp region forced composed pp×tp meshes dense. The fix FLATTENS
+    the decode loop to ONE manual region over ``{"pp", "tp"}``: pp stays
+    manual on the layer axis (pool + params), tp goes manual on the
+    KV-head axis (pool/staging/q — attention is independent per KV
+    head, so the kernel runs unchanged on each shard's local heads),
+    and the only collectives are the Megatron pair hand-written in
+    ``model.decode_block``/``_mlp`` (``tp_axis=``: one ``psum`` after
+    the row-parallel ``wo``, one after ``w_down``) plus a tiled
+    ``all_gather`` of the per-shard logits before sampling. Greedy
+    parity with the unpipelined engine is preserved — the math is the
+    same sum, just reduced explicitly.
 """
 
 from __future__ import annotations
@@ -48,6 +63,33 @@ from ..ops import apply_rope, rms_norm
 from .model import _gather_ctx, _mlp, _project_qkv, decode_block
 
 
+def _manual_layer_specs(config: LlamaConfig, axes=("pp", "tp")):
+    """Per-leaf PartitionSpecs for ``params["layers"]`` inside a manual
+    region over ``axes``: each leaf's logical axes map through the
+    standard rule table (layers→pp, heads/kv_heads/mlp→tp), with every
+    mesh axis OUTSIDE the manual set dropped (those stay auto/size-1).
+    The flattened pp×tp decode region needs real per-leaf specs — a
+    blanket ``P("pp")`` would silently all-gather the tp shards."""
+    from ..models.llama import param_axes
+    from ..parallel.sharding import DEFAULT_RULES
+
+    def to_spec(logical):
+        names = []
+        for ax in logical:
+            mesh_ax = DEFAULT_RULES.get(ax)
+            if isinstance(mesh_ax, tuple):
+                mesh_ax = next((a for a in mesh_ax if a in axes), None)
+            if mesh_ax not in axes:
+                mesh_ax = None
+            names.append(mesh_ax)
+        while names and names[-1] is None:
+            names.pop()
+        return P(*names)
+
+    return jax.tree.map(to_spec, param_axes(config)["layers"],
+                        is_leaf=lambda x: isinstance(x, tuple))
+
+
 @functools.partial(jax.jit,
                    static_argnames=("config", "page_size", "mesh"),
                    donate_argnames=("pages",))
@@ -57,12 +99,16 @@ def pp_prefill_chunk(params, pages, block_table, tokens, start_pos,
     """Pipeline-staged ``prefill_chunk``: same contract as
     ``model.prefill_chunk`` (pages updated, hidden [C, E] returned) with
     params["layers"]/pages sharded P("pp") on the layer axis.
+    ``start_pos`` is NOT required to be page-aligned (round 15): the
+    chunk's K/V lands via the same row-granular ``(page, offset)``
+    scatter the single-host prefill uses, so a prefix-cache partial
+    tail-block hit can start the suffix mid-page on a pp mesh too — the
+    gate that kept ``supports_prefix_cow`` off the pp path.
     ``lora``/``lora_slot`` apply one adapter to the whole chunk (stacks
     sharded over pp on their layer axis, like the params)."""
     c = config
     pp = mesh.shape["pp"]
     C = tokens.shape[0]
-    n_chunk_pages = C // page_size
     max_ctx = block_table.shape[0] * page_size
     kh, g = c.n_kv_heads, c.n_heads // c.n_kv_heads
     causal = jnp.arange(C)[:, None] >= jnp.arange(C)[None, :]
@@ -74,8 +120,12 @@ def pp_prefill_chunk(params, pages, block_table, tokens, start_pos,
         perm = [(i, (i + 1) % pp) for i in range(pp)]
         positions = start_pos + jnp.arange(C, dtype=jnp.int32)
         ctx_live = jnp.arange(max_ctx, dtype=jnp.int32) < start_pos
-        first = start_pos // page_size
-        write_ids = lax.dynamic_slice(block_table, (first,), (n_chunk_pages,))
+        # Row-granular write destinations: position p -> (its page, its
+        # offset). Pad rows past the table clamp to the last page (masked
+        # until decode overwrites them) — identical to model.prefill_chunk.
+        write_pages = block_table[jnp.minimum(
+            positions // page_size, block_table.shape[0] - 1)]        # [C]
+        write_offs = positions % page_size                            # [C]
         x0 = embed[tokens][None].astype(c.dtype)       # [1, C, E]
 
         def tick(carry, t):
@@ -128,16 +178,19 @@ def pp_prefill_chunk(params, pages, block_table, tokens, start_pos,
                         flat, lora_local["wo.A"], lora_local["wo.B"],
                         l, lslot).astype(out.dtype)
                 x2 = _mlp(xc + out, layer, c)
-                # Guarded page write: stages without the real chunk write
-                # the OLD page values back (branchless no-op).
-                k_new = jnp.swapaxes(
-                    k[0].reshape(kh, n_chunk_pages, page_size, c.head_dim), 0, 1)
-                v_new = jnp.swapaxes(
-                    v[0].reshape(kh, n_chunk_pages, page_size, c.head_dim), 0, 1)
-                kp = kp.at[l, write_ids].set(
-                    jnp.where(live, k_new, kp[l, write_ids]))
-                vp = vp.at[l, write_ids].set(
-                    jnp.where(live, v_new, vp[l, write_ids]))
+                # Guarded ROW-granular scatter: row j of the chunk lands
+                # at (page of position start+j, its offset) — mid-page
+                # starts never clobber a COW fork's copied prefix rows.
+                # Stages without the real chunk write the OLD rows back
+                # (branchless no-op, exactly like the old page write).
+                k_new = jnp.swapaxes(k[0], 0, 1)       # [C, KH, D]
+                v_new = jnp.swapaxes(v[0], 0, 1)
+                kp = kp.at[l, write_pages, :, write_offs, :].set(
+                    jnp.where(live, k_new,
+                              kp[l, write_pages, :, write_offs, :]))
+                vp = vp.at[l, write_pages, :, write_offs, :].set(
+                    jnp.where(live, v_new,
+                              vp[l, write_pages, :, write_offs, :]))
                 return (x2, kp, vp), None
 
             n_local = kp.shape[0]
@@ -200,6 +253,16 @@ def pp_decode_loop(params, pages, block_tables, tokens, pos, temps, eos_ids,
     dispatch boundary. ``live_pages`` bounds the kernel grid by POOL
     context only (staged tokens never touch the pool mid-dispatch).
 
+    Composed pp×tp meshes (round 15): with ``paged=True`` and ``tp`` >
+    1 the region is manual over BOTH axes — pp on layers, tp on KV
+    heads — because the opaque kernel cannot sit under an auto-tp
+    partition. Per-leaf in_specs carry the params' real tp axes
+    (``_manual_layer_specs``), ``decode_block``/``_mlp`` psum the two
+    row-parallel projections over ``tp_axis="tp"``, and the per-shard
+    logits ``all_gather`` (tiled, vocab-shard order) before sampling so
+    every device samples the identical token. Dense decode keeps the
+    old manual-pp-only region with tp auto.
+
     ``lora``/``lora_idx`` thread the device-resident adapter stacks
     through the pipeline: the stacks are sharded over ``pp`` on their
     layer axis (matching ``params["layers"]``), so ``decode_block``'s
@@ -214,6 +277,11 @@ def pp_decode_loop(params, pages, block_tables, tokens, pos, temps, eos_ids,
 
     c = config
     pp = mesh.shape["pp"]
+    tp = mesh.shape.get("tp", 1)
+    # The kernel forces the composed mesh manual over tp too (see module
+    # docstring); dense tp stays an auto axis exactly as before.
+    tp_manual = bool(paged and tp > 1)
+    tp_axis = "tp" if tp_manual else None
     slots = tokens.shape[0]
     m = slots // pp
     maxp = block_tables.shape[1]
@@ -235,14 +303,15 @@ def pp_decode_loop(params, pages, block_tables, tokens, pos, temps, eos_ids,
         stage = lax.axis_index("pp")
         perm = [(i, (i + 1) % pp) for i in range(pp)]
         n_local = kp.shape[0]  # this stage's layer count
+        kh_local = kp.shape[2]  # KV heads (a tp shard when tp is manual)
         if paged:
             from ..ops.paged_attention import stage_rows
 
             sc = stage_rows(n_steps)
-            # Per-GROUP staging carry [Ll, pp, m, KH, SC, D]: group g's
-            # row r holds position pos0_g + r (LOCAL layers only — the
-            # pool shard and the staging shard stay aligned).
-            stage_shape = (n_local, pp, m, c.n_kv_heads, sc, c.head_dim)
+            # Per-GROUP staging carry [Ll, pp, m, KHl, SC, D]: group g's
+            # row r holds position pos0_g + r (LOCAL layers AND local KV
+            # heads — pool shard and staging shard stay aligned).
+            stage_shape = (n_local, pp, m, kh_local, sc, c.head_dim)
             ks0 = jnp.zeros(stage_shape, kp.dtype)
             vs0 = jnp.zeros(stage_shape, vp.dtype)
         else:
@@ -281,7 +350,8 @@ def pp_decode_loop(params, pages, block_tables, tokens, pos, temps, eos_ids,
                     paged=paged, live_pages=live_pages if paged else None,
                     lora=lora_local, lora_idx=lidx,
                     stage=stg, stage_step=rc if paged else None,
-                    stage_live=live_round if paged else None)
+                    stage_live=live_round if paged else None,
+                    tp_axis=tp_axis)
                 return (x2, kp, vp, stg), None
 
             (x, kp, vp, stage_g), _ = lax.scan(
@@ -298,6 +368,12 @@ def pp_decode_loop(params, pages, block_tables, tokens, pos, temps, eos_ids,
             hidden = rms_norm(x, final_norm, eps=c.norm_eps)
             logits = jnp.einsum(
                 "bse,ev->bsv", hidden, lm_head)[:, 0].astype(jnp.float32)
+            if tp_manual:
+                # lm_head is vocab-sharded over tp inside the manual
+                # region: gather the shards (tiled = vocab order) so
+                # argmax/categorical see the full distribution and every
+                # device samples the identical token.
+                logits = lax.all_gather(logits, "tp", axis=1, tiled=True)
             key, sub = jax.random.split(key)
             greedy = jnp.argmax(logits, axis=-1)
             temps_c = temp_g[g]
@@ -338,9 +414,9 @@ def pp_decode_loop(params, pages, block_tables, tokens, pos, temps, eos_ids,
             # The one pool write of the whole dispatch, per stage over its
             # LOCAL layers: regroup the per-group staging carry back to
             # slot order and commit (mirrors decode_loop + commit_staging).
-            ks_flat = ks.reshape(n_local, slots, c.n_kv_heads,
+            ks_flat = ks.reshape(n_local, slots, kh_local,
                                  ks.shape[4], c.head_dim)
-            vs_flat = vs.reshape(n_local, slots, c.n_kv_heads,
+            vs_flat = vs.reshape(n_local, slots, kh_local,
                                  vs.shape[4], c.head_dim)
             committed = commit_staging(
                 {"k": kp, "v": vp}, (ks_flat, vs_flat),
@@ -350,22 +426,37 @@ def pp_decode_loop(params, pages, block_tables, tokens, pos, temps, eos_ids,
             jnp.where(stage == pp - 1, outputs, jnp.zeros_like(outputs)), "pp")
         return outputs.reshape(n_steps, slots), key, {"k": kp, "v": vp}
 
-    layer_spec = jax.tree.map(lambda _: P("pp"), params["layers"])
+    if tp_manual:
+        # Flattened manual region: per-leaf specs carry the params' real
+        # tp axes (heads/kv_heads/mlp), the pool/staging shard KV heads,
+        # lm_head shards vocab. Outputs are tp-invariant (psum'd partials
+        # + all-gathered logits), so they stay unsharded in out_specs.
+        layer_spec = _manual_layer_specs(config)
+        page_spec = P("pp", None, "tp")
+        head_spec = P(None, "tp")
+        manual_axes = frozenset({"pp", "tp"})
+    else:
+        layer_spec = jax.tree.map(lambda _: P("pp"), params["layers"])
+        page_spec = P("pp")
+        head_spec = P()
+        manual_axes = frozenset({"pp"})
     args = [params["layers"], pages["k"], pages["v"], params["embed"],
             params["final_norm"], params["lm_head"],
             bt_g, tok_g, pos_g, temp_g, eos_g, rem_g, pos, key]
-    specs = [layer_spec, P("pp"), P("pp"), P(), P(), P(),
+    specs = [layer_spec, page_spec, page_spec, P(), P(), head_spec,
              P(), P(), P(), P(), P(), P(), P(), P()]
     if lora is not None:
         # Adapter stacks shard over pp on their layer axis, exactly like
         # params["layers"] — local layer indices address them directly.
+        # (LoRA never runs under manual tp: the executor refuses
+        # lora_config on tp > 1 meshes, so the stacks need no tp specs.)
         args += [lora, idx_g]
         specs += [jax.tree.map(lambda _: P("pp"), lora), P()]
     fn = jax.shard_map(
         per_device, mesh=mesh,
         in_specs=tuple(specs),
-        out_specs=(P(), P(), {"k": P("pp"), "v": P("pp")}),
-        axis_names=frozenset({"pp"}),
+        out_specs=(P(), P(), {"k": page_spec, "v": page_spec}),
+        axis_names=manual_axes,
         check_vma=False,
     )
     return fn(*args)
@@ -385,14 +476,15 @@ def pp_prefill_chunks(params, pages, block_table, tokens_m, start_pos0,
     attention at stage s needs chunk j's stage-s K/V, which stage s wrote
     one tick earlier — the dependency is satisfied by construction.
 
-    tokens_m:   [m, C] int32 — consecutive chunks (C a page multiple).
+    tokens_m:   [m, C] int32 — consecutive chunks (C a page multiple;
+                ``start_pos0`` itself may be mid-page — rows scatter at
+                ``(page, offset)`` granularity since round 15).
     start_pos0: scalar int32 — chunk j starts at ``start_pos0 + j*C``.
     Returns (pages, hidden [m, C, E]).
     """
     c = config
     pp = mesh.shape["pp"]
     m, C = tokens_m.shape
-    n_chunk_pages = C // page_size
     max_ctx = block_table.shape[0] * page_size
     kh, g = c.n_kv_heads, c.n_heads // c.n_kv_heads
     causal = jnp.arange(C)[:, None] >= jnp.arange(C)[None, :]
@@ -411,8 +503,12 @@ def pp_prefill_chunks(params, pages, block_table, tokens_m, start_pos0,
             start_j = start_pos0 + jc * C
             positions = start_j + jnp.arange(C, dtype=jnp.int32)
             ctx_live = jnp.arange(max_ctx, dtype=jnp.int32) < start_j
-            first = start_j // page_size
-            write_ids = lax.dynamic_slice(block_table, (first,), (n_chunk_pages,))
+            # Row-granular destinations (round 15): chunk starts need not
+            # be page-aligned — a partial-block prefix hit shifts EVERY
+            # chunk of the wavefront mid-page.
+            write_pages = block_table[jnp.minimum(
+                positions // page_size, block_table.shape[0] - 1)]
+            write_offs = positions % page_size
             # stage 0 injects chunk t's embedding at its entry tick
             x0 = embed[tokens_m[jnp.clip(t, 0, m - 1)]][None].astype(c.dtype)
             x = jnp.where((stage == 0) & (t < m), x0, act)
@@ -441,14 +537,14 @@ def pp_prefill_chunks(params, pages, block_table, tokens_m, start_pos0,
                 attn = attn.reshape(1, c.n_heads, C, c.head_dim)
                 out = jnp.einsum("bhsd,hde->bse", attn, layer["wo"])
                 x2 = _mlp(xc + out, layer, c)
-                k_new = jnp.swapaxes(
-                    k[0].reshape(kh, n_chunk_pages, page_size, c.head_dim), 0, 1)
-                v_new = jnp.swapaxes(
-                    v[0].reshape(kh, n_chunk_pages, page_size, c.head_dim), 0, 1)
-                kp = kp.at[l, write_ids].set(
-                    jnp.where(valid, k_new, kp[l, write_ids]))
-                vp = vp.at[l, write_ids].set(
-                    jnp.where(valid, v_new, vp[l, write_ids]))
+                k_new = jnp.swapaxes(k[0], 0, 1)       # [C, KH, D]
+                v_new = jnp.swapaxes(v[0], 0, 1)
+                kp = kp.at[l, write_pages, :, write_offs, :].set(
+                    jnp.where(valid, k_new,
+                              kp[l, write_pages, :, write_offs, :]))
+                vp = vp.at[l, write_pages, :, write_offs, :].set(
+                    jnp.where(valid, v_new,
+                              vp[l, write_pages, :, write_offs, :]))
                 return (x2, kp, vp), None
 
             (x, kp, vp), _ = lax.scan(

@@ -266,8 +266,13 @@ def paged_decode_attention(
                   runs the kernel on its local heads, and nothing is
                   gathered — attention is embarrassingly parallel over
                   KV heads. Manual over {"tp"} only, so other mesh axes
-                  stay auto-partitioned (the pp_model.py idiom).
-                  Requires ``KH %% tp == 0`` (enforced by the executor).
+                  stay auto-partitioned. Requires ``KH %% tp == 0``
+                  (enforced by the executor). Used by PURE-tp meshes
+                  only: pp meshes — composed pp×tp included — call the
+                  kernel with ``mesh=None`` from inside
+                  ``pp_model.pp_decode_loop``'s own manual region
+                  (flattened over {"pp","tp"} when tp composes), where
+                  every operand is already a local shard.
 
     Returns [slots, KH, G, D] in q.dtype.
     """

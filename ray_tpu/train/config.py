@@ -97,6 +97,11 @@ class Result:
     # failure and of the first resumed report, plus the resume path — the
     # recovery bench derives `recovery_train_resume_s` from these.
     recovery_events: list[dict] = dataclasses.field(default_factory=list)
+    # Compiled-loop mode only (train/loop.py): per-run drive statistics —
+    # mode, per-step wall, checkpoint-commit windows and
+    # `train_ckpt_overlap_frac` (fraction of checkpoint commit time that
+    # overlapped step compute; the bench records it as a guarded cell).
+    loop_stats: dict | None = None
 
     @property
     def best_checkpoints(self) -> list:

@@ -431,3 +431,65 @@ def test_speculative_skip_markers_honored():
     assert {r["metric"] for r in result["skipped"]} == {
         "decode_tok_s_plain", "decode_tok_s_speculative",
         "spec_accept_rate", "spec_tokens_per_dispatch"}
+
+
+def test_train_loop_metrics_directions():
+    """Round-15 cells: dispatch overhead regresses UP (µs, and the
+    "overhead" substring), MFU/overlap-frac are pointwise 0-1
+    higher-better, tok/s cells ride the audited _tok_s suffix, and the
+    ckpt save-block is a latency. Shadow audit: no train-loop cell ends
+    in a bare "_s", so none can fall into the lower-better "_s" bucket
+    (the pre-PR-11 _mb_s trap)."""
+    assert bench_check._direction("train_step_dispatch_overhead_us") == "down"
+    assert bench_check._direction(
+        "train_step_dispatch_overhead_eager_us") == "down"
+    assert bench_check._direction("train_mfu_eager") == "up"
+    assert bench_check._direction("train_mfu_loop") == "up"
+    assert bench_check._direction("train_mfu_1b_seq8k") == "up"
+    assert bench_check._direction("mfu") == "up"
+    assert bench_check._direction("mfu_8b_proxy") == "up"
+    assert bench_check._direction("train_ckpt_overlap_frac") == "up"
+    assert bench_check._direction("train_loop_tok_s") == "up"
+    assert bench_check._direction("train_eager_tok_s") == "up"
+    assert bench_check._direction("train_loop_ckpt_save_block_ms") == "down"
+    # a dispatch-overhead GROWTH is the regression
+    old = {"train_step_dispatch_overhead_us": 300.0,
+           "train_ckpt_overlap_frac": 0.75}
+    new = {"train_step_dispatch_overhead_us": 900.0,
+           "train_ckpt_overlap_frac": 0.78}
+    result = bench_check.compare(old, new)
+    assert {r["metric"] for r in result["regressions"]} == {
+        "train_step_dispatch_overhead_us"}
+
+
+def test_mfu_compares_in_points():
+    """MFU is a 0-1 fraction whose cell tag follows the unit
+    (train_mfu_eager), so it is matched by SUBSTRING and compared in
+    points: a 0.45 -> 0.30 collapse regresses, a CPU-sandbox
+    0.00005 -> 0.00002 wiggle is noise — a relative compare would have
+    flagged the wiggle as a 60% regression."""
+    result = bench_check.compare({"train_mfu_loop": 0.45},
+                                 {"train_mfu_loop": 0.30})
+    assert [r["metric"] for r in result["regressions"]] == ["train_mfu_loop"]
+    result2 = bench_check.compare({"train_mfu_loop": 5e-05},
+                                  {"train_mfu_loop": 2e-05})
+    assert not result2["regressions"]
+    # config echoes stay untracked bookkeeping
+    result3 = bench_check.compare({"train_loop_bench_ticks_cfg": 150},
+                                  {"train_loop_bench_ticks_cfg": 50})
+    assert not result3["regressions"] and not result3["missing"]
+
+
+def test_train_loop_skip_markers_honored():
+    """RAY_TPU_BENCH_SKIP_TRAIN_LOOP=1 leaves the three *_skipped
+    markers; every train-loop cell lands in skipped, never missing."""
+    old = {"train_step_dispatch_overhead_eager_us": 6400.0,
+           "train_step_dispatch_overhead_us": 320.0,
+           "train_mfu_eager": 5e-05, "train_mfu_loop": 6e-05,
+           "train_ckpt_overlap_frac": 0.75}
+    new = {"train_mfu_skipped": True,
+           "train_step_dispatch_overhead_skipped": True,
+           "train_ckpt_overlap_frac_skipped": True}
+    result = bench_check.compare(old, new)
+    assert not result["missing"] and not result["regressions"]
+    assert {r["metric"] for r in result["skipped"]} == set(old)

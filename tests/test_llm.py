@@ -858,6 +858,53 @@ def test_engine_cached_vs_cold_greedy_parity(small_model):
     assert cold.metrics["prefix_cached_tokens"] == 0
 
 
+@requires_shard_map
+def test_pp_partial_block_cow_parity(small_model):
+    """Round 15 (PR 10 residue a): pp engines admit PARTIAL-block prefix
+    hits. The pp prefill scatters rows at (page, offset) granularity, so
+    a cached suffix can start mid-page on a COW-forked shared page —
+    `supports_prefix_cow` is no longer gated off the pp path. Cached
+    resend and a mid-tail divergence must decode byte-identically to
+    full recompute, with real COW forks on the trie."""
+    from ray_tpu.parallel import MeshConfig, create_mesh
+
+    cfg, params = small_model
+    n = len(jax.devices())
+    mesh = create_mesh(MeshConfig(pp=2, dp=max(1, n // 2)))
+    eng = InferenceEngine(cfg, params, max_slots=4, max_len=64, page_size=8,
+                          mesh=mesh)
+    assert eng._cow_enabled, "pp executor must support prefix COW now"
+
+    prompt_a = list(range(1, 20))           # 2 full pages + 3 partial rows
+    a = Request("a", list(prompt_a), max_new_tokens=4)
+    eng.add_request(a)
+    while not a.done:
+        eng.step()
+    assert a.generated == naive_greedy(params, cfg, prompt_a, 4)
+
+    # Uniform resend: full-block hits + partial tail rows -> the suffix
+    # starts MID-PAGE and the first write COW-forks the shared tail.
+    b = Request("b", list(prompt_a), max_new_tokens=4)
+    eng.add_request(b)
+    while not b.done:
+        eng.step()
+    assert b.generated == a.generated
+    assert b.cached_prefix_tokens == 18     # 2 pages + 2 partial rows
+    assert eng.metrics["cow_forks"] >= 1
+
+    # Mid-tail divergence: shares the chain, diverges inside the partial
+    # block — forks its own copy, decodes identically to recompute.
+    forks_before = eng.metrics["cow_forks"]
+    prompt_c = prompt_a[:17] + [99, 98, 97]
+    c = Request("c", list(prompt_c), max_new_tokens=5)
+    eng.add_request(c)
+    while not c.done:
+        eng.step()
+    assert c.generated == naive_greedy(params, cfg, prompt_c, 5)
+    assert c.cached_prefix_tokens == 17
+    assert eng.metrics["cow_forks"] > forks_before
+
+
 def test_engine_multiturn_session_reuse(small_model):
     """Multi-turn session: turn 2's prompt embeds turn 1's prompt AND
     generated answer verbatim — generated-token pages registered at

@@ -249,8 +249,9 @@ def test_engine_greedy_parity_stage_wraparound(small_model):
 
 def test_resolve_attention_impl():
     """"auto" must pick the kernel exactly when a TPU backend is present —
-    since round 8 that includes PIPELINE meshes (the pp tick loop threads
-    the staging carry); only the pp x tp composition stays dense."""
+    on EVERY mesh shape: round 8 lifted pure-pp, round 15 lifted the
+    pp x tp composition (the decode loop flattens to one manual region
+    over both axes), so no TPU mesh resolves dense anymore."""
     import types
 
     tp_mesh = types.SimpleNamespace(shape={"tp": 4, "dp": 1})
@@ -263,8 +264,9 @@ def test_resolve_attention_impl():
     assert resolve_attention_impl("auto", tp_mesh, backend="tpu") == "paged"
     # ROADMAP item 4 closed: pp meshes take the kernel too
     assert resolve_attention_impl("auto", pp_mesh, backend="tpu") == "paged"
-    # residue: the kernel's tp shard_map can't nest inside the pp region
-    assert resolve_attention_impl("auto", pp_tp_mesh, backend="tpu") == "dense"
+    # ROADMAP item 6 closed: composed pp x tp takes the kernel too
+    # (flattened {"pp","tp"} manual region — the round-8 residue)
+    assert resolve_attention_impl("auto", pp_tp_mesh, backend="tpu") == "paged"
     # explicit choices pass through untouched
     assert resolve_attention_impl("dense", backend="tpu") == "dense"
     assert resolve_attention_impl("paged", backend="cpu") == "paged"
@@ -321,23 +323,101 @@ def test_pipeline_parallel_paged_parity(small_model):
     assert [r.generated for r in reqs] == expected
 
 
-def test_paged_refused_over_pp_tp_mesh(small_model):
-    """The one residue of the lifted refusal: pp x tp composed meshes
-    must refuse 'paged' loudly (the kernel's tp shard_map cannot nest
-    inside the pp manual region) and resolve 'auto' to dense."""
-    pytest.importorskip("jax", reason="jax required")
-    if not HAS_SHARD_MAP:
-        pytest.skip("pp engine needs jax.shard_map")
+def test_decode_block_manual_tp_psum_parity(small_model):
+    """The flattened pp×tp region's hand-written tp collectives
+    (decode_block/_mlp ``tp_axis=``: psum after the row-parallel wo and
+    w_down) must reproduce the unsharded block bit-for-bit in f32. Runs
+    WITHOUT shard_map: ``jax.vmap(axis_name="tp")`` over hand-split
+    KV-head/mlp shards gives the same manual-collective semantics, so
+    the sandbox (jax 0.4.37) covers the math the composed-mesh parity
+    test exercises end-to-end on the driver's jax."""
+    from ray_tpu.llm.model import decode_block
+
+    cfg, params = small_model
+    tp = 2
+    rng = np.random.default_rng(5)
+    page, n, max_pages = 8, 3, 4
+    pool = 32
+    layer = {k: v[0] for k, v in params["layers"].items()}  # layer 0
+    kf = jnp.array(rng.standard_normal(
+        (1, pool, cfg.n_kv_heads, page, cfg.head_dim)), jnp.float32)
+    vf = jnp.array(rng.standard_normal(kf.shape), jnp.float32)
+    x = jnp.array(rng.standard_normal((n, 1, cfg.hidden)), jnp.float32)
+    bt = jnp.array(rng.permutation(pool)[: n * max_pages].reshape(
+        n, max_pages), jnp.int32)
+    pos = jnp.array([5, 11, 17], jnp.int32)
+    widx = jnp.take_along_axis(bt, (pos // page)[:, None], axis=1)[:, 0]
+    l = jnp.int32(0)
+
+    # Ground truth: the unsharded block.
+    full_x2, full_kf, full_vf, _ = decode_block(
+        x, layer, kf, vf, l, bt, pos, widx, cfg, page)
+
+    # Hand-shard heads/mlp the way the manual region receives them.
+    def split(a, axis):
+        return jnp.stack(jnp.split(a, tp, axis=axis))
+
+    layer_sh = {
+        "attn_norm": layer["attn_norm"], "mlp_norm": layer["mlp_norm"],
+        "wq": split(layer["wq"], 1), "wk": split(layer["wk"], 1),
+        "wv": split(layer["wv"], 1), "wo": split(layer["wo"], 0),
+        "w_gate": split(layer["w_gate"], 1),
+        "w_up": split(layer["w_up"], 1),
+        "w_down": split(layer["w_down"], 0),
+    }
+    kf_sh, vf_sh = split(kf, 2), split(vf, 2)
+
+    def shard_block(layer_local, kf_l, vf_l):
+        return decode_block(x, layer_local, kf_l, vf_l, l, bt, pos, widx,
+                            cfg, page, tp_axis="tp")
+
+    x2_sh, kf2_sh, vf2_sh, _ = jax.vmap(
+        shard_block, axis_name="tp",
+        in_axes=({"attn_norm": None, "mlp_norm": None, "wq": 0, "wk": 0,
+                  "wv": 0, "wo": 0, "w_gate": 0, "w_up": 0, "w_down": 0},
+                 0, 0))(layer_sh, kf_sh, vf_sh)
+
+    # psum'd activations are replicated across shards and exact in f32
+    np.testing.assert_allclose(np.asarray(x2_sh[0]), np.asarray(full_x2),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(x2_sh[0]),
+                                  np.asarray(x2_sh[1]))
+    # each shard wrote its local KV heads: concat == the unsharded pool
+    np.testing.assert_array_equal(
+        np.concatenate(list(np.asarray(kf2_sh)), axis=2),
+        np.asarray(full_kf))
+    np.testing.assert_array_equal(
+        np.concatenate(list(np.asarray(vf2_sh)), axis=2),
+        np.asarray(full_vf))
+
+
+@requires_shard_map
+def test_paged_composed_pp_tp_parity(small_model):
+    """Round 15: the composed pp x tp mesh takes the kernel. The decode
+    loop runs as ONE flattened manual region over {"pp","tp"} — pp
+    manual on layers, tp manual on KV heads, Megatron psums after
+    wo/w_down, tiled logits all_gather before sampling — and must stay
+    greedy byte-identical to the single-device dense engine (the lifted
+    round-8 residue: `resolve_attention_impl` no longer falls back dense
+    on exactly the mesh shape a real v5p slice uses)."""
     from ray_tpu.parallel import MeshConfig, create_mesh
 
     cfg, params = small_model
     n = len(jax.devices())
     if n < 4:
         pytest.skip("needs 4 devices for a pp=2 x tp=2 mesh")
+    prompts = [[1, 5, 9], [2, 4, 6, 8, 10, 12, 14], list(range(1, 20)),
+               [7, 3, 7]]
+    expected = _run_engine(cfg, params, prompts, "dense", max_new_tokens=12)
+
     mesh = create_mesh(MeshConfig(pp=2, tp=2, dp=max(1, n // 4)))
-    with pytest.raises(ValueError, match="compose"):
-        InferenceEngine(cfg, params, max_slots=2, max_len=64, page_size=8,
-                        mesh=mesh, attention_impl="paged")
-    eng = InferenceEngine(cfg, params, max_slots=2, max_len=64, page_size=8,
-                          mesh=mesh, attention_impl="auto")
-    assert eng.attention_impl == "dense"
+    eng = InferenceEngine(cfg, params, max_slots=4, max_len=64, page_size=8,
+                          mesh=mesh, attention_impl="paged")
+    assert eng.attention_impl == "paged"
+    reqs = [Request(f"r{i}", list(p), max_new_tokens=12)
+            for i, p in enumerate(prompts)]
+    for r in reqs:
+        eng.add_request(r)
+    while any(not r.done for r in reqs):
+        eng.step()
+    assert [r.generated for r in reqs] == expected
