@@ -937,6 +937,32 @@ def main() -> None:
             ray_tpu.shutdown()
         except Exception:
             pass
+    extra_tenancy: dict = {}
+    try:
+        from ray_tpu._tenancy_bench import run_tenancy_bench
+
+        # Returns *_skipped markers itself when
+        # RAY_TPU_BENCH_SKIP_TENANCY=1, so skipped cells are always
+        # declared rather than silently vanishing.
+        extra_tenancy = run_tenancy_bench()
+    except Exception as e:
+        print(f"tenancy bench failed: {e}", file=sys.stderr)
+        extra_tenancy = {
+            "tenancy_bench_error": f"{type(e).__name__}: {e}",
+            "tenant_quiet_p95_ttft_ms_skipped": True,
+            "tenant_goodput_frac_skipped": True,
+            "tenant_mixed_batch_parity_skipped": True,
+            "tenant_mixed_dispatch_parity_skipped": True,
+            "adapter_hot_load_ms_skipped": True,
+        }
+        try:
+            import ray_tpu
+            from ray_tpu import serve
+
+            serve.shutdown()
+            ray_tpu.shutdown()
+        except Exception:
+            pass
     extra_speculative: dict = {}
     try:
         from ray_tpu._speculative_bench import run_speculative_bench
@@ -983,6 +1009,7 @@ def main() -> None:
         **extra_recovery,
         **extra_overload,
         **extra_train_loop,
+        **extra_tenancy,
         **extra_speculative,
         # Last: the migration bench's 2k-cell cold TTFT supersedes the
         # serve bench's ~1.6k-prompt cold cell under the same key, so

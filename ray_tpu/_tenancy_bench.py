@@ -1,0 +1,376 @@
+"""Multi-tenant LoRA multiplexing bench: SLO isolation + mixed decode.
+
+ISSUE 16 acceptance cells, runnable standalone (``python -m ray_tpu.cli
+bench tenancy``) or inside ``bench.py``:
+
+  * ``tenant_quiet_p95_ttft_ms_solo`` / ``_noisy`` — a quiet tenant's
+    client TTFT p95 alone vs while a noisy tenant storms the SAME
+    deployment at far beyond capacity. The noisy tenant carries a token
+    quota (the SLO-enforcement mechanism under test): its storm is
+    quota-shed to a bounded admitted stream, so the quiet p95 must move
+    ≤ 15%.
+  * ``tenant_goodput_frac_hot`` / ``_cold`` — per-tenant goodput under
+    a mixed 2× open-loop storm where the "hot" tenant's adapter is
+    HBM-resident and the "cold" tenant's adapter must hot-load through
+    the replica's adapter LRU mid-storm.
+  * ``tenant_mixed_batch_parity`` — 1.0 iff a decode batch mixing
+    DISTINCT adapters returns byte-identical greedy tokens to serving
+    the same requests sequentially.
+  * ``tenant_mixed_dispatch_parity`` — 1.0 iff the mixed-adapter batch
+    consumed exactly as many ``decode_dispatches`` as a same-shape
+    single-adapter batch (decode cost must not scale with the number of
+    distinct adapters: one dispatch carries the whole mix).
+  * ``adapter_hot_load_ms`` — mean filesystem-read + device-scatter
+    time to hot-load one adapter into the stack.
+
+CPU-sandbox honest: debug presets, byte tokenizer, quotas fixed in
+absolute tokens/s (no machine-speed calibration creep). Set
+``RAY_TPU_BENCH_SKIP_TENANCY=1`` to leave ``*_skipped`` markers that
+``bench_check`` honors.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+SKIP_MARKERS = {
+    "tenant_quiet_p95_ttft_ms_skipped": True,
+    "tenant_goodput_frac_skipped": True,
+    "tenant_mixed_batch_parity_skipped": True,
+    "tenant_mixed_dispatch_parity_skipped": True,
+    "adapter_hot_load_ms_skipped": True,
+}
+
+
+def _pct(sorted_vals: list[float], q: float) -> float:
+    return sorted_vals[max(0, int(len(sorted_vals) * q) - 1)]
+
+
+def _rand_adapter(cfg, rng, rank: int = 2, scale: float = 0.5) -> dict:
+    """Random rank-``rank`` adapter arrays for every attention proj."""
+    import numpy as np
+
+    L, E, H, KH, D = (cfg.n_layers, cfg.hidden, cfg.n_heads,
+                      cfg.n_kv_heads, cfg.head_dim)
+    dims = {"wq": (E, H * D), "wk": (E, KH * D), "wv": (E, KH * D),
+            "wo": (H * D, E)}
+    out = {}
+    for p, (ein, eout) in dims.items():
+        out[f"{p}.A"] = (rng.standard_normal((L, ein, rank))
+                         * scale / ein ** 0.5).astype(np.float32)
+        out[f"{p}.B"] = (rng.standard_normal((L, rank, eout))
+                         * scale).astype(np.float32)
+    return out
+
+
+def _engine_cells(out: dict) -> None:
+    """Mixed-adapter decode cells straight off the engine: greedy byte
+    parity vs sequential, dispatch-count parity vs a single-adapter
+    batch of the same shape, and the adapter hot-load time."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.llm.engine import InferenceEngine, Request
+    from ray_tpu.llm.lora import LoRAServingConfig, save_adapter
+    from ray_tpu.models.llama import PRESETS, init_params
+
+    cfg = dataclasses.replace(PRESETS["debug"], dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(16)
+    lora_dir = tempfile.mkdtemp(prefix="raytpu_tenancy_eng_")
+    adapters = ("t1", "t2", "t3")
+    for name in adapters:
+        save_adapter(os.path.join(lora_dir, f"{name}.npz"),
+                     _rand_adapter(cfg, rng))
+    lora = LoRAServingConfig(max_loras=4, max_rank=4,
+                             dynamic_lora_loading_path=lora_dir)
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8, 2, 8],
+               [1, 6, 1, 8, 0, 3, 3, 9, 8, 8], [5, 5, 5, 9, 7]]
+    # Parity batch: one base + three DISTINCT adapters decode together.
+    # The dispatch-count comparison uses all-adapter batches of the same
+    # shape (mixed vs uniform) so plan selection is identical and the
+    # ONLY variable is how many distinct adapters the batch carries.
+    parity_models = [None, "t1", "t2", "t3"]
+    mixed_models = ["t1", "t2", "t3", "t1"]
+    single_models = ["t1", "t1", "t1", "t1"]
+
+    def run(models, concurrent: bool):
+        eng = InferenceEngine(cfg, params, max_slots=4, max_len=64,
+                              lora_config=lora, enable_prefix_cache=False)
+        reqs = [Request(f"r{i}", p, max_new_tokens=8, model=m)
+                for i, (p, m) in enumerate(zip(prompts, models))]
+        d0 = eng.metrics["decode_dispatches"]
+        if concurrent:
+            for r in reqs:
+                eng.add_request(r)
+            while any(not r.done for r in reqs):
+                eng.step()
+        else:
+            for r in reqs:
+                eng.add_request(r)
+                while not r.done:
+                    eng.step()
+        loads = eng.lora_manager.stats() if eng.lora_manager else {}
+        return ([list(r.generated) for r in reqs],
+                eng.metrics["decode_dispatches"] - d0, loads)
+
+    parity_toks, _, load_stats = run(parity_models, concurrent=True)
+    seq_toks, _, _ = run(parity_models, concurrent=False)
+    _, mixed_d, _ = run(mixed_models, concurrent=True)
+    _, single_d, _ = run(single_models, concurrent=True)
+    out["tenant_mixed_batch_parity"] = (
+        1.0 if parity_toks == seq_toks else 0.0)
+    out["tenant_mixed_dispatch_parity"] = (
+        1.0 if mixed_d == single_d else 0.0)
+    out["tenant_mixed_decode_dispatches_cfg"] = mixed_d
+    out["tenant_single_decode_dispatches_cfg"] = single_d
+    if load_stats.get("avg_load_ms"):
+        out["adapter_hot_load_ms"] = round(load_stats["avg_load_ms"], 2)
+
+
+def _one_request(addr: str, route: str, prompt: str, max_tokens: int,
+                 model: str | None, tenant_header: str | None,
+                 client_timeout: float) -> dict:
+    """One streaming completion carrying the tenant routing key (JSON
+    ``model`` field or ``x-raytpu-model`` header); returns {"status",
+    "ttft_s", "wall_s", "text", "finish", "retry_after"}."""
+    body: dict = {"prompt": prompt, "max_tokens": max_tokens,
+                  "stream": True}
+    if model:
+        body["model"] = model
+    headers = {"Content-Type": "application/json"}
+    if tenant_header:
+        headers["x-raytpu-model"] = tenant_header
+    req = urllib.request.Request(addr + route + "/v1/completions",
+                                 data=json.dumps(body).encode(),
+                                 headers=headers)
+    t0 = time.perf_counter()
+    out = {"status": "200", "ttft_s": None, "wall_s": None, "text": "",
+           "finish": "", "retry_after": None}
+    try:
+        with urllib.request.urlopen(req, timeout=client_timeout) as resp:
+            for line in resp:
+                line = line.decode().strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                choice = json.loads(line[6:])["choices"][0]
+                if out["ttft_s"] is None and choice.get("text"):
+                    out["ttft_s"] = time.perf_counter() - t0
+                out["text"] += choice.get("text", "")
+                if choice.get("finish_reason"):
+                    out["finish"] = choice["finish_reason"]
+    except urllib.error.HTTPError as e:
+        out["status"] = str(e.code)
+        out["retry_after"] = e.headers.get("Retry-After")
+        try:
+            e.read()
+        except Exception:
+            pass
+    except Exception as e:
+        out["status"] = type(e).__name__
+    out["wall_s"] = time.perf_counter() - t0
+    return out
+
+
+def run_tenancy_bench(storm_s: float | None = None) -> dict:
+    if os.environ.get("RAY_TPU_BENCH_SKIP_TENANCY") == "1":
+        return dict(SKIP_MARKERS)
+    out: dict = {}
+    _engine_cells(out)
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm import build_llm_app
+    from ray_tpu.llm.lora import save_adapter
+    from ray_tpu.models.llama import PRESETS
+
+    import numpy as np
+
+    preset = os.environ.get("RAY_TPU_TENANCY_PRESET", "debug-128")
+    storm_s = storm_s or float(os.environ.get("RAY_TPU_TENANCY_STORM_S", "6"))
+    max_tokens = 8
+    max_slots = 4
+    quiet_n = 10
+
+    lora_dir = tempfile.mkdtemp(prefix="raytpu_tenancy_")
+    rng = np.random.default_rng(7)
+    for name in ("noisy", "hot", "cold"):
+        save_adapter(os.path.join(lora_dir, f"{name}.npz"),
+                     _rand_adapter(PRESETS[preset], rng))
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    # The noisy tenant's quota is the isolation mechanism: fixed in
+    # ABSOLUTE tokens/s (~half a request per second at 48 tokens each),
+    # far below any machine's capacity, so the storm is quota-shed to a
+    # trickle no matter how fast or slow the sandbox is.
+    tenancy_config = {
+        "max_loaded_adapters": 2,
+        "tenants": {
+            "quiet": {"weight": 2.0},
+            "noisy": {"weight": 1.0, "tokens_per_s": 24.0,
+                      "burst_tokens": 96.0},
+            "hot": {"weight": 1.0},
+            "cold": {"weight": 1.0},
+        },
+    }
+    serve.run(
+        build_llm_app(
+            preset, max_slots=max_slots, max_len=128, page_size=16,
+            prefill_chunk_size=64, num_replicas=1,
+            max_ongoing_requests=max_slots, max_queued_requests=8,
+            lora_config={"max_loras": 4, "max_rank": 4,
+                         "dynamic_lora_loading_path": lora_dir},
+            tenancy_config=tenancy_config),
+        name="tenancy", route_prefix="/mt", timeout_s=360.0)
+    addr = serve.http_address()
+    route = "/mt"
+    # The router queue bound lives in the PROXY process: tune it through
+    # the live-config seam (an in-process config write would be a no-op).
+    proxy = ray_tpu.get_actor("SERVE_PROXY")
+    saved_cfg = ray_tpu.get(proxy.apply_config.remote(
+        {"serve_max_queued_requests": 16}), timeout=30)
+    try:
+        def prompt_for(tag: str, i: int) -> str:
+            return f"req {tag}-{i}: " + "abcdefgh" * (4 + i % 3)
+
+        # Warm every prompt shape for the quiet/noisy/hot tenants (the
+        # p95 cells must measure queueing and adapter mixing, not
+        # first-touch XLA). "cold" is deliberately NOT warmed: its
+        # adapter must hot-load mid-storm through the LRU.
+        warm = []
+        for i in range(6):
+            warm.append(threading.Thread(
+                target=_one_request,
+                args=(addr, route, prompt_for("warm", i), max_tokens,
+                      None, "quiet", 180.0), daemon=True))
+        for i, model in enumerate(("noisy", "hot", "noisy", "hot")):
+            warm.append(threading.Thread(
+                target=_one_request,
+                args=(addr, route, prompt_for("warm", 6 + i), max_tokens,
+                      model, None, 180.0), daemon=True))
+        for t in warm:
+            t.start()
+        for t in warm:
+            t.join(timeout=240)
+
+        def quiet_loop(tag: str) -> list[dict]:
+            return [_one_request(addr, route, prompt_for(tag, i),
+                                 max_tokens, None, "quiet", 120.0)
+                    for i in range(quiet_n)]
+
+        # ---- solo: the quiet tenant alone on the deployment.
+        t0 = time.perf_counter()
+        solo = quiet_loop("solo")
+        solo_elapsed = time.perf_counter() - t0
+        solo_ttfts = sorted(r["ttft_s"] for r in solo
+                            if r["status"] == "200" and r["ttft_s"])
+        solo_walls = sorted(r["wall_s"] for r in solo
+                            if r["status"] == "200")
+        if not solo_ttfts:
+            raise RuntimeError("quiet tenant served 0 solo requests")
+        out["tenant_quiet_p95_ttft_ms_solo"] = round(
+            1000 * _pct(solo_ttfts, 0.95), 1)
+
+        # ---- noisy: closed-loop storm (8 clients, far beyond the
+        # 4-slot capacity) on the quota-limited tenant while the quiet
+        # tenant repeats the SAME closed loop.
+        stop = threading.Event()
+        noisy_results: list[dict] = []
+        nlock = threading.Lock()
+
+        def noisy_client(cid: int) -> None:
+            j = 0
+            while not stop.is_set():
+                r = _one_request(addr, route, prompt_for(f"n{cid}", j),
+                                 max_tokens, "noisy", None, 120.0)
+                j += 1
+                with nlock:
+                    noisy_results.append(r)
+                if r["status"] != "200":
+                    # honest Retry-After pacing keeps the storm open-loop
+                    # bounded instead of a tight 429 spin
+                    stop.wait(min(2.0, float(r["retry_after"] or 1)))
+
+        nthreads = [threading.Thread(target=noisy_client, args=(i,),
+                                     daemon=True) for i in range(8)]
+        for t in nthreads:
+            t.start()
+        time.sleep(0.5)  # let the storm reach the router queue first
+        noisy = quiet_loop("noisy")
+        stop.set()
+        for t in nthreads:
+            t.join(timeout=150)
+        noisy_ttfts = sorted(r["ttft_s"] for r in noisy
+                             if r["status"] == "200" and r["ttft_s"])
+        if noisy_ttfts:
+            out["tenant_quiet_p95_ttft_ms_noisy"] = round(
+                1000 * _pct(noisy_ttfts, 0.95), 1)
+        out["tenant_quiet_noisy_200s_cfg"] = len(noisy_ttfts)
+        out["tenant_noisy_quota_429_cfg"] = sum(
+            1 for r in noisy_results if r["status"] == "429")
+        out["tenant_noisy_admitted_cfg"] = sum(
+            1 for r in noisy_results if r["status"] == "200")
+
+        # ---- mixed 2× storm: hot (adapter resident) vs cold (adapter
+        # hot-loads through the LRU mid-storm), open-loop arrivals at
+        # ~2× the solo-derived capacity, alternating tenants.
+        solo_rps = len(solo_walls) / max(1e-3, solo_elapsed)
+        offered_rps = 2.0 * solo_rps * max_slots
+        n_offered = min(64, max(12, int(offered_rps * storm_s)))
+        budget_s = 4.0 * _pct(solo_walls, 0.5) + 2.0
+        results: list[dict | None] = [None] * n_offered
+        t0 = time.perf_counter()
+
+        def fire(i: int) -> None:
+            delay = t0 + i / offered_rps - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            model = "hot" if i % 2 == 0 else "cold"
+            results[i] = _one_request(addr, route,
+                                      prompt_for(f"s{model}", i),
+                                      max_tokens, model, None, 120.0)
+
+        threads = [threading.Thread(target=fire, args=(i,), daemon=True)
+                   for i in range(n_offered)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+
+        for tenant in ("hot", "cold"):
+            mine = [r for i, r in enumerate(results)
+                    if r is not None
+                    and ("hot" if i % 2 == 0 else "cold") == tenant]
+            good = sum(1 for r in mine if r["status"] == "200"
+                       and r["wall_s"] is not None
+                       and r["wall_s"] <= budget_s)
+            out[f"tenant_goodput_frac_{tenant}"] = round(
+                good / max(1, len(mine)), 4)
+        out["tenant_storm_offered_cfg"] = n_offered
+    finally:
+        try:
+            ray_tpu.get(proxy.apply_config.remote(saved_cfg), timeout=30)
+        except Exception:
+            pass
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(run_tenancy_bench()))

@@ -59,16 +59,27 @@ _HIGHER_BETTER_SUFFIX = ("_per_s", "_per_sec", "_mb_s", "_tok_s",
 # a 45-point collapse; 0.02 -> 0.01 is noise, not a 50% regression.
 # "_accept_rate": the speculative drafter's 0-1 accept fraction.
 # "_frac" covers train_ckpt_overlap_frac (round 15) alongside the
-# serve goodput/suffix fractions.
-_POINTWISE_RATE_SUFFIX = ("_hit_rate", "_accept_rate", "_frac")
+# serve goodput/suffix fractions. "_parity": greedy byte-parity cells
+# (spec_parity, serve_overload_parity, tenant_mixed_batch_parity) — a
+# 1.0-or-broken invariant, so pointwise; any slip below 1.0 is the
+# regression. Round-16 shadow audit: the new tenancy cells end in
+# "_ms" (tenant_quiet_p95_ttft_ms*, adapter_hot_load_ms — lower-better,
+# and "ttft" substring already matches the quiet-p95 pair), "_frac"
+# (tenant_goodput_frac_* — pointwise), and "_parity"; none end in a
+# bare "_s", so the pre-PR-11 "_mb_s" shadowing hazard doesn't apply.
+_POINTWISE_RATE_SUFFIX = ("_hit_rate", "_accept_rate", "_frac", "_parity")
 # MFU is a 0-1 fraction too, but its cell tag often FOLLOWS the unit
 # ("mfu", "mfu_8b_proxy", "train_mfu_eager", "train_mfu_loop",
 # "train_mfu_1b_seq8k"), so it is matched by substring, not suffix.
+# "goodput_frac": same tag-after-unit shape — the round-16 audit found
+# serve_goodput_frac_unprotected and tenant_goodput_frac_{hot,cold}
+# fell out of the "_frac" suffix into a relative compare, where a
+# CPU-sandbox 0.05 -> 0.04 wiggle reads as a 20% regression.
 # Round-15 audit note: none of the mfu cells end in "_s"/"_ms", so the
 # lower-better suffix table cannot shadow them (the pre-PR-11 "_mb_s"
 # hazard) — but a relative compare would still flag a 0.0002-point CPU
 # wiggle as a regression; points are the right scale.
-_POINTWISE_RATE_SUBSTR = ("mfu",)
+_POINTWISE_RATE_SUBSTR = ("mfu", "goodput_frac")
 # Lower is better. Peak-memory gauges count as regressions when they
 # GROW >threshold (a quiet 2x pool blowup is exactly what they exist
 # to catch). "_lag_steps": checkpoint lag (steps replayed after a

@@ -130,6 +130,25 @@ def _cmd_bench(args) -> int:
                   and result.get("spec_parity", 1.0) == 1.0) \
             or bool(result.get("decode_tok_s_speculative_skipped"))
         prefixes = ("decode_tok_s_", "spec_")
+    elif args.bench_cmd == "tenancy":
+        from ray_tpu._tenancy_bench import run_tenancy_bench
+
+        result = run_tenancy_bench(storm_s=args.storm)
+        # Acceptance (ISSUE 16): mixed-adapter decode is byte-exact AND
+        # one dispatch carries the whole adapter mix (dispatch count
+        # flat vs a single-adapter batch); the noisy tenant's storm
+        # moves the quiet tenant's p95 TTFT ≤ 15%; per-tenant goodput
+        # under the mixed hot/cold storm is recorded.
+        solo = result.get("tenant_quiet_p95_ttft_ms_solo")
+        noisy = result.get("tenant_quiet_p95_ttft_ms_noisy")
+        ok = bool(
+            result.get("tenant_mixed_batch_parity", 0.0) == 1.0
+            and result.get("tenant_mixed_dispatch_parity", 0.0) == 1.0
+            and solo and noisy is not None and noisy <= 1.15 * solo
+            and result.get("tenant_goodput_frac_hot") is not None
+            and result.get("tenant_goodput_frac_cold") is not None
+        ) or bool(result.get("tenant_mixed_batch_parity_skipped"))
+        prefixes = ("tenant_", "adapter_")
     elif args.bench_cmd == "core" and getattr(args, "scale", False):
         import os
 
@@ -349,6 +368,22 @@ def main(argv: list[str] | None = None) -> int:
     bspec.add_argument("--check-against", default=None, metavar="BENCH_JSON",
                        help="run ray_tpu.bench_check against a recorded "
                             "BENCH_r*.json and exit non-zero on regression")
+    bten = bench_sub.add_parser(
+        "tenancy", help="multi-tenant multiplexing cells: quiet-tenant "
+                        "TTFT p95 solo vs under a quota-shed noisy "
+                        "storm (must move ≤ 15%), per-tenant goodput "
+                        "with a hot (resident) vs cold (LRU hot-load) "
+                        "adapter under a mixed 2x storm, mixed-adapter "
+                        "greedy byte parity + one-dispatch decode "
+                        "(tenant_mixed_{batch,dispatch}_parity must be "
+                        "1.0), and adapter_hot_load_ms; *_skipped "
+                        "markers via RAY_TPU_BENCH_SKIP_TENANCY=1")
+    bten.add_argument("--storm", type=float, default=None,
+                      help="mixed hot/cold storm seconds (default "
+                           "$RAY_TPU_TENANCY_STORM_S or 6)")
+    bten.add_argument("--check-against", default=None, metavar="BENCH_JSON",
+                      help="run ray_tpu.bench_check against a recorded "
+                           "BENCH_r*.json and exit non-zero on regression")
     serve_p = sub.add_parser(
         "serve", help="Serve control-plane inspection")
     serve_sub = serve_p.add_subparsers(dest="serve_cmd", required=True)
@@ -537,6 +572,25 @@ def main(argv: list[str] | None = None) -> int:
                     parts.append(f"circuit[{rid}]={cst}")
                 if parts:
                     print("  overload: " + " ".join(parts))
+                ten = dict(st.get("tenancy") or {})
+                resident = ten.get("resident_adapters") or []
+                if resident or ten.get("adapter_defers"):
+                    line = "  adapters: resident=" + (",".join(resident) or "-")
+                    if ten.get("adapter_defers"):
+                        line += f" defers={ten['adapter_defers']}"
+                    print(line)
+                for tenant, row in sorted((ten.get("tenants") or {}).items()):
+                    tparts = [f"admitted={row.get('admitted', 0)}"]
+                    for k in ("shed", "quota_rejects"):
+                        if row.get(k):
+                            tparts.append(f"{k}={row[k]}")
+                    if row.get("quota_remaining") is not None:
+                        tparts.append(
+                            f"quota_remaining={row['quota_remaining']}")
+                    if row.get("p95_ttft_ms") is not None:
+                        tparts.append(
+                            f"p95_ttft_ms={round(float(row['p95_ttft_ms']), 1)}")
+                    print(f"  tenant[{tenant}]: " + " ".join(tparts))
                 for e in st.get("autoscale_events") or []:
                     ts = datetime.datetime.fromtimestamp(e["ts"]).strftime(
                         "%H:%M:%S")

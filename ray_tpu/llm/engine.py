@@ -519,6 +519,10 @@ class InferenceEngine:
                         "deadline_expired_running": 0,
                         "queue_rejects": 0,
                         "admission_rejects": 0,
+                        # Tenancy: admissions deferred because every
+                        # HBM-resident adapter was pinned by an in-flight
+                        # request (the request waits, it is not failed).
+                        "adapter_defers": 0,
                         # Speculative decoding: drafted tokens sent to
                         # verification, drafts the target accepted,
                         # tokens emitted by verify dispatches, verify
@@ -689,9 +693,13 @@ class InferenceEngine:
     @property
     def mixed_dispatch_enabled(self) -> bool:
         """True when steps fuse prefill chunks into the decode dispatch
-        (token budget > 0 and the executor has the fused entry point)."""
+        (token budget > 0 and the executor has the fused entry point).
+        A LoRA stack no longer disables this: the fused dispatch's
+        decode half carries the per-slot ``lora_idx``, so slots holding
+        DIFFERENT adapters decode together in one dispatch — only
+        adapter-bound prefills stay legacy (plan selection skips them,
+        and an all-adapter prefill queue falls back per step)."""
         return (self.prefill_token_budget > 0
-                and self.lora_manager is None
                 and getattr(self.executor, "supports_mixed_dispatch", False))
 
     @property
@@ -928,7 +936,24 @@ class InferenceEngine:
                         # after).
                         r.lora_slot = self.lora_manager.acquire(r.model)
                     except Exception as e:
+                        from .tenancy import AdapterCapacityError
+
                         self._release_admission_locked(r)
+                        if isinstance(e, AdapterCapacityError):
+                            # Every resident adapter is pinned by an
+                            # in-flight request: a QUEUEING condition,
+                            # not a client error — the request stays at
+                            # the head of the queue until a finishing
+                            # request unpins a slot. Back out the reuse
+                            # accounting taken above so the retry does
+                            # not double-count.
+                            self.metrics["prefix_hit_pages"] -= len(hits)
+                            self.metrics["prefix_cached_tokens"] -= \
+                                r.cached_prefix_tokens
+                            self.metrics["prompt_tokens"] -= len(r.prompt)
+                            self._waiting.appendleft(r)
+                            self.metrics["adapter_defers"] += 1
+                            break
                         r.done, r.finish_reason = True, "admission_failed"
                         logger.warning("adapter %r load failed: %s", r.model, e)
                         continue
@@ -954,6 +979,8 @@ class InferenceEngine:
             self.allocator.release(r.cow_page)
             r.cow_page = None
         r.shared_pages = 0
+        r.partial_len = 0
+        r.prefill_pos = 0
 
     def _record_prefix_match_span(self, r: Request) -> None:
         """One span per admission: how much of the prompt the prefix

@@ -547,11 +547,14 @@ class LocalEngineExecutor:
     @property
     def supports_mixed_dispatch(self) -> bool:
         """Mixed (prefill+decode fused) dispatch: available off the pp
-        path (the pp tick loop doesn't thread the fused program yet) and
-        without a LoRA stack (adapter prefill needs per-op slot plumbing
-        the fused program doesn't carry — the engine's starvation guard
-        bounds decode stalls there instead)."""
-        return self._pp == 1 and self.lora_stack is None
+        path (the pp tick loop doesn't thread the fused program yet).
+        With a LoRA stack the DECODE half of the fused program carries
+        per-slot adapter deltas (``_decode_kwargs`` threads lora/
+        lora_idx), so mixed-adapter decode batches still run in ONE
+        dispatch; only adapter-bound PREFILL stays on the legacy chunk
+        path (the fused prefill ops don't carry per-op slot plumbing —
+        the engine's plan selector excludes those prompts)."""
+        return self._pp == 1
 
     def mixed(self, prefill_plans: list, block_tables: np.ndarray,
               tokens: np.ndarray, pos: np.ndarray, temps: np.ndarray,

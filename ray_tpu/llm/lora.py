@@ -27,7 +27,7 @@ URIs both work — the reference's ``dynamic_lora_loading_path``.
 from __future__ import annotations
 
 import functools
-import threading
+import time
 from dataclasses import dataclass
 
 import jax
@@ -44,6 +44,11 @@ class LoRAServingConfig:
     max_loras: int = 4          # stack slots (excluding the identity slot)
     max_rank: int = 16
     dynamic_lora_loading_path: str | None = None  # base URI for adapters
+    # HBM residency cap: at most this many adapters occupy stack slots at
+    # once (0 = max_loras). The stack is allocated for max_loras either
+    # way; the cap bounds how many the LRU keeps WARM, so a fleet can
+    # trade hot-load latency for headroom per replica.
+    max_loaded_adapters: int = 0
 
 
 def init_lora_stack(config, max_loras: int, max_rank: int) -> dict:
@@ -114,19 +119,26 @@ class LoRAManager:
     the slot for an adapter id, loading it into a free/evicted slot on
     first use (reference ``LoraModelLoader.load_model``; disk->HBM here,
     no remote download cache needed — pyarrow.fs reads the URI directly).
+
+    Residency/pin/LRU bookkeeping lives in ``tenancy.AdapterPool``
+    (shared with the serve status plumbing); this class owns naming,
+    loading, and the device install. When every resident adapter is
+    pinned by in-flight requests, ``acquire`` raises
+    ``tenancy.AdapterCapacityError`` and the ENGINE defers admission
+    (head-of-line wait) instead of failing the request.
     """
 
     def __init__(self, config, serving: LoRAServingConfig, install_fn):
         """``install_fn(slot, arrays_dict)`` writes into the device stack
         (the executor owns the stack arrays; the manager owns naming)."""
+        from .tenancy import AdapterPool
+
         self._config = config
         self._serving = serving
         self._install = install_fn
-        self._lock = threading.Lock()
-        self._slots: dict[str, int] = {}          # adapter_id -> slot
-        self._order: list[str] = []               # LRU, oldest first
-        self._free = list(range(1, serving.max_loras + 1))
-        self._pinned: dict[int, int] = {}         # slot -> active request count
+        self._pool = AdapterPool(
+            capacity=serving.max_loras,
+            max_resident=getattr(serving, "max_loaded_adapters", 0))
 
     def resolve_path(self, adapter_id: str) -> str:
         base = self._serving.dynamic_lora_loading_path
@@ -138,60 +150,51 @@ class LoRAManager:
 
     def acquire(self, adapter_id: str | None) -> int:
         """Slot for this request's adapter (0 = base). Pins the slot for
-        the request's lifetime; pair with ``release``."""
+        the request's lifetime; pair with ``release``. A cold adapter
+        hot-loads (filesystem read + device scatter) and records an
+        ``llm.adapter_load`` span; ``AdapterCapacityError`` propagates
+        un-wrapped so admission can defer rather than fail."""
         if not adapter_id:
             return 0
-        with self._lock:
-            slot = self._slots.get(adapter_id)
-            if slot is not None:
-                self._order.remove(adapter_id)
-                self._order.append(adapter_id)
-                self._pinned[slot] = self._pinned.get(slot, 0) + 1
-                return slot
-            slot = self._evict_or_free_locked()
-            self._slots[adapter_id] = slot
-            self._order.append(adapter_id)
-            self._pinned[slot] = self._pinned.get(slot, 0) + 1
-        # Load outside the lock (filesystem read + device write).
+        slot = self._pool.lookup(adapter_id)
+        if slot is not None:
+            return slot
+        slot = self._pool.begin_load(adapter_id)   # may raise capacity
+        t0 = time.time()
         try:
             arrays = self._pad(load_adapter_arrays(self.resolve_path(adapter_id)))
             self._install(slot, arrays)
         except Exception:
-            with self._lock:
-                self._slots.pop(adapter_id, None)
-                if adapter_id in self._order:
-                    self._order.remove(adapter_id)
-                n = self._pinned.get(slot, 1) - 1
-                if n:
-                    self._pinned[slot] = n
-                else:
-                    self._pinned.pop(slot, None)
-                self._free.append(slot)
+            self._pool.abort_load(adapter_id)
             raise
+        load_ms = (time.time() - t0) * 1000.0
+        self._pool.commit_load(adapter_id, load_ms)
+        self._record_load_span(adapter_id, slot, t0, load_ms)
         return slot
+
+    def _record_load_span(self, adapter_id: str, slot: int, t0: float,
+                          load_ms: float) -> None:
+        from ..observability import tracing
+
+        wire = tracing.current_wire()
+        tracing.record_span(tracing.make_span(
+            "llm.adapter_load", "llm", t0, t0 + load_ms / 1000.0,
+            (wire or {}).get("trace_id", ""),
+            (wire or {}).get("span_id", ""),
+            attrs={"adapter": adapter_id, "slot": slot,
+                   "load_ms": round(load_ms, 3)}))
 
     def release(self, slot: int) -> None:
         if slot == 0:
             return
-        with self._lock:
-            n = self._pinned.get(slot, 0) - 1
-            if n > 0:
-                self._pinned[slot] = n
-            else:
-                self._pinned.pop(slot, None)
+        self._pool.unpin_slot(slot)
 
-    def _evict_or_free_locked(self) -> int:
-        if self._free:
-            return self._free.pop()
-        for aid in self._order:                    # oldest first
-            s = self._slots[aid]
-            if s not in self._pinned:
-                self._order.remove(aid)
-                del self._slots[aid]
-                return s                           # stack row overwritten
-        raise RuntimeError(
-            f"all {self._serving.max_loras} LoRA slots pinned by active "
-            "requests; raise lora_config.max_loras")
+    def resident(self) -> dict[str, int]:
+        """adapter_id -> stack slot, LRU order (``serve.status()`` rows)."""
+        return self._pool.resident()
+
+    def stats(self) -> dict:
+        return self._pool.stats()
 
     def _pad(self, arrays: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
         """Zero-pad rank to max_rank and validate shapes."""

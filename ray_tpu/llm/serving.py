@@ -39,7 +39,7 @@ def _llm_metrics() -> dict:
             _metrics["ttft"] = Histogram(
                 "serve_ttft_ms",
                 "Time from request arrival to first generated token",
-                tag_keys=("deployment",))
+                tag_keys=("deployment", "tenant"))
             _metrics["prefix_hit_rate"] = Gauge(
                 "serve_prefix_cache_hit_rate",
                 "Fraction of cacheable prompt pages served from the "
@@ -60,12 +60,15 @@ def _deployment_tag(fallback: str) -> str:
     return fallback
 
 
-def _observe_ttft(req: Request, deployment: str, engine=None) -> None:
+def _observe_ttft(req: Request, deployment: str, engine=None,
+                  tenant: str = "default", ledger=None) -> None:
     if req.first_token_at is None:
         return
+    ttft_ms = 1000.0 * (req.first_token_at - req.arrived_at)
     _llm_metrics()["ttft"].observe(
-        1000.0 * (req.first_token_at - req.arrived_at),
-        tags={"deployment": deployment})
+        ttft_ms, tags={"deployment": deployment, "tenant": tenant})
+    if ledger is not None:
+        ledger.note_ttft(tenant, ttft_ms)
     if engine is not None:
         _llm_metrics()["prefix_hit_rate"].set(
             engine.prefix_cache_hit_rate, tags={"deployment": deployment})
@@ -106,10 +109,19 @@ class LLMDeployment:
         max_queued_requests: int = 0,
         admission_watermark_pages: int | None = None,
         speculation_config=None,
+        tenancy_config: dict | None = None,
     ):
+        from .tenancy import TenancyConfig, TenantLedger
+
         mesh = None
         executor = None
         self._sharded = None
+        # Multi-tenant policy: per-tenant quotas/weights + the replica's
+        # HBM adapter residency cap. The same dict rides init_kwargs so
+        # the controller publishes the WEIGHTS to routers via long poll;
+        # this replica enforces the QUOTAS and reports per-tenant rows.
+        tcfg = TenancyConfig.from_dict(tenancy_config) or TenancyConfig()
+        self.tenancy = TenantLedger(tcfg)
         lora = None
         if lora_config is not None:
             # Reference: LLMConfig.lora_config + dynamic_lora_loading_path
@@ -119,7 +131,12 @@ class LLMDeployment:
             # stack and decode with it (multi-adapter batching).
             from .lora import LoRAServingConfig
 
-            lora = LoRAServingConfig(**lora_config)
+            lc = dict(lora_config)
+            # Tenancy's HBM residency cap applies to the adapter LRU
+            # unless the lora config pins its own.
+            if tcfg.max_loaded_adapters and "max_loaded_adapters" not in lc:
+                lc["max_loaded_adapters"] = tcfg.max_loaded_adapters
+            lora = LoRAServingConfig(**lc)
         if num_hosts > 1 or shard_resources is not None:
             # Replica-spans-hosts: one engine-shard actor per host placed
             # by a placement group, jax.distributed across them, the
@@ -253,6 +270,15 @@ class LLMDeployment:
             return None
         return model
 
+    def _tenant_for(self, model: str | None) -> str:
+        """Tenant key for one request: the ``model`` body field, else
+        the proxy-resolved multiplexed model id riding the replica
+        thread-local, else the shared default tenant."""
+        from ..serve.multiplex import get_multiplexed_model_id
+        from .tenancy import tenant_of
+
+        return tenant_of(model or get_multiplexed_model_id())
+
     def _note_residency(self, group: str, req: Request) -> None:
         """Record that this replica now holds (or refreshed) KV for the
         request's prefix group, and whether the request actually hit the
@@ -318,6 +344,11 @@ class LLMDeployment:
             deadline = self._effective_deadline()
         ids = self.tokenizer.encode(prompt)
         rid = self._next_rid()
+        tenant = self._tenant_for(model)
+        # Token quota, charged worst case (prompt + max_new) up front:
+        # QuotaExceeded propagates with its own http_status/retry_after,
+        # so the proxy answers an honest 429 + Retry-After.
+        self.tenancy.admit(tenant, len(ids) + max_new_tokens)
         req = Request(rid, ids, max_new_tokens, temperature,
                       eos_id=self.tokenizer.eos_id,
                       model=self._adapter_for(model),
@@ -346,7 +377,9 @@ class LLMDeployment:
             self._events.pop(rid, None)
         else:
             finish = req.finish_reason
-        _observe_ttft(req, _deployment_tag(self.model_id), self.engine)
+        _observe_ttft(req, _deployment_tag(self.model_id), self.engine,
+                      tenant=tenant, ledger=self.tenancy)
+        self.tenancy.note_tokens(tenant, len(req.generated))
         self._note_residency(self._group_of(prompt, session_id), req)
         return {
             "request_id": rid,
@@ -357,11 +390,14 @@ class LLMDeployment:
         }
 
     # ----------------------------------------------------- streaming path
-    def _admit_streaming(self, req: Request) -> queue.Queue:
+    def _admit_streaming(self, req: Request,
+                         tenant: str = "default") -> queue.Queue:
         """Register the token queue and admit ``req``. Split from
-        ``_stream_tokens`` so admission — and its QueueFullError shed —
-        happens BEFORE the SSE response head is yielded: the proxy can
-        then still answer a clean 503 + Retry-After status line."""
+        ``_stream_tokens`` so admission — and its QueueFullError /
+        QuotaExceeded shed — happens BEFORE the SSE response head is
+        yielded: the proxy can then still answer a clean 503/429 +
+        Retry-After status line."""
+        self.tenancy.admit(tenant, len(req.prompt) + req.max_new_tokens)
         q: queue.Queue = queue.Queue()
         self._token_queues[req.request_id] = q
         try:
@@ -372,12 +408,13 @@ class LLMDeployment:
         return q
 
     def _stream_tokens(self, req: Request, group: str = "",
-                       q: queue.Queue | None = None):
+                       q: queue.Queue | None = None,
+                       tenant: str = "default"):
         """Yield engine events for one request as they are produced; on
         GeneratorExit (consumer gone) cancel the request so its pages and
         slot free immediately."""
         if q is None:
-            q = self._admit_streaming(req)
+            q = self._admit_streaming(req, tenant)
         deadline = time.monotonic() + self.request_timeout_s
         first = True
         try:
@@ -392,13 +429,15 @@ class LLMDeployment:
                 if first:
                     first = False
                     _observe_ttft(req, _deployment_tag(self.model_id),
-                                  self.engine)
+                                  self.engine, tenant=tenant,
+                                  ledger=self.tenancy)
                     self._note_residency(group, req)
                 yield event
                 if event["done"]:
                     return
         finally:
             self._token_queues.pop(req.request_id, None)
+            self.tenancy.note_tokens(tenant, len(req.generated))
             if not req.done:
                 self.engine.cancel(req.request_id)
 
@@ -707,20 +746,22 @@ class LLMDeployment:
                       model=self._adapter_for(body.get("model")),
                       deadline=self._effective_deadline(body))
         group = self._group_of(prompt, body.get("session_id"))
+        tenant = self._tenant_for(body.get("model"))
 
         def gen():
             self._maybe_spill_migrate(prompt, body.get("model"))
-            # Admit BEFORE the response head: a bounded-queue shed (or an
-            # invalid prompt) surfaces on a clean error status instead of
-            # a truncated 200 stream.
-            q = self._admit_streaming(req)
+            # Admit BEFORE the response head: a bounded-queue shed, a
+            # quota-exhausted 429, or an invalid prompt surfaces on a
+            # clean error status instead of a truncated 200 stream.
+            q = self._admit_streaming(req, tenant)
             yield {"__serve_response__": True, "content_type": "text/event-stream"}
             if chat:
                 head = {"id": cid, "object": obj, "created": created, "model": model,
                         "choices": [{"index": 0, "delta": {"role": "assistant"},
                                      "finish_reason": None}]}
                 yield f"data: {json.dumps(head)}\n\n"
-            for event in self._stream_tokens(req, group, q=q):
+            for event in self._stream_tokens(req, group, q=q,
+                                             tenant=tenant):
                 # Terminal-only events (deadline expiry) carry token -1:
                 # no text, just the finish_reason.
                 text = (self.tokenizer.decode([event["token"]])
@@ -765,6 +806,20 @@ class LLMDeployment:
                 "deadline_expired_running": m["deadline_expired_running"],
                 "queue_rejects": m["queue_rejects"],
                 "admission_rejects": m["admission_rejects"]}
+
+    def tenancy_stats(self) -> dict:
+        """Per-tenant rows + adapter residency for this replica, picked
+        up by the replica actor's ``latency_snapshot`` probe
+        (``serve_tenancy`` row) and folded into ``serve.status()`` /
+        ``cli serve status`` per-tenant tables."""
+        out: dict = {"tenants": self.tenancy.snapshot(),
+                     "adapter_defers":
+                         self.engine.metrics.get("adapter_defers", 0)}
+        lm = self.engine.lora_manager
+        if lm is not None:
+            out["adapters"] = lm.stats()
+            out["resident_adapters"] = list(lm.resident())
+        return out
 
     def pool_stats(self) -> dict:
         """Engine page-pool accounting (chaos invariant surface)."""
@@ -827,7 +882,9 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
                   host_kv_cache_pages: int = 0,
                   max_queued_requests: int = 0,
                   admission_watermark_pages: int | None = None,
-                  speculation_config=None):
+                  speculation_config=None,
+                  lora_config: dict | None = None,
+                  tenancy_config: dict | None = None):
     """Build a Serve Application serving ``preset`` (serve.run-able).
     Pass ``ray_actor_options={"resources": {"TPU": 1}, ...}`` to pin each
     replica (engine) to a TPU chip. For an engine that SPANS hosts, set
@@ -862,7 +919,9 @@ def build_llm_app(preset: str = "debug-128", *, num_replicas: int = 1,
         host_kv_cache_pages=host_kv_cache_pages,
         max_queued_requests=max_queued_requests,
         admission_watermark_pages=admission_watermark_pages,
-        speculation_config=speculation_config)
+        speculation_config=speculation_config,
+        lora_config=lora_config,
+        tenancy_config=tenancy_config)
     if serve_disaggregation is None:
         dep = deployment(
             LLMDeployment,

@@ -94,6 +94,11 @@ class _DeploymentState:
         # Aggregated overload counters from the replicas' probe rows
         # (deadline expiries, engine-queue sheds, admission rejects).
         self.overload: dict = {}
+        # Aggregated per-tenant state from the replicas' ``serve_tenancy``
+        # probe rows (quota counters, windowed TTFT p95, resident
+        # adapters) — surfaced in status and fed to the latency-SLO
+        # autoscaler so one noisy tenant's breach triggers scaling.
+        self.tenancy: dict = {}
 
     @property
     def name(self) -> str:
@@ -158,6 +163,8 @@ class ServeController:
                 self._routes = {p: t for p, t in self._routes.items() if t[0] != app_name}
                 self._routes[route_prefix] = (app_name, ingress)
             self._push_routes()
+            for state in new_states.values():
+                self._push_tenancy(state)
             self._checkpoint()
         return True
 
@@ -196,6 +203,7 @@ class ServeController:
                     "preemption_evictions": list(state.preemption_evictions[-10:]),
                     "prefix_affinity": dict(state.prefix_affinity),
                     "overload": dict(state.overload),
+                    "tenancy": dict(state.tenancy),
                 }
             return out
 
@@ -361,6 +369,7 @@ class ServeController:
         with self._lock:
             self._fold_prefix_residency(state, probes)
             self._fold_overload(state, probes)
+            self._fold_tenancy(state, probes)
             self._autoscale_from_probes(state, probes)
             target = state.target_replicas
             for r in list(state.replicas):
@@ -520,6 +529,53 @@ class ServeController:
         if replicas:
             agg["replicas"] = replicas
             state.overload = agg
+
+    @staticmethod
+    def _fold_tenancy(state: _DeploymentState, probes: dict) -> None:
+        """Merge the replicas' ``serve_tenancy`` probe rows into one
+        per-tenant view: counters sum across replicas, the windowed TTFT
+        p95 takes the worst replica (one hot replica breaching the SLO
+        is a breach), and each replica's resident adapters are unioned.
+        Feeds ``serve.status()`` and the latency-SLO autoscaler."""
+        sum_keys = ("admitted", "shed", "quota_rejects",
+                    "tokens_in", "tokens_out")
+        tenants: dict[str, dict] = {}
+        resident: list[str] = []
+        adapter_defers = 0
+        replicas = 0
+        for p in probes.values():
+            for row in p.get("latency") or []:
+                if row.get("name") != "serve_tenancy":
+                    continue
+                replicas += 1
+                adapter_defers += int(row.get("adapter_defers", 0) or 0)
+                for aid in row.get("resident_adapters") or []:
+                    if aid not in resident:
+                        resident.append(aid)
+                for tenant, t_row in (row.get("tenants") or {}).items():
+                    agg = tenants.setdefault(
+                        tenant, {k: 0 for k in sum_keys})
+                    for k in sum_keys:
+                        agg[k] += int(t_row.get(k, 0) or 0)
+                    agg["weight"] = t_row.get("weight", agg.get("weight", 1.0))
+                    p95 = t_row.get("p95_ttft_ms")
+                    if p95 is not None:
+                        agg["p95_ttft_ms"] = max(
+                            float(p95), float(agg.get("p95_ttft_ms") or 0.0))
+                    remaining = t_row.get("quota_remaining")
+                    if remaining is not None:
+                        # quota buckets are per-replica: remaining budget
+                        # across the deployment is their sum
+                        agg["quota_remaining"] = round(
+                            float(agg.get("quota_remaining") or 0.0)
+                            + float(remaining), 1)
+        if replicas:
+            state.tenancy = {
+                "replicas": replicas,
+                "tenants": tenants,
+                "resident_adapters": resident,
+                "adapter_defers": adapter_defers,
+            }
 
     def _replica_alive(self, r: _Replica) -> bool:
         try:
@@ -713,29 +769,44 @@ class ServeController:
         p_qw = (self._windowed_quantile(state, "serve_queue_wait_ms", q,
                                         window, now)
                 if target_qw else None)
-        breach = (p_ttft is not None and p_ttft > target_ttft) or (
-            target_qw is not None and p_qw is not None and p_qw > float(target_qw))
+        # Worst-tenant windowed TTFT p95 from the folded ``serve_tenancy``
+        # rows: a single tenant breaching the SLO must scale the
+        # deployment even when the aggregate histogram is diluted by a
+        # healthy majority (the noisy-neighbor blind spot).
+        tenant_p95 = None
+        for t_row in (state.tenancy.get("tenants") or {}).values():
+            t95 = t_row.get("p95_ttft_ms")
+            if t95 is not None:
+                tenant_p95 = max(float(t95), tenant_p95 or 0.0)
+        ttft_breach = p_ttft is not None and p_ttft > target_ttft
+        qw_breach = (target_qw is not None and p_qw is not None
+                     and p_qw > float(target_qw))
+        tenant_breach = tenant_p95 is not None and tenant_p95 > target_ttft
+        breach = ttft_breach or qw_breach or tenant_breach
         headroom = float(auto.get("downscale_headroom") or 0.5)
         clear = (p_ttft is None or p_ttft < headroom * target_ttft) and (
-            target_qw is None or p_qw is None or p_qw < headroom * float(target_qw))
+            target_qw is None or p_qw is None or p_qw < headroom * float(target_qw)) and (
+            tenant_p95 is None or tenant_p95 < headroom * target_ttft)
         state.slo_breach_streak = state.slo_breach_streak + 1 if breach else 0
         state.slo_ok_streak = state.slo_ok_streak + 1 if clear else 0
         cycles = max(1, int(auto.get("breach_cycles") or 1))
         cur = state.target_replicas
-        trigger = ("serve_queue_wait_ms_p%d" % round(100 * q)
-                   if breach and target_qw is not None and p_qw is not None
-                   and p_qw > float(target_qw)
-                   else "serve_ttft_ms_p%d" % round(100 * q))
+        if qw_breach:
+            trigger = "serve_queue_wait_ms_p%d" % round(100 * q)
+            value, target = p_qw, float(target_qw)
+        elif tenant_breach and not ttft_breach:
+            trigger = "tenant_ttft_ms_p95"
+            value, target = tenant_p95, target_ttft
+        else:
+            trigger = "serve_ttft_ms_p%d" % round(100 * q)
+            value, target = p_ttft, target_ttft
         if (breach and cur < auto["max_replicas"]
                 and state.slo_breach_streak >= cycles
                 and now - state.last_scale_up >= auto["upscale_delay_s"]):
             state.target_replicas = cur + 1
             state.last_scale_up = now
             state.slo_breach_streak = 0
-            self._record_scale_event(
-                state, cur, cur + 1, trigger,
-                p_qw if "queue_wait" in trigger else p_ttft,
-                float(target_qw) if "queue_wait" in trigger else target_ttft)
+            self._record_scale_event(state, cur, cur + 1, trigger, value, target)
         elif (clear and cur > auto["min_replicas"]
                 and state.slo_ok_streak >= cycles
                 and now - state.last_scale_down >= auto["downscale_delay_s"]):
@@ -758,6 +829,23 @@ class ServeController:
             if r.state == RUNNING
         ]
         self._long_poll.notify_changed(f"replicas::{state.app_name}::{state.name}", table)
+
+    def _push_tenancy(self, state: _DeploymentState) -> None:
+        """Publish the deployment's tenant weights on the ``tenancy::``
+        long-poll key so every router's weighted-fair queue uses the
+        same shares the replicas' quota ledgers were configured with."""
+        tcfg = (state.config.get("init_kwargs") or {}).get("tenancy_config")
+        weights = {}
+        if tcfg:
+            try:
+                from ..llm.tenancy import TenancyConfig
+
+                cfg = TenancyConfig.from_dict(tcfg)
+                weights = cfg.weights() if cfg is not None else {}
+            except Exception:
+                logger.warning("bad tenancy_config for %s", state.name)
+        self._long_poll.notify_changed(
+            f"tenancy::{state.app_name}::{state.name}", {"weights": weights})
 
     def _push_routes(self) -> None:
         self._long_poll.notify_changed(

@@ -49,6 +49,24 @@ def _request_deadline_budget(request: Request) -> float:
     return max(0.0, get_config().serve_default_deadline_s)
 
 
+def _request_model_id(request: Request) -> str:
+    """Multiplexed model id, unified at the front door: the legacy
+    ``serve_multiplexed_model_id`` header, the tenancy spelling
+    ``x-raytpu-model``, and an OpenAI-style JSON body ``model`` field
+    all resolve to the SAME routing key — a client using any spelling
+    lands on the same resident replica (and the same tenant ledger)."""
+    from .multiplex import resolve_model_id
+
+    body = None
+    if request.body and request.headers.get(
+            "content-type", "").startswith("application/json"):
+        try:
+            body = json.loads(request.body)
+        except Exception:
+            body = None
+    return resolve_model_id(request.headers, body)
+
+
 def _request_prefix_group(request: Request) -> str:
     """Prefix-group key for affinity routing, extracted at the front
     door: an explicit ``x-raytpu-session`` header (multi-turn sessions)
@@ -145,6 +163,22 @@ class ProxyActor:
     def ready(self) -> bool:
         self._check_started()
         return True
+
+    def apply_config(self, overrides: dict) -> dict:
+        """Live-tune serve knobs (router queue bound, shed policy) in
+        THIS proxy process — the router reads config per request, so a
+        change takes effect on the next assignment without a proxy
+        restart. Returns the previous values so a caller can restore."""
+        from ..core.config import get_config
+
+        cfg = get_config()
+        prev = {}
+        for k, v in (overrides or {}).items():
+            if not hasattr(cfg, k):
+                raise AttributeError(f"unknown config entry {k!r}")
+            prev[k] = getattr(cfg, k)
+            setattr(cfg, k, v)
+        return prev
 
     def overload_stats(self) -> dict:
         """Per-deployment overload counters from this proxy's routers
@@ -251,9 +285,13 @@ class ProxyActor:
         handle = self._handles.get(key)
         if handle is None:
             handle = self._handles[key] = DeploymentHandle(*key)
-        # Multiplexing: the target model id rides a request header
-        # (reference serve_multiplexed_model_id) and biases routing.
-        model_id = request.headers.get("serve_multiplexed_model_id", "")
+        # Multiplexing/tenancy: the target model id rides a request
+        # header (reference serve_multiplexed_model_id, or the tenancy
+        # spelling x-raytpu-model, or the JSON body's model field — one
+        # routing key) and biases routing toward replicas with the
+        # adapter resident; it also names the request's TENANT for
+        # quotas / weighted fair queueing.
+        model_id = _request_model_id(request)
         if model_id:
             handle = handle.options(multiplexed_model_id=model_id)
         # Prefix/session affinity: requests sharing a session id or a
@@ -285,7 +323,8 @@ class ProxyActor:
                 "llm.shed", "serve", t0, time.time(),
                 ctx.trace_id, ctx.parent_id, attrs={
                     "reason": reason, "app": route["app"],
-                    "deployment": route["deployment"]}))
+                    "deployment": route["deployment"],
+                    "tenant": model_id or "default"}))
 
         def _retry_after_hint() -> int:
             try:

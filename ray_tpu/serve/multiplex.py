@@ -19,7 +19,25 @@ from typing import Any, Callable
 _request_context = threading.local()
 
 MULTIPLEXED_MODEL_ID_HEADER = "serve_multiplexed_model_id"
+# Tenancy spelling of the same routing key (multi-tenant LoRA
+# multiplexing): both headers — and an OpenAI-style JSON body ``model``
+# field — resolve to ONE model id at the proxy, so a client using either
+# lands on the same resident replica.
+X_RAYTPU_MODEL_HEADER = "x-raytpu-model"
 MULTIPLEXED_KWARG = "_serve_multiplexed_model_id"
+
+
+def resolve_model_id(headers: dict, body: "dict | None" = None) -> str:
+    """Unify the multiplex header spellings into one routing key:
+    ``serve_multiplexed_model_id`` wins (backward compat), then
+    ``x-raytpu-model``, then the request body's ``model`` field. Header
+    lookup is case-insensitive (HTTP semantics)."""
+    lowered = {str(k).lower(): v for k, v in (headers or {}).items()}
+    mid = lowered.get(MULTIPLEXED_MODEL_ID_HEADER) \
+        or lowered.get(X_RAYTPU_MODEL_HEADER)
+    if not mid and isinstance(body, dict):
+        mid = body.get("model")
+    return str(mid) if mid else ""
 
 
 def set_multiplexed_model_id(model_id: str) -> None:

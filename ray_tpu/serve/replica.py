@@ -144,6 +144,16 @@ class ReplicaActor:
                              **(overload() or {})})
             except Exception:
                 pass
+        # Tenancy rows (per-tenant quotas/TTFT + resident adapters) ride
+        # the same probe so serve.status() shows per-tenant state without
+        # waiting on the metrics flush.
+        tenancy = getattr(self._callable, "tenancy_stats", None)
+        if tenancy is not None:
+            try:
+                rows.append({"name": "serve_tenancy",
+                             **(tenancy() or {})})
+            except Exception:
+                pass
         return rows
 
     def reconfigure(self, user_config: Any) -> bool:

@@ -154,7 +154,7 @@ def _serve_metrics():
                 "Requests shed by overload protection (bounded router "
                 "queue, circuit breaker, replica exhaustion) — fast "
                 "honest 503s instead of queue collapse",
-                tag_keys=("deployment", "reason"))
+                tag_keys=("deployment", "reason", "tenant"))
             _metrics["deadline_expired"] = Counter(
                 "serve_deadline_expired",
                 "Requests whose end-to-end deadline expired, by where "
@@ -251,13 +251,20 @@ class Router:
         self.spill_migrations = 0
         self._init_overload_state()
         controller = ray.get_actor(CONTROLLER_NAME)
-        self._long_poll = LongPollClient(controller, {self._key: self._update_replicas})
+        self._tenancy_key = f"tenancy::{app_name}::{deployment_name}"
+        self._long_poll = LongPollClient(
+            controller, {self._key: self._update_replicas,
+                         self._tenancy_key: self._update_tenancy})
         # prime with the current table so the first request needn't wait a
         # full poll round-trip
         try:
             snap = ray.get(controller.get_snapshot.remote(self._key), timeout=30)
             if snap is not None:
                 self._update_replicas(snap)
+            tsnap = ray.get(
+                controller.get_snapshot.remote(self._tenancy_key), timeout=30)
+            if tsnap is not None:
+                self._update_tenancy(tsnap)
         except Exception:
             pass
 
@@ -268,20 +275,44 @@ class Router:
         503 Retry-After derives from."""
         from collections import deque as _deque
 
+        from ..llm.tenancy import WeightedFairQueue
+
         # Requests currently blocked waiting for a replica slot:
-        # [{"cheap": bool, "shed": bool}] in arrival order. Over the
-        # serve_max_queued_requests bound, new arrivals are shed — unless
-        # cost-aware shedding lets a cheap (KV-cached) request preempt the
-        # queue slot of an expensive (cold-suffix) waiter.
+        # [{"cheap": bool, "shed": bool, "tenant": str, "ticket": int}]
+        # in arrival order. Over the serve_max_queued_requests bound, new
+        # arrivals are shed — unless cost-aware shedding lets a cheap
+        # (KV-cached) request preempt the queue slot of an expensive
+        # (cold-suffix) waiter, or tenant-aware shedding lets a tenant
+        # UNDER its weighted fair share of queue slots preempt the newest
+        # waiter of the most over-share tenant.
         self._waiters: list[dict] = []
+        # Weighted fair queueing among waiters (tenancy): under
+        # saturation only the waiter holding the minimum virtual finish
+        # time proceeds, so admitted throughput follows tenant weights.
+        # Weights arrive via the tenancy:: long-poll key (empty = every
+        # tenant weight 1.0 — FIFO-equivalent, the pre-tenancy behavior).
+        self._wfq = WeightedFairQueue()
+        self._tenant_weights: dict[str, float] = {}
         # replica_id -> {"state": "closed"|"open"|"half_open",
         #                "failures": consecutive timeouts, "opened_at"}
         self._circuit: dict[str, dict] = {}
         # monotonic stamps of recent request completions (release()):
         # the observed service rate behind Retry-After.
         self._completions: "_deque[float]" = _deque()
-        self.overload_stats = {"shed": {}, "deadline_expired_queued": 0,
+        self.overload_stats = {"shed": {}, "shed_by_tenant": {},
+                               "deadline_expired_queued": 0,
                                "circuit_opens": 0}
+
+    def _update_tenancy(self, value: Any) -> None:
+        """Long-poll push of the deployment's tenancy policy (published
+        by the controller from the deployment's ``tenancy_config``):
+        installs per-tenant WFQ weights."""
+        weights = (value or {}).get("weights") if isinstance(value, dict) \
+            else None
+        with self._cond:
+            self._tenant_weights = dict(weights or {})
+            self._wfq.set_weights(self._tenant_weights)
+            self._cond.notify_all()
 
     def _update_replicas(self, table: Any) -> None:
         from ..core.api import ActorHandle
@@ -487,12 +518,16 @@ class Router:
         with self._cond:
             return self._retry_after_locked()
 
-    def _note_shed_locked(self, deployment: str, reason: str) -> None:
+    def _note_shed_locked(self, deployment: str, reason: str,
+                          tenant: str = "default") -> None:
         shed = self.overload_stats["shed"]
         shed[reason] = shed.get(reason, 0) + 1
+        by_tenant = self.overload_stats["shed_by_tenant"]
+        by_tenant[tenant] = by_tenant.get(tenant, 0) + 1
         try:
             _serve_metrics()["shed"].inc(
-                tags={"deployment": deployment, "reason": reason})
+                tags={"deployment": deployment, "reason": reason,
+                      "tenant": tenant})
         except Exception:
             pass
 
@@ -502,6 +537,8 @@ class Router:
         with self._cond:
             return {
                 "shed": dict(self.overload_stats["shed"]),
+                "shed_by_tenant":
+                    dict(self.overload_stats["shed_by_tenant"]),
                 "deadline_expired_queued":
                     self.overload_stats["deadline_expired_queued"],
                 "circuit_opens": self.overload_stats["circuit_opens"],
@@ -538,19 +575,36 @@ class Router:
         import time
 
         from ..core.config import get_config
+        from ..llm.tenancy import tenant_of
 
         cfg = get_config()
         if timeout is None:
             timeout = cfg.serve_router_assign_timeout_s
         wait_deadline = time.monotonic() + timeout
         deployment = self._key.rsplit("::", 1)[-1]
+        tenant = tenant_of(model_id)
         entry: dict | None = None
         with self._cond:
             try:
                 while True:
                     candidates, circuit_blocked = \
                         self._candidates_locked(cfg)
-                    if candidates:
+                    queued = any(not e.get("shed") for e in self._waiters
+                                 if e is not entry)
+                    if candidates and (self._wfq_head_locked(entry)
+                                       if entry is not None
+                                       else not queued):
+                        # Weighted fair queueing: a QUEUED request
+                        # proceeds only while it holds the minimum
+                        # virtual finish time among waiters, so under
+                        # saturation admitted throughput follows tenant
+                        # weights instead of arrival order — and a fresh
+                        # arrival never barges past the wait queue (it
+                        # joins it below instead).
+                        if entry is not None:
+                            self._wfq.complete(entry["ticket"])
+                            entry["ticket"] = None
+                            self._cond.notify_all()
                         return self._pick_locked(
                             candidates, cfg, deployment, model_id,
                             prefix_group, spill_out)
@@ -569,7 +623,8 @@ class Router:
                             circuit_blocked >= len(self._replicas):
                         # Every replica's circuit is open (and still
                         # cooling): fail fast, never queue for a corpse.
-                        self._note_shed_locked(deployment, "circuit_open")
+                        self._note_shed_locked(deployment, "circuit_open",
+                                               tenant)
                         raise RequestShed(
                             f"all {len(self._replicas)} replicas of "
                             f"{self._key} are circuit-open",
@@ -577,9 +632,10 @@ class Router:
                             retry_after=self._retry_after_locked())
                     if entry is None:
                         entry = self._enqueue_waiter_locked(
-                            cfg, deployment, prefix_group)
+                            cfg, deployment, prefix_group, tenant)
                     elif entry.get("shed"):
-                        self._note_shed_locked(deployment, "preempted")
+                        self._note_shed_locked(deployment, "preempted",
+                                               tenant)
                         raise RequestShed(
                             "queue slot preempted by a cached (cheap) "
                             "request under overload",
@@ -596,18 +652,30 @@ class Router:
                     self._cond.wait(min(remaining, 1.0))
             finally:
                 if entry is not None:
+                    if entry.get("ticket") is not None:
+                        # Shed/timed out before service: drop the WFQ
+                        # stamp without advancing the virtual clock.
+                        self._wfq.cancel(entry["ticket"])
                     try:
                         self._waiters.remove(entry)
                     except ValueError:
                         pass
 
+    def _wfq_head_locked(self, entry: dict) -> bool:
+        ticket = entry.get("ticket")
+        return ticket is None or self._wfq.is_head(ticket)
+
     def _enqueue_waiter_locked(self, cfg, deployment: str,
-                               prefix_group: str) -> dict:
+                               prefix_group: str,
+                               tenant: str = "default") -> dict:
         """Join the router wait queue, enforcing the bound. A cheap
         request (prefix group resident on a live replica → small cold
         suffix) over the bound preempts the oldest expensive waiter's
-        slot under the "cost" policy; otherwise the incoming request is
-        shed."""
+        slot under the "cost" policy; failing that, a tenant still UNDER
+        its weighted fair share of queue slots preempts the newest
+        waiter of the most over-share tenant (tenant-aware shedding: a
+        noisy tenant's flood sheds its own waiters, not the quiet
+        tenant's). Otherwise the incoming request is shed."""
         bound = cfg.serve_max_queued_requests
         cheap = bool(prefix_group
                      and self._group_affinity.get(prefix_group)
@@ -618,17 +686,58 @@ class Router:
             if cfg.serve_shed_policy == "cost" and cheap:
                 victim = next((e for e in live if not e["cheap"]), None)
             if victim is None:
-                self._note_shed_locked(deployment, "queue_full")
+                victim = self._fair_share_victim_locked(live, tenant, bound)
+            if victim is None:
+                self._note_shed_locked(deployment, "queue_full", tenant)
                 raise RequestShed(
                     f"router queue for {self._key} is full "
                     f"({len(live)} waiting, bound {bound})",
                     reason="queue_full",
                     retry_after=self._retry_after_locked())
             victim["shed"] = True
+            if victim.get("ticket") is not None:
+                # Unblock the WFQ head check immediately — the victim's
+                # own thread only wakes to raise its shed.
+                self._wfq.cancel(victim["ticket"])
+                victim["ticket"] = None
             self._cond.notify_all()
-        entry = {"cheap": cheap, "shed": False}
+        entry = {"cheap": cheap, "shed": False, "tenant": tenant,
+                 "ticket": self._wfq.enqueue(tenant)}
         self._waiters.append(entry)
         return entry
+
+    def _fair_share_victim_locked(self, live: list[dict], tenant: str,
+                                  bound: int) -> dict | None:
+        """Tenant-aware preemption under a full queue: if the incoming
+        tenant holds FEWER queue slots than its weight-proportional fair
+        share, the newest waiter of the tenant most OVER its share (never
+        the incoming tenant) gives up its slot. With one tenant — or no
+        configured weights and balanced queues — this never fires, so
+        single-tenant shedding behaves exactly as before."""
+        counts: dict[str, int] = {}
+        for e in live:
+            counts[e.get("tenant", "default")] = \
+                counts.get(e.get("tenant", "default"), 0) + 1
+        tenants = set(counts) | {tenant}
+        total_w = sum(max(1e-6, self._tenant_weights.get(t, 1.0))
+                      for t in tenants)
+        share = {t: bound * max(1e-6, self._tenant_weights.get(t, 1.0))
+                 / total_w for t in tenants}
+        if counts.get(tenant, 0) >= share[tenant]:
+            return None
+        worst, worst_over = None, 0.0
+        for t, n in counts.items():
+            if t == tenant:
+                continue
+            over = n - share[t]
+            if over > worst_over:
+                worst, worst_over = t, over
+        if worst is None:
+            return None
+        for e in reversed(live):                     # newest first
+            if e.get("tenant", "default") == worst:
+                return e
+        return None
 
     def _pick_locked(self, candidates: list[str], cfg, deployment: str,
                      model_id: str, prefix_group: str,

@@ -493,3 +493,67 @@ def test_train_loop_skip_markers_honored():
     result = bench_check.compare(old, new)
     assert not result["missing"] and not result["regressions"]
     assert {r["metric"] for r in result["skipped"]} == set(old)
+
+
+def test_tenancy_metrics_directions():
+    """Round-16 cells: the quiet-tenant p95 pair and the adapter hot-load
+    are latencies ("_ms", plus the "ttft" substring on the p95 pair),
+    goodput fractions are pointwise 0-1, and both parity cells ride the
+    "_parity" suffix (1.0-or-broken invariants). Shadow audit: no
+    tenancy cell ends in a bare "_s", so the lower-better "_s" bucket
+    (the pre-PR-11 _mb_s trap) cannot shadow any of them."""
+    assert bench_check._direction("tenant_quiet_p95_ttft_ms_solo") == "down"
+    assert bench_check._direction("tenant_quiet_p95_ttft_ms_noisy") == "down"
+    assert bench_check._direction("adapter_hot_load_ms") == "down"
+    assert bench_check._direction("tenant_goodput_frac_hot") == "up"
+    assert bench_check._direction("tenant_goodput_frac_cold") == "up"
+    assert bench_check._direction("tenant_mixed_batch_parity") == "up"
+    assert bench_check._direction("tenant_mixed_dispatch_parity") == "up"
+    # a quiet-p95 GROWTH under the noisy storm is the regression the
+    # isolation cells exist to catch
+    old = {"tenant_quiet_p95_ttft_ms_noisy": 80.0,
+           "tenant_goodput_frac_hot": 0.9}
+    new = {"tenant_quiet_p95_ttft_ms_noisy": 160.0,
+           "tenant_goodput_frac_hot": 0.92}
+    result = bench_check.compare(old, new)
+    assert {r["metric"] for r in result["regressions"]} == {
+        "tenant_quiet_p95_ttft_ms_noisy"}
+
+
+def test_tenancy_parity_and_goodput_compare_in_points():
+    """A parity cell slipping 1.0 -> 0.0 (mixed batch no longer byte-
+    identical) is a 100-point regression; a goodput 0.05 -> 0.04 wiggle
+    is noise, not a 20% drop. Dispatch counts and storm sizes are _cfg
+    bookkeeping, never tracked."""
+    result = bench_check.compare({"tenant_mixed_batch_parity": 1.0},
+                                 {"tenant_mixed_batch_parity": 0.0})
+    assert [r["metric"] for r in result["regressions"]] == [
+        "tenant_mixed_batch_parity"]
+    result2 = bench_check.compare({"tenant_goodput_frac_cold": 0.05},
+                                  {"tenant_goodput_frac_cold": 0.04})
+    assert not result2["regressions"]
+    result3 = bench_check.compare(
+        {"tenant_mixed_decode_dispatches_cfg": 8,
+         "tenant_storm_offered_cfg": 64,
+         "tenant_noisy_quota_429_cfg": 12},
+        {"tenant_mixed_decode_dispatches_cfg": 24,
+         "tenant_storm_offered_cfg": 16,
+         "tenant_noisy_quota_429_cfg": 0})
+    assert not result3["regressions"] and not result3["missing"]
+
+
+def test_tenancy_skip_markers_honored():
+    """RAY_TPU_BENCH_SKIP_TENANCY=1 leaves the module's SKIP_MARKERS:
+    every tenancy cell lands in skipped, never missing."""
+    from ray_tpu._tenancy_bench import SKIP_MARKERS
+
+    old = {"tenant_quiet_p95_ttft_ms_solo": 60.0,
+           "tenant_quiet_p95_ttft_ms_noisy": 66.0,
+           "tenant_goodput_frac_hot": 0.9,
+           "tenant_goodput_frac_cold": 0.7,
+           "tenant_mixed_batch_parity": 1.0,
+           "tenant_mixed_dispatch_parity": 1.0,
+           "adapter_hot_load_ms": 50.0}
+    result = bench_check.compare(old, dict(SKIP_MARKERS))
+    assert not result["missing"] and not result["regressions"]
+    assert {r["metric"] for r in result["skipped"]} == set(old)
