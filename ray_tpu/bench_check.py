@@ -80,6 +80,16 @@ _POINTWISE_RATE_SUFFIX = ("_hit_rate", "_accept_rate", "_frac", "_parity")
 # hazard) — but a relative compare would still flag a 0.0002-point CPU
 # wiggle as a regression; points are the right scale.
 _POINTWISE_RATE_SUBSTR = ("mfu", "goodput_frac")
+# Pointwise cells that regress UP: still compared in points on the 0-1
+# scale, but LOWER is better. Round-18 audit: before this table,
+# ``loop_obs_overhead_frac`` (stall-recorder cost as a fraction of tick
+# dispatch) fell into the pointwise branch and was guarded BACKWARDS —
+# the "_frac" suffix check ran before the "overhead" substring, so a
+# recorder cost blowup 0.01 -> 0.15 read as a 14-point improvement.
+# "stall_wait": the dag loop's wait_up/wait_down stall split — a stage
+# spending more of its tick blocked is the regression (the compute_frac
+# cell stays higher-better pointwise via the plain "_frac" suffix).
+_POINTWISE_DOWN_SUBSTR = ("overhead", "stall_wait")
 # Lower is better. Peak-memory gauges count as regressions when they
 # GROW >threshold (a quiet 2x pool blowup is exactly what they exist
 # to catch). "_lag_steps": checkpoint lag (steps replayed after a
@@ -108,7 +118,12 @@ def _pointwise(name: str) -> bool:
 
 def _direction(name: str) -> str:
     """'up' = larger is better, 'down' = smaller is better."""
-    if name.endswith(_HIGHER_BETTER_SUFFIX) or _pointwise(name):
+    if _pointwise(name):
+        # Pointwise cells carry their own direction: fractions are
+        # higher-better unless the name marks them as a cost/stall.
+        return "down" if any(s in name for s in _POINTWISE_DOWN_SUBSTR) \
+            else "up"
+    if name.endswith(_HIGHER_BETTER_SUFFIX):
         return "up"
     if name.endswith(_LOWER_BETTER_SUFFIX) or any(
             s in name for s in _LOWER_BETTER_SUBSTR):
@@ -153,9 +168,11 @@ def compare(old: dict, new: dict, threshold: float = 0.10) -> dict:
             out["missing"].append({"metric": name, "old": ov, "new": None})
             continue
         if _pointwise(name):
-            # 0-1 rates compare in POINTS, higher-better: the threshold
-            # is a point budget on the 0-1 scale (0.10 = 10 points).
-            better = round(nv - ov, 4)
+            # 0-1 rates compare in POINTS: the threshold is a point
+            # budget on the 0-1 scale (0.10 = 10 points). Direction
+            # comes from the name — overhead/stall fracs regress UP.
+            delta = round(nv - ov, 4)
+            better = delta if _direction(name) == "up" else -delta
             row = {"metric": name, "old": ov, "new": nv, "change": better}
             if better < -threshold:
                 out["regressions"].append(row)

@@ -72,7 +72,7 @@ def _cmd_bench(args) -> int:
 
         result = run_dag_bench(ticks=args.ticks, bursts=args.bursts)
         ok = bool(result.get("dag_tick_dispatch_overhead_us"))
-        prefixes = ("dag_", "pp_decode_")
+        prefixes = ("dag_", "pp_decode_", "loop_obs_")
     elif args.bench_cmd == "recovery":
         from ray_tpu._recovery_bench import run_recovery_bench
 
@@ -209,6 +209,22 @@ def main(argv: list[str] | None = None) -> int:
     tr.add_argument("trace_id", nargs="?",
                     help="trace id (omit to list recent traces)")
     tr.add_argument("--limit", type=int, default=20)
+    tr.add_argument("--request", default=None, metavar="REQUEST_ID",
+                    help="show the flight-recorder timeline dumped for "
+                         "one LLM request on SLO breach (deadline "
+                         "expiry, shed, TTFT-SLO breach)")
+    loop_p = sub.add_parser(
+        "loop", help="compiled-loop stall attribution")
+    loop_sub = loop_p.add_subparsers(dest="loop_cmd", required=True)
+    ltop = loop_sub.add_parser(
+        "top", help="live per-stage wait_up/compute/wait_down splits and "
+                    "the bottleneck stage for every compiled loop this "
+                    "process owns (loops are driver-local; run in the "
+                    "driver, or point a dashboard at /api/loops)")
+    ltop.add_argument("--once", action="store_true",
+                      help="print one snapshot and exit (no live refresh)")
+    ltop.add_argument("--interval", type=float, default=2.0,
+                      help="refresh period in seconds (default 2)")
     sub.add_parser("metrics", help="aggregated metrics (Prometheus text format)")
     sub.add_parser("status", help="cluster resource overview")
     doctor_p = sub.add_parser(
@@ -447,7 +463,30 @@ def main(argv: list[str] | None = None) -> int:
     elif args.cmd == "trace":
         from ray_tpu.observability import format_trace_tree
 
-        if args.trace_id:
+        if args.request:
+            span = st.find_request_timeline(args.request)
+            if span is None:
+                print(f"no llm.request_timeline dump for request "
+                      f"{args.request!r} (dumps fire on SLO breach: "
+                      f"deadline expiry, shed, or TTFT-SLO breach)")
+                return 1
+            if args.as_json:
+                print(json.dumps(span, indent=2, default=str))
+            else:
+                attrs = span.get("attrs") or {}
+                print(f"request {args.request}  reason={attrs.get('reason')}"
+                      f"  events={attrs.get('n_events')}"
+                      f"  dropped={attrs.get('dropped')}")
+                t0 = None
+                for ev in attrs.get("events") or []:
+                    t = float(ev.get("t", 0.0))
+                    if t0 is None:
+                        t0 = t
+                    pin = " (pinned)" if ev.get("pinned") else ""
+                    print(f"  +{1000 * (t - t0):9.3f} ms  "
+                          f"{str(ev.get('ev', '?')):16s} "
+                          f"value={ev.get('v', 0)}{pin}")
+        elif args.trace_id:
             spans = st.list_spans(trace_id=args.trace_id)
             if args.as_json:
                 print(json.dumps(spans, indent=2, default=str))
@@ -459,6 +498,47 @@ def main(argv: list[str] | None = None) -> int:
                 print(json.dumps(rows, indent=2, default=str))
             else:
                 _print_table(rows, ["trace_id", "root", "spans", "duration_ms"])
+    elif args.cmd == "loop":
+        import time as _time
+
+        def _loop_rows():
+            rows = []
+            for loop in st.loop_stats():
+                for name, s in (loop.get("stages") or {}).items():
+                    frac = s.get("frac") or {}
+                    rows.append({
+                        "loop": loop.get("loop_id", "")[:12],
+                        "stage": name,
+                        "ticks": s.get("ticks", 0),
+                        "wait_up": f"{frac.get('wait_up', 0.0):.0%}",
+                        "compute": f"{frac.get('compute', 0.0):.0%}",
+                        "wait_down": f"{frac.get('wait_down', 0.0):.0%}",
+                        "state": s.get("state", ""),
+                        "bottleneck": ("<-- bottleneck"
+                                       if loop.get("bottleneck") == name
+                                       else ""),
+                    })
+            return rows
+
+        cols = ["loop", "stage", "ticks", "wait_up", "compute",
+                "wait_down", "state", "bottleneck"]
+        while True:
+            rows = _loop_rows()
+            if args.as_json:
+                print(json.dumps(st.loop_stats(), indent=2, default=str))
+            elif rows:
+                _print_table(rows, cols)
+            else:
+                print("no live compiled loops in this process "
+                      "(loops are driver-local; run inside the driver or "
+                      "query the dashboard's /api/loops)")
+            if args.once:
+                break
+            try:
+                _time.sleep(max(0.1, args.interval))
+            except KeyboardInterrupt:
+                break
+            print("\x1b[2J\x1b[H", end="")  # clear + home for the refresh
     elif args.cmd == "metrics":
         from ray_tpu.util.metrics import get_metrics, prometheus_text
 
@@ -579,6 +659,7 @@ def main(argv: list[str] | None = None) -> int:
                     if ten.get("adapter_defers"):
                         line += f" defers={ten['adapter_defers']}"
                     print(line)
+                scope = ten.get("scope")
                 for tenant, row in sorted((ten.get("tenants") or {}).items()):
                     tparts = [f"admitted={row.get('admitted', 0)}"]
                     for k in ("shed", "quota_rejects"):
@@ -590,7 +671,23 @@ def main(argv: list[str] | None = None) -> int:
                     if row.get("p95_ttft_ms") is not None:
                         tparts.append(
                             f"p95_ttft_ms={round(float(row['p95_ttft_ms']), 1)}")
+                    if row.get("slo_burn_frac") is not None:
+                        tparts.append(
+                            f"slo_burn={float(row['slo_burn_frac']):.0%}")
+                    if row.get("cost_correction") is not None:
+                        tparts.append(
+                            f"cost_corr={row['cost_correction']}")
+                    if scope:
+                        tparts.append(f"scope={scope}")
                     print(f"  tenant[{tenant}]: " + " ".join(tparts))
+                for b in (ten.get("last_breaches") or [])[-3:]:
+                    ts = datetime.datetime.fromtimestamp(
+                        b.get("ts", 0.0)).strftime("%H:%M:%S")
+                    print(f"  breach[{ts}] request={b.get('request_id')} "
+                          f"reason={b.get('reason')} "
+                          f"events={b.get('n_events')} "
+                          f"(full dump: cli trace --request "
+                          f"{b.get('request_id')})")
                 for e in st.get("autoscale_events") or []:
                     ts = datetime.datetime.fromtimestamp(e["ts"]).strftime(
                         "%H:%M:%S")

@@ -295,6 +295,14 @@ class RayTpuConfig:
     # ray_tpu_dag_loop_ticks_total metric).
     dag_loop_credits: int = 8
     dag_loop_span_every: int = 64
+    # Tick stall attribution (observability/loop_recorder.py): each
+    # resident stage records its per-tick wait_up/compute/wait_down split
+    # into a fixed-size in-process ring and flushes aggregate histograms
+    # on the span cadence above. Always-on by default — the dag bench's
+    # loop_obs_overhead_frac cell guards the cost at ≤ 2% of tick
+    # dispatch; False is the bench's recorder-off baseline.
+    dag_loop_stall_recording: bool = True
+    dag_loop_stall_ring: int = 256
 
     # --- serve ---------------------------------------------------------------
     serve_router_assign_timeout_s: float = 60.0

@@ -39,10 +39,12 @@ Differences from the one-shot DAG worth knowing:
 
 from __future__ import annotations
 
+import json
 import os
 import tempfile
 import time
 import uuid
+import weakref
 
 from .channel import (ChannelClosed, RingChannel, TcpLoopReader,
                       TcpLoopServer)
@@ -77,6 +79,9 @@ def _loop_metrics():
     if _tick_metrics is None:
         from ..util.metrics import Counter, Gauge
 
+        from ..observability.loop_recorder import TICK_MS_BOUNDARIES
+        from ..util.metrics import Histogram
+
         _tick_metrics = (
             Counter("ray_tpu_dag_loop_ticks_total",
                     "Iterations executed by resident compiled-loop stages",
@@ -85,16 +90,67 @@ def _loop_metrics():
                   "Unconsumed iterations queued in a loop stage's output "
                   "channel (0..credits; credits = backpressure engaged)",
                   tag_keys=("loop", "stage")),
+            Histogram("ray_tpu_dag_loop_tick_ms",
+                      "Per-tick stall attribution of resident loop stages: "
+                      "time waiting on upstream input (bucket=wait_up), "
+                      "computing (bucket=compute), and waiting on "
+                      "downstream credits (bucket=wait_down)",
+                      boundaries=TICK_MS_BOUNDARIES,
+                      tag_keys=("loop", "stage", "bucket")),
         )
     return _tick_metrics
 
 
+# Snapshot-file writes (snapshot aggregation + JSON + atomic replace,
+# ~1ms on slow container filesystems) are time-gated: amortized over the
+# span cadence alone they were the recorder's dominant cost on fast
+# loops. The first flush always writes so stats() sees a young loop.
+_STALL_FILE_MIN_S = 0.5
+
+
+def _flush_stall(ring, hist, stall_tags, stall_path: str | None,
+                 force: bool = False) -> None:
+    """Drain the stage's stall ring into the aggregated histogram and
+    (node-locally) an atomically-replaced snapshot file the driver's
+    ``CompiledLoop.stats()`` reads without any actor RPC. Runs on the
+    span cadence, never per tick; never raises into the loop."""
+    if ring is None:
+        return
+    try:
+        rows = ring.drain()
+        if rows:
+            # one bulk observe per bucket — per-sample observe() calls
+            # (lock + tag-key resolution each) made the flush the
+            # dominant recorder cost at ~45µs/tick amortized
+            hist.observe_many([r[0] for r in rows], tags=stall_tags[0])
+            hist.observe_many([r[1] for r in rows], tags=stall_tags[1])
+            hist.observe_many([r[2] for r in rows], tags=stall_tags[2])
+        now = time.monotonic()
+        if stall_path and (force or now - ring.last_file_ts
+                           >= _STALL_FILE_MIN_S):
+            ring.last_file_ts = now
+            tmp = stall_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(ring.snapshot(), f)
+            os.replace(tmp, stall_path)
+    except Exception:
+        pass  # observability must never break the loop
+
+
 def _loop_tick(instance, method_name: str, in_specs: list, out_desc,
-               loop_id: str, span_every: int) -> str:
+               loop_id: str, span_every: int, stage_label: str | None = None,
+               stall_path: str | None = None, stall_record: bool = True,
+               stall_ring: int = 256) -> str:
     """The resident tick executor (ships to the stage actor via
     ``__ray_call__`` and never returns until teardown): read one
     iteration's inputs, apply the bound method, stream the result out.
-    Blocking anywhere in the channel protocol IS the backpressure."""
+    Blocking anywhere in the channel protocol IS the backpressure —
+    which is exactly what the stall ring attributes: per tick, the time
+    blocked in upstream ``read()`` (wait_up) vs the bound method
+    (compute) vs downstream ``write()`` credit waits (wait_down), into a
+    fixed-size in-process ring. Aggregates leave the process only on the
+    ``span_every`` flush cadence (histogram + node-local snapshot file);
+    the tick path itself does no allocation and no RPC for it."""
     from ..core.rpc import get_chaos
 
     readers = {i: _open_loop_reader(spec) for i, (kind, spec)
@@ -107,12 +163,26 @@ def _loop_tick(instance, method_name: str, in_specs: list, out_desc,
         with open(path + ".ready", "w") as f:
             f.write("1")  # compile blocks on this marker (see _wait_ready)
     method = getattr(instance, method_name)
+    stage = stage_label or method_name
     ticks = 0
-    counter, occupancy = _loop_metrics()
-    tags = {"loop": loop_id, "stage": method_name}
+    counter, occupancy, tick_hist = _loop_metrics()
+    tags = {"loop": loop_id, "stage": stage}
+    ring = None
+    stall_tags = None
+    if stall_record:
+        from ..observability import loop_recorder
+
+        ring = loop_recorder.get_stall_ring(loop_id, stage, stall_ring)
+        stall_tags = tuple({"loop": loop_id, "stage": stage, "bucket": b}
+                           for b in loop_recorder.STALL_BUCKETS)
+    # Stall aggregates ride the span cadence; with tick spans disabled
+    # they still flush, at the default stride.
+    flush_every = span_every or 64
+    perf = time.perf_counter
     try:
         while True:
             args, upstream_error = [], None
+            r0 = perf()
             for i, (kind, spec) in enumerate(in_specs):
                 if kind == "const":
                     args.append(spec)
@@ -121,6 +191,7 @@ def _loop_tick(instance, method_name: str, in_specs: list, out_desc,
                 if is_error and upstream_error is None:
                     upstream_error = value
                 args.append(value)
+            c0 = perf()
             if get_chaos().take_kill_loop_tick():
                 # Deterministic chaos: this stage dies mid-loop, exactly
                 # between consuming its inputs and producing its output.
@@ -140,18 +211,28 @@ def _loop_tick(instance, method_name: str, in_specs: list, out_desc,
 
                 payload = _pack_error(
                     RayTaskError(method_name, traceback.format_exc(), e))
+            c1 = perf()
             out.write(payload)
+            w1 = perf()
             ticks += 1
+            if ring is not None:
+                ring.record((c0 - r0) * 1e3, (c1 - c0) * 1e3,
+                            (w1 - c1) * 1e3)
             counter.inc(tags=tags)
             occupancy.set(out.occupancy(), tags=tags)
+            if ticks % flush_every == 0:
+                _flush_stall(ring, tick_hist, stall_tags, stall_path)
             if span_every and ticks % span_every == 0:
                 from ..observability import tracing
 
                 tracing.record_span(tracing.make_span(
                     "dag.loop.tick", "dag", t0, time.time(), loop_id,
-                    attrs={"stage": method_name, "tick": ticks,
+                    attrs={"stage": stage, "tick": ticks,
                            "out_occupancy": out.occupancy()}))
     except ChannelClosed:
+        # final flush is forced past the file-write gate: teardown's
+        # final_stats snapshot must see the complete tick history
+        _flush_stall(ring, tick_hist, stall_tags, stall_path, force=True)
         out.close_writer()  # cascade teardown downstream
         return "closed"
     finally:
@@ -178,6 +259,9 @@ class CompiledLoop:
         self.capacity = max_buffer_size or cfg.dag_channel_capacity
         self.credits = max(2, credits or cfg.dag_loop_credits)
         self._span_every = cfg.dag_loop_span_every
+        self._stall_record = bool(
+            getattr(cfg, "dag_loop_stall_recording", True))
+        self._stall_ring = int(getattr(cfg, "dag_loop_stall_ring", 256))
         self._dir: str | None = None
         self._input_node: InputNode | None = None
         self._outputs: list[ClassMethodNode] = []
@@ -220,6 +304,15 @@ class CompiledLoop:
                     "node per actor (create a separate actor per stage)")
             seen_actors[actor_id] = node.method_name
             self._stage_nodes.append(node)
+        # Stable per-stage labels for metrics/stats: the method name,
+        # disambiguated when two actors run same-named stages.
+        self._stage_labels: list[str] = []
+        name_counts: dict[str, int] = {}
+        for node in self._stage_nodes:
+            k = name_counts.get(node.method_name, 0)
+            name_counts[node.method_name] = k + 1
+            self._stage_labels.append(
+                node.method_name if k == 0 else f"{node.method_name}#{k}")
 
         # Consumers per producer, in deterministic order; one reader end
         # per (consumer, arg position) so a node consuming the same
@@ -298,7 +391,10 @@ class CompiledLoop:
         # are listening before producers can emit.
         self._actors = []
         self._actor_nodes: list[tuple[str, str]] = []  # (actor hex, node id)
-        for node in self._stage_nodes:
+        # Stage label -> node-local stall snapshot file (None for stages
+        # on other nodes — those surface through the GCS metrics flush).
+        self._stall_files: dict[str, str | None] = {}
+        for i, node in enumerate(self._stage_nodes):
             self._actor_nodes.append(
                 (node.actor._actor_id.hex(), node_of[id(node)]))
             in_specs = []
@@ -309,9 +405,14 @@ class CompiledLoop:
                         ("chan", self._reader_spec[(id(arg), idx)]))
                 else:
                     in_specs.append(("const", arg))
+            label = self._stage_labels[i]
+            stall_path = (os.path.join(self._dir, f"stall_{i}.json")
+                          if node_of[id(node)] == driver_node else None)
+            self._stall_files[label] = stall_path
             ref = node.actor.__ray_call__.remote(
                 _loop_tick, node.method_name, in_specs,
-                self._out_desc[id(node)], self.loop_id, self._span_every)
+                self._out_desc[id(node)], self.loop_id, self._span_every,
+                label, stall_path, self._stall_record, self._stall_ring)
             self._loop_refs.append(ref)
             self._actors.append(node.actor)
         self._wait_ready(timeout=cfg.dag_ready_timeout_s)
@@ -319,6 +420,8 @@ class CompiledLoop:
         # loop task, and the orphan-lease watchdog must not mistake the
         # (idle-looking, never-returning) lease for a stranded grant.
         self._pinned = self._pin_workers(True)
+        self.final_stats: dict | None = None  # captured at teardown
+        _register_loop(self)
 
     # ------------------------------------------------------------- plumbing
     def _toposort(self) -> list[DAGNode]:
@@ -422,6 +525,55 @@ class CompiledLoop:
         """Iterations put but not yet fully consumed by ``get``."""
         return self._puts - self._gets
 
+    def stats(self, fallback_gcs: bool = True) -> dict:
+        """Observability snapshot of the resident pipeline: per-stage
+        tick stall attribution plus put/get progress and a bottleneck
+        classification. Reads the node-local snapshot files the stages
+        flush on the span cadence — no actor RPC (a resident stage's
+        actor is parked in ``_loop_tick`` and could never answer one).
+        Stages on OTHER nodes have no local file; their aggregates are
+        rebuilt from the GCS-flushed histogram when ``fallback_gcs``.
+        Stage ``state`` is ``compute_bound`` / ``starved`` (wait_up
+        dominant) / ``backpressured`` (wait_down dominant) / ``idle``;
+        the loop's ``bottleneck`` is the stage with the highest compute
+        share — everyone else is waiting on it."""
+        from ..observability import loop_recorder
+
+        stages: dict[str, dict] = {}
+        unseen = []
+        for label, path in self._stall_files.items():
+            snap = None
+            if path:
+                try:
+                    with open(path) as f:
+                        snap = json.load(f)
+                except Exception:
+                    snap = None
+            if snap is None:
+                unseen.append(label)
+                snap = {"ticks": 0, "overflowed": False, "totals_ms": {},
+                        "frac": {}, "recent_mean_ms": {}}
+            stages[label] = snap
+        if unseen and fallback_gcs and self._stall_record:
+            for label, snap in _stall_from_metrics(self.loop_id).items():
+                if not stages.get(label, {}).get("ticks"):
+                    stages[label] = snap
+        for snap in stages.values():
+            snap["state"] = loop_recorder.classify_stage(
+                snap.get("frac"), snap.get("ticks", 0))
+        return {
+            "loop_id": self.loop_id,
+            "stages": stages,
+            "bottleneck": loop_recorder.classify_loop(stages),
+            "recording": self._stall_record,
+            "puts": self._puts,
+            "gets": self._gets,
+            "in_flight": self.in_flight,
+            "credits": self.credits,
+            "broken": self._broken,
+            "torn_down": self._torn_down,
+        }
+
     def put(self, value, timeout: float | None = 60.0) -> None:
         """Enqueue one iteration input. Blocks only when the pipeline
         already holds ``credits`` unconsumed iterations (backpressure)."""
@@ -518,6 +670,12 @@ class CompiledLoop:
         for r in getattr(self, "_out_readers", []):
             r.close()
         if self._dir is not None:
+            try:
+                # Last look at the stall files before they vanish — the
+                # train runner reports this as its loop_stats.
+                self.final_stats = self.stats(fallback_gcs=False)
+            except Exception:
+                pass
             import shutil
 
             shutil.rmtree(self._dir, ignore_errors=True)
@@ -528,6 +686,69 @@ class CompiledLoop:
             self.teardown(timeout=1.0)
         except Exception:
             pass
+
+
+# Driver-local registry of live loops: CompiledLoop objects only exist
+# in the process that compiled them, so `state.loop_stats()` / the
+# dashboard's /api/loops answer from here (weak — teardown or GC drops
+# the entry without bookkeeping).
+_live_loops: "weakref.WeakValueDictionary[str, CompiledLoop]" = \
+    weakref.WeakValueDictionary()
+
+
+def _register_loop(loop: CompiledLoop) -> None:
+    _live_loops[loop.loop_id] = loop
+
+
+def live_loop_stats() -> list[dict]:
+    """``stats()`` for every live (not torn down) compiled loop this
+    driver process owns, newest first by loop id order of creation."""
+    out = []
+    for loop in list(_live_loops.values()):
+        if loop._torn_down:
+            continue
+        try:
+            out.append(loop.stats())
+        except Exception:
+            continue
+    return out
+
+
+def _stall_from_metrics(loop_id: str) -> dict[str, dict]:
+    """Cross-node fallback for ``CompiledLoop.stats()``: rebuild a
+    stage's stall aggregates from the GCS-aggregated
+    ``ray_tpu_dag_loop_tick_ms`` histogram rows (remote stages flush it
+    through the ordinary metrics flusher; there is no node-local file to
+    read). Best-effort — returns {} without a cluster."""
+    from ..observability.loop_recorder import STALL_BUCKETS
+
+    try:
+        from ..util.metrics import get_metrics
+
+        rows = get_metrics()
+    except Exception:
+        return {}
+    stages: dict[str, dict] = {}
+    for m in rows:
+        if m.get("name") != "ray_tpu_dag_loop_tick_ms":
+            continue
+        tags = m.get("tags") or {}
+        if tags.get("loop") != loop_id:
+            continue
+        st = stages.setdefault(tags.get("stage", "?"), {
+            "ticks": 0, "overflowed": False,
+            "totals_ms": {b: 0.0 for b in STALL_BUCKETS},
+            "frac": {}, "recent_mean_ms": {}})
+        bucket = tags.get("bucket", "")
+        if bucket in st["totals_ms"]:
+            st["totals_ms"][bucket] += float(m.get("value") or 0.0)
+            if bucket == "compute":
+                st["ticks"] += int(m.get("count") or 0)
+    for st in stages.values():
+        total = sum(st["totals_ms"].values()) or 1.0
+        st["frac"] = {b: round(v / total, 4)
+                      for b, v in st["totals_ms"].items()}
+    return stages
 
 
 def compile_loop(output_node: DAGNode, max_buffer_size: int | None = None,

@@ -30,7 +30,7 @@ _ENDPOINTS = [
     "nodes", "actors", "tasks", "objects", "workers",
     "placement_groups", "jobs", "metrics", "cluster_resources",
     "available_resources", "timeline", "grafana_dashboard",
-    "errors", "diagnostics", "traces", "memory", "profiles",
+    "errors", "diagnostics", "traces", "memory", "profiles", "loops",
 ]
 
 
@@ -58,6 +58,10 @@ def _collect(endpoint: str):
         return state.memory_summary()
     if endpoint == "profiles":
         return state.list_profiles()
+    if endpoint == "loops":
+        # Compiled-loop stall attribution (driver-local: the dashboard
+        # thread runs in the driver, which owns the CompiledLoop objects).
+        return state.loop_stats()
     if endpoint == "placement_groups":
         return state.list_placement_groups()
     if endpoint == "jobs":

@@ -186,6 +186,24 @@ def generate_dashboard(title: str = "ray_tpu cluster") -> dict:
             {"expr": "ray_tpu_dag_loop_channel_occupancy",
              "legend": "{{stage}}"},
         ], grid={"x": 2 * W, "y": 4 + 6 * H, "w": W, "h": H}),
+        # Tick stall attribution (observability PR): where each resident
+        # stage's tick time goes — waiting on upstream input, computing,
+        # or waiting on downstream credits. A stage whose wait_down p95
+        # tracks another stage's compute p95 IS being backpressured by
+        # it; the p95 split names the bottleneck without a profiler.
+        _panel(48, "Loop tick stall split p95 (wait_up/compute/wait_down)", [
+            {"expr": "histogram_quantile(0.95, sum by (le, stage, bucket) "
+                     "(rate(ray_tpu_dag_loop_tick_ms_bucket[5m])))",
+             "legend": "{{stage}} {{bucket}}"},
+        ], grid={"x": 0, "y": 4 + 6 * H, "w": W, "h": H}, unit="ms"),
+        # Per-tenant SLO burn (flight-recorder PR): the fraction of each
+        # tenant's recent requests breaching its TTFT SLO — the same
+        # number serve.status() shows and breach timeline dumps key off.
+        _panel(49, "Tenant SLO burn rate", [
+            {"expr": "tenant_slo_burn_frac",
+             "legend": "{{deployment}}/{{tenant}}"},
+        ], grid={"x": W, "y": 4 + 6 * H, "w": W, "h": H},
+            unit="percentunit"),
         # Row 6: memory observability (memory PR): per-node object-store
         # usage vs capacity/pinned, HBM used vs limit, worker RSS, and the
         # spill-rate-by-node view that pairs with the leak watcher.

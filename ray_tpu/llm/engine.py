@@ -31,6 +31,7 @@ import logging
 import numpy as np
 
 from ..models.llama import LlamaConfig, PRESETS
+from ..observability import loop_recorder
 from .executor import LocalEngineExecutor
 
 logger = logging.getLogger(__name__)
@@ -94,6 +95,19 @@ class Request:
     spec_drafted: int = 0
     spec_accepted: int = 0
     spec_rollbacks: int = 0
+    # Flight recorder (observability/loop_recorder.py): a bounded,
+    # always-on event timeline — admission, prefix hits, COW forks,
+    # prefill chunks, first token, per-token ITL, speculation rounds,
+    # migrations, shed/deadline, retire. On SLO breach it dumps ONCE as
+    # a ``llm.request_timeline`` span (see ``InferenceEngine.
+    # dump_timeline``).
+    timeline: "object" = None
+
+    def __post_init__(self):
+        if self.timeline is None:
+            from ..observability.loop_recorder import RequestTimeline
+
+            self.timeline = RequestTimeline()
 
 
 class QueueFullError(RuntimeError):
@@ -539,7 +553,15 @@ class InferenceEngine:
                         # the ratio is per-sequence per-forward (1.0 =
                         # plain decode), independent of batch size.
                         "spec_slot_rounds": 0,
-                        "spec_rollbacks": 0}
+                        "spec_rollbacks": 0,
+                        # Flight recorder: request timelines dumped as
+                        # llm.request_timeline spans on SLO breach
+                        # (deadline expiry, shed, TTFT-SLO breach) —
+                        # at most one dump per request.
+                        "timeline_dumps": 0}
+        # Last few breach dumps, for serve.status() "last-breach" rows
+        # (the full event payload lives in the span store).
+        self._breach_samples: deque[dict] = deque(maxlen=8)
 
     @staticmethod
     def total_pages(max_slots: int, max_len: int, page_size: int,
@@ -567,11 +589,16 @@ class InferenceEngine:
             if self.max_queued_requests and \
                     len(self._waiting) >= self.max_queued_requests:
                 self.metrics["queue_rejects"] += 1
-                raise QueueFullError(
+                err = QueueFullError(
                     f"engine admission queue is full "
                     f"({len(self._waiting)} waiting, bound "
                     f"{self.max_queued_requests})",
                     retry_after=self._queue_retry_after_locked())
+                request.timeline.add(loop_recorder.EV_SHED, 0)
+                self.dump_timeline(request, "shed_queue_full")
+                raise err
+            request.timeline.add(loop_recorder.EV_ADMIT, len(request.prompt),
+                                 now=request.arrived_wall)
             self._waiting.append(request)
 
     def _queue_retry_after_locked(self) -> int:
@@ -616,6 +643,10 @@ class InferenceEngine:
     def _retire_locked(self, r: Request) -> None:
         """Free the request's slot and pages (idempotent). Full PROMPT
         pages enter the prefix cache instead of the free list."""
+        if r.slot != -1 or r.block_table:
+            # First retire only (the guard is the idempotence condition
+            # below): close the flight-recorder timeline.
+            r.timeline.add(loop_recorder.EV_RETIRE, len(r.generated))
         if r.slot >= 0 and r.slot in self._active:
             self._active.pop(r.slot, None)
             self._free_slots.append(r.slot)
@@ -782,6 +813,7 @@ class InferenceEngine:
         per expiry so streams end promptly with finish_reason
         "deadline"."""
         events: list[dict] = []
+        breached: list[Request] = []
         now = time.time()
         with self._lock:
             if self._waiting and any(
@@ -792,6 +824,8 @@ class InferenceEngine:
                     if r.deadline is not None and now >= r.deadline:
                         r.done, r.finish_reason = True, "deadline"
                         self.metrics["deadline_expired_queued"] += 1
+                        r.timeline.add(loop_recorder.EV_DEADLINE, 0, now=now)
+                        breached.append(r)
                         events.append({"request_id": r.request_id,
                                        "token": -1, "done": True,
                                        "finish_reason": "deadline"})
@@ -819,12 +853,16 @@ class InferenceEngine:
                     expired.append(r)  # the flush drops its handle
             for r in expired:
                 r.done, r.finish_reason = True, "deadline"
+                r.timeline.add(loop_recorder.EV_DEADLINE, 0, now=now)
                 self._retire_locked(r)
                 self.metrics["deadline_expired_running"] += 1
+                breached.append(r)
                 events.append({"request_id": r.request_id, "token": -1,
                                "done": True, "finish_reason": "deadline"})
         for r in expired:
             self._record_decode_span(r)
+        for r in breached:
+            self.dump_timeline(r, "deadline")
         return events
 
     def _step_scheduled(self) -> list[dict]:
@@ -967,6 +1005,9 @@ class InferenceEngine:
                 self._prefilling.append(r)
                 admitted.append(r)
         for r in admitted:
+            if r.cached_prefix_tokens:
+                r.timeline.add(loop_recorder.EV_PREFIX_HIT,
+                               r.cached_prefix_tokens)
             self._record_prefix_match_span(r)
 
     def _release_admission_locked(self, r: Request) -> None:
@@ -1140,6 +1181,7 @@ class InferenceEngine:
             r.shared_pages = idx
             r.cow_page = None
             self.metrics["cow_forks"] += 1
+            r.timeline.add(loop_recorder.EV_COW_FORK, new)
 
     def _prefill_chunk_one(self, r: Request) -> list[dict]:
         self._maybe_cow(r)
@@ -1168,6 +1210,7 @@ class InferenceEngine:
             self.executor.prefill_many(bt, tokens_m, r.prefill_pos, handle, full)
             self.metrics["prefill_chunks"] += m
             r.prefill_pos += take
+            r.timeline.add(loop_recorder.EV_PREFILL_CHUNK, take)
         else:
             # Bucket, clamped so the chunk's pages never run past the
             # table (both operands are page-aligned).
@@ -1183,6 +1226,7 @@ class InferenceEngine:
                                   lora_slot=r.lora_slot)
             self.metrics["prefill_chunks"] += 1
             r.prefill_pos += take
+            r.timeline.add(loop_recorder.EV_PREFILL_CHUNK, take)
         if not final:
             return []  # more chunks to go
         # Prompt complete: queue the last real position's hidden state
@@ -1232,6 +1276,8 @@ class InferenceEngine:
             r.pos = len(r.prompt)
             r.first_token_at = now
             r.first_token_wall = now_wall
+            r.timeline.add(loop_recorder.EV_FIRST_TOKEN, r.prefill_pos,
+                           now=now_wall)
             self._record_prefill_span(r)
             events.append(self._emit(r, int(tokens[i])))
         return events
@@ -1249,6 +1295,43 @@ class InferenceEngine:
             attrs={"request_id": r.request_id,
                    "prompt_tokens": len(r.prompt),
                    "cached_prefix_tokens": r.cached_prefix_tokens}))
+
+    # -------------------------------------------------------- flight recorder
+    def dump_timeline(self, r: Request, reason: str) -> bool:
+        """Dump one request's flight-recorder timeline as a single
+        ``llm.request_timeline`` span (attrs carry the full event list:
+        admission → prefix hits → prefill chunks → first token →
+        per-token deltas → terminal event). Fires AT MOST ONCE per
+        request — the first SLO breach (deadline expiry, shed, TTFT-SLO
+        breach from the serving layer) wins; later triggers are no-ops.
+        Returns True when a dump was recorded."""
+        tl = r.timeline
+        if tl is None or tl.dumped:
+            return False
+        tl.dumped = True
+        from ..observability import tracing
+
+        payload = tl.to_payload()
+        trace = r.trace or {}
+        now = time.time()
+        tracing.record_span(tracing.make_span(
+            "llm.request_timeline", "llm",
+            payload["start"] or r.arrived_wall, now,
+            trace.get("trace_id") or tracing.new_trace_id(),
+            trace.get("span_id", ""),
+            attrs={"request_id": r.request_id, "reason": reason,
+                   "model": r.model or "", **payload}))
+        self.metrics["timeline_dumps"] += 1
+        self._breach_samples.append({
+            "request_id": r.request_id, "reason": reason, "ts": now,
+            "model": r.model or "", "n_events": payload["n_events"],
+            "overflowed": payload["overflowed"],
+            "events": payload["events"][-16:]})
+        return True
+
+    def breach_samples(self) -> list[dict]:
+        """Most recent breach dumps (bounded), for serve.status() rows."""
+        return list(self._breach_samples)
 
     def _record_decode_span(self, r: Request) -> None:
         if not r.trace:
@@ -1387,6 +1470,8 @@ class InferenceEngine:
                 emitted += 1
             dr = drafted.get(slot, 0)
             accepted = min(max(0, emitted - 1), dr)
+            if dr:
+                r.timeline.add(loop_recorder.EV_SPEC_ROUND, accepted)
             r.spec_drafted += dr
             r.spec_accepted += accepted
             self.metrics["spec_drafted_tokens"] += dr
@@ -1482,6 +1567,7 @@ class InferenceEngine:
             r = p["request"]
             self.metrics["prefill_chunks"] += 1
             r.prefill_pos = p["start_pos"] + p["take"]
+            r.timeline.add(loop_recorder.EV_PREFILL_CHUNK, p["take"])
             if not p["final"]:
                 continue
             with self._lock:
@@ -1505,6 +1591,9 @@ class InferenceEngine:
 
     def _emit(self, r: Request, token: int) -> dict:
         r.generated.append(token)
+        # Per-token ITL record: deltas between consecutive EV_TOKEN
+        # timestamps are the inter-token latencies in the dump.
+        r.timeline.add(loop_recorder.EV_TOKEN, len(r.generated))
         if (r.eos_id is not None and token == r.eos_id) or token in r.stop_ids:
             r.done, r.finish_reason = True, "stop"
         elif len(r.generated) >= r.max_new_tokens:

@@ -45,6 +45,12 @@ def _llm_metrics() -> dict:
                 "Fraction of cacheable prompt pages served from the "
                 "engine's prefix cache (0-1, since engine start)",
                 tag_keys=("deployment",))
+            _metrics["slo_burn"] = Gauge(
+                "tenant_slo_burn_frac",
+                "Fraction of the tenant's windowed TTFT samples that "
+                "breached its ttft_slo_ms objective (0-1; 0 when no SLO "
+                "is configured)",
+                tag_keys=("deployment", "tenant"))
         return _metrics
 
 
@@ -68,7 +74,18 @@ def _observe_ttft(req: Request, deployment: str, engine=None,
     _llm_metrics()["ttft"].observe(
         ttft_ms, tags={"deployment": deployment, "tenant": tenant})
     if ledger is not None:
-        ledger.note_ttft(tenant, ttft_ms)
+        breached = ledger.note_ttft(tenant, ttft_ms)
+        _llm_metrics()["slo_burn"].set(
+            ledger.slo_burn_frac(tenant),
+            tags={"deployment": deployment, "tenant": tenant})
+        if breached and engine is not None:
+            # SLO breach: dump the request's flight-recorder timeline
+            # (at most once per request) so the slow path is replayable
+            # via `cli trace --request`.
+            try:
+                engine.dump_timeline(req, "ttft_slo")
+            except Exception:
+                pass
     if engine is not None:
         _llm_metrics()["prefix_hit_rate"].set(
             engine.prefix_cache_hit_rate, tags={"deployment": deployment})
@@ -78,6 +95,11 @@ class LLMDeployment:
     """User-facing deployment class: wrap with ``serve.deployment`` (see
     ``build_llm_app``). Methods run on replica executor threads; one
     background thread drives the engine so requests batch continuously."""
+
+    # Thread-local handoff marker: _import_migration stamps the KV token
+    # count here so the request object created later ON THE SAME THREAD
+    # gets an EV_MIGRATE flight-recorder event.
+    _migrate_tls = threading.local()
 
     def __init__(
         self,
@@ -353,6 +375,12 @@ class LLMDeployment:
                       eos_id=self.tokenizer.eos_id,
                       model=self._adapter_for(model),
                       deadline=deadline)
+        migrated = getattr(self._migrate_tls, "tokens", None)
+        if migrated is not None:
+            from ..observability import loop_recorder
+
+            req.timeline.add(loop_recorder.EV_MIGRATE, migrated)
+            self._migrate_tls.tokens = None
         done = threading.Event()
         self._events[rid] = done  # before add: the engine may finish fast
         try:
@@ -380,6 +408,12 @@ class LLMDeployment:
         _observe_ttft(req, _deployment_tag(self.model_id), self.engine,
                       tenant=tenant, ledger=self.tenancy)
         self.tenancy.note_tokens(tenant, len(req.generated))
+        # Retire-time WFQ cost correction: the admission estimate charged
+        # prompt + max_new worst case; fold the ACTUAL token count into
+        # the tenant's EWMA ratio (published to routers via tenancy
+        # long-poll) so future estimates converge on reality.
+        self.tenancy.note_actual(tenant, len(ids) + max_new_tokens,
+                                 len(ids) + len(req.generated))
         self._note_residency(self._group_of(prompt, session_id), req)
         return {
             "request_id": rid,
@@ -438,6 +472,9 @@ class LLMDeployment:
         finally:
             self._token_queues.pop(req.request_id, None)
             self.tenancy.note_tokens(tenant, len(req.generated))
+            self.tenancy.note_actual(
+                tenant, len(req.prompt) + req.max_new_tokens,
+                len(req.prompt) + len(req.generated))
             if not req.done:
                 self.engine.cancel(req.request_id)
 
@@ -542,6 +579,10 @@ class LLMDeployment:
             attrs = {"status": f"{type(e).__name__}: {e}",
                      "complete": False}
         attrs["kind"] = "disagg_handoff"
+        if attrs.get("complete"):
+            # Mark the NEXT request this thread creates (the migrated
+            # completion below) with a flight-recorder migrate event.
+            self._migrate_tls.tokens = int(attrs.get("cached_tokens") or 0)
         self._record_kv_migrate_span(t0w, attrs)
 
     def _disagg_request(self, body: dict, prompt: str, chat: bool):
@@ -814,7 +855,11 @@ class LLMDeployment:
         ``cli serve status`` per-tenant tables."""
         out: dict = {"tenants": self.tenancy.snapshot(),
                      "adapter_defers":
-                         self.engine.metrics.get("adapter_defers", 0)}
+                         self.engine.metrics.get("adapter_defers", 0),
+                     # Most recent flight-recorder breach dumps (deadline
+                     # expiries / sheds / TTFT-SLO breaches) on this
+                     # replica — the serve.status() "last breach" rows.
+                     "last_breaches": self.engine.breach_samples()}
         lm = self.engine.lora_manager
         if lm is not None:
             out["adapters"] = lm.stats()

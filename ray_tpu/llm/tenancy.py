@@ -51,18 +51,24 @@ class TenantSpec:
 
     ``weight`` is the WFQ share under saturation (relative, not a
     fraction); ``tokens_per_s`` is the sustained token quota (0 =
-    unmetered) with ``burst_tokens`` of credit on top."""
+    unmetered) with ``burst_tokens`` of credit on top; ``ttft_slo_ms``
+    is the tenant's TTFT objective (0 = no SLO) — breaches feed the
+    ``slo_burn_frac`` burn-rate row and trigger a flight-recorder
+    timeline dump for the breaching request."""
 
     name: str
     weight: float = 1.0
     tokens_per_s: float = 0.0
     burst_tokens: float = 0.0
+    ttft_slo_ms: float = 0.0
 
     def __post_init__(self):
         if self.weight <= 0:
             raise ValueError(f"tenant {self.name!r}: weight must be > 0")
         if self.tokens_per_s < 0 or self.burst_tokens < 0:
             raise ValueError(f"tenant {self.name!r}: quota must be >= 0")
+        if self.ttft_slo_ms < 0:
+            raise ValueError(f"tenant {self.name!r}: ttft_slo_ms must be >= 0")
 
 
 @dataclass(frozen=True)
@@ -84,7 +90,8 @@ class TenancyConfig:
                 name=name,
                 weight=float(spec.get("weight", 1.0)),
                 tokens_per_s=float(spec.get("tokens_per_s", 0.0)),
-                burst_tokens=float(spec.get("burst_tokens", 0.0))))
+                burst_tokens=float(spec.get("burst_tokens", 0.0)),
+                ttft_slo_ms=float(spec.get("ttft_slo_ms", 0.0))))
         return TenancyConfig(
             tenants=tuple(tenants),
             max_loaded_adapters=int(d.get("max_loaded_adapters", 0)))
@@ -377,6 +384,15 @@ class _TenantState:
     tokens_in: int = 0
     tokens_out: int = 0
     ttft_ms: deque = field(default_factory=lambda: deque(maxlen=256))
+    # Windowed SLO breach flags (parallel window to ttft_ms): burn
+    # fraction = mean over the reservoir, so it recovers as the window
+    # rolls — a burn-rate, not a lifetime counter.
+    slo_window: deque = field(default_factory=lambda: deque(maxlen=256))
+    slo_breaches: int = 0
+    # EWMA of actual_cost / estimated_cost at retire: >1 means the
+    # prompt+max_tokens heuristic UNDER-charges this tenant's WFQ share.
+    cost_ratio: float = 1.0
+    cost_samples: int = 0
 
 
 class TenantLedger:
@@ -424,9 +440,47 @@ class TenantLedger:
         with self._lock:
             self._state_locked(tenant).tokens_out += generated
 
-    def note_ttft(self, tenant: str, ttft_ms: float) -> None:
+    def note_ttft(self, tenant: str, ttft_ms: float) -> bool:
+        """Record one TTFT sample; returns True when it breached the
+        tenant's ``ttft_slo_ms`` (callers use that to trigger the
+        flight-recorder dump for the breaching request)."""
         with self._lock:
-            self._state_locked(tenant).ttft_ms.append(float(ttft_ms))
+            st = self._state_locked(tenant)
+            st.ttft_ms.append(float(ttft_ms))
+            slo = st.spec.ttft_slo_ms
+            if slo <= 0:
+                return False
+            breached = ttft_ms > slo
+            st.slo_window.append(1 if breached else 0)
+            if breached:
+                st.slo_breaches += 1
+            return breached
+
+    def slo_burn_frac(self, tenant: str) -> float:
+        """Fraction of the windowed TTFT reservoir that breached the
+        tenant's SLO (0.0 when no SLO configured or no samples yet)."""
+        with self._lock:
+            st = self._state_locked(tenant)
+            if not st.slo_window:
+                return 0.0
+            return sum(st.slo_window) / len(st.slo_window)
+
+    def note_actual(self, tenant: str, estimated: float, actual: float) -> None:
+        """Retire-time WFQ cost correction: fold actual/estimated into
+        the tenant's EWMA ratio. The router scales this tenant's future
+        cost estimates by the published ratio, so tenants whose requests
+        systematically overrun (or undershoot) their ``max_tokens``
+        heuristic still get charged their true share."""
+        if estimated <= 0:
+            return
+        ratio = max(0.01, min(100.0, float(actual) / float(estimated)))
+        with self._lock:
+            st = self._state_locked(tenant)
+            if st.cost_samples == 0:
+                st.cost_ratio = ratio
+            else:
+                st.cost_ratio = 0.8 * st.cost_ratio + 0.2 * ratio
+            st.cost_samples += 1
 
     def quota_remaining(self, tenant: str) -> float | None:
         with self._lock:
@@ -451,6 +505,14 @@ class TenantLedger:
                        "tokens_out": st.tokens_out,
                        "weight": st.spec.weight,
                        "p95_ttft_ms": round(p95, 3)}
+                if st.cost_samples:
+                    row["cost_correction"] = round(st.cost_ratio, 4)
+                if st.spec.ttft_slo_ms > 0:
+                    row["ttft_slo_ms"] = st.spec.ttft_slo_ms
+                    row["slo_breaches"] = st.slo_breaches
+                    row["slo_burn_frac"] = round(
+                        sum(st.slo_window) / len(st.slo_window), 4) \
+                        if st.slo_window else 0.0
                 if st.bucket is not None:
                     st.bucket._refill(time.monotonic())
                     row["quota_remaining"] = round(max(0.0, st.bucket.level), 1)

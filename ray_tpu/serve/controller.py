@@ -370,6 +370,18 @@ class ServeController:
             self._fold_prefix_residency(state, probes)
             self._fold_overload(state, probes)
             self._fold_tenancy(state, probes)
+            # Re-publish tenancy when the folded retire-time cost
+            # correction moved, so routers scale their WFQ estimates.
+            corr = {t: row.get("cost_correction")
+                    for t, row in ((state.tenancy or {}).get("tenants")
+                                   or {}).items()
+                    if row.get("cost_correction") is not None}
+            if not hasattr(self, "_pushed_corrections"):
+                self._pushed_corrections = {}
+            ckey = f"{state.app_name}::{state.name}"
+            if corr and corr != self._pushed_corrections.get(ckey):
+                self._pushed_corrections[ckey] = corr
+                self._push_tenancy(state)
             self._autoscale_from_probes(state, probes)
             target = state.target_replicas
             for r in list(state.replicas):
@@ -541,6 +553,7 @@ class ServeController:
                     "tokens_in", "tokens_out")
         tenants: dict[str, dict] = {}
         resident: list[str] = []
+        last_breaches: list[dict] = []
         adapter_defers = 0
         replicas = 0
         for p in probes.values():
@@ -552,6 +565,7 @@ class ServeController:
                 for aid in row.get("resident_adapters") or []:
                     if aid not in resident:
                         resident.append(aid)
+                last_breaches.extend(row.get("last_breaches") or [])
                 for tenant, t_row in (row.get("tenants") or {}).items():
                     agg = tenants.setdefault(
                         tenant, {k: 0 for k in sum_keys})
@@ -562,6 +576,26 @@ class ServeController:
                     if p95 is not None:
                         agg["p95_ttft_ms"] = max(
                             float(p95), float(agg.get("p95_ttft_ms") or 0.0))
+                    burn = t_row.get("slo_burn_frac")
+                    if burn is not None:
+                        # like p95: one hot replica burning the SLO IS a
+                        # burn — take the worst replica's fraction
+                        agg["slo_burn_frac"] = max(
+                            float(burn), float(agg.get("slo_burn_frac")
+                                               or 0.0))
+                        agg["ttft_slo_ms"] = t_row.get(
+                            "ttft_slo_ms", agg.get("ttft_slo_ms"))
+                        agg["slo_breaches"] = int(agg.get("slo_breaches", 0)) \
+                            + int(t_row.get("slo_breaches", 0) or 0)
+                    corr = t_row.get("cost_correction")
+                    if corr is not None:
+                        # mean across reporting replicas (each is already
+                        # an EWMA over that replica's retires)
+                        n = int(agg.get("_corr_n", 0))
+                        prev = float(agg.get("cost_correction") or 0.0)
+                        agg["cost_correction"] = round(
+                            (prev * n + float(corr)) / (n + 1), 4)
+                        agg["_corr_n"] = n + 1
                     remaining = t_row.get("quota_remaining")
                     if remaining is not None:
                         # quota buckets are per-replica: remaining budget
@@ -569,12 +603,21 @@ class ServeController:
                         agg["quota_remaining"] = round(
                             float(agg.get("quota_remaining") or 0.0)
                             + float(remaining), 1)
+        for agg in tenants.values():
+            agg.pop("_corr_n", None)
         if replicas:
+            # Most recent breach dumps across the fleet, newest last.
+            last_breaches.sort(key=lambda b: b.get("ts", 0.0))
             state.tenancy = {
                 "replicas": replicas,
                 "tenants": tenants,
                 "resident_adapters": resident,
                 "adapter_defers": adapter_defers,
+                "last_breaches": last_breaches[-8:],
+                # Counters/quota sum over N per-replica ledgers: an
+                # N-replica deployment admits ~N× a single replica's
+                # tokens_per_s quota (each replica meters independently).
+                "scope": "per_replica_sum",
             }
 
     def _replica_alive(self, r: _Replica) -> bool:
@@ -831,9 +874,11 @@ class ServeController:
         self._long_poll.notify_changed(f"replicas::{state.app_name}::{state.name}", table)
 
     def _push_tenancy(self, state: _DeploymentState) -> None:
-        """Publish the deployment's tenant weights on the ``tenancy::``
+        """Publish the deployment's tenant weights — and the folded
+        retire-time cost-correction ratios — on the ``tenancy::``
         long-poll key so every router's weighted-fair queue uses the
-        same shares the replicas' quota ledgers were configured with."""
+        same shares the replicas' quota ledgers were configured with and
+        scales its token-cost estimates by observed reality."""
         tcfg = (state.config.get("init_kwargs") or {}).get("tenancy_config")
         weights = {}
         if tcfg:
@@ -844,8 +889,13 @@ class ServeController:
                 weights = cfg.weights() if cfg is not None else {}
             except Exception:
                 logger.warning("bad tenancy_config for %s", state.name)
+        correction = {
+            t: row["cost_correction"]
+            for t, row in ((state.tenancy or {}).get("tenants") or {}).items()
+            if row.get("cost_correction") is not None}
         self._long_poll.notify_changed(
-            f"tenancy::{state.app_name}::{state.name}", {"weights": weights})
+            f"tenancy::{state.app_name}::{state.name}",
+            {"weights": weights, "cost_correction": correction})
 
     def _push_routes(self) -> None:
         self._long_poll.notify_changed(

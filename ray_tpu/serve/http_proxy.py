@@ -67,6 +67,31 @@ def _request_model_id(request: Request) -> str:
     return resolve_model_id(request.headers, body)
 
 
+def _request_cost_estimate(request: Request) -> float:
+    """Estimated token cost for WFQ (prompt length + max_tokens), parsed
+    from OpenAI-style JSON bodies at the front door. The ByteTokenizer
+    maps ~1 char to 1 token, so character length IS the prompt token
+    estimate. Non-JSON / unparseable requests cost 1.0 (plain
+    per-request fairness — the pre-cost behavior). The estimate is
+    corrected at retire via the tenant's published EWMA ratio."""
+    if not (request.body and request.headers.get(
+            "content-type", "").startswith("application/json")):
+        return 1.0
+    try:
+        body = json.loads(request.body)
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list):
+            prompt = prompt[0] if prompt else ""
+        if not prompt and isinstance(body.get("messages"), list):
+            prompt = "\n".join(
+                str(m.get("content", "")) for m in body["messages"]
+                if isinstance(m, dict))
+        max_tokens = int(body.get("max_tokens", 16))
+        return float(max(1, len(str(prompt or "")) + max(0, max_tokens)))
+    except Exception:
+        return 1.0
+
+
 def _request_prefix_group(request: Request) -> str:
     """Prefix-group key for affinity routing, extracted at the front
     door: an explicit ``x-raytpu-session`` header (multi-turn sessions)
@@ -307,6 +332,12 @@ class ProxyActor:
         deadline = time.time() + budget if budget else None
         if deadline is not None:
             handle = handle.options(deadline=deadline)
+        # WFQ cost: estimated tokens (prompt length + max_tokens), so
+        # router-level fair queueing charges big requests more than
+        # small ones instead of a flat 1.0 per request.
+        cost = _request_cost_estimate(request)
+        if cost != 1.0:
+            handle = handle.options(request_cost=cost)
         # Root span for the request (or a continuation of the client's
         # trace via the x-raytpu-trace header); everything downstream —
         # router queue, replica task, engine prefill/decode — chains

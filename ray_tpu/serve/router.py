@@ -189,7 +189,8 @@ def prefix_group_key(session_id: str = "", text: str = "",
 def _assign_traced(router: "Router", metrics: dict, deployment: str,
                    model_id: str, prefix_group: str = "",
                    spill_out: dict | None = None,
-                   deadline: float | None = None) -> tuple[str, Any]:
+                   deadline: float | None = None,
+                   cost: float = 1.0) -> tuple[str, Any]:
     """Assign a replica, recording the router queue wait as both a
     histogram observation and (inside an active trace) a span."""
     import time as _time
@@ -200,7 +201,7 @@ def _assign_traced(router: "Router", metrics: dict, deployment: str,
     try:
         replica_id, actor = router.assign_replica(
             model_id=model_id, prefix_group=prefix_group,
-            spill_out=spill_out, deadline=deadline)
+            spill_out=spill_out, deadline=deadline, cost=cost)
     finally:
         wait_ms = 1000 * (_time.monotonic() - t0m)
         metrics["queue_wait"].observe(wait_ms, tags={"deployment": deployment})
@@ -293,6 +294,11 @@ class Router:
         # tenant weight 1.0 — FIFO-equivalent, the pre-tenancy behavior).
         self._wfq = WeightedFairQueue()
         self._tenant_weights: dict[str, float] = {}
+        # Retire-time cost correction published by the controller (per
+        # tenant, EWMA of actual/estimated token cost): scales the
+        # estimated WFQ cost so tenants that systematically overrun
+        # their max_tokens heuristic still pay their true share.
+        self._cost_correction: dict[str, float] = {}
         # replica_id -> {"state": "closed"|"open"|"half_open",
         #                "failures": consecutive timeouts, "opened_at"}
         self._circuit: dict[str, dict] = {}
@@ -309,9 +315,12 @@ class Router:
         installs per-tenant WFQ weights."""
         weights = (value or {}).get("weights") if isinstance(value, dict) \
             else None
+        correction = (value or {}).get("cost_correction") \
+            if isinstance(value, dict) else None
         with self._cond:
             self._tenant_weights = dict(weights or {})
             self._wfq.set_weights(self._tenant_weights)
+            self._cost_correction = dict(correction or {})
             self._cond.notify_all()
 
     def _update_replicas(self, table: Any) -> None:
@@ -552,7 +561,8 @@ class Router:
                        model_id: str = "",
                        prefix_group: str = "",
                        spill_out: dict | None = None,
-                       deadline: float | None = None) -> tuple[str, Any]:
+                       deadline: float | None = None,
+                       cost: float = 1.0) -> tuple[str, Any]:
         """Power-of-two choice among replicas below their cap; blocks while
         every replica is saturated (backpressure) — but only up to the
         ``serve_max_queued_requests`` bound: over it the request is SHED
@@ -632,7 +642,8 @@ class Router:
                             retry_after=self._retry_after_locked())
                     if entry is None:
                         entry = self._enqueue_waiter_locked(
-                            cfg, deployment, prefix_group, tenant)
+                            cfg, deployment, prefix_group, tenant,
+                            cost=cost)
                     elif entry.get("shed"):
                         self._note_shed_locked(deployment, "preempted",
                                                tenant)
@@ -667,7 +678,8 @@ class Router:
 
     def _enqueue_waiter_locked(self, cfg, deployment: str,
                                prefix_group: str,
-                               tenant: str = "default") -> dict:
+                               tenant: str = "default",
+                               cost: float = 1.0) -> dict:
         """Join the router wait queue, enforcing the bound. A cheap
         request (prefix group resident on a live replica → small cold
         suffix) over the bound preempts the oldest expensive waiter's
@@ -701,8 +713,15 @@ class Router:
                 self._wfq.cancel(victim["ticket"])
                 victim["ticket"] = None
             self._cond.notify_all()
+        # WFQ cost = estimated tokens (prompt + max_tokens heuristic
+        # from the proxy), scaled by the tenant's published retire-time
+        # correction ratio — NOT a flat 1.0/request, so a tenant issuing
+        # few huge requests can't out-consume one issuing many small
+        # ones at equal weight.
+        cost = max(1e-9, float(cost)) * \
+            max(0.01, self._cost_correction.get(tenant, 1.0))
         entry = {"cheap": cheap, "shed": False, "tenant": tenant,
-                 "ticket": self._wfq.enqueue(tenant)}
+                 "ticket": self._wfq.enqueue(tenant, cost=cost)}
         self._waiters.append(entry)
         return entry
 
@@ -995,6 +1014,7 @@ class DeploymentHandle:
     def __init__(self, app_name: str, deployment_name: str, method_name: str = "",
                  multiplexed_model_id: str = "", prefix_group: str = "",
                  deadline: float | None = None,
+                 request_cost: float = 1.0,
                  _router_holder: dict | None = None):
         self.app_name = app_name
         self.deployment_name = deployment_name
@@ -1005,6 +1025,9 @@ class DeploymentHandle:
         # caps the router wait, rides the request to the replica, and
         # bounds engine admission/decode.
         self._deadline = deadline
+        # Estimated WFQ cost in tokens (prompt + max_tokens heuristic);
+        # 1.0 = unknown (plain per-request fairness, the old behavior).
+        self._request_cost = request_cost
         # Shared, mutable: every handle derived from this one (h.method)
         # must reuse ONE router — a router per derived handle would leak a
         # long-poll thread per request.
@@ -1022,13 +1045,15 @@ class DeploymentHandle:
     def options(self, method_name: str = "",
                 multiplexed_model_id: str = "",
                 prefix_group: str = "",
-                deadline: float | None = None) -> "DeploymentHandle":
+                deadline: float | None = None,
+                request_cost: float | None = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.app_name, self.deployment_name,
             method_name or self._method_name,
             multiplexed_model_id or self._multiplexed_model_id,
             prefix_group or self._prefix_group,
             deadline if deadline is not None else self._deadline,
+            request_cost if request_cost is not None else self._request_cost,
             _router_holder=self._router_holder,
         )
 
@@ -1072,7 +1097,7 @@ class DeploymentHandle:
         replica_id, actor = _assign_traced(
             router, metrics, self.deployment_name, self._multiplexed_model_id,
             self._prefix_group, spill_out=spill_out,
-            deadline=self._deadline)
+            deadline=self._deadline, cost=self._request_cost)
         self._inject_migrate_from(router, metrics, spill_out, kwargs)
         if self._multiplexed_model_id:
             kwargs[MULTIPLEXED_KWARG] = self._multiplexed_model_id
@@ -1121,7 +1146,7 @@ class DeploymentHandle:
         replica_id, actor = _assign_traced(
             router, metrics, self.deployment_name, self._multiplexed_model_id,
             self._prefix_group, spill_out=spill_out,
-            deadline=self._deadline)
+            deadline=self._deadline, cost=self._request_cost)
         self._inject_migrate_from(router, metrics, spill_out, kwargs)
         if self._multiplexed_model_id:
             kwargs[MULTIPLEXED_KWARG] = self._multiplexed_model_id
@@ -1155,4 +1180,5 @@ class DeploymentHandle:
                                    self._method_name,
                                    self._multiplexed_model_id,
                                    self._prefix_group,
-                                   self._deadline))
+                                   self._deadline,
+                                   self._request_cost))

@@ -279,6 +279,17 @@ class TrainLoopRunner:
         }
         if getattr(self, "_torn_down_in_s", None) is not None:
             self.stats["loop_torn_down_in_s"] = round(self._torn_down_in_s, 4)
+        loop_stats = getattr(self, "_loop_stats", None)
+        if loop_stats:
+            self.stats["loop_stall"] = {
+                "bottleneck": loop_stats.get("bottleneck"),
+                "stages": {
+                    name: {"ticks": st.get("ticks", 0),
+                           "state": st.get("state"),
+                           "frac": st.get("frac")}
+                    for name, st in (loop_stats.get("stages") or {}).items()
+                },
+            }
         return self.stats
 
     # ------------------------------------------------------------------
@@ -322,6 +333,10 @@ class TrainLoopRunner:
                 got += 1
         finally:
             loop.teardown()
+            # Stall attribution of the drive: which of data/step/ckpt
+            # the loop actually waited on. Teardown captures it after
+            # the stages' final flush, before the snapshot files vanish.
+            self._loop_stats = getattr(loop, "final_stats", None)
             self._torn_down_in_s = getattr(loop, "torn_down_in_s", None)
 
     # ------------------------------------------------------------------

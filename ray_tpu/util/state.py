@@ -46,6 +46,44 @@ def list_traces(limit: int = 100) -> list[dict]:
     return _gcs("ListTraces", {"limit": limit})["traces"]
 
 
+def loop_stats() -> list[dict]:
+    """Per-loop stall attribution for every compiled loop THIS process
+    compiled (loops are driver-owned objects — there is no cluster-wide
+    loop registry): one row per live loop with per-stage
+    wait_up/compute/wait_down splits and the bottleneck stage. Stats
+    come from node-local snapshot files the resident stages flush on the
+    span cadence (no RPC to the parked stage actors)."""
+    from ..dag.loop import live_loop_stats
+
+    return live_loop_stats()
+
+
+def find_request_timeline(request_id: str, limit: int = 200) -> dict | None:
+    """The most recent ``llm.request_timeline`` breach dump for one
+    request id: scans this process's local span buffer first (standalone
+    engines), then recent traces in the GCS span store. Returns the span
+    dict (attrs carry the event list) or None."""
+    from ..observability import tracing
+
+    def _match(spans):
+        hits = [s for s in spans
+                if s.get("name") == "llm.request_timeline"
+                and (s.get("attrs") or {}).get("request_id") == request_id]
+        return max(hits, key=lambda s: s.get("end", 0.0)) if hits else None
+
+    hit = _match(tracing.local_spans())
+    if hit is not None:
+        return hit
+    try:
+        for row in list_traces(limit=limit):
+            hit = _match(list_spans(trace_id=row["trace_id"]))
+            if hit is not None:
+                return hit
+    except Exception:
+        return None
+    return None
+
+
 def _fanout_raylets(method: str, payload: dict, result_key: str) -> list[dict]:
     """Call a raylet RPC on every alive node concurrently; tag each row
     with its node_id. Nodes that fail to answer are skipped."""

@@ -557,3 +557,46 @@ def test_tenancy_skip_markers_honored():
     result = bench_check.compare(old, dict(SKIP_MARKERS))
     assert not result["missing"] and not result["regressions"]
     assert {r["metric"] for r in result["skipped"]} == set(old)
+
+
+def test_round18_obs_metric_directions():
+    """Round-18 shadow-suffix audit: pointwise cells now carry their own
+    direction. Before _POINTWISE_DOWN_SUBSTR, the "_frac" suffix check
+    ran ahead of the "overhead" substring, so the recorder-cost gate
+    loop_obs_overhead_frac was guarded BACKWARDS (a cost blowup read as
+    an improvement). Stall WAIT splits regress up; compute split stays
+    higher-better; raw per-tick cells end in "_us" (lower-better)."""
+    assert bench_check._pointwise("loop_obs_overhead_frac")
+    assert bench_check._direction("loop_obs_overhead_frac") == "down"
+    assert bench_check._direction("dag_loop_stall_wait_up_frac") == "down"
+    assert bench_check._direction("dag_loop_stall_wait_down_frac") == "down"
+    assert bench_check._direction("dag_loop_stall_compute_frac") == "up"
+    assert bench_check._direction("loop_obs_tick_recording_us") == "down"
+    assert bench_check._direction("loop_obs_tick_baseline_us") == "down"
+    # representative earlier names keep their directions (shadow audit)
+    assert bench_check._direction("kv_migration_mb_s") == "up"
+    assert bench_check._direction("dag_tick_dispatch_overhead_us") == "down"
+    assert bench_check._direction("tenant_goodput_frac_hot") == "up"
+    assert bench_check._direction("train_ckpt_overlap_frac") == "up"
+    assert bench_check._direction("serve_goodput_frac_unprotected") == "up"
+
+
+def test_obs_overhead_frac_regresses_up_in_points():
+    """The recorder-cost fraction compares in POINTS and lower-better:
+    0.01 -> 0.18 is a 17-point cost blowup (regression); the inverse is
+    an improvement; a 2-point compute-frac wiggle stays within budget."""
+    old = {"loop_obs_overhead_frac": 0.01,
+           "dag_loop_stall_wait_up_frac": 0.20,
+           "dag_loop_stall_compute_frac": 0.60}
+    new = {"loop_obs_overhead_frac": 0.18,
+           "dag_loop_stall_wait_up_frac": 0.35,
+           "dag_loop_stall_compute_frac": 0.58}
+    result = bench_check.compare(old, new)
+    assert {r["metric"] for r in result["regressions"]} == {
+        "loop_obs_overhead_frac", "dag_loop_stall_wait_up_frac"}
+    assert {r["metric"] for r in result["ok"]} == {
+        "dag_loop_stall_compute_frac"}
+    result2 = bench_check.compare(
+        {"loop_obs_overhead_frac": 0.18}, {"loop_obs_overhead_frac": 0.01})
+    assert {r["metric"] for r in result2["improvements"]} == {
+        "loop_obs_overhead_frac"}

@@ -471,3 +471,90 @@ def test_allreduce_collective_node(ray_cluster):
         np.testing.assert_allclose(out, [6.0, 0.0])
     finally:
         compiled.teardown()
+
+
+def test_compiled_loop_stall_attribution_and_loop_top(ray_cluster, capsys):
+    """ISSUE 18 tentpole: every resident stage records per-tick
+    wait_up/compute/wait_down splits into its in-process ring and
+    flushes a node-local snapshot on the ``dag_loop_span_every``
+    cadence. ``stats()`` aggregates them with ZERO actor RPC (a resident
+    stage's actor is parked in ``_loop_tick`` and could never answer
+    one), names the bottleneck stage, and survives teardown via
+    ``final_stats``; ``cli loop top --once`` renders the same rows."""
+    from ray_tpu.cli import main
+    from ray_tpu.core.config import get_config
+    from ray_tpu.dag import compile_loop
+    from ray_tpu.dag.loop import live_loop_stats
+
+    cfg = get_config()
+    saved = cfg.dag_loop_span_every
+    cfg.dag_loop_span_every = 4  # stall snapshots flush every 4 ticks
+    try:
+        a, b = Adder.remote(1), Adder.remote(10)
+        with InputNode() as inp:
+            dag = b.add.bind(a.add.bind(inp))
+        loop = compile_loop(dag)
+        try:
+            for i in range(12):
+                assert loop.run(i) == i + 11
+            # run() round-trips, so the tick-12 flush has already landed
+            # in the node-local snapshot files — no GCS fallback needed.
+            stats = loop.stats(fallback_gcs=False)
+            assert stats["recording"] and len(stats["stages"]) == 2
+            for snap in stats["stages"].values():
+                # the first span-cadence flush always writes the file;
+                # later writes are time-gated (teardown forces the last)
+                assert snap["ticks"] >= 4
+                assert abs(sum(snap["frac"].values()) - 1.0) < 0.02
+                assert snap["state"] in ("compute_bound", "starved",
+                                         "backpressured")
+            assert stats["bottleneck"] in stats["stages"]
+            assert stats["puts"] == stats["gets"] == 12
+            # the driver-local registry backs state.loop_stats() (and
+            # through it `cli loop top` + the dashboard /api/loops)
+            assert any(row["loop_id"] == loop.loop_id
+                       for row in live_loop_stats())
+            capsys.readouterr()
+            assert main(["loop", "top", "--once"]) == 0
+            out = capsys.readouterr().out
+            assert loop.loop_id[:12] in out and "bottleneck" in out
+        finally:
+            loop.teardown()
+        # teardown drained a final flush and snapshotted the aggregates
+        # before deleting the channel dir
+        final = loop.final_stats
+        assert final is not None and final["bottleneck"] in final["stages"]
+        assert all(s["ticks"] >= 12 for s in final["stages"].values())
+        assert not any(row["loop_id"] == loop.loop_id
+                       for row in live_loop_stats())
+        capsys.readouterr()
+        assert main(["loop", "top", "--once"]) == 0  # empty table is fine
+    finally:
+        cfg.dag_loop_span_every = saved
+
+
+def test_compiled_loop_stall_recording_disabled(ray_cluster):
+    """``dag_loop_stall_recording=False`` (the bench's baseline mode)
+    compiles a loop whose ticks skip the recorder entirely — stats()
+    still answers, with empty stages and ``recording: False``."""
+    from ray_tpu.core.config import get_config
+    from ray_tpu.dag import compile_loop
+
+    cfg = get_config()
+    saved = cfg.dag_loop_stall_recording
+    cfg.dag_loop_stall_recording = False
+    try:
+        a, b = Adder.remote(1), Adder.remote(10)
+        with InputNode() as inp:
+            dag = b.add.bind(a.add.bind(inp))
+        loop = compile_loop(dag)
+        try:
+            for i in range(6):
+                assert loop.run(i) == i + 11
+            stats = loop.stats(fallback_gcs=False)
+            assert stats["recording"] is False
+            assert all(s["ticks"] == 0 for s in stats["stages"].values())
+        finally:
+            loop.teardown()
+    finally:
+        cfg.dag_loop_stall_recording = saved

@@ -583,3 +583,72 @@ def test_tenant_aware_shed_quiet_tenant_clean_e2e(serve_instance):
         if proxy is not None and saved:
             ray_tpu.get(proxy.apply_config.remote(saved), timeout=30)
         serve.delete("shed")
+
+
+def test_wfq_token_cost_equalizes_skewed_request_sizes():
+    """ISSUE 18 satellite: WFQ charges ESTIMATED TOKENS, not 1.0 per
+    request. With equal weights, a tenant sending 100x-larger requests
+    admits ~100x fewer of them — the admitted TOKEN throughput is what
+    equalizes. (Under the old cost=1.0 charging, request counts
+    equalized and the big tenant took ~100x the token share.)"""
+    wfq = WeightedFairQueue({"big": 1.0, "small": 1.0})
+    cost = {"big": 400.0, "small": 4.0}
+    tickets = {"big": [], "small": []}
+    admitted_tok = {"big": 0.0, "small": 0.0}
+    admitted_req = {"big": 0, "small": 0}
+    for t in ("big", "small"):
+        for _ in range(3):                       # standing backlog
+            tickets[t].append(wfq.enqueue(t, cost=cost[t]))
+    for _ in range(606):
+        head = next(tk for t in tickets for tk in tickets[t]
+                    if wfq.is_head(tk))
+        tenant = "big" if head in tickets["big"] else "small"
+        wfq.complete(head)
+        tickets[tenant].remove(head)
+        admitted_req[tenant] += 1
+        admitted_tok[tenant] += cost[tenant]
+        tickets[tenant].append(wfq.enqueue(tenant, cost=cost[tenant]))
+    tok_ratio = admitted_tok["big"] / admitted_tok["small"]
+    assert 0.8 <= tok_ratio <= 1.25, admitted_tok
+    req_ratio = admitted_req["small"] / admitted_req["big"]
+    assert 80 <= req_ratio <= 125, admitted_req
+
+
+def test_ledger_cost_correction_ewma_and_clamp():
+    """Retire-time correction: tenants that systematically stop far
+    short of max_tokens get their estimates scaled DOWN (EWMA of
+    actual/estimated, clamped to [0.01, 100])."""
+    ledger = TenantLedger(TenancyConfig.from_dict(
+        {"tenants": {"early-stopper": {}}}))
+    ledger.note_actual("early-stopper", estimated=1000.0, actual=100.0)
+    row = ledger.snapshot()["early-stopper"]
+    assert row["cost_correction"] == 0.1       # first sample sets it
+    for _ in range(40):
+        ledger.note_actual("early-stopper", estimated=1000.0, actual=100.0)
+    row = ledger.snapshot()["early-stopper"]
+    assert abs(row["cost_correction"] - 0.1) < 0.01   # EWMA converges
+    ledger.note_actual("early-stopper", estimated=1.0, actual=10_000.0)
+    st = ledger._tenants["early-stopper"]
+    assert st.cost_ratio <= 100.0              # clamp survives outliers
+    ledger.note_actual("early-stopper", estimated=0.0, actual=5.0)  # no-op
+
+
+def test_ledger_slo_burn_tracks_breaches_and_recovers():
+    """ttft_slo_ms: note_ttft returns True on breach, the burn fraction
+    is windowed (recovers as healthy samples roll the window), and the
+    snapshot row carries slo fields only for tenants WITH an SLO."""
+    ledger = TenantLedger(TenancyConfig.from_dict(
+        {"tenants": {"slo": {"ttft_slo_ms": 100.0}, "free": {}}}))
+    assert ledger.note_ttft("slo", 250.0) is True
+    assert ledger.note_ttft("slo", 50.0) is False
+    assert ledger.note_ttft("free", 10_000.0) is False  # no SLO, no breach
+    assert ledger.slo_burn_frac("slo") == 0.5
+    for _ in range(6):
+        ledger.note_ttft("slo", 50.0)
+    assert ledger.slo_burn_frac("slo") == 1 / 8
+    rows = ledger.snapshot()
+    assert rows["slo"]["ttft_slo_ms"] == 100.0
+    assert rows["slo"]["slo_breaches"] == 1
+    assert rows["slo"]["slo_burn_frac"] == round(1 / 8, 4)
+    assert "slo_burn_frac" not in rows["free"]
+    assert ledger.slo_burn_frac("free") == 0.0
