@@ -963,6 +963,31 @@ def main() -> None:
             ray_tpu.shutdown()
         except Exception:
             pass
+    extra_fleet: dict = {}
+    try:
+        from ray_tpu._fleet_bench import run_fleet_bench
+
+        # Returns *_skipped markers itself when
+        # RAY_TPU_BENCH_SKIP_FLEET=1, so skipped cells are always
+        # declared rather than silently vanishing.
+        extra_fleet = run_fleet_bench()
+    except Exception as e:
+        print(f"fleet bench failed: {e}", file=sys.stderr)
+        extra_fleet = {
+            "fleet_bench_error": f"{type(e).__name__}: {e}",
+            "fleet_skipped": True,
+            "serve_replica_cold_start_s_skipped": True,
+            "serve_replica_promote_s_skipped": True,
+            "serve_replica_promote_speedup_skipped": True,
+        }
+        try:
+            import ray_tpu
+            from ray_tpu import serve
+
+            serve.shutdown()
+            ray_tpu.shutdown()
+        except Exception:
+            pass
     extra_speculative: dict = {}
     try:
         from ray_tpu._speculative_bench import run_speculative_bench
@@ -1010,6 +1035,7 @@ def main() -> None:
         **extra_overload,
         **extra_train_loop,
         **extra_tenancy,
+        **extra_fleet,
         **extra_speculative,
         # Last: the migration bench's 2k-cell cold TTFT supersedes the
         # serve bench's ~1.6k-prompt cold cell under the same key, so

@@ -1,0 +1,253 @@
+"""Always-warm fleet bench: standby promotion vs cold start, weight
+broadcast parity, and goodput through a traffic step.
+
+ISSUE 19 acceptance cells, runnable standalone (``python -m ray_tpu.cli
+bench fleet``) or inside ``bench.py``:
+
+  * ``serve_replica_cold_start_s`` — full replica cold start: weight
+    init + engine construction + the first token (prefill/decode
+    compile included), the price the SLO pays without a warm pool.
+  * ``serve_replica_promote_s`` — standby promotion on the SAME engine:
+    weights restored host→device onto a warm compile cache, then the
+    first token. ``serve_replica_promote_speedup`` = cold / promote,
+    targeting ≥ 10×.
+  * ``fleet_broadcast_parity`` — 1.0 iff TWO concurrent readers of one
+    ``WeightBroadcastSource`` stream both reconstruct a pytree whose
+    content fingerprint is byte-identical to the donor's (the fan-out
+    weight-delivery path vs direct load).
+  * ``fleet_goodput_frac_step`` — fraction of requests completing with
+    a 200 inside the latency budget while offered load STEPS to 10× the
+    measured solo rate against a 1-running + 1-standby deployment; the
+    step is what the predictive/standby machinery exists to absorb.
+
+CPU-sandbox honest: debug presets, byte tokenizer, no wall-clock SLO
+claims — the promote speedup compares two timings on the same machine
+and the parity/goodput cells are scale-free. Set
+``RAY_TPU_BENCH_SKIP_FLEET=1`` to leave ``*_skipped`` markers that
+``bench_check`` honors.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+SKIP_MARKERS = {
+    "fleet_skipped": True,
+    "serve_replica_cold_start_s_skipped": True,
+    "serve_replica_promote_s_skipped": True,
+    "serve_replica_promote_speedup_skipped": True,
+}
+
+
+def _pct(sorted_vals: list[float], q: float) -> float:
+    return sorted_vals[max(0, int(len(sorted_vals) * q) - 1)]
+
+
+def _engine_cells(out: dict) -> None:
+    """Cold start vs standby promotion, plus broadcast parity — straight
+    off the engine so the comparison isolates what the fleet changes:
+    where the weights come from and whether the compile cache is warm."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.llm.engine import InferenceEngine, Request
+    from ray_tpu.llm.weights import (WeightBroadcastSource,
+                                     params_fingerprint,
+                                     receive_weight_stream)
+    from ray_tpu.models.llama import PRESETS, init_params
+
+    cfg = dataclasses.replace(PRESETS["debug"], dtype=jnp.float32)
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+
+    def first_token(eng) -> None:
+        r = Request(f"warm{eng.metrics['weights_promoted']}-{time.time_ns()}",
+                    list(prompt), max_new_tokens=1)
+        eng.add_request(r)
+        while not r.done:
+            eng.step()
+
+    # ---- cold start: everything a fresh replica pays — weight init,
+    # engine construction, and the first token's XLA compiles.
+    t0 = time.perf_counter()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(cfg, params, max_slots=2, max_len=64,
+                          enable_prefix_cache=False)
+    first_token(eng)
+    cold_s = time.perf_counter() - t0
+    out["serve_replica_cold_start_s"] = round(cold_s, 4)
+
+    # ---- standby promotion: same engine demoted to host RAM (compile
+    # cache stays warm), then promoted and serving its first token.
+    assert eng.demote_weights_to_host()["ok"]
+    t0 = time.perf_counter()
+    assert eng.promote_weights_from_host()["ok"]
+    first_token(eng)
+    promote_s = time.perf_counter() - t0
+    out["serve_replica_promote_s"] = round(promote_s, 4)
+    out["serve_replica_promote_speedup"] = round(
+        cold_s / max(1e-9, promote_s), 2)
+
+    # ---- broadcast parity: two concurrent readers of one source must
+    # reconstruct the donor's exact bytes (content fingerprints equal).
+    want = params_fingerprint(eng.executor.params)
+    src = WeightBroadcastSource(eng.executor.params, model="fleet-bench",
+                                n_readers=2)
+    results: list[dict | None] = [None, None]
+
+    def read(i: int) -> None:
+        results[i] = receive_weight_stream(src.address, timeout_s=60.0)
+
+    threads = [threading.Thread(target=read, args=(i,), daemon=True)
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    src.join(timeout=10)
+    ok = all(r is not None and r["complete"] and r["fingerprint"] == want
+             and params_fingerprint(r["params"]) == want for r in results)
+    out["fleet_broadcast_parity"] = 1.0 if ok else 0.0
+    out["fleet_broadcast_bytes_cfg"] = results[0]["bytes"] if results[0] else 0
+
+
+def _one_request(addr: str, route: str, prompt: str, max_tokens: int,
+                 client_timeout: float) -> dict:
+    """One streaming completion; returns {"status", "wall_s"}."""
+    body = {"prompt": prompt, "max_tokens": max_tokens, "stream": True}
+    req = urllib.request.Request(addr + route + "/v1/completions",
+                                 data=json.dumps(body).encode(),
+                                 headers={"Content-Type": "application/json"})
+    t0 = time.perf_counter()
+    out = {"status": "200", "wall_s": None}
+    try:
+        with urllib.request.urlopen(req, timeout=client_timeout) as resp:
+            for _ in resp:
+                pass
+    except urllib.error.HTTPError as e:
+        out["status"] = str(e.code)
+        try:
+            e.read()
+        except Exception:
+            pass
+    except Exception as e:
+        out["status"] = type(e).__name__
+    out["wall_s"] = time.perf_counter() - t0
+    return out
+
+
+def _step_cells(out: dict, step_s: float) -> None:
+    """Goodput through a 10× offered-rate step against a deployment kept
+    at 1 running + 1 standby replica: the autoscaler's breach promotes
+    the standby (one host→device transfer) instead of paying a cold
+    start mid-step."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm import build_llm_app
+
+    max_tokens = 8
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    serve.run(
+        build_llm_app(
+            "debug-128", max_slots=4, max_len=128, page_size=16,
+            prefill_chunk_size=64, num_replicas=1,
+            max_ongoing_requests=4, max_queued_requests=16,
+            autoscaling_config={
+                "min_replicas": 1, "max_replicas": 2,
+                "mode": "latency_slo", "target_ttft_ms": 400.0,
+                "latency_window_s": 5.0, "breach_cycles": 1,
+                "upscale_delay_s": 0.0, "downscale_delay_s": 3600.0,
+                "standby_replicas": 1, "predictive": True,
+                "predictive_horizon_s": 5.0,
+            }),
+        name="fleet", route_prefix="/fleet", timeout_s=360.0)
+    addr = serve.http_address()
+    route = "/fleet"
+    try:
+        def prompt_for(tag: str, i: int) -> str:
+            return f"req {tag}-{i}: " + "abcdefgh" * (4 + i % 3)
+
+        # Wait for the standby pool to warm (the controller starts the
+        # extra replica and demotes it once RUNNING).
+        warm_deadline = time.time() + 180.0
+        standby_warm = False
+        def dep_status() -> dict:
+            return next(iter((serve.status().get("fleet") or {}).values()),
+                        None) or {}
+
+        while time.time() < warm_deadline:
+            if dep_status().get("standby_replicas", 0) >= 1:
+                standby_warm = True
+                break
+            time.sleep(0.5)
+        out["fleet_standby_warm_cfg"] = bool(standby_warm)
+
+        # Solo phase: closed-loop trickle to measure this machine's
+        # single-replica service rate (and warm the XLA cache).
+        solo = [_one_request(addr, route, prompt_for("solo", i),
+                             max_tokens, 120.0) for i in range(8)]
+        solo_walls = sorted(r["wall_s"] for r in solo
+                            if r["status"] == "200")
+        if not solo_walls:
+            raise RuntimeError("solo phase served 0 requests")
+        solo_rps = 1.0 / max(1e-3, sum(solo_walls) / len(solo_walls))
+        budget_s = 6.0 * _pct(solo_walls, 0.5) + 2.0
+
+        # Step phase: offered rate jumps to 10× the solo service rate,
+        # open-loop paced so slow responses can't throttle the offer.
+        offered_rps = 10.0 * solo_rps
+        n_offered = min(48, max(12, int(offered_rps * step_s)))
+        results: list[dict | None] = [None] * n_offered
+        t0 = time.perf_counter()
+
+        def fire(i: int) -> None:
+            delay = t0 + i / offered_rps - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            results[i] = _one_request(addr, route, prompt_for("step", i),
+                                      max_tokens, 120.0)
+
+        threads = [threading.Thread(target=fire, args=(i,), daemon=True)
+                   for i in range(n_offered)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        done = [r for r in results if r is not None]
+        good = sum(1 for r in done if r["status"] == "200"
+                   and r["wall_s"] is not None and r["wall_s"] <= budget_s)
+        out["fleet_goodput_frac_step"] = round(good / max(1, len(done)), 4)
+        out["fleet_step_offered_cfg"] = n_offered
+        dep = dep_status()
+        promote = dep.get("last_promote") or {}
+        out["fleet_step_promote_path_cfg"] = promote.get("path") or ""
+        out["fleet_step_running_cfg"] = dep.get("running_replicas")
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+
+
+def run_fleet_bench(step_s: float | None = None) -> dict:
+    if os.environ.get("RAY_TPU_BENCH_SKIP_FLEET") == "1":
+        return dict(SKIP_MARKERS)
+    step_s = step_s or float(os.environ.get("RAY_TPU_FLEET_STEP_S", "6"))
+    out: dict = {}
+    _engine_cells(out)
+    _step_cells(out, step_s)
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(run_fleet_bench()))

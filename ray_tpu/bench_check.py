@@ -80,6 +80,16 @@ _POINTWISE_RATE_SUFFIX = ("_hit_rate", "_accept_rate", "_frac", "_parity")
 # hazard) — but a relative compare would still flag a 0.0002-point CPU
 # wiggle as a regression; points are the right scale.
 _POINTWISE_RATE_SUBSTR = ("mfu", "goodput_frac")
+# Round-19 shadow audit (fleet bench): ``serve_replica_promote_s`` /
+# ``serve_replica_cold_start_s`` end in a bare "_s" → lower-better, the
+# right call (promotion getting slower IS the regression the always-warm
+# pool exists to prevent). ``fleet_broadcast_parity`` rides the
+# "_parity" pointwise suffix (1.0-or-broken), ``fleet_goodput_frac_step``
+# the "goodput_frac" substring (pointwise — a CPU-sandbox 0.05 wiggle
+# must not read as a relative collapse), and
+# ``serve_replica_promote_speedup`` falls through to the default
+# higher-better. ``fleet_skipped``/per-cell ``*_skipped`` markers flow
+# through _skip_prefixes like every other suite's.
 # Pointwise cells that regress UP: still compared in points on the 0-1
 # scale, but LOWER is better. Round-18 audit: before this table,
 # ``loop_obs_overhead_frac`` (stall-recorder cost as a fraction of tick

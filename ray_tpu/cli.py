@@ -149,6 +149,20 @@ def _cmd_bench(args) -> int:
             and result.get("tenant_goodput_frac_cold") is not None
         ) or bool(result.get("tenant_mixed_batch_parity_skipped"))
         prefixes = ("tenant_", "adapter_")
+    elif args.bench_cmd == "fleet":
+        from ray_tpu._fleet_bench import run_fleet_bench
+
+        result = run_fleet_bench(step_s=args.step)
+        # Acceptance (ISSUE 19): standby promotion ≥ 10× faster than a
+        # cold replica start, the fan-out weight broadcast is
+        # byte-identical to direct load, and goodput through the 10×
+        # offered-rate step is recorded.
+        ok = bool(
+            result.get("serve_replica_promote_speedup", 0.0) >= 10.0
+            and result.get("fleet_broadcast_parity", 0.0) == 1.0
+            and result.get("fleet_goodput_frac_step") is not None
+        ) or bool(result.get("fleet_skipped"))
+        prefixes = ("fleet_", "serve_replica_")
     elif args.bench_cmd == "core" and getattr(args, "scale", False):
         import os
 
@@ -400,6 +414,21 @@ def main(argv: list[str] | None = None) -> int:
     bten.add_argument("--check-against", default=None, metavar="BENCH_JSON",
                       help="run ray_tpu.bench_check against a recorded "
                            "BENCH_r*.json and exit non-zero on regression")
+    bfleet = bench_sub.add_parser(
+        "fleet", help="always-warm fleet cells: standby promotion vs "
+                      "cold replica start (serve_replica_promote_s, "
+                      "speedup must be ≥ 10x), fan-out weight-broadcast "
+                      "byte parity (fleet_broadcast_parity must be 1.0), "
+                      "and goodput through a 10x offered-rate step "
+                      "against a 1-running + 1-standby deployment; "
+                      "*_skipped markers via RAY_TPU_BENCH_SKIP_FLEET=1")
+    bfleet.add_argument("--step", type=float, default=None,
+                        help="traffic-step seconds (default "
+                             "$RAY_TPU_FLEET_STEP_S or 6)")
+    bfleet.add_argument("--check-against", default=None,
+                        metavar="BENCH_JSON",
+                        help="run ray_tpu.bench_check against a recorded "
+                             "BENCH_r*.json and exit non-zero on regression")
     serve_p = sub.add_parser(
         "serve", help="Serve control-plane inspection")
     serve_sub = serve_p.add_subparsers(dest="serve_cmd", required=True)
@@ -652,6 +681,24 @@ def main(argv: list[str] | None = None) -> int:
                     parts.append(f"circuit[{rid}]={cst}")
                 if parts:
                     print("  overload: " + " ".join(parts))
+                # Always-warm fleet: standby pool, scale-to-zero park,
+                # and the last standby promotion with its path/timing.
+                if st.get("standby_replicas") or st.get("scaled_to_zero") \
+                        or st.get("last_promote"):
+                    fparts = [f"standby={st.get('standby_replicas', 0)}"]
+                    if st.get("scaled_to_zero"):
+                        fparts.append("scaled_to_zero")
+                    fl = st.get("fleet") or {}
+                    if fl.get("idle_s") is not None:
+                        fparts.append(f"idle_s={round(fl['idle_s'], 1)}")
+                    if fl.get("host_resident"):
+                        fparts.append(f"host_resident={fl['host_resident']}")
+                    lp = st.get("last_promote") or {}
+                    if lp:
+                        fparts.append(
+                            f"last_promote={lp.get('path')}"
+                            f"/{round(float(lp.get('seconds') or 0), 3)}s")
+                    print("  fleet: " + " ".join(fparts))
                 ten = dict(st.get("tenancy") or {})
                 resident = ten.get("resident_adapters") or []
                 if resident or ten.get("adapter_defers"):

@@ -554,6 +554,12 @@ class InferenceEngine:
                         # plain decode), independent of batch size.
                         "spec_slot_rounds": 0,
                         "spec_rollbacks": 0,
+                        # Weight residency (always-warm fleet): demotions
+                        # of the weight pytree to host RAM, promotions
+                        # back to device (device_put, not a reload), and
+                        # the last promotion's wall time.
+                        "weights_demoted": 0, "weights_promoted": 0,
+                        "weight_promote_ms": 0.0,
                         # Flight recorder: request timelines dumped as
                         # llm.request_timeline spans on SLO breach
                         # (deadline expiry, shed, TTFT-SLO breach) —
@@ -562,6 +568,12 @@ class InferenceEngine:
         # Last few breach dumps, for serve.status() "last-breach" rows
         # (the full event payload lives in the span store).
         self._breach_samples: deque[dict] = deque(maxlen=8)
+        # Weight residency (always-warm fleet): the host-RAM copy of the
+        # weight pytree while demoted. The lock serializes demote /
+        # promote against each other and against admission's lazy
+        # re-promotion.
+        self._host_params = None
+        self._residency_lock = threading.Lock()
 
     @staticmethod
     def total_pages(max_slots: int, max_len: int, page_size: int,
@@ -585,6 +597,10 @@ class InferenceEngine:
             from ..observability import tracing
 
             request.trace = tracing.current_wire()
+        # Scale-to-zero wake: the first request onto a demoted engine
+        # promotes the host-resident weights before it queues, so its
+        # TTFT carries the device_put, not a crash or a cold load.
+        self._ensure_weights_resident()
         with self._lock:
             if self.max_queued_requests and \
                     len(self._waiting) >= self.max_queued_requests:
@@ -797,6 +813,10 @@ class InferenceEngine:
 
         Returns emission events ``{"request_id", "token", "done",
         "finish_reason"}``."""
+        if self.has_work:
+            # Belt-and-braces for a demote racing admission: no dispatch
+            # ever runs against executor.params=None.
+            self._ensure_weights_resident()
         expired = self._expire_deadlines()
         if expired:
             return expired + self._step_scheduled()
@@ -1632,6 +1652,103 @@ class InferenceEngine:
                 "prefilling": len(self._prefilling),
                 "waiting": len(self._waiting),
             }
+
+    # ------------------------------------------------------- weight residency
+    @property
+    def supports_weight_residency(self) -> bool:
+        """Host-tier weight demotion (``llm/weights.py``): the executor
+        must own a ``params`` pytree it lets us swap (single-device
+        ``LocalEngineExecutor``; sharded/pp executors place their own)."""
+        return bool(getattr(self.executor, "supports_weight_residency",
+                            False)) and hasattr(self.executor, "params")
+
+    def weights_resident(self) -> bool:
+        """True while the weight pytree is on device (normal serving)."""
+        return getattr(self.executor, "params", None) is not None
+
+    def demote_weights_to_host(self) -> dict:
+        """Standby demotion: copy the weight pytree to host RAM and drop
+        the device reference, freeing HBM while the compile cache (and
+        the whole engine — pool, trie, adapters) stays warm. Refused
+        while any request is in flight — a demote mid-decode would pull
+        the weights out from under a dispatch."""
+        from . import weights as wlib
+
+        with self._residency_lock:
+            if not self.supports_weight_residency:
+                return {"ok": False, "reason": "unsupported"}
+            if not self.weights_resident():
+                return {"ok": True, "already": True, "bytes": 0,
+                        "seconds": 0.0}
+            if self.has_work:
+                return {"ok": False, "reason": "busy"}
+            t0 = time.monotonic()
+            host = wlib.tree_to_host(self.executor.params)
+            self._host_params = host
+            self.executor.params = None  # device buffers free on GC
+            self.metrics["weights_demoted"] += 1
+            # Scale-to-zero reclaims the adapter stack too: no request
+            # is in flight, so every resident adapter is unpinned.
+            adapters = (self.lora_manager.unload_idle()
+                        if self.lora_manager is not None else 0)
+            return {"ok": True, "bytes": wlib.tree_bytes(host),
+                    "adapters_unloaded": adapters,
+                    "seconds": round(time.monotonic() - t0, 6)}
+
+    def promote_weights_from_host(self) -> dict:
+        """Standby promotion: ``device_put`` the host copy back. The
+        host copy is KEPT (weights are immutable under inference) so the
+        next demotion is a pointer drop, not another device pull."""
+        from . import weights as wlib
+
+        with self._residency_lock:
+            return self._promote_locked(wlib)
+
+    def _promote_locked(self, wlib) -> dict:
+        if self.weights_resident():
+            return {"ok": True, "already": True, "seconds": 0.0}
+        if self._host_params is None:
+            return {"ok": False, "reason": "no_host_copy"}
+        t0 = time.monotonic()
+        params = wlib.host_to_device(self._host_params)
+        try:
+            import jax
+
+            jax.block_until_ready(params)  # honest promote timing
+        except Exception:
+            pass
+        self.executor.params = params
+        dt = time.monotonic() - t0
+        self.metrics["weights_promoted"] += 1
+        self.metrics["weight_promote_ms"] = round(dt * 1000.0, 3)
+        return {"ok": True, "seconds": round(dt, 6)}
+
+    def install_weights(self, host_tree) -> dict:
+        """Adopt a weight pytree delivered over the broadcast wire
+        (``receive_weight_stream``): it becomes the host copy, then
+        promotes if the engine is currently demoted. A resident engine
+        only refreshes its host copy — live dispatches keep their
+        device tree until the next demote/promote cycle."""
+        from . import weights as wlib
+
+        with self._residency_lock:
+            if not self.supports_weight_residency:
+                return {"ok": False, "reason": "unsupported"}
+            self._host_params = wlib.tree_to_host(host_tree)
+            if self.weights_resident():
+                return {"ok": True, "resident": True, "seconds": 0.0}
+            return self._promote_locked(wlib)
+
+    def _ensure_weights_resident(self) -> None:
+        """First-request promotion: admission and the step loop call
+        this so a request that lands on a demoted (scale-to-zero'd)
+        engine pays one device_put, never a crash."""
+        if self.weights_resident() or self._host_params is None:
+            return
+        from . import weights as wlib
+
+        with self._residency_lock:
+            self._promote_locked(wlib)
 
     # ----------------------------------------------------------- KV migration
     @property

@@ -310,6 +310,13 @@ class LocalEngineExecutor:
             b *= 2
         return min(b, max_pages)
 
+    @property
+    def supports_weight_residency(self) -> bool:
+        """Host-tier weight demotion/promotion (``llm/weights.py``):
+        single-device executors only — mesh-sharded params own their
+        placement and a plain ``device_put`` would lose it."""
+        return self._replicated is None
+
     def install_adapter(self, slot: int, arrays: dict) -> None:
         """Write one adapter's padded A/B arrays into stack slot ``slot``
         (the ``LoRAManager``'s device hook). Arrays ride ``_put`` so a

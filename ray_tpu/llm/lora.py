@@ -139,6 +139,10 @@ class LoRAManager:
         self._pool = AdapterPool(
             capacity=serving.max_loras,
             max_resident=getattr(serving, "max_loaded_adapters", 0))
+        # Explicit eviction zeroes the device slot (one install of the
+        # identity adapter) so the HBM is reclaimed NOW, not whenever a
+        # future load happens to recycle the slot.
+        self._pool.on_evict = self._zero_slot
 
     def resolve_path(self, adapter_id: str) -> str:
         base = self._serving.dynamic_lora_loading_path
@@ -188,6 +192,29 @@ class LoRAManager:
         if slot == 0:
             return
         self._pool.unpin_slot(slot)
+
+    def _zero_slot(self, adapter_id: str, slot: int) -> None:
+        """Write the identity (all-zero) adapter over an evicted slot."""
+        c, r_max = self._config, self._serving.max_rank
+        L = c.n_layers
+        dims = {"wq": (c.hidden, c.n_heads * c.head_dim),
+                "wk": (c.hidden, c.n_kv_heads * c.head_dim),
+                "wv": (c.hidden, c.n_kv_heads * c.head_dim),
+                "wo": (c.n_heads * c.head_dim, c.hidden)}
+        zeros = {}
+        for p, (ein, eout) in dims.items():
+            zeros[f"{p}.A"] = np.zeros((L, ein, r_max), np.float32)
+            zeros[f"{p}.B"] = np.zeros((L, r_max, eout), np.float32)
+        self._install(slot, zeros)
+
+    def evict(self, adapter_id: str) -> bool:
+        """Explicitly unload one idle adapter (device slot zeroed)."""
+        return self._pool.evict(adapter_id) is not None
+
+    def unload_idle(self) -> int:
+        """Unload every adapter not pinned by an in-flight request —
+        the fleet scale-to-zero HBM reclaim. Returns adapters released."""
+        return len(self._pool.evict_idle())
 
     def resident(self) -> dict[str, int]:
         """adapter_id -> stack slot, LRU order (``serve.status()`` rows)."""

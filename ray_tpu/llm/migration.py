@@ -23,6 +23,11 @@ source death / timeout / reservation failure mid-stream leaves the
 importer holding a contiguous PREFIX of the chain — a prefix of a valid
 chain is itself a valid chain, so it registers what it has and the
 request cold-prefills only the rest.
+
+The same wire shape (meta → payload chunks → end/abort over a
+credit-based ``TcpLoopServer``) carries WEIGHT pytrees for the
+always-warm fleet: ``llm/weights.py`` is the weight-broadcast analogue
+of this module, with N promoting replicas as readers of one warm donor.
 """
 
 from __future__ import annotations

@@ -248,6 +248,11 @@ class LLMDeployment:
         # Spill-migration exporters opened FOR remote pullers (reaped as
         # their streams drain — see _track_spill_source).
         self._spill_sources: list = []
+        # Always-warm fleet: request-idle clock (scale-to-zero input)
+        # and the seed that reproduces this deployment's weights for the
+        # promotion ladder's last-resort cold re-init.
+        self._last_request_ts = time.time()
+        self._seed = seed
         self._running = True
         self._loop_thread = threading.Thread(target=self._engine_loop, daemon=True)
         self._loop_thread.start()
@@ -284,6 +289,9 @@ class LLMDeployment:
     def _next_rid(self) -> str:
         with self._lock:
             self._counter += 1
+            # Every request path mints an rid, so this is the one choke
+            # point the fleet idle clock needs.
+            self._last_request_ts = time.time()
             return f"req-{self._counter}-{uuid.uuid4().hex[:8]}"
 
     def _adapter_for(self, model: str | None) -> str | None:
@@ -869,6 +877,97 @@ class LLMDeployment:
     def pool_stats(self) -> dict:
         """Engine page-pool accounting (chaos invariant surface)."""
         return self.engine.pool_stats()
+
+    # ------------------------------------------------------ fleet lifecycle
+    def fleet_stats(self) -> dict:
+        """Per-replica fleet row, picked up by the replica actor's
+        ``latency_snapshot`` probe (``serve_fleet``) and folded by the
+        controller into the scale-to-zero / standby decisions: how long
+        since the last request landed here, and where the weights are."""
+        eng = self.engine
+        with self._lock:
+            last = self._last_request_ts
+        idle = 0.0 if eng.has_work else max(0.0, time.time() - last)
+        return {"idle_s": round(idle, 3),
+                "residency_capable": eng.supports_weight_residency,
+                "weights_on_host": not eng.weights_resident(),
+                "weights_demoted": eng.metrics.get("weights_demoted", 0),
+                "weights_promoted": eng.metrics.get("weights_promoted", 0),
+                "weight_promote_ms":
+                    eng.metrics.get("weight_promote_ms", 0.0)}
+
+    def fleet_demote(self) -> dict:
+        """Standby demotion: weights to host RAM + idle-adapter unload.
+        Refused (``ok=False, reason="busy"``) while requests are in
+        flight — the controller just retries next reconcile round."""
+        return self.engine.demote_weights_to_host()
+
+    def fleet_promote(self, weight_address: str | None = None) -> dict:
+        """Promotion ladder: broadcast stream (when the controller hands
+        us a donor's ``weight_address``) → host-RAM copy → deterministic
+        cold re-init. Each rung degrades to the next, so a donor dying
+        mid-stream costs the faster path, never the promotion."""
+        eng = self.engine
+        t0 = time.monotonic()
+        ladder = []
+        if weight_address and eng.supports_weight_residency:
+            from .weights import receive_weight_stream
+
+            res = receive_weight_stream(weight_address,
+                                        like=eng._host_params)
+            if res["params"] is not None:
+                out = eng.install_weights(res["params"])
+                if out.get("ok"):
+                    return {"ok": True, "path": "stream",
+                            "bytes": res["bytes"],
+                            "seconds": round(time.monotonic() - t0, 6)}
+            ladder.append(f"stream:{res['status']}")
+        out = eng.promote_weights_from_host()
+        if out.get("ok"):
+            path = "resident" if out.get("already") else "host"
+            return {"ok": True, "path": path, "ladder": ladder,
+                    "seconds": round(time.monotonic() - t0, 6)}
+        ladder.append(f"host:{out.get('reason', '?')}")
+        if eng.supports_weight_residency and not eng.weights_resident():
+            # Last resort: weights here come from the seeded init, so a
+            # cold re-init reproduces them bit-for-bit (the checkpoint
+            # re-load of a real deployment).
+            import jax
+
+            from ..models.llama import init_params
+
+            params = init_params(eng.config, jax.random.PRNGKey(self._seed))
+            out = eng.install_weights(params)
+            if out.get("ok"):
+                return {"ok": True, "path": "cold_init", "ladder": ladder,
+                        "seconds": round(time.monotonic() - t0, 6)}
+            ladder.append(f"cold_init:{out.get('reason', '?')}")
+        return {"ok": eng.weights_resident(), "path": "failed",
+                "ladder": ladder,
+                "seconds": round(time.monotonic() - t0, 6)}
+
+    def open_weight_stream(self, n_readers: int = 1,
+                           _die_after_chunks: int | None = None
+                           ) -> dict | None:
+        """Open a weight broadcast from this (warm or standby) replica:
+        N cold/standby replicas stream ONE read of the weights instead
+        of N independent loads. Rides the same source-reaping registry
+        as the KV spill exporters. Returns ``{"weight_address",
+        "fingerprint"}`` or None when there is nothing to serve."""
+        eng = self.engine
+        params = getattr(eng.executor, "params", None)
+        if params is None:
+            params = eng._host_params
+        if params is None or not eng.supports_weight_residency:
+            return None
+        from .weights import WeightBroadcastSource
+
+        src = WeightBroadcastSource(
+            params, model=self.model_id, n_readers=n_readers,
+            _die_after_chunks=_die_after_chunks)
+        self._track_spill_source(src)
+        return {"weight_address": src.address,
+                "fingerprint": src.fingerprint}
 
     # ---------------------------------------------------------- HTTP entry
     def __call__(self, request):

@@ -260,6 +260,13 @@ class AdapterPool:
         self._evictions = 0
         self._hits = 0
         self._load_ms_total = 0.0
+        self._device_unloads = 0
+        # Device release hook: ``on_evict(adapter_id, slot)`` fires when
+        # an EXPLICIT eviction returns a slot to the free list, so the
+        # owner zeroes the stack slot and the HBM is actually reclaimed
+        # (LRU replacement inside ``begin_load`` skips it — the incoming
+        # adapter's install overwrites the slot immediately anyway).
+        self.on_evict = None
 
     # -- residency -------------------------------------------------------
     def lookup(self, adapter_id: str) -> int | None:
@@ -348,6 +355,44 @@ class AdapterPool:
                     st.pins -= 1
                     return
 
+    # -- explicit eviction (idle-adapter device unload) -----------------
+    def evict(self, adapter_id: str) -> int | None:
+        """Evict one UNPINNED resident adapter and return its slot to
+        the free list — then fire ``on_evict`` (outside the lock) so the
+        device stack slot is zeroed, not left holding stale weights
+        until some future load recycles it. Returns the freed slot, or
+        None when the adapter is absent or pinned."""
+        with self._lock:
+            st = self._resident.get(adapter_id)
+            if st is None or st.pins > 0:
+                return None
+            self._order.remove(adapter_id)
+            del self._resident[adapter_id]
+            self._free.append(st.slot)
+            self._evictions += 1
+            self._device_unloads += 1
+            slot = st.slot
+        if self.on_evict is not None:
+            try:
+                self.on_evict(adapter_id, slot)
+            except Exception:
+                pass
+        return slot
+
+    def evict_idle(self) -> list[tuple[str, int]]:
+        """Evict EVERY unpinned resident adapter (fleet scale-to-zero:
+        an idle replica hands its whole adapter stack's HBM back).
+        Returns the ``(adapter_id, slot)`` pairs released."""
+        with self._lock:
+            victims = [aid for aid in list(self._order)
+                       if self._resident[aid].pins == 0]
+        out = []
+        for aid in victims:
+            slot = self.evict(aid)
+            if slot is not None:
+                out.append((aid, slot))
+        return out
+
     # -- introspection ---------------------------------------------------
     def resident(self) -> dict[str, int]:
         """adapter_id -> slot, LRU order (oldest first)."""
@@ -369,6 +414,11 @@ class AdapterPool:
                 "hits": self._hits,
                 "loads": self._loads,
                 "evictions": self._evictions,
+                # HBM-slot accounting: slots genuinely free (zeroed or
+                # never used) vs merely recyclable, and how many
+                # evictions actually released device memory.
+                "free_slots": len(self._free),
+                "device_unloads": self._device_unloads,
                 "avg_load_ms": (self._load_ms_total / self._loads
                                 if self._loads else 0.0),
             }

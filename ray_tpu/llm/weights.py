@@ -1,0 +1,297 @@
+"""Weight residency and delivery: the host-RAM weight tier plus a
+chunked weight-broadcast wire.
+
+The fleet subsystem (``serve/fleet.py``) treats replica capacity as a
+warm resource, and that only works if the expensive part of a replica —
+its weight pytree — can (a) step off the device without being thrown
+away and (b) travel to cold replicas without N independent checkpoint
+loads. This module provides both halves:
+
+* **Host tier**: :func:`tree_to_host` / :func:`host_to_device` move a
+  params pytree between HBM and host RAM, generalizing the PR 11
+  host-KV spill tier from pages to weights. A demoted (standby) replica
+  keeps its host copy + compile cache; promotion is one ``device_put``
+  sweep, not a checkpoint load + compile.
+
+* **Broadcast wire**: :class:`WeightBroadcastSource` streams a params
+  pytree over the same credit-bounded ``TcpLoopServer`` the KV
+  migration path uses (``llm/migration.py`` — pickled kind-tagged
+  chunks, close-after-drain, chaos hook), but with ``n_readers=N`` so N
+  cold replicas consume ONE read of the weights. ``_min_acked`` counts
+  unconnected readers as cursor 0, so the writer's window throttles to
+  the slowest/late-joining reader — true broadcast backpressure.
+
+Wire protocol (pickled dicts, exactly-once, in order):
+
+    {"kind": "meta",  "model", "n_leaves", "total_bytes",
+                      "treedef": bytes|None, "fingerprint"}
+    {"kind": "chunk", "leaf", "dtype", "shape", "offset", "data"}
+    {"kind": "end",   "fingerprint"}                 # complete
+    {"kind": "abort"}                                # source failed
+
+Failure is graceful by construction: a receiver that loses the stream
+mid-flight (source death, timeout, bad digest) returns ``params=None``
+with a status string, and the caller falls back to its host copy or a
+direct load — promotion never wedges on a dead broadcaster.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+import threading
+import time
+
+import numpy as np
+
+from ..dag.channel import ChannelClosed, TcpLoopReader, TcpLoopServer
+
+# One chunk per write keeps the credit window meaningful: 4 MiB chunks x
+# 8 slots bounds writer-ahead memory at ~32 MiB regardless of model size.
+DEFAULT_CHUNK_BYTES = 4 << 20
+
+
+def _config():
+    from ..core.config import get_config
+
+    return get_config()
+
+
+def _tree_lib():
+    import jax
+
+    return jax.tree_util
+
+
+# --------------------------------------------------------------- host tier
+def tree_to_host(params):
+    """Copy every leaf of ``params`` to a host ``np.ndarray`` (the
+    standby residency form). Device buffers are NOT freed here — drop
+    the device reference after this returns to release HBM."""
+    tu = _tree_lib()
+    return tu.tree_map(lambda x: np.asarray(x), params)
+
+
+def host_to_device(host_tree, put=None):
+    """Promote a host tree back to device arrays. ``put`` defaults to
+    ``jax.device_put`` (replicated single-device form — the executor's
+    own ``_put`` handles sharded layouts)."""
+    if put is None:
+        import jax
+
+        put = jax.device_put
+    tu = _tree_lib()
+    return tu.tree_map(put, host_tree)
+
+
+def tree_bytes(params) -> int:
+    tu = _tree_lib()
+    return sum(int(np.asarray(l).nbytes) for l in tu.tree_leaves(params))
+
+
+def params_fingerprint(params) -> str:
+    """Order-stable content digest of a params pytree: dtype, shape and
+    raw bytes of every leaf in flatten order. Byte-parity between a
+    broadcast-received tree and a direct load means equal fingerprints."""
+    tu = _tree_lib()
+    h = hashlib.sha256()
+    for leaf in tu.tree_leaves(params):
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        h.update(str(arr.dtype).encode())
+        h.update(repr(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------- broadcast wire
+class WeightBroadcastSource:
+    """Warm-side exporter: snapshots ``params`` to host in the caller's
+    thread (so the source stays valid even if the donor replica demotes
+    or mutates afterwards), then streams it chunk-by-chunk from a
+    background thread to ``n_readers`` consumers.
+
+    Mirrors :class:`~ray_tpu.llm.migration.KVMigrationSource`: same
+    channel, same close-after-drain, same ``_die_after_chunks`` chaos
+    hook so tests can kill the wire exactly as a dead donor would."""
+
+    def __init__(self, params, model: str = "", n_readers: int = 1,
+                 chunk_bytes: int | None = None,
+                 advertise: str | None = None,
+                 _die_after_chunks: int | None = None):
+        tu = _tree_lib()
+        leaves, treedef = tu.tree_flatten(params)
+        self._leaves = [np.ascontiguousarray(np.asarray(l)) for l in leaves]
+        try:
+            self._treedef_blob = pickle.dumps(
+                treedef, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception:
+            # Unpicklable structure: receivers must supply ``like=``.
+            self._treedef_blob = None
+        self.model = model
+        self.fingerprint = params_fingerprint(self._leaves)
+        self.chunk_bytes = max(64 << 10, chunk_bytes or DEFAULT_CHUNK_BYTES)
+        self._server = TcpLoopServer(n_slots=8, n_readers=max(1, n_readers),
+                                     advertise=advertise)
+        self._die_after = _die_after_chunks
+        self._killed = False
+        self.stats = {"leaves": len(self._leaves), "bytes": 0, "chunks": 0,
+                      "total_bytes": sum(l.nbytes for l in self._leaves)}
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="weight-broadcast-src")
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return self._server.address
+
+    def _send(self, msg: dict) -> None:
+        blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        self._server.write(blob, timeout=_config().kv_migration_timeout_s)
+        self.stats["bytes"] += len(blob)
+
+    def _run(self) -> None:
+        try:
+            self._send({"kind": "meta", "model": self.model,
+                        "n_leaves": len(self._leaves),
+                        "total_bytes": self.stats["total_bytes"],
+                        "treedef": self._treedef_blob,
+                        "fingerprint": self.fingerprint})
+            for i, leaf in enumerate(self._leaves):
+                raw = leaf.tobytes()
+                off = 0
+                # Zero-size leaves still need one chunk so the receiver
+                # materializes them.
+                while off < len(raw) or off == 0:
+                    data = raw[off:off + self.chunk_bytes]
+                    self._send({"kind": "chunk", "leaf": i,
+                                "dtype": str(leaf.dtype),
+                                "shape": tuple(leaf.shape),
+                                "offset": off, "data": data})
+                    off += max(1, len(data))
+                    self.stats["chunks"] += 1
+                    if self._die_after is not None \
+                            and self.stats["chunks"] >= self._die_after:
+                        self._killed = True
+                        self._server.close()  # simulated donor death
+                        return
+                    if off >= len(raw):
+                        break
+            self._send({"kind": "end", "fingerprint": self.fingerprint})
+        except Exception:
+            try:
+                self._send({"kind": "abort"})
+            except Exception:
+                pass
+        finally:
+            try:
+                # Close-after-drain: queued chunks (and the end marker)
+                # still reach every reader, then they see ChannelClosed.
+                self._server.close_writer(timeout=5.0)
+            except Exception:
+                pass
+
+    def join(self, timeout: float | None = 60.0) -> None:
+        self._thread.join(timeout)
+
+    def close(self) -> None:
+        self._thread.join(timeout=5.0)
+        try:
+            self._server.close()
+        except Exception:
+            pass
+
+
+def receive_weight_stream(address: str, like=None,
+                          timeout_s: float | None = None,
+                          connect_timeout: float = 10.0) -> dict:
+    """Cold-side importer: pull one weight broadcast into host arrays
+    and rebuild the pytree (from the wire's pickled treedef, or from
+    ``like``'s structure when the wire carries none).
+
+    Degrades, never fails: any wire error, an incomplete leaf set, or a
+    digest mismatch returns ``params=None`` with a ``status`` string so
+    the caller falls back to its own load path. Returns
+    ``{"params", "bytes", "leaves", "seconds", "complete", "status",
+    "fingerprint", "model"}``."""
+    t0 = time.monotonic()
+    out = {"params": None, "bytes": 0, "leaves": 0, "seconds": 0.0,
+           "complete": False, "status": "ok", "fingerprint": "",
+           "model": ""}
+    if timeout_s is None:
+        timeout_s = _config().kv_migration_timeout_s
+    n_leaves = 0
+    treedef_blob = None
+    claimed = ""
+    bufs: dict[int, dict] = {}
+    reader = None
+    try:
+        reader = TcpLoopReader(address, connect_timeout=connect_timeout)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            blob = reader.read(timeout=max(0.1, deadline - time.monotonic()))
+            out["bytes"] += len(blob)
+            msg = pickle.loads(blob)
+            kind = msg.get("kind")
+            if kind == "meta":
+                n_leaves = int(msg.get("n_leaves", 0))
+                treedef_blob = msg.get("treedef")
+                claimed = msg.get("fingerprint") or ""
+                out["model"] = msg.get("model") or ""
+            elif kind == "chunk":
+                ent = bufs.setdefault(int(msg["leaf"]), {
+                    "dtype": msg["dtype"], "shape": msg["shape"],
+                    "data": bytearray()})
+                # In-order wire: offsets only ever append.
+                ent["data"] += msg["data"]
+            elif kind == "end":
+                out["complete"] = True
+                claimed = msg.get("fingerprint") or claimed
+                break
+            elif kind == "abort":
+                out["status"] = "aborted"
+                break
+    except (ChannelClosed, TimeoutError, ConnectionError, OSError,
+            EOFError, pickle.UnpicklingError) as e:
+        out["status"] = type(e).__name__
+    finally:
+        if reader is not None:
+            reader.close()
+    out["leaves"] = len(bufs)
+    if not out["complete"] or len(bufs) != n_leaves or n_leaves == 0:
+        if out["status"] == "ok":
+            out["status"] = "incomplete"
+        out["seconds"] = round(time.monotonic() - t0, 6)
+        return out
+    leaves = []
+    for i in range(n_leaves):
+        ent = bufs[i]
+        arr = np.frombuffer(bytes(ent["data"]), dtype=np.dtype(ent["dtype"]))
+        leaves.append(arr.reshape(ent["shape"]))
+    digest = params_fingerprint(leaves)
+    out["fingerprint"] = digest
+    if claimed and digest != claimed:
+        out["status"] = "digest_mismatch"
+        out["complete"] = False
+        out["seconds"] = round(time.monotonic() - t0, 6)
+        return out
+    tu = _tree_lib()
+    treedef = None
+    if treedef_blob:
+        try:
+            treedef = pickle.loads(treedef_blob)
+        except Exception:
+            treedef = None
+    if treedef is None and like is not None:
+        treedef = tu.tree_structure(like)
+    if treedef is None:
+        out["status"] = "no_structure"
+        out["seconds"] = round(time.monotonic() - t0, 6)
+        return out
+    try:
+        out["params"] = tu.tree_unflatten(treedef, leaves)
+    except Exception:
+        out["status"] = "structure_mismatch"
+        out["seconds"] = round(time.monotonic() - t0, 6)
+        return out
+    out["seconds"] = round(time.monotonic() - t0, 6)
+    return out

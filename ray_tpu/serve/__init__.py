@@ -15,6 +15,7 @@ from .api import (
     shutdown,
     start,
     status,
+    update_tenancy_config,
 )
 from .batching import batch
 from .config_api import build_app_from_spec, deploy_config, serve_status
@@ -60,4 +61,5 @@ __all__ = [
     "shutdown",
     "start",
     "status",
+    "update_tenancy_config",
 ]

@@ -97,6 +97,11 @@ def _deployment_config(app: Application, app_name: str) -> dict:
                 "slo_quantile": auto.slo_quantile,
                 "downscale_headroom": auto.downscale_headroom,
                 "breach_cycles": auto.breach_cycles,
+                "standby_replicas": auto.standby_replicas,
+                "scale_to_zero_idle_s": auto.scale_to_zero_idle_s,
+                "scheduled_capacity": auto.scheduled_capacity,
+                "predictive": auto.predictive,
+                "predictive_horizon_s": auto.predictive_horizon_s,
             }
             if auto
             else None
@@ -174,6 +179,20 @@ def status() -> dict:
     except Exception:
         pass
     return out
+
+
+def update_tenancy_config(tenancy_config: dict, *, app_name: str = "default",
+                          deployment_name: str | None = None) -> dict:
+    """Live-reconfigure a deployment's tenant WFQ weights/quotas without
+    a redeploy: the controller swaps the stored ``tenancy_config`` and
+    re-publishes the folded weights long-poll key, so every router picks
+    the change up on its next poll (PR 16 residue c). Returns the
+    controller's ``{"updated": [deployment names]}`` summary."""
+    controller = ray.get_actor(CONTROLLER_NAME)
+    return ray.get(
+        controller.update_tenancy_config.remote(
+            app_name, deployment_name, tenancy_config),
+        timeout=30)
 
 
 def delete(name: str) -> None:

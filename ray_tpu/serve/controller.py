@@ -22,6 +22,7 @@ import cloudpickle
 
 from ..core import api as ray
 from ..chaos import clock as chaos_clock
+from . import fleet as fleet_policy
 from .long_poll import LongPollHost
 
 logger = logging.getLogger(__name__)
@@ -30,6 +31,10 @@ logger = logging.getLogger(__name__)
 STARTING = "STARTING"
 RUNNING = "RUNNING"
 STOPPING = "STOPPING"
+# Always-warm fleet (serve/fleet.py): replica alive with weights in host
+# RAM and the compile cache warm — excluded from routing (the table only
+# carries RUNNING), promoted back via one fleet_promote RPC.
+STANDBY = "STANDBY"
 
 CHECKPOINT_KEY = "serve:controller:checkpoint"
 
@@ -54,6 +59,10 @@ class _Replica:
         # mode the snapshot is pulled at a relaxed cadence — residency
         # doesn't need the every-round freshness autoscaling does.
         self.last_latency_probe = 0.0
+        # Set when a fleet_demote reported "unsupported" (plain callable
+        # or sharded executor): the replica stays RUNNING and the
+        # standby machinery stops retrying it.
+        self.fleet_unsupported = False
 
 
 class _DeploymentState:
@@ -99,6 +108,21 @@ class _DeploymentState:
         # adapters) — surfaced in status and fed to the latency-SLO
         # autoscaler so one noisy tenant's breach triggers scaling.
         self.tenancy: dict = {}
+        # Always-warm fleet: folded ``serve_fleet`` probe rows (fleet
+        # idle age + weight residency), the scale-to-zero latch, the
+        # router-signalled first-request wake, the last standby
+        # promotion (timing surfaces in status / `cli serve status`),
+        # and the TTFT trend samples predictive upscale extrapolates.
+        self.fleet: dict = {}
+        self.scaled_to_zero = False
+        self.wake_pending = False
+        self.last_promote: dict | None = None
+        self.ttft_trend: list[tuple[float, float]] = []
+        # Wall time of the last wake/scheduled un-zero: replicas keep
+        # reporting their pre-wake idle age until the first request
+        # lands, so scale-to-zero holds off for a grace window after a
+        # wake or the pool would re-latch before serving anything.
+        self.last_wake = 0.0
 
     @property
     def name(self) -> str:
@@ -187,15 +211,25 @@ class ServeController:
             out = {}
             for name, state in app.items():
                 running = [r for r in state.replicas if r.state == RUNNING and r.version == state.version]
+                standby = [r for r in state.replicas
+                           if r.state == STANDBY and r.version == state.version]
                 auto = state.config.get("autoscaling") or {}
                 out[name] = {
                     "target_replicas": state.target_replicas,
                     "running_replicas": len(running),
+                    "standby_replicas": len(standby),
                     "version": state.version,
                     # Disaggregated pool membership ("prefill"/"decode",
                     # None for unified deployments).
                     "pool": state.config.get("pool"),
-                    "healthy": len(running) >= state.target_replicas,
+                    # A deployment parked at zero with a warm standby
+                    # pool is healthy by design, not degraded.
+                    "healthy": (len(running) >= state.target_replicas
+                                or (state.scaled_to_zero and bool(standby))),
+                    "scaled_to_zero": state.scaled_to_zero,
+                    "fleet": dict(state.fleet),
+                    "last_promote": (dict(state.last_promote)
+                                     if state.last_promote else None),
                     "deleted": bool(state.config.get("deleted")),
                     "last_start_failure": state.last_start_failure,
                     "autoscaling_mode": auto.get("mode") if auto else None,
@@ -206,6 +240,39 @@ class ServeController:
                     "tenancy": dict(state.tenancy),
                 }
             return out
+
+    def wake_deployment(self, app_name: str, name: str | None = None) -> bool:
+        """First-request wake: routers call this (fire-and-forget) when a
+        request lands on an empty replica table. The next reconcile
+        round clears scale-to-zero and promotes standbys."""
+        woke = False
+        with self._lock:
+            for dname, state in (self._apps.get(app_name) or {}).items():
+                if name is not None and dname != name:
+                    continue
+                state.wake_pending = True
+                woke = True
+        return woke
+
+    def update_tenancy_config(self, app_name: str, name: str | None,
+                              tenancy_config: dict) -> dict:
+        """Live tenant reconfigure: swap a deployment's tenancy config
+        (WFQ weights / quotas) and re-publish the folded weights on the
+        ``tenancy::`` long-poll key — routers pick the new shares up
+        mid-run, no redeploy, no replica restart."""
+        updated = []
+        with self._lock:
+            for dname, state in (self._apps.get(app_name) or {}).items():
+                if name is not None and dname != name:
+                    continue
+                kwargs = dict(state.config.get("init_kwargs") or {})
+                kwargs["tenancy_config"] = tenancy_config
+                state.config["init_kwargs"] = kwargs
+                self._push_tenancy(state)
+                updated.append(dname)
+        if updated:
+            self._checkpoint()
+        return {"updated": updated}
 
     def list_deployments(self) -> dict:
         with self._lock:
@@ -318,7 +385,10 @@ class ServeController:
                     # here embeds the creation task's traceback): the
                     # "failed to start" log line must name the cause.
                     p["failure"] = f"{type(e).__name__}: {e}"
-            elif r.state == RUNNING:
+            elif r.state in (RUNNING, STANDBY):
+                # STANDBY replicas ride the same probe path: liveness,
+                # reconfigure, and the fleet/latency snapshot all still
+                # apply — only routing excludes them.
                 if not r.node_id:
                     # Resolve placement from the GCS actor table (never
                     # from the replica: a preempted node may not answer).
@@ -364,6 +434,8 @@ class ServeController:
 
         # ---- decision phase: mutate under the lock, RPC-free.
         to_kill: list[_Replica] = []
+        to_promote: list[_Replica] = []
+        to_demote: list[_Replica] = []
         n_to_start = 0
         dirty = False
         with self._lock:
@@ -382,7 +454,9 @@ class ServeController:
             if corr and corr != self._pushed_corrections.get(ckey):
                 self._pushed_corrections[ckey] = corr
                 self._push_tenancy(state)
+            self._fold_fleet(state, probes)
             self._autoscale_from_probes(state, probes)
+            self._apply_fleet_policy(state)
             target = state.target_replicas
             for r in list(state.replicas):
                 p = probes.get(r.replica_id, {})
@@ -454,7 +528,7 @@ class ServeController:
                     # window. The STOPPING cleanup reaps it.
                     self._drain_replica(r)
                     dirty = True
-                elif r.state == RUNNING and not p.get("alive", True):
+                elif r.state in (RUNNING, STANDBY) and not p.get("alive", True):
                     logger.warning("replica %s died; removing", r.replica_id)
                     state.replicas.remove(r)
                     to_kill.append(r)
@@ -469,6 +543,15 @@ class ServeController:
             current = [r for r in state.replicas if r.state in (STARTING, RUNNING)]
             cur_version = [r for r in current if r.version == state.version]
             old_version = [r for r in current if r.version != state.version]
+            # Standby replicas of a superseded version (or of a deleted
+            # deployment) carry stale weights — drain them; the warm pool
+            # only ever serves the current version.
+            for r in list(state.replicas):
+                if r.state == STANDBY and (
+                        r.version != state.version
+                        or state.config.get("deleted")):
+                    self._drain_replica(r)
+                    dirty = True
             # rolling update: surge one new replica, then drain one old
             # (deployment_state.py rolling update with max surge 1)
             if old_version:
@@ -478,14 +561,48 @@ class ServeController:
                     self._drain_replica(old_version[0])
                     dirty = True
             else:
-                if len(cur_version) < target:
-                    n_to_start = target - len(cur_version)
-                elif len(cur_version) > target:
+                auto = state.config.get("autoscaling")
+                # Standby pool size only applies to fleet-capable
+                # deployments (ones whose replicas report serve_fleet
+                # rows) — a plain-callable deployment never demotes.
+                # A deleted deployment must never refill its pool: the
+                # stale-standby drain above empties it, and a nonzero
+                # want_standby here would restart a replica every round
+                # until the shutdown deadline (start→demote→drain storm).
+                want_standby = (fleet_policy.desired_standby(auto)
+                                if state.fleet
+                                and not state.config.get("deleted") else 0)
+                standby = [r for r in state.replicas
+                           if r.state == STANDBY
+                           and r.version == state.version]
+                eff_target = 0 if state.scaled_to_zero else target
+                deficit = eff_target - len(cur_version)
+                if deficit > 0:
+                    # Promote warm standbys before starting cold
+                    # replicas: promotion is one host→device transfer on
+                    # a warm compile cache, a start is a full init.
+                    to_promote = standby[:deficit]
+                    n_to_start = deficit - len(to_promote)
+                elif deficit < 0:
                     running = [r for r in cur_version if r.state == RUNNING]
-                    excess = len(cur_version) - target
+                    excess = -deficit
                     for r in (running or cur_version)[:excess]:
-                        self._drain_replica(r)
-                    dirty = True
+                        if (r.state == RUNNING and not r.fleet_unsupported
+                                and len(standby) + len(to_demote)
+                                < want_standby):
+                            to_demote.append(r)
+                        else:
+                            self._drain_replica(r)
+                            dirty = True
+                # Standby pool maintenance: with the active set
+                # satisfied, grow the pool one replica per round — the
+                # extra start turns RUNNING, becomes excess next round,
+                # and the branch above demotes it into the pool.
+                if (deficit <= 0 and not to_demote
+                        and len(standby) < want_standby
+                        and n_to_start == 0
+                        and not any(r.state == STARTING for r in cur_version)):
+                    n_to_start = 1
 
         # ---- action phase: actor create/kill RPCs without the lock.
         for r in to_kill:
@@ -498,10 +615,141 @@ class ServeController:
         for _ in range(n_to_start):
             self._start_replica(state)
             dirty = True
+        # Fleet transitions are replica RPCs, so they stay out of the
+        # lock too. Demotion parks weights in host RAM; promotion walks
+        # the replica's ladder (broadcast stream → host copy → cold
+        # re-init) so a dead donor never strands a standby.
+        for r in to_demote:
+            try:
+                res = ray.get(r.actor.fleet_demote.remote(), timeout=30) or {}
+            except Exception as e:
+                res = {"ok": False, "reason": f"rpc_failed: {e}"}
+            if res.get("ok"):
+                with self._lock:
+                    r.state = STANDBY
+                logger.info("replica %s demoted to standby (%s bytes to host)",
+                            r.replica_id, res.get("bytes"))
+                dirty = True
+            elif res.get("reason") == "unsupported":
+                r.fleet_unsupported = True
+            # "busy": leave RUNNING; retried next round once drained.
+        if to_promote:
+            addr = self._weight_donor_address(state, to_promote)
+            for r in to_promote:
+                try:
+                    res = ray.get(r.actor.fleet_promote.remote(addr),
+                                  timeout=120) or {}
+                except Exception as e:
+                    res = {"ok": False, "path": f"rpc_failed: {e}"}
+                if res.get("ok"):
+                    with self._lock:
+                        r.state = RUNNING
+                        state.last_promote = {
+                            "replica_id": r.replica_id,
+                            "path": res.get("path"),
+                            "seconds": res.get("seconds"),
+                            "ts": time.time(),
+                        }
+                    logger.info("replica %s promoted via %s in %.3fs",
+                                r.replica_id, res.get("path"),
+                                float(res.get("seconds") or 0.0))
+                else:
+                    logger.warning("promotion of %s failed (%s); draining",
+                                   r.replica_id, res.get("path"))
+                    with self._lock:
+                        self._drain_replica(r)
+                dirty = True
         if dirty:
             with self._lock:
                 self._push_replica_table(state)
         return dirty
+
+    def _weight_donor_address(self, state: _DeploymentState,
+                              to_promote: list) -> str | None:
+        """For a fan-out promotion, open ONE weight broadcast on a donor
+        replica so N cold promotions stream from a single reader-backed
+        source instead of N separate loads. A single promotion uses its
+        own host copy (the 'host' ladder rung) — no wire needed."""
+        if len(to_promote) < 2:
+            return None
+        promoting = {r.replica_id for r in to_promote}
+        with self._lock:
+            donors = [r for r in state.replicas
+                      if r.state in (RUNNING, STANDBY)
+                      and r.replica_id not in promoting
+                      and not r.fleet_unsupported]
+        for donor in donors:
+            try:
+                res = ray.get(
+                    donor.actor.open_weight_stream.remote(len(to_promote)),
+                    timeout=30)
+            except Exception:
+                continue
+            if res and res.get("weight_address"):
+                return res["weight_address"]
+        return None
+
+    def _fold_fleet(self, state: _DeploymentState, probes: dict) -> None:
+        """Fold the replicas' ``serve_fleet`` probe rows (request-idle
+        age, weight residency) into the deployment view the fleet policy
+        consumes. Held under the controller lock by the decision phase."""
+        rows = []
+        for p in probes.values():
+            for row in p.get("latency") or []:
+                if row.get("name") == "serve_fleet":
+                    rows.append(row)
+        folded = fleet_policy.fold_fleet_rows(rows)
+        if folded is not None:
+            state.fleet = folded
+
+    def _apply_fleet_policy(self, state: _DeploymentState) -> None:
+        """Scheduled capacity, wake, and scale-to-zero — the pure
+        policy lives in serve/fleet.py; this applies its answers to the
+        deployment FSM (called under the controller lock)."""
+        auto = state.config.get("autoscaling")
+        if not auto or state.config.get("deleted"):
+            return
+        now = time.time()
+        floor = fleet_policy.scheduled_floor(
+            auto.get("scheduled_capacity"), now)
+        if floor > 0:
+            floor = min(floor, int(auto.get("max_replicas") or floor))
+            if state.scaled_to_zero:
+                state.scaled_to_zero = False
+                self._record_scale_event(
+                    state, 0, state.target_replicas, "scheduled_capacity",
+                    floor, floor)
+            if state.target_replicas < floor:
+                self._record_scale_event(
+                    state, state.target_replicas, floor,
+                    "scheduled_capacity", floor, floor)
+                state.target_replicas = floor
+        if floor > 0:
+            state.last_wake = now
+        if state.wake_pending:
+            state.wake_pending = False
+            if state.scaled_to_zero:
+                # First request after scale-to-zero: the router saw an
+                # empty replica table and poked us — promote NOW, don't
+                # wait for an idle-age flip.
+                state.scaled_to_zero = False
+                state.last_wake = now
+                self._record_scale_event(
+                    state, 0, state.target_replicas, "wake", None,
+                    state.target_replicas)
+        idle_thresh = float(fleet_policy._cfg_get(
+            auto, "scale_to_zero_idle_s", 0) or 0)
+        woke_recently = (idle_thresh > 0
+                         and now - state.last_wake < idle_thresh)
+        if (not state.scaled_to_zero and floor == 0 and not woke_recently
+                and fleet_policy.should_scale_to_zero(
+                    (state.fleet or {}).get("idle_s"), auto)
+                and state.fleet.get("residency_capable")):
+            state.scaled_to_zero = True
+            self._record_scale_event(
+                state, state.target_replicas, 0, "scale_to_zero",
+                state.fleet.get("idle_s"),
+                fleet_policy._cfg_get(auto, "scale_to_zero_idle_s"))
 
     @staticmethod
     def _fold_prefix_residency(state: _DeploymentState, probes: dict) -> None:
@@ -821,13 +1069,27 @@ class ServeController:
             t95 = t_row.get("p95_ttft_ms")
             if t95 is not None:
                 tenant_p95 = max(float(t95), tenant_p95 or 0.0)
+        # Predictive upscale (fleet round): extrapolate the windowed TTFT
+        # trend ``predictive_horizon_s`` ahead — a projected breach counts
+        # as a breach NOW, so capacity promotes before the p95 crosses
+        # the SLO instead of after.
+        pred_ttft = None
+        if auto.get("predictive"):
+            state.ttft_trend.append((now, p_ttft))
+            state.ttft_trend = [
+                (t, v) for t, v in state.ttft_trend if now - t <= 2 * window]
+            pred_ttft = fleet_policy.slope_projection(
+                state.ttft_trend,
+                float(auto.get("predictive_horizon_s") or 10.0))
+        pred_breach = pred_ttft is not None and pred_ttft > target_ttft
         ttft_breach = p_ttft is not None and p_ttft > target_ttft
         qw_breach = (target_qw is not None and p_qw is not None
                      and p_qw > float(target_qw))
         tenant_breach = tenant_p95 is not None and tenant_p95 > target_ttft
-        breach = ttft_breach or qw_breach or tenant_breach
+        breach = ttft_breach or qw_breach or tenant_breach or pred_breach
         headroom = float(auto.get("downscale_headroom") or 0.5)
-        clear = (p_ttft is None or p_ttft < headroom * target_ttft) and (
+        clear = (not pred_breach) and (
+            p_ttft is None or p_ttft < headroom * target_ttft) and (
             target_qw is None or p_qw is None or p_qw < headroom * float(target_qw)) and (
             tenant_p95 is None or tenant_p95 < headroom * target_ttft)
         state.slo_breach_streak = state.slo_breach_streak + 1 if breach else 0
@@ -840,6 +1102,9 @@ class ServeController:
         elif tenant_breach and not ttft_breach:
             trigger = "tenant_ttft_ms_p95"
             value, target = tenant_p95, target_ttft
+        elif pred_breach and not ttft_breach:
+            trigger = "predicted_ttft_ms"
+            value, target = pred_ttft, target_ttft
         else:
             trigger = "serve_ttft_ms_p%d" % round(100 * q)
             value, target = p_ttft, target_ttft
@@ -916,6 +1181,7 @@ class ServeController:
                                 for r in s.replicas
                             ],
                             "next_no": s.next_replica_no,
+                            "scaled_to_zero": s.scaled_to_zero,
                         }
                         for name, s in deps.items()
                     }
@@ -947,13 +1213,19 @@ class ServeController:
                 state = _DeploymentState(app, saved["config"])
                 state.target_replicas = saved["target"]
                 state.next_replica_no = saved["next_no"]
+                # Older checkpoints predate the fleet fields — .get keeps
+                # them adoptable.
+                state.scaled_to_zero = bool(saved.get("scaled_to_zero"))
                 for replica_id, version, actor_id, rstate in saved["replicas"]:
-                    if rstate != RUNNING:
+                    # STANDBY replicas are re-adopted too: their host-RAM
+                    # weights and warm compile cache survive a controller
+                    # restart (the replica actor never died).
+                    if rstate not in (RUNNING, STANDBY):
                         continue
                     try:
                         handle = ActorHandle(actor_id)
                         r = _Replica(replica_id, version, handle, actor_id)
-                        r.state = RUNNING
+                        r.state = rstate
                         state.replicas.append(r)
                     except Exception:
                         pass

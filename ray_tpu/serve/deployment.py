@@ -44,6 +44,22 @@ class AutoscalingConfig:
     slo_quantile: float = 0.95
     downscale_headroom: float = 0.5
     breach_cycles: int = 2
+    # --- always-warm fleet (serve/fleet.py) ---
+    # Replicas kept STANDBY: started, compile cache warm, weights in
+    # host RAM. Promotion to RUNNING is a device_put, not a cold start.
+    standby_replicas: int = 0
+    # After this many request-idle seconds the deployment demotes every
+    # RUNNING replica to standby (first request promotes one back).
+    # None/0 disables scale-to-zero.
+    scale_to_zero_idle_s: float | None = None
+    # [{"start": unix, "end": unix, "min_replicas": N}, ...]: capacity
+    # floors for known spikes, applied before any breach is observed.
+    scheduled_capacity: list | None = None
+    # Predictive upscale (latency_slo mode): project the windowed TTFT
+    # quantile ``predictive_horizon_s`` ahead by its rate of change and
+    # scale up when the PROJECTION breaches — before the p95 does.
+    predictive: bool = False
+    predictive_horizon_s: float = 10.0
 
 
 class Deployment:

@@ -154,7 +154,44 @@ class ReplicaActor:
                              **(tenancy() or {})})
             except Exception:
                 pass
+        # Fleet rows (request-idle age + weight residency) feed the
+        # controller's scale-to-zero / standby decisions.
+        fleet = getattr(self._callable, "fleet_stats", None)
+        if fleet is not None:
+            try:
+                rows.append({"name": "serve_fleet", **(fleet() or {})})
+            except Exception:
+                pass
         return rows
+
+    # ------------------------------------------------------ fleet lifecycle
+    def fleet_demote(self) -> dict:
+        """Demote to STANDBY: weights to host RAM, compile cache kept.
+        Plain callables have nothing to demote — report unsupported so
+        the controller leaves them RUNNING."""
+        fn = getattr(self._callable, "fleet_demote", None)
+        if fn is None:
+            return {"ok": False, "reason": "unsupported"}
+        with self._lock:
+            if self._ongoing:
+                return {"ok": False, "reason": "busy"}
+        return fn()
+
+    def fleet_promote(self, weight_address: str | None = None) -> dict:
+        """Promote from STANDBY back to serving. Plain callables never
+        demoted, so promotion is trivially complete."""
+        fn = getattr(self._callable, "fleet_promote", None)
+        if fn is None:
+            return {"ok": True, "path": "noop"}
+        return fn(weight_address)
+
+    def open_weight_stream(self, n_readers: int = 1) -> dict | None:
+        """Open a weight broadcast from this replica (the donor side of
+        a fan-out promotion). None when the callable can't serve one."""
+        fn = getattr(self._callable, "open_weight_stream", None)
+        if fn is None:
+            return None
+        return fn(n_readers)
 
     def reconfigure(self, user_config: Any) -> bool:
         fn = getattr(self._callable, "reconfigure", None)

@@ -252,6 +252,12 @@ class Router:
         self.spill_migrations = 0
         self._init_overload_state()
         controller = ray.get_actor(CONTROLLER_NAME)
+        # Kept for the first-request wake path: a request landing on an
+        # empty replica table pokes the controller to un-park a
+        # scaled-to-zero deployment (fire-and-forget, rate-limited).
+        self._controller = controller
+        self._wake_target = (app_name, deployment_name)
+        self._last_wake_rpc = 0.0
         self._tenancy_key = f"tenancy::{app_name}::{deployment_name}"
         self._long_poll = LongPollClient(
             controller, {self._key: self._update_replicas,
@@ -660,6 +666,11 @@ class Router:
                             f"No replica available for {self._key} within "
                             f"{timeout}s ({len(self._replicas)} replicas, "
                             "all saturated)")
+                    if not self._replicas:
+                        # Empty table: likely a scale-to-zero park — poke
+                        # the controller to promote a standby, then keep
+                        # waiting for the long-poll table push.
+                        self._maybe_wake_locked()
                     self._cond.wait(min(remaining, 1.0))
             finally:
                 if entry is not None:
@@ -675,6 +686,22 @@ class Router:
     def _wfq_head_locked(self, entry: dict) -> bool:
         ticket = entry.get("ticket")
         return ticket is None or self._wfq.is_head(ticket)
+
+    def _maybe_wake_locked(self) -> None:
+        """Fire-and-forget wake_deployment, at most once a second — the
+        RPC is idempotent (sets a flag the next reconcile consumes), so
+        rate-limiting only spares the controller queue, not correctness."""
+        import time as _time
+
+        now = _time.monotonic()
+        if now - self._last_wake_rpc < 1.0:
+            return
+        self._last_wake_rpc = now
+        try:
+            app, deployment = self._wake_target
+            self._controller.wake_deployment.remote(app, deployment)
+        except Exception:
+            pass
 
     def _enqueue_waiter_locked(self, cfg, deployment: str,
                                prefix_group: str,

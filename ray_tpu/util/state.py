@@ -58,6 +58,32 @@ def loop_stats() -> list[dict]:
     return live_loop_stats()
 
 
+def serve_fleet() -> dict:
+    """Always-warm fleet view per serve deployment: running vs standby
+    replica counts, the scale-to-zero latch, folded replica residency
+    (idle age, host-resident weight copies), and the last standby
+    promotion with its path and timing — pulled from the controller's
+    ``get_app_status`` so ``cli serve status`` and tests see one truth."""
+    from ..serve import api as serve_api
+
+    out: dict = {}
+    try:
+        status = serve_api.status()
+    except Exception:
+        return out
+    for app, deps in (status or {}).items():
+        for name, dep in (deps or {}).items():
+            out[f"{app}#{name}"] = {
+                "running": dep.get("running_replicas"),
+                "standby": dep.get("standby_replicas"),
+                "target": dep.get("target_replicas"),
+                "scaled_to_zero": dep.get("scaled_to_zero"),
+                "fleet": dep.get("fleet") or {},
+                "last_promote": dep.get("last_promote"),
+            }
+    return out
+
+
 def find_request_timeline(request_id: str, limit: int = 200) -> dict | None:
     """The most recent ``llm.request_timeline`` breach dump for one
     request id: scans this process's local span buffer first (standalone

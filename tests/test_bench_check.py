@@ -600,3 +600,62 @@ def test_obs_overhead_frac_regresses_up_in_points():
         {"loop_obs_overhead_frac": 0.18}, {"loop_obs_overhead_frac": 0.01})
     assert {r["metric"] for r in result2["improvements"]} == {
         "loop_obs_overhead_frac"}
+
+
+def test_fleet_metrics_directions():
+    """Round-19 cells: standby promote and cold start are wall-clock
+    seconds (the bare "_s" suffix, lower-better), the promote speedup is
+    a ratio (higher-better default), broadcast parity rides the
+    "_parity" suffix and the step goodput the "goodput_frac" substring —
+    both pointwise 0-1 higher-better. Shadow audit: "speedup" must NOT
+    fall into the lower-better "_s" bucket."""
+    assert bench_check._direction("serve_replica_cold_start_s") == "down"
+    assert bench_check._direction("serve_replica_promote_s") == "down"
+    assert bench_check._direction("serve_replica_promote_speedup") == "up"
+    assert bench_check._pointwise("fleet_broadcast_parity")
+    assert bench_check._direction("fleet_broadcast_parity") == "up"
+    assert bench_check._pointwise("fleet_goodput_frac_step")
+    assert bench_check._direction("fleet_goodput_frac_step") == "up"
+    # A promote-time blowup (warm pool no longer warm) and a speedup
+    # collapse are exactly the regressions these cells exist to catch.
+    old = {"serve_replica_promote_s": 0.005,
+           "serve_replica_promote_speedup": 600.0}
+    new = {"serve_replica_promote_s": 0.5,
+           "serve_replica_promote_speedup": 7.0}
+    result = bench_check.compare(old, new)
+    assert {r["metric"] for r in result["regressions"]} == set(old)
+
+
+def test_fleet_parity_and_goodput_compare_in_points():
+    """Parity 1.0 -> 0.0 (broadcast no longer byte-identical) is a
+    100-point regression; a small goodput wiggle through the step is
+    noise; warm-pool/step bookkeeping (_cfg) is never tracked."""
+    result = bench_check.compare({"fleet_broadcast_parity": 1.0},
+                                 {"fleet_broadcast_parity": 0.0})
+    assert [r["metric"] for r in result["regressions"]] == [
+        "fleet_broadcast_parity"]
+    result2 = bench_check.compare({"fleet_goodput_frac_step": 0.30},
+                                  {"fleet_goodput_frac_step": 0.27})
+    assert not result2["regressions"]
+    result3 = bench_check.compare(
+        {"fleet_standby_warm_cfg": True, "fleet_step_offered_cfg": 24,
+         "fleet_step_promote_path_cfg": "host",
+         "fleet_broadcast_bytes_cfg": 429137, "fleet_step_running_cfg": 2},
+        {"fleet_step_offered_cfg": 12})
+    assert not result3["regressions"] and not result3["missing"]
+
+
+def test_fleet_skip_markers_honored():
+    """RAY_TPU_BENCH_SKIP_FLEET=1 leaves the module's SKIP_MARKERS: the
+    fleet_ prefix marker covers every fleet_* cell and the per-metric
+    markers cover the serve_replica_* cells — skipped, never missing."""
+    from ray_tpu._fleet_bench import SKIP_MARKERS
+
+    old = {"serve_replica_cold_start_s": 3.4,
+           "serve_replica_promote_s": 0.004,
+           "serve_replica_promote_speedup": 800.0,
+           "fleet_broadcast_parity": 1.0,
+           "fleet_goodput_frac_step": 0.3}
+    result = bench_check.compare(old, dict(SKIP_MARKERS))
+    assert not result["missing"] and not result["regressions"]
+    assert {r["metric"] for r in result["skipped"]} == set(old)

@@ -652,3 +652,129 @@ def test_ledger_slo_burn_tracks_breaches_and_recovers():
     assert rows["slo"]["slo_burn_frac"] == round(1 / 8, 4)
     assert "slo_burn_frac" not in rows["free"]
     assert ledger.slo_burn_frac("free") == 0.0
+
+
+# ----------------------- round 19: HBM-slot accounting + live reweight
+def test_adapter_pool_explicit_evict_accounting():
+    """Satellite: an explicit eviction returns the slot to the FREE list
+    (not merely the recyclable pool), fires the device-release hook, and
+    counts as a device_unload — while pinned adapters stay untouchable."""
+    pool = AdapterPool(capacity=4)
+    fired = []
+    pool.on_evict = lambda aid, slot: fired.append((aid, slot))
+    for aid in ("a", "b"):
+        pool.begin_load(aid)
+        pool.commit_load(aid, 1.0)
+        pool.unpin(aid)
+    st0 = pool.stats()
+    assert st0["free_slots"] == 2 and st0["device_unloads"] == 0
+    slot = pool.evict("a")
+    assert slot is not None and fired == [("a", slot)]
+    st = pool.stats()
+    assert st["free_slots"] == 3 and st["device_unloads"] == 1
+    assert list(pool.resident()) == ["b"]
+    assert pool.evict("missing") is None
+    pool.begin_load("c")                 # pinned by the in-flight load
+    assert pool.evict("c") is None
+    assert pool.stats()["device_unloads"] == 1
+
+
+def test_adapter_pool_evict_idle_skips_pinned():
+    """evict_idle (the scale-to-zero HBM reclaim) releases every
+    UNPINNED adapter and leaves in-flight ones resident."""
+    pool = AdapterPool(capacity=4)
+    for aid in ("a", "b", "c"):
+        pool.begin_load(aid)
+        pool.commit_load(aid, 1.0)
+    pool.unpin("a")
+    pool.unpin("b")                      # "c" stays pinned
+    released = pool.evict_idle()
+    assert sorted(aid for aid, _ in released) == ["a", "b"]
+    st = pool.stats()
+    assert st["free_slots"] == 3 and st["device_unloads"] == 2
+    assert list(pool.resident()) == ["c"]
+
+
+def test_lora_manager_unload_idle_zeroes_device_slot(small_model, tmp_path):
+    """Satellite: unloading an idle adapter actually zeroes its device
+    stack slot (HBM holds the identity adapter again, not stale weights)
+    and the slot accounting shows the release; the adapter hot-reloads
+    cleanly on next use."""
+    from ray_tpu.llm.lora import LoRAServingConfig, save_adapter
+
+    cfg, params = small_model
+    rng = np.random.default_rng(7)
+    save_adapter(str(tmp_path / "ad1.npz"), _make_adapter(cfg, rng))
+    lora = LoRAServingConfig(max_loras=2, max_rank=4,
+                             dynamic_lora_loading_path=str(tmp_path))
+    eng = InferenceEngine(cfg, params, max_slots=2, max_len=64,
+                          lora_config=lora, enable_prefix_cache=False)
+    r = Request("r1", [3, 1, 4, 1, 5], max_new_tokens=4, model="ad1")
+    eng.add_request(r)
+    while not r.done:
+        eng.step()
+    (aid, slot), = eng.lora_manager.resident().items()
+    assert aid == "ad1"
+    stack = eng.executor.lora_stack
+    assert any(np.asarray(stack[k][:, slot]).any() for k in stack), \
+        "adapter install left the stack slot empty"
+    assert eng.lora_manager.unload_idle() == 1
+    stack = eng.executor.lora_stack
+    for k in stack:
+        assert not np.asarray(stack[k][:, slot]).any(), \
+            f"{k} slot {slot} still holds weights after unload"
+    st = eng.lora_manager.stats()
+    assert st["device_unloads"] == 1 and st["resident_count"] == 0
+    assert st["free_slots"] == 2
+    r2 = Request("r2", [3, 1, 4, 1, 5], max_new_tokens=4, model="ad1")
+    eng.add_request(r2)
+    while not r2.done:
+        eng.step()
+    assert list(eng.lora_manager.resident()) == ["ad1"]
+    assert list(r2.generated) == list(r.generated)
+
+
+def test_live_wfq_reweight_midrun_e2e(serve_instance):
+    """Satellite: serve.update_tenancy_config flips tenant WFQ weights
+    MID-RUN — the controller re-publishes the ``tenancy::`` long-poll
+    key, a live router picks the new shares up without a redeploy, and
+    the same replica keeps serving."""
+    from ray_tpu.llm import build_llm_app
+    from ray_tpu.serve.router import Router
+
+    app = build_llm_app(
+        "debug-128", max_slots=2, max_len=64, page_size=8,
+        prefill_chunk_size=32, num_replicas=1, max_ongoing_requests=4,
+        tenancy_config={"tenants": {"gold": {"weight": 3.0},
+                                    "free": {"weight": 1.0}}})
+    serve.run(app, name="wfq", route_prefix="/wfq", timeout_s=240.0)
+    addr = serve.http_address()
+    body = {"prompt": "hello weights", "max_tokens": 4}
+    status, raw, _h = _post(addr, "/wfq/v1/completions", body, timeout=180.0)
+    assert status == 200, raw[:200]
+
+    router = Router("wfq", "LLMDeployment")  # live, like the proxy's
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not router._tenant_weights:
+            time.sleep(0.2)
+        assert router._tenant_weights == {"gold": 3.0, "free": 1.0}
+
+        out = serve.update_tenancy_config(
+            {"tenants": {"gold": {"weight": 8.0}, "free": {"weight": 1.0}}},
+            app_name="wfq")
+        assert out["updated"] == ["LLMDeployment"]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline \
+                and router._tenant_weights.get("gold") != 8.0:
+            time.sleep(0.2)
+        assert router._tenant_weights == {"gold": 8.0, "free": 1.0}
+        # No redeploy: the same single replica answers after the flip.
+        status, raw, _h = _post(addr, "/wfq/v1/completions", body,
+                                timeout=60.0)
+        assert status == 200, raw[:200]
+        st = next(iter(serve.status().get("wfq", {}).values()), {})
+        assert st.get("running_replicas") == 1
+    finally:
+        router._long_poll.stop()
+    serve.delete("wfq")
